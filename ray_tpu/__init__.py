@@ -1,0 +1,81 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+A from-scratch rebuild of the capability surface of Ray (reference:
+``/root/reference``, ``python/ray/__init__.py``) designed TPU-first:
+
+- the accelerator data plane is the XLA compiler (``jax.lax`` collectives over
+  ICI emitted by jit-compiled SPMD programs), not a NCCL-style library;
+- the scheduler treats TPU pod slices as first-class, gang-scheduled resources
+  with ICI-topology-aware placement groups;
+- the libraries (train/tune/data/serve/rllib) drive JAX/XLA programs.
+
+Public core API mirrors the reference's L9 surface
+(``python/ray/_private/worker.py:1341`` ``ray.init``, ``:3343`` ``ray.remote``,
+``:2722/2890/2955`` ``get/put/wait``).
+"""
+
+from ray_tpu._private.worker import (
+    init,
+    shutdown,
+    is_initialized,
+    get,
+    put,
+    wait,
+    kill,
+    cancel,
+    get_runtime_context,
+    remote,
+)
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.object_ref import ObjectRef
+from ray_tpu.exceptions import (
+    RayTpuError,
+    TaskError,
+    ActorError,
+    ActorDiedError,
+    ObjectLostError,
+    GetTimeoutError,
+)
+from ray_tpu.util.placement_group import (
+    placement_group,
+    remove_placement_group,
+    PlacementGroup,
+)
+from ray_tpu._private.state import (
+    cluster_resources,
+    available_resources,
+    nodes,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_runtime_context",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "ObjectRef",
+    "RayTpuError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "ObjectLostError",
+    "GetTimeoutError",
+    "placement_group",
+    "remove_placement_group",
+    "PlacementGroup",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "__version__",
+]

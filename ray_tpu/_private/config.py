@@ -1,0 +1,99 @@
+"""Typed, env-overridable config flag table.
+
+Analog of the reference's ``RAY_CONFIG`` macro system
+(``src/ray/common/ray_config_def.h`` — 219 flags, each overridable via a
+``RAY_<name>`` env var and propagated to child processes). Here the table is a
+dataclass of typed fields; every field is overridable with ``RAY_TPU_<NAME>``
+and the resolved table is pickled into worker bootstrap messages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    if typ is str:
+        return value
+    return json.loads(value)
+
+
+@dataclasses.dataclass
+class Config:
+    # --- scheduling ---
+    # Max tasks queued before submitter backpressure kicks in.
+    max_pending_tasks: int = 1_000_000
+    # Hybrid policy threshold: fraction of a node's resources in use above
+    # which the scheduler prefers spreading (reference:
+    # hybrid_scheduling_policy.h:50 `spread_threshold`).
+    scheduler_spread_threshold: float = 0.5
+    # Top-k fraction of candidate nodes to randomize over.
+    scheduler_top_k_fraction: float = 0.2
+    # --- workers ---
+    worker_register_timeout_s: float = 120.0
+    worker_pool_prestart: bool = True
+    idle_worker_kill_s: float = 300.0
+    maximum_startup_concurrency: int = 2
+    # --- object store ---
+    # Objects <= this many bytes are returned inline through the control plane
+    # (reference: max_direct_call_object_size, ray_config_def.h).
+    max_inline_object_size: int = 100 * 1024
+    object_store_memory: int = 2 * 1024**3
+    object_store_full_delay_ms: int = 100
+    # Chunk size for node-to-node object transfer.
+    object_transfer_chunk_bytes: int = 8 * 1024**2
+    # --- fault tolerance ---
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    health_check_period_ms: int = 1000
+    health_check_failure_threshold: int = 5
+    # Fault injection: probability of dropping an RPC (reference:
+    # src/ray/rpc/rpc_chaos.h `RAY_testing_rpc_failure`).
+    testing_rpc_failure_prob: float = 0.0
+    # --- logging/observability ---
+    event_buffer_size: int = 10000
+    metrics_report_interval_ms: int = 2000
+    # --- TPU ---
+    tpu_chips_per_host_default: int = 4
+    tpu_slice_grace_period_s: float = 60.0
+
+    @classmethod
+    def from_env(cls, overrides: dict | None = None) -> "Config":
+        kwargs: dict[str, Any] = {}
+        for f in dataclasses.fields(cls):
+            env_key = "RAY_TPU_" + f.name.upper()
+            if env_key in os.environ:
+                kwargs[f.name] = _coerce(os.environ[env_key], f.type if isinstance(f.type, type) else type(f.default))
+        if overrides:
+            for k, v in overrides.items():
+                if k not in {f.name for f in dataclasses.fields(cls)}:
+                    raise ValueError(f"Unknown config key: {k}")
+                kwargs[k] = v
+        return cls(**kwargs)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config.from_env()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

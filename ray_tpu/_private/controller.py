@@ -1,0 +1,1168 @@
+"""The controller: single-host control plane (GCS + raylet analog).
+
+Runs inside the driver process as a set of threads. Responsibilities mirror
+the reference's head-node stack:
+
+- cluster membership + resource accounting       ≈ GcsNodeManager/GcsResourceManager
+  (``src/ray/gcs/gcs_server/gcs_server.cc:219``)
+- task queueing + scheduling policies            ≈ ClusterTaskManager/LocalTaskManager
+  (``src/ray/raylet/scheduling/cluster_task_manager.h:44``)
+- worker process pool with on-demand spawn       ≈ WorkerPool (``src/ray/raylet/worker_pool.h:283``)
+- actor directory + restart                      ≈ GcsActorManager (``gcs_actor_manager.cc:398``)
+- object directory + dependency management       ≈ OwnershipObjectDirectory + DependencyManager
+- reference counting + freeing                   ≈ ReferenceCounter (``reference_count.h:73``)
+- internal KV                                    ≈ GCS internal KV
+
+Data plane (object payloads) bypasses the controller: workers write to the
+shared-memory plasma store and only locations travel through here — the same
+split the reference makes between raylet control RPCs and plasma.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import defaultdict, deque
+from concurrent.futures import ThreadPoolExecutor
+from multiprocessing.connection import Listener
+from typing import Any, Optional
+
+from ray_tpu._private import protocol as P
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import (
+    ActorID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu._private.object_store import MemoryStore, PlasmaClient, PlasmaStore
+from ray_tpu._private.serialization import SerializationContext, SerializedObject
+from ray_tpu._private.task_spec import TaskSpec, TaskType
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    PlacementGroupSchedulingError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class NodeState:
+    def __init__(self, node_id: NodeID, resources: dict[str, float], labels=None):
+        self.node_id = node_id
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.labels = labels or {}
+        self.alive = True
+
+    def fits(self, demand: dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+    def allocate(self, demand: dict[str, float]):
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+
+    def release(self, demand: dict[str, float]):
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    def utilization(self) -> float:
+        fracs = [
+            1.0 - self.available.get(k, 0.0) / t
+            for k, t in self.total.items()
+            if t > 0
+        ]
+        return max(fracs) if fracs else 0.0
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: WorkerID, node_id: NodeID, proc=None, conn=None):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.proc = proc
+        self.conn = conn
+        self.registered = threading.Event()
+        self.running: dict[TaskID, "PendingTask"] = {}
+        self.actor_id: Optional[ActorID] = None
+        self.dead = False
+        self.last_idle_t = time.monotonic()
+        self.send_lock = threading.Lock()
+        # Environment fingerprint this worker was spawned with (TPU
+        # visibility, runtime_env vars); only matching tasks may reuse it.
+        self.fingerprint = (False, ())
+
+    def send(self, msg):
+        with self.send_lock:
+            self.conn.send(msg)
+
+
+class PendingTask:
+    def __init__(self, spec: TaskSpec, deps: set[ObjectID]):
+        self.spec = spec
+        self.unresolved = set(deps)
+        self.all_deps = set(deps)
+        self.retries_left = spec.max_retries
+        self.worker: Optional[WorkerHandle] = None
+        self.cancelled = False
+
+
+class ActorState:
+    def __init__(self, actor_id: ActorID, creation_spec: TaskSpec):
+        self.actor_id = actor_id
+        self.creation_spec = creation_spec
+        self.state = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+        self.worker: Optional[WorkerHandle] = None
+        self.queue: deque[PendingTask] = deque()
+        self.inflight = 0
+        self.restarts_left = creation_spec.max_restarts
+        self.death_cause: Optional[str] = None
+        self.name: Optional[str] = None
+        # (node, pg_bundle, resources) held while ALIVE.
+        self.held: Optional[tuple] = None
+
+
+class PlacementGroupState:
+    def __init__(self, pg_id: PlacementGroupID, bundles: list[dict], strategy: str):
+        self.pg_id = pg_id
+        self.bundles = bundles  # resource dicts
+        self.strategy = strategy
+        self.bundle_nodes: list[Optional[NodeID]] = [None] * len(bundles)
+        self.bundle_available: list[dict] = [dict(b) for b in bundles]
+        self.ready = threading.Event()
+        self.removed = False
+
+
+class Controller:
+    def __init__(self, config: Config, head_resources: dict[str, float], mode: str = "process"):
+        self.config = config
+        self.mode = mode
+        self.lock = threading.RLock()
+        self.shutting_down = False
+
+        # Object plane.
+        self.memory_store = MemoryStore()  # object_id -> (kind, payload)
+        self.plasma = PlasmaStore(config.object_store_memory)
+        self.plasma_client = PlasmaClient()
+
+        # Cluster state.
+        self.nodes: dict[NodeID, NodeState] = {}
+        self.head_node_id = NodeID.from_random()
+        self.nodes[self.head_node_id] = NodeState(self.head_node_id, head_resources)
+
+        # Scheduling state.
+        self.ready_queue: deque[PendingTask] = deque()
+        self.waiting_on_deps: dict[ObjectID, list[PendingTask]] = defaultdict(list)
+        self.pending_by_id: dict[TaskID, PendingTask] = {}
+        self.sched_cv = threading.Condition(self.lock)
+
+        # Workers.
+        self.workers: dict[WorkerID, WorkerHandle] = {}
+        self.idle_workers: dict[NodeID, list[WorkerHandle]] = defaultdict(list)
+        self.starting_workers = 0
+
+        # Actors.
+        self.actors: dict[ActorID, ActorState] = {}
+        self.named_actors: dict[str, ActorID] = {}
+
+        # Placement groups.
+        self.placement_groups: dict[PlacementGroupID, PlacementGroupState] = {}
+
+        # Reference counting: driver-held handles + pins from pending tasks.
+        self.ref_counts: dict[ObjectID, int] = defaultdict(int)
+
+        # Internal KV (GCS KV analog).
+        self.kv: dict[tuple[str, bytes], bytes] = {}
+
+        # Observability: task events ring buffer.
+        self.task_events: deque[dict] = deque(maxlen=config.event_buffer_size)
+
+        self.serialization = SerializationContext()
+        self._reply_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="ctrl-reply")
+
+        # Control-plane listener for worker processes.
+        self.address = None
+        self.listener = None
+        self._authkey = os.urandom(16)
+        self._threads: list[threading.Thread] = []
+        if mode == "process":
+            addr_dir = os.environ.get("TMPDIR", "/tmp")
+            self.address = os.path.join(addr_dir, f"ray_tpu_{os.getpid()}_{id(self):x}.sock")
+            self.listener = Listener(self.address, family="AF_UNIX", authkey=self._authkey)
+            t = threading.Thread(target=self._accept_loop, daemon=True, name="ctrl-accept")
+            t.start()
+            self._threads.append(t)
+
+        t = threading.Thread(target=self._schedule_loop, daemon=True, name="ctrl-sched")
+        t.start()
+        self._threads.append(t)
+
+    # ------------------------------------------------------------------ nodes
+
+    def add_node(self, resources: dict[str, float], labels=None) -> NodeID:
+        """Add a fake node (multi-node-on-one-host testing; reference:
+        ``python/ray/cluster_utils.py:135``)."""
+        with self.lock:
+            node_id = NodeID.from_random()
+            self.nodes[node_id] = NodeState(node_id, resources, labels)
+            self.sched_cv.notify_all()
+            return node_id
+
+    def remove_node(self, node_id: NodeID):
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return
+            node.alive = False
+            victims = [w for w in self.workers.values() if w.node_id == node_id]
+        for w in victims:
+            self._on_worker_death(w, reason=f"node {node_id.hex()[:8]} removed")
+
+    # ------------------------------------------------------------ object plane
+
+    def put_serialized(self, object_id: ObjectID, sobj: SerializedObject, is_error=False):
+        """Store a driver-side object (inline or plasma by size)."""
+        if sobj.total_bytes() <= self.config.max_inline_object_size or is_error:
+            self.memory_store.put(object_id, ("error" if is_error else "inline", sobj))
+        else:
+            data = sobj.to_bytes()
+            seg, name = self.plasma.create(object_id, len(data))
+            seg.buf[: len(data)] = data
+            self.plasma.seal(object_id, name, len(data))
+            self.memory_store.put(object_id, ("plasma", (name, len(data))))
+        self._on_object_sealed(object_id)
+
+    def resolve_object(self, entry) -> SerializedObject:
+        kind, payload = entry
+        if kind in ("inline", "error"):
+            return payload
+        shm_name, size = payload
+        return self.plasma_client.read(shm_name, size)
+
+    def get_entries(self, object_ids: list[ObjectID], timeout=None):
+        return self.memory_store.get(object_ids, timeout=timeout)
+
+    def _on_object_sealed(self, object_id: ObjectID):
+        with self.lock:
+            waiters = self.waiting_on_deps.pop(object_id, [])
+            for pt in waiters:
+                pt.unresolved.discard(object_id)
+                if not pt.unresolved:
+                    if pt.spec.is_actor_task():
+                        # Actor tasks stay queued on their actor (head-of-line
+                        # blocking preserves ordering); just re-pump.
+                        actor = self.actors.get(pt.spec.actor_id)
+                        if actor is not None:
+                            self._pump_actor(actor)
+                    else:
+                        self._enqueue_ready(pt)
+            if waiters:
+                self.sched_cv.notify_all()
+            # All handles to this object were already dropped: free eagerly.
+            if object_id not in self.ref_counts:
+                self._free_object(object_id)
+
+    # Reference counting -----------------------------------------------------
+
+    def add_ref(self, object_id: ObjectID):
+        with self.lock:
+            self.ref_counts[object_id] += 1
+
+    def remove_ref(self, object_id: ObjectID):
+        with self.lock:
+            self.ref_counts[object_id] -= 1
+            if self.ref_counts[object_id] <= 0:
+                del self.ref_counts[object_id]
+                self._free_object(object_id)
+
+    def _free_object(self, object_id: ObjectID):
+        self.memory_store.delete([object_id])
+        self.plasma.delete(object_id)
+
+    # ------------------------------------------------------------- submission
+
+    def submit_task(self, spec: TaskSpec):
+        deps = {a[1] for a in spec.args if a[0] == "ref"}
+        pt = PendingTask(spec, deps)
+        with self.lock:
+            self.pending_by_id[spec.task_id] = pt
+            # Pin deps for the task's lifetime.
+            for d in pt.all_deps:
+                self.ref_counts[d] += 1
+            if spec.task_type == TaskType.ACTOR_TASK:
+                self._submit_actor_task(pt)
+                return
+            unresolved = {d for d in pt.unresolved if not self.memory_store.contains(d)}
+            pt.unresolved = unresolved
+            if unresolved:
+                for d in unresolved:
+                    self.waiting_on_deps[d].append(pt)
+            else:
+                self._enqueue_ready(pt)
+            self.sched_cv.notify_all()
+
+    def _enqueue_ready(self, pt: PendingTask):
+        self.ready_queue.append(pt)
+
+    def _submit_actor_task(self, pt: PendingTask):
+        actor = self.actors.get(pt.spec.actor_id)
+        if actor is None or actor.state == "DEAD":
+            reason = actor.death_cause if actor else "actor not found"
+            self._fail_task(pt, ActorDiedError(pt.spec.actor_id.hex(), reason or "actor died"))
+            return
+        actor.queue.append(pt)
+        self._pump_actor(actor)
+
+    def _pump_actor(self, actor: ActorState):
+        """Dispatch queued actor calls respecting max_concurrency + ordering."""
+        if actor.state != "ALIVE" or actor.worker is None:
+            return
+        maxc = actor.creation_spec.max_concurrency
+        while actor.queue and actor.inflight < maxc:
+            pt = actor.queue[0]
+            unresolved = {d for d in pt.unresolved if not self.memory_store.contains(d)}
+            if unresolved:
+                # Keep ordering: wait for the head-of-line task's deps.
+                pt.unresolved = unresolved
+                for d in unresolved:
+                    if pt not in self.waiting_on_deps[d]:
+                        self.waiting_on_deps[d].append(pt)
+                break
+            actor.queue.popleft()
+            actor.inflight += 1
+            self._dispatch_to_worker(actor.worker, pt)
+
+    # ------------------------------------------------------------- scheduling
+
+    def _schedule_loop(self):
+        while True:
+            with self.sched_cv:
+                if self.shutting_down:
+                    return
+                try:
+                    progressed = self._try_dispatch_locked()
+                    # Retry placement of pending placement groups whenever
+                    # the cluster state may have changed (resources freed,
+                    # nodes joined) — reference: GcsPlacementGroupMgr retries.
+                    for pg in self.placement_groups.values():
+                        if not pg.removed and not pg.ready.is_set():
+                            if self._try_place_pg(pg):
+                                progressed = True
+                except Exception:
+                    # The scheduler thread must never die; a scheduling bug on
+                    # one task must not freeze the cluster.
+                    logger.error("scheduler iteration failed:\n%s", traceback.format_exc())
+                    progressed = False
+                if not progressed:
+                    # Nothing dispatchable right now: sleep until a task is
+                    # submitted, a worker frees up/registers, or a node joins.
+                    self.sched_cv.wait(timeout=0.5)
+
+    def _try_dispatch_locked(self) -> bool:
+        progressed = False
+        remaining = deque()
+        while self.ready_queue:
+            pt = self.ready_queue.popleft()
+            if pt.cancelled:
+                continue
+            if pt.spec.task_type == TaskType.ACTOR_TASK:
+                actor = self.actors.get(pt.spec.actor_id)
+                if actor is not None:
+                    actor.queue.appendleft(pt)
+                    self._pump_actor(actor)
+                progressed = True
+                continue
+            if self._try_place(pt):
+                progressed = True
+            else:
+                remaining.append(pt)
+        self.ready_queue = remaining
+        return progressed
+
+    def _pick_node(self, pt: PendingTask) -> Optional[NodeState]:
+        """Scheduling policies (reference: ``raylet/scheduling/policy/``)."""
+        spec = pt.spec
+        strat = spec.strategy
+        demand = dict(spec.resources)
+        alive = [n for n in self.nodes.values() if n.alive]
+
+        if strat.kind == "placement_group":
+            pg = self.placement_groups.get(strat.placement_group_id)
+            if pg is None or pg.removed:
+                return None
+            indices = (
+                [strat.bundle_index]
+                if strat.bundle_index >= 0
+                else range(len(pg.bundles))
+            )
+            for i in indices:
+                nid = pg.bundle_nodes[i]
+                if nid is None:
+                    continue
+                avail = pg.bundle_available[i]
+                if all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
+                    node = self.nodes.get(nid)
+                    if node is not None and node.alive:
+                        pt._pg_bundle = (pg, i)  # type: ignore[attr-defined]
+                        return node
+            return None
+
+        if strat.kind == "node_affinity":
+            node = self.nodes.get(strat.node_id)
+            if node is not None and node.alive and node.fits(demand):
+                return node
+            if strat.soft:
+                pass  # fall through to default policy
+            else:
+                return None
+
+        candidates = [n for n in alive if n.fits(demand)]
+        if not candidates:
+            return None
+        if strat.kind == "spread":
+            # Round-robin by lowest utilization (reference: spread policy).
+            return min(candidates, key=lambda n: n.utilization())
+        # Hybrid policy: prefer head/local node below the spread threshold,
+        # else least-utilized (reference: hybrid_scheduling_policy.h:50).
+        head = self.nodes.get(self.head_node_id)
+        if (
+            head is not None
+            and head.alive
+            and head.fits(demand)
+            and head.utilization() < self.config.scheduler_spread_threshold
+        ):
+            return head
+        return min(candidates, key=lambda n: n.utilization())
+
+    def _try_place(self, pt: PendingTask) -> bool:
+        node = self._pick_node(pt)
+        if node is None:
+            self._maybe_autoscale_hint(pt)
+            return False
+        worker = self._acquire_worker(node, pt)
+        if worker is None:
+            return False
+        demand = pt.spec.resources
+        node.allocate(demand)
+        pg_bundle = getattr(pt, "_pg_bundle", None)
+        if pg_bundle is not None:
+            pg, i = pg_bundle
+            for k, v in demand.items():
+                pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) - v
+        pt._node = node  # type: ignore[attr-defined]
+        self._dispatch_to_worker(worker, pt)
+        return True
+
+    def _maybe_autoscale_hint(self, pt: PendingTask):
+        # Hook point for the autoscaler (resource demand snapshot).
+        pass
+
+    @staticmethod
+    def _env_fingerprint(spec: TaskSpec):
+        """Workers are only reusable by tasks with the same environment needs
+        (TPU visibility is baked in at spawn; runtime_env vars likewise)."""
+        env_vars = (spec.runtime_env or {}).get("env_vars") or {}
+        return (bool(spec.resources.get("TPU")), tuple(sorted(env_vars.items())))
+
+    def _acquire_worker(self, node: NodeState, pt: PendingTask) -> Optional[WorkerHandle]:
+        idle = self.idle_workers.get(node.node_id, [])
+        want = self._env_fingerprint(pt.spec)
+        for i in range(len(idle) - 1, -1, -1):
+            w = idle[i]
+            if w.dead:
+                idle.pop(i)
+            elif w.fingerprint == want:
+                idle.pop(i)
+                return w
+        if self.starting_workers >= self.config.maximum_startup_concurrency:
+            return None
+        self.starting_workers += 1
+        threading.Thread(
+            target=self._start_worker, args=(node.node_id, pt.spec), daemon=True
+        ).start()
+        return None
+
+    def _start_worker(self, node_id: NodeID, spec_hint: TaskSpec):
+        try:
+            worker = self._spawn_worker_process(node_id, spec_hint)
+            ok = worker.registered.wait(self.config.worker_register_timeout_s)
+            with self.lock:
+                self.starting_workers -= 1
+                if ok:
+                    self.idle_workers[node_id].append(worker)
+                else:
+                    worker.dead = True
+                    logger.error("worker failed to register in time")
+                self.sched_cv.notify_all()
+        except Exception:
+            with self.lock:
+                self.starting_workers -= 1
+            logger.error("worker spawn failed:\n%s", traceback.format_exc())
+
+    def _spawn_worker_process(self, node_id: NodeID, spec_hint: TaskSpec) -> WorkerHandle:
+        if self.mode == "thread":
+            handle = self._spawn_worker_thread(node_id)
+            handle.fingerprint = self._env_fingerprint(spec_hint)
+            return handle
+        import subprocess
+
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER"] = "1"
+        env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
+        # Make the ray_tpu package + the driver's modules importable in the
+        # fresh interpreter (reference: services.py propagates sys.path).
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        extra_path = [pkg_root, os.getcwd()]
+        existing = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in extra_path if p] + ([existing] if existing else [])
+        )
+        # Accelerator visibility: workers only see the TPU if their tasks ask
+        # for it (reference: accelerators/tpu.py TPU_VISIBLE_CHIPS).
+        if not spec_hint.resources.get("TPU"):
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        env_overrides = spec_hint.runtime_env.get("env_vars", {}) if spec_hint.runtime_env else {}
+        env.update({k: str(v) for k, v in env_overrides.items()})
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main", self.address, worker_id.hex()],
+            env=env,
+            stdout=None,
+            stderr=None,
+        )
+        handle = WorkerHandle(worker_id, node_id, proc=proc)
+        handle.fingerprint = self._env_fingerprint(spec_hint)
+        with self.lock:
+            self.workers[worker_id] = handle
+        return handle
+
+    def _spawn_worker_thread(self, node_id: NodeID) -> WorkerHandle:
+        """Thread-mode worker: same execution loop, in-process (local_mode
+        analog; reference: ``ray.init(local_mode=True)``)."""
+        from ray_tpu._private.worker_runtime import WorkerRuntime, InProcessChannel
+
+        worker_id = WorkerID.from_random()
+        chan_a, chan_b = InProcessChannel.pair()
+        handle = WorkerHandle(worker_id, node_id, proc=None, conn=chan_a)
+        runtime = WorkerRuntime(worker_id, chan_b, in_process=True)
+        t = threading.Thread(target=runtime.run, daemon=True, name=f"worker-{worker_id.hex()[:6]}")
+        t.start()
+        with self.lock:
+            self.workers[worker_id] = handle
+        reader = threading.Thread(
+            target=self._worker_reader, args=(handle,), daemon=True, name=f"rd-{worker_id.hex()[:6]}"
+        )
+        reader.start()
+        handle.registered.wait(5)
+        return handle
+
+    # ------------------------------------------------------- worker transport
+
+    def _accept_loop(self):
+        while not self.shutting_down:
+            try:
+                conn = self.listener.accept()
+            except (OSError, EOFError):
+                return
+            threading.Thread(target=self._handshake, args=(conn,), daemon=True).start()
+
+    def _handshake(self, conn):
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            conn.close()
+            return
+        if not isinstance(msg, P.RegisterWorker):
+            conn.close()
+            return
+        with self.lock:
+            handle = self.workers.get(msg.worker_id)
+            if handle is None:
+                conn.close()
+                return
+            handle.conn = conn
+            handle.registered.set()
+        self._worker_reader(handle)
+
+    def _worker_reader(self, handle: WorkerHandle):
+        conn = handle.conn
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            if isinstance(msg, P.RegisterWorker):
+                handle.registered.set()
+            elif isinstance(msg, P.TaskDone):
+                self._on_task_done(handle, msg)
+            elif isinstance(msg, P.GetObjects):
+                # Blocking op: dedicated thread so waiters can't starve the
+                # control plane (no bounded pool → no waiter deadlock).
+                threading.Thread(
+                    target=self._handle_get, args=(handle, msg), daemon=True
+                ).start()
+            elif isinstance(msg, P.PutObject):
+                self._handle_put(handle, msg)
+            elif isinstance(msg, P.Request):
+                if msg.op in ("wait", "pg_ready", "get_entries"):
+                    threading.Thread(
+                        target=self._handle_request, args=(handle, msg), daemon=True
+                    ).start()
+                else:
+                    self._handle_request(handle, msg)
+            elif isinstance(msg, P.FreeObjects):
+                for oid in msg.object_ids:
+                    self.remove_ref(oid)
+            elif isinstance(msg, P.WorkerError):
+                logger.error("worker %s error: %s", handle.worker_id.hex()[:8], msg.message)
+        self._on_worker_death(handle, reason="connection closed")
+
+    def _handle_get(self, handle: WorkerHandle, msg: P.GetObjects):
+        entries = self.memory_store.get(msg.object_ids, timeout=None)
+        results = []
+        for oid, entry in zip(msg.object_ids, entries):
+            kind, payload = entry
+            if kind in ("inline", "error"):
+                results.append((oid, kind, payload.to_bytes()))
+            else:
+                results.append((oid, "plasma", payload))
+        try:
+            handle.send(P.GetReply(msg.req_id, results))
+        except (OSError, EOFError):
+            pass
+
+    def _handle_put(self, handle: WorkerHandle, msg: P.PutObject):
+        if msg.kind == "inline":
+            self.memory_store.put(msg.object_id, ("inline", SerializedObject.from_buffer(msg.payload)))
+        else:
+            shm_name, size = msg.payload
+            self.plasma.seal(msg.object_id, shm_name, size)
+            self.memory_store.put(msg.object_id, ("plasma", (shm_name, size)))
+        self._on_object_sealed(msg.object_id)
+        try:
+            handle.send(P.PutAck(msg.req_id))
+        except (OSError, EOFError):
+            pass
+
+    def _handle_request(self, handle: WorkerHandle, msg: P.Request):
+        try:
+            payload = self._dispatch_request(msg.op, msg.payload)
+            reply = P.Reply(msg.req_id, payload)
+        except Exception as e:  # noqa: BLE001
+            reply = P.Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        try:
+            handle.send(reply)
+        except (OSError, EOFError):
+            pass
+
+    def _dispatch_request(self, op: str, payload):
+        if op == "submit_task":
+            spec, name = payload
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                self.register_actor(spec, name=name)
+            else:
+                self.submit_task(spec)
+            return None
+        if op == "add_ref":
+            for oid in payload:
+                self.add_ref(oid)
+            return None
+        if op == "wait":
+            object_ids, num_returns, timeout = payload
+            return self.memory_store.wait(object_ids, num_returns, timeout)
+        if op == "get_named_actor":
+            actor_id = self.get_named_actor(payload)
+            if actor_id is None:
+                return None
+            actor = self.actors[actor_id]
+            return (actor_id, actor.creation_spec.max_concurrency)
+        if op == "kill_actor":
+            actor_id, no_restart = payload
+            self.kill_actor(actor_id, no_restart)
+            return None
+        if op == "cancel":
+            self.cancel_task(payload)
+            return None
+        if op == "pg_create":
+            bundles, strategy, name = payload
+            return self.create_placement_group(bundles, strategy, name)
+        if op == "pg_ready":
+            pg_id, timeout = payload
+            return self.pg_ready(pg_id, timeout)
+        if op == "pg_remove":
+            self.remove_placement_group(payload)
+            return None
+        if op == "pg_table":
+            pg = self.placement_groups.get(payload)
+            if pg is None:
+                return None
+            return {
+                "bundles": pg.bundles,
+                "strategy": pg.strategy,
+                "nodes": [n.hex() if n else None for n in pg.bundle_nodes],
+                "ready": pg.ready.is_set(),
+            }
+        if op == "cluster_resources":
+            return self.cluster_resources()
+        if op == "available_resources":
+            return self.available_resources()
+        if op == "nodes":
+            return self.node_infos()
+        if op == "kv_put":
+            ns, key, value = payload
+            self.kv[(ns, key)] = value
+            return None
+        if op == "kv_get":
+            ns, key = payload
+            return self.kv.get((ns, key))
+        if op == "kv_del":
+            ns, key = payload
+            return self.kv.pop((ns, key), None) is not None
+        if op == "kv_keys":
+            ns, prefix = payload
+            return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+        if op == "actor_state":
+            actor = self.actors.get(payload)
+            return actor.state if actor else None
+        raise ValueError(f"unknown controller op: {op}")
+
+    # ------------------------------------------------------------ dispatching
+
+    def _dispatch_to_worker(self, worker: WorkerHandle, pt: PendingTask):
+        spec = pt.spec
+        resolved_args = []
+        for a in spec.args:
+            if a[0] == "ref":
+                entry = self.memory_store.get([a[1]], timeout=0)[0]
+                if entry is None:
+                    # Dependency vanished (e.g. freed between restarts and no
+                    # lineage to rebuild it) — fail rather than crash dispatch.
+                    from ray_tpu.exceptions import ObjectLostError
+
+                    with self.lock:
+                        self._release_task_resources(pt)
+                        if not worker.dead and worker.actor_id is None:
+                            self.idle_workers[worker.node_id].append(worker)
+                    self._fail_task(pt, ObjectLostError(a[1].hex()))
+                    return
+                kind, payload = entry
+                if kind in ("inline", "error"):
+                    resolved_args.append((kind, payload.to_bytes()))
+                else:
+                    resolved_args.append(("plasma", payload))
+            else:
+                resolved_args.append(a)
+        pt.worker = worker
+        worker.running[spec.task_id] = pt
+        self.task_events.append(
+            {"task_id": spec.task_id.hex(), "name": spec.name, "event": "DISPATCHED", "t": time.time()}
+        )
+        try:
+            worker.send(P.ExecuteTask(spec, resolved_args))
+        except (OSError, EOFError):
+            self._on_worker_death(worker, reason="send failed")
+
+    def _on_task_done(self, worker: WorkerHandle, msg: P.TaskDone):
+        with self.lock:
+            pt = worker.running.pop(msg.task_id, None)
+        if pt is None:
+            return
+        spec = pt.spec
+        failed = False
+        for oid, kind, payload in msg.results:
+            if kind == "plasma":
+                shm_name, size = payload
+                self.plasma.seal(oid, shm_name, size)
+                self.memory_store.put(oid, ("plasma", (shm_name, size)))
+            else:
+                if kind == "error":
+                    failed = True
+                self.memory_store.put(oid, (kind, SerializedObject.from_buffer(payload)))
+            self._on_object_sealed(oid)
+        self.task_events.append(
+            {
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "event": "FAILED" if failed else "FINISHED",
+                "exec_ms": msg.exec_ms,
+                "t": time.time(),
+            }
+        )
+        with self.lock:
+            if not spec.is_actor_creation() or failed:
+                # Actors hold their resources for their lifetime (released on
+                # actor death); everything else releases at task completion.
+                self._release_task_resources(pt)
+            self.pending_by_id.pop(spec.task_id, None)
+            self._unpin_task_deps(pt)
+            if spec.is_actor_creation():
+                actor = self.actors.get(spec.actor_id)
+                if actor is not None:
+                    if failed:
+                        actor.state = "DEAD"
+                        actor.death_cause = "creation task failed"
+                        self._drain_actor_queue(actor)
+                    else:
+                        actor.state = "ALIVE"
+                        actor.worker = worker
+                        actor.held = (getattr(pt, "_node", None), getattr(pt, "_pg_bundle", None), dict(spec.resources))
+                        worker.actor_id = actor.actor_id
+                        self._pump_actor(actor)
+            elif spec.is_actor_task():
+                actor = self.actors.get(spec.actor_id)
+                if actor is not None:
+                    actor.inflight -= 1
+                    self._pump_actor(actor)
+            else:
+                # Normal task worker returns to the idle pool.
+                if not worker.dead and worker.actor_id is None:
+                    worker.last_idle_t = time.monotonic()
+                    self.idle_workers[worker.node_id].append(worker)
+            self.sched_cv.notify_all()
+
+    def _release_task_resources(self, pt: PendingTask):
+        node = getattr(pt, "_node", None)
+        if node is not None:
+            node.release(pt.spec.resources)
+            pt._node = None
+        pg_bundle = getattr(pt, "_pg_bundle", None)
+        if pg_bundle is not None:
+            pg, i = pg_bundle
+            for k, v in pt.spec.resources.items():
+                pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) + v
+            pt._pg_bundle = None
+
+    def _unpin(self, object_id: ObjectID):
+        self.ref_counts[object_id] -= 1
+        if self.ref_counts[object_id] <= 0:
+            del self.ref_counts[object_id]
+            self._free_object(object_id)
+
+    # --------------------------------------------------------------- failures
+
+    def _on_worker_death(self, worker: WorkerHandle, reason: str):
+        with self.lock:
+            if worker.dead:
+                return
+            worker.dead = True
+            self.workers.pop(worker.worker_id, None)
+            pool = self.idle_workers.get(worker.node_id)
+            if pool and worker in pool:
+                pool.remove(worker)
+            running = list(worker.running.values())
+            worker.running.clear()
+        for pt in running:
+            with self.lock:
+                self._release_task_resources(pt)
+            if pt.spec.is_actor_task():
+                with self.lock:
+                    actor = self.actors.get(pt.spec.actor_id)
+                    if actor is not None:
+                        actor.inflight = max(0, actor.inflight - 1)
+                self._fail_task(pt, ActorDiedError(pt.spec.actor_id.hex(), reason))
+            elif pt.retries_left > 0:
+                pt.retries_left -= 1
+                pt.worker = None
+                logger.warning(
+                    "retrying task %s after worker death (%d retries left)",
+                    pt.spec.name,
+                    pt.retries_left,
+                )
+                with self.lock:
+                    self._enqueue_ready(pt)
+                    self.sched_cv.notify_all()
+            else:
+                self._fail_task(pt, WorkerCrashedError(f"worker died: {reason}"))
+        if worker.actor_id is not None:
+            self._on_actor_worker_death(worker.actor_id, reason)
+
+    def _on_actor_worker_death(self, actor_id: ActorID, reason: str):
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is None or actor.state == "DEAD":
+                return
+            actor.worker = None
+            actor.inflight = 0
+            self._release_actor_resources(actor)
+            if actor.restarts_left != 0:
+                if actor.restarts_left > 0:
+                    actor.restarts_left -= 1
+                actor.state = "RESTARTING"
+                # Re-pin creation args for the restart run (the original pins
+                # were released when the first creation task completed).
+                deps = {a[1] for a in actor.creation_spec.args if a[0] == "ref"}
+                creation = PendingTask(actor.creation_spec, deps)
+                for d in deps:
+                    self.ref_counts[d] += 1
+                unresolved = {d for d in deps if not self.memory_store.contains(d)}
+                creation.unresolved = unresolved
+                self.pending_by_id[actor.creation_spec.task_id] = creation
+                if unresolved:
+                    for d in unresolved:
+                        self.waiting_on_deps[d].append(creation)
+                else:
+                    self._enqueue_ready(creation)
+                self.sched_cv.notify_all()
+            else:
+                actor.state = "DEAD"
+                actor.death_cause = reason
+                self._drain_actor_queue(actor)
+
+    def _release_actor_resources(self, actor: ActorState):
+        if actor.held is None:
+            return
+        node, pg_bundle, resources = actor.held
+        actor.held = None
+        if node is not None:
+            node.release(resources)
+        if pg_bundle is not None:
+            pg, i = pg_bundle
+            for k, v in resources.items():
+                pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) + v
+
+    def _drain_actor_queue(self, actor: ActorState):
+        while actor.queue:
+            pt = actor.queue.popleft()
+            self._fail_task(pt, ActorDiedError(actor.actor_id.hex(), actor.death_cause or "actor died"))
+
+    def _fail_task(self, pt: PendingTask, error: Exception):
+        sobj = self.serialization.serialize(
+            TaskError(pt.spec.name, error) if not isinstance(error, TaskError) else error
+        )
+        for oid in pt.spec.return_ids():
+            self.memory_store.put(oid, ("error", sobj))
+            self._on_object_sealed(oid)
+        with self.lock:
+            self.pending_by_id.pop(pt.spec.task_id, None)
+            self._unpin_task_deps(pt)
+
+    def _unpin_task_deps(self, pt: PendingTask):
+        """Release the submission-time pins on a task's args exactly once."""
+        if getattr(pt, "_deps_unpinned", False):
+            return
+        pt._deps_unpinned = True
+        for d in pt.all_deps:
+            self._unpin(d)
+
+    # ----------------------------------------------------------------- actors
+
+    def register_actor(self, spec: TaskSpec, name: Optional[str] = None) -> ActorState:
+        with self.lock:
+            actor = ActorState(spec.actor_id, spec)
+            actor.name = name
+            self.actors[spec.actor_id] = actor
+            if name:
+                if name in self.named_actors:
+                    raise ValueError(f"actor name {name!r} already taken")
+                self.named_actors[name] = spec.actor_id
+        self.submit_task(spec)
+        return actor
+
+    def get_named_actor(self, name: str) -> Optional[ActorID]:
+        with self.lock:
+            return self.named_actors.get(name)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return
+            if no_restart:
+                actor.restarts_left = 0
+            worker = actor.worker
+        if worker is not None:
+            try:
+                worker.send(P.KillActor(actor_id))
+            except (OSError, EOFError):
+                pass
+            # Process-mode: terminate outright (SIGKILL analog of ray.kill).
+            if worker.proc is not None:
+                worker.proc.terminate()
+        with self.lock:
+            if no_restart:
+                actor = self.actors.get(actor_id)
+                if actor is not None:
+                    actor.state = "DEAD"
+                    actor.death_cause = "killed via ray_tpu.kill"
+                    self._release_actor_resources(actor)
+                    self._drain_actor_queue(actor)
+                    if actor.name:
+                        self.named_actors.pop(actor.name, None)
+
+    def cancel_task(self, object_id: ObjectID):
+        task_id = object_id.task_id()
+        with self.lock:
+            pt = self.pending_by_id.get(task_id)
+            if pt is None:
+                return
+            pt.cancelled = True
+            if pt.worker is None:
+                from ray_tpu.exceptions import TaskCancelledError
+
+                self._fail_task(pt, TaskCancelledError(f"task {pt.spec.name} cancelled"))
+
+    # ------------------------------------------------------- placement groups
+
+    def create_placement_group(
+        self, bundles: list[dict], strategy: str, name: str = ""
+    ) -> PlacementGroupID:
+        pg_id = PlacementGroupID.from_random()
+        pg = PlacementGroupState(pg_id, bundles, strategy)
+        with self.lock:
+            self.placement_groups[pg_id] = pg
+            self._try_place_pg(pg)
+        return pg_id
+
+    def _try_place_pg(self, pg: PlacementGroupState):
+        """All-or-nothing bundle reservation (2-phase commit analog;
+        reference: ``gcs_placement_group_scheduler.h`` PACK/SPREAD/STRICT_*)."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        assignment: list[Optional[NodeState]] = [None] * len(pg.bundles)
+        scratch = {n.node_id: dict(n.available) for n in alive}
+
+        def fits(nid, demand):
+            a = scratch[nid]
+            return all(a.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+        def take(nid, demand):
+            a = scratch[nid]
+            for k, v in demand.items():
+                a[k] = a.get(k, 0.0) - v
+
+        strategy = pg.strategy
+        if strategy in ("STRICT_PACK", "PACK"):
+            # Try to land all bundles on one node first.
+            total: dict[str, float] = {}
+            for b in pg.bundles:
+                for k, v in b.items():
+                    total[k] = total.get(k, 0.0) + v
+            for n in sorted(alive, key=lambda n: -n.utilization()):
+                if n.fits(total):
+                    assignment = [n] * len(pg.bundles)
+                    take(n.node_id, total)
+                    break
+            if assignment[0] is None and strategy == "STRICT_PACK":
+                return False
+        if assignment[0] is None:
+            # Greedy per-bundle placement.
+            used_nodes: set[NodeID] = set()
+            for i, b in enumerate(pg.bundles):
+                candidates = [n for n in alive if fits(n.node_id, b)]
+                if strategy == "STRICT_SPREAD":
+                    candidates = [n for n in candidates if n.node_id not in used_nodes]
+                if not candidates:
+                    return False
+                if strategy in ("SPREAD", "STRICT_SPREAD"):
+                    pick = min(candidates, key=lambda n: (n.node_id in used_nodes, n.utilization()))
+                else:
+                    pick = max(candidates, key=lambda n: n.utilization())
+                assignment[i] = pick
+                used_nodes.add(pick.node_id)
+                take(pick.node_id, b)
+        # Commit.
+        for i, (node, b) in enumerate(zip(assignment, pg.bundles)):
+            node.allocate(b)
+            pg.bundle_nodes[i] = node.node_id
+            pg.bundle_available[i] = dict(b)
+        pg.ready.set()
+        return True
+
+    def remove_placement_group(self, pg_id: PlacementGroupID):
+        with self.lock:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.removed:
+                return
+            pg.removed = True
+            for i, nid in enumerate(pg.bundle_nodes):
+                if nid is None:
+                    continue
+                node = self.nodes.get(nid)
+                if node is not None:
+                    node.release(pg.bundles[i])
+
+    def pg_ready(self, pg_id: PlacementGroupID, timeout=None) -> bool:
+        with self.lock:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None:
+                raise PlacementGroupSchedulingError("unknown placement group")
+            if not pg.ready.is_set():
+                self._try_place_pg(pg)
+        return pg.ready.wait(timeout=timeout if timeout is not None else 1e9)
+
+    # ------------------------------------------------------------------ state
+
+    def cluster_resources(self) -> dict[str, float]:
+        with self.lock:
+            out: dict[str, float] = {}
+            for n in self.nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.total.items():
+                    out[k] = out.get(k, 0.0) + v
+            return out
+
+    def available_resources(self) -> dict[str, float]:
+        with self.lock:
+            out: dict[str, float] = {}
+            for n in self.nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.available.items():
+                    out[k] = out.get(k, 0.0) + v
+            return out
+
+    def node_infos(self) -> list[dict]:
+        with self.lock:
+            return [
+                {
+                    "NodeID": n.node_id.hex(),
+                    "Alive": n.alive,
+                    "Resources": dict(n.total),
+                    "Available": dict(n.available),
+                    "Labels": dict(n.labels),
+                }
+                for n in self.nodes.values()
+            ]
+
+    # -------------------------------------------------------------- lifecycle
+
+    def shutdown(self):
+        with self.lock:
+            if self.shutting_down:
+                return
+            self.shutting_down = True
+            workers = list(self.workers.values())
+            self.sched_cv.notify_all()
+        for w in workers:
+            try:
+                if w.conn is not None:
+                    w.send(P.Shutdown())
+            except (OSError, EOFError):
+                pass
+        deadline = time.monotonic() + 2.0
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
+                except Exception:
+                    w.proc.kill()
+        if self.listener is not None:
+            try:
+                self.listener.close()
+            except OSError:
+                pass
+            try:
+                os.unlink(self.address)
+            except OSError:
+                pass
+        self.plasma.shutdown()
+        self.plasma_client.close()
+        self._reply_pool.shutdown(wait=False)
+
+

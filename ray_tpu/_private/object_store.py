@@ -1,0 +1,254 @@
+"""Object stores: in-process memory store + shared-memory (plasma-analog) store.
+
+Two tiers, mirroring the reference:
+
+- ``MemoryStore`` ≈ ``CoreWorkerMemoryStore``
+  (``src/ray/core_worker/store_provider/memory_store/memory_store.h:45``):
+  small objects and inline task returns, living in the owner process, with
+  blocking waits.
+- ``PlasmaStore``/``PlasmaClient`` ≈ the plasma shared-memory store
+  (``src/ray/object_manager/plasma/store.h``): large objects in shared memory
+  segments, zero-copy mapped by any worker process on the node. Here each
+  sealed object is one POSIX shm segment (``multiprocessing.shared_memory``);
+  the C++ store (ray_tpu/core) can replace this backend without changing the
+  client API.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Iterable, Optional
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import SerializedObject
+from ray_tpu.exceptions import ObjectStoreFullError
+
+
+class MemoryStore:
+    """Thread-safe in-process object map with blocking get."""
+
+    def __init__(self):
+        self._objects: dict[ObjectID, SerializedObject] = {}
+        self._errors: dict[ObjectID, SerializedObject] = {}
+        self._cv = threading.Condition()
+
+    def put(self, object_id: ObjectID, obj: SerializedObject, is_error: bool = False):
+        with self._cv:
+            self._objects[object_id] = obj
+            if is_error:
+                self._errors[object_id] = obj
+            self._cv.notify_all()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._cv:
+            return object_id in self._objects
+
+    def get(
+        self, object_ids: Iterable[ObjectID], timeout: Optional[float] = None
+    ) -> list[Optional[SerializedObject]]:
+        object_ids = list(object_ids)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                missing = [o for o in object_ids if o not in self._objects]
+                if not missing:
+                    return [self._objects[o] for o in object_ids]
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return [self._objects.get(o) for o in object_ids]
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait()
+
+    def wait(
+        self, object_ids: list[ObjectID], num_returns: int, timeout: Optional[float]
+    ) -> tuple[list[ObjectID], list[ObjectID]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = [o for o in object_ids if o in self._objects]
+                if len(ready) >= num_returns:
+                    ready_set = set(ready[:num_returns])
+                    return (
+                        [o for o in object_ids if o in ready_set],
+                        [o for o in object_ids if o not in ready_set],
+                    )
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        ready_set = set(ready)
+                        return (
+                            [o for o in object_ids if o in ready_set],
+                            [o for o in object_ids if o not in ready_set],
+                        )
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait()
+
+    def delete(self, object_ids: Iterable[ObjectID]):
+        with self._cv:
+            for o in object_ids:
+                self._objects.pop(o, None)
+                self._errors.pop(o, None)
+
+    def size(self) -> int:
+        with self._cv:
+            return len(self._objects)
+
+
+class PlasmaStore:
+    """Node-local shared-memory object store (single authority per node).
+
+    Lives in the controller/raylet process. Tracks segment names, sizes, and
+    pin counts; evicts unpinned sealed objects LRU when over capacity
+    (reference: ``plasma/eviction_policy.h``).
+    """
+
+    def __init__(self, capacity_bytes: int):
+        self._capacity = capacity_bytes
+        self._used = 0
+        self._lock = threading.Lock()
+        # object id -> (shm_name, size)
+        self._sealed: "OrderedDict[ObjectID, tuple[str, int]]" = OrderedDict()
+        self._pins: dict[ObjectID, int] = {}
+        self._segments: dict[str, object] = {}  # shm_name -> SharedMemory (creator side)
+
+    def create(self, object_id: ObjectID, size: int):
+        from multiprocessing import shared_memory
+
+        with self._lock:
+            if self._used + size > self._capacity:
+                self._evict_locked(self._used + size - self._capacity)
+            if self._used + size > self._capacity:
+                raise ObjectStoreFullError(
+                    f"object of size {size} does not fit (capacity {self._capacity}, used {self._used})"
+                )
+            name = "rt_" + object_id.hex()[:24]
+            seg = shared_memory.SharedMemory(create=True, size=max(size, 1), name=name)
+            # The store owns segment lifecycle (explicit unlink on delete);
+            # keep the process-level resource tracker out of it so exit-time
+            # "leaked shared_memory" warnings don't fire for live objects.
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(seg._name, "shared_memory")  # type: ignore[attr-defined]
+            except Exception:
+                pass
+            self._segments[name] = seg
+            self._used += size
+            return seg, name
+
+    def seal(self, object_id: ObjectID, shm_name: str, size: int):
+        with self._lock:
+            self._sealed[object_id] = (shm_name, size)
+            self._sealed.move_to_end(object_id)
+
+    def lookup(self, object_id: ObjectID) -> Optional[tuple[str, int]]:
+        with self._lock:
+            entry = self._sealed.get(object_id)
+            if entry is not None:
+                self._sealed.move_to_end(object_id)
+            return entry
+
+    def pin(self, object_id: ObjectID):
+        with self._lock:
+            self._pins[object_id] = self._pins.get(object_id, 0) + 1
+
+    def unpin(self, object_id: ObjectID):
+        with self._lock:
+            n = self._pins.get(object_id, 0) - 1
+            if n <= 0:
+                self._pins.pop(object_id, None)
+            else:
+                self._pins[object_id] = n
+
+    def delete(self, object_id: ObjectID):
+        with self._lock:
+            self._delete_locked(object_id)
+
+    def _delete_locked(self, object_id: ObjectID):
+        entry = self._sealed.pop(object_id, None)
+        if entry is None:
+            return
+        shm_name, size = entry
+        self._used -= size
+        seg = self._segments.pop(shm_name, None)
+        if seg is not None:
+            try:
+                seg.close()
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+
+    def _evict_locked(self, need_bytes: int):
+        freed = 0
+        for oid in list(self._sealed.keys()):
+            if freed >= need_bytes:
+                break
+            if self._pins.get(oid, 0) > 0:
+                continue
+            _, size = self._sealed[oid]
+            self._delete_locked(oid)
+            freed += size
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used
+
+    def shutdown(self):
+        with self._lock:
+            for oid in list(self._sealed.keys()):
+                self._delete_locked(oid)
+            for name, seg in list(self._segments.items()):
+                try:
+                    seg.close()
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+            self._segments.clear()
+
+
+class PlasmaClient:
+    """Per-process client: write objects into / map objects out of shm.
+
+    In-process fast path when colocated with the store; worker processes get
+    (shm_name, size) via the control plane and attach directly — attach/read
+    is zero-copy (``np.frombuffer`` over the mapped segment), matching the
+    plasma client contract (``plasma/client.cc``).
+    """
+
+    def __init__(self):
+        self._attached: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def read(self, shm_name: str, size: int) -> SerializedObject:
+        from multiprocessing import shared_memory
+
+        with self._lock:
+            seg = self._attached.get(shm_name)
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=shm_name)
+                self._attached[shm_name] = seg
+        return SerializedObject.from_buffer(seg.buf[:size])
+
+    def detach(self, shm_name: str):
+        with self._lock:
+            seg = self._attached.pop(shm_name, None)
+        if seg is not None:
+            try:
+                seg.close()
+            except BufferError:
+                # Buffers still mapped into live arrays; leave to GC.
+                self._attached[shm_name] = seg
+
+    def close(self):
+        with self._lock:
+            for seg in self._attached.values():
+                try:
+                    seg.close()
+                except BufferError:
+                    pass
+            self._attached.clear()

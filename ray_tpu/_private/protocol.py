@@ -1,0 +1,113 @@
+"""Wire protocol between the controller process and worker processes.
+
+The reference's control plane is gRPC (``src/ray/rpc/``); here the single-host
+control plane is length-delimited pickled messages over
+``multiprocessing.connection`` (AF_UNIX) — the same lease-then-push shape
+(scheduler pushes ``ExecuteTask`` to a leased worker; data plane bypasses the
+controller via shared memory). A gRPC/C++ transport can replace this without
+changing message semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.task_spec import TaskSpec
+
+
+# ---- worker -> controller ----
+
+@dataclasses.dataclass
+class RegisterWorker:
+    worker_id: WorkerID
+    pid: int
+
+
+@dataclasses.dataclass
+class TaskDone:
+    task_id: TaskID
+    # list of (object_id, kind, payload): kind in {"inline", "plasma", "error"}
+    # inline/error payload = flattened SerializedObject bytes;
+    # plasma payload = (shm_name, size)
+    results: list
+    actor_id: Optional[ActorID] = None
+    # Execution info for observability (task events; reference:
+    # task_event_buffer.h).
+    exec_ms: float = 0.0
+
+
+@dataclasses.dataclass
+class GetObjects:
+    req_id: int
+    object_ids: list
+
+
+@dataclasses.dataclass
+class PutObject:
+    req_id: int
+    object_id: ObjectID
+    # Either inline bytes or a plasma (shm_name, size) the worker created.
+    kind: str
+    payload: Any
+
+
+@dataclasses.dataclass
+class WorkerError:
+    message: str
+    task_id: Optional[TaskID] = None
+
+
+@dataclasses.dataclass
+class Request:
+    """Generic worker→controller RPC (submit_task, register_actor, kv ops,
+    placement-group ops, state queries, ref counting...)."""
+
+    req_id: int
+    op: str
+    payload: Any
+
+
+@dataclasses.dataclass
+class Reply:
+    req_id: int
+    payload: Any
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class FreeObjects:
+    object_ids: list
+
+
+# ---- controller -> worker ----
+
+@dataclasses.dataclass
+class ExecuteTask:
+    spec: TaskSpec
+    # Resolved args: parallel to spec.args; refs replaced by ("inline", bytes)
+    # or ("plasma", (shm_name, size)).
+    resolved_args: list
+
+
+@dataclasses.dataclass
+class GetReply:
+    req_id: int
+    # list of (object_id, kind, payload) — kind in {"inline","plasma","error"}
+    results: list
+
+
+@dataclasses.dataclass
+class PutAck:
+    req_id: int
+
+
+@dataclasses.dataclass
+class KillActor:
+    actor_id: ActorID
+
+
+@dataclasses.dataclass
+class Shutdown:
+    pass

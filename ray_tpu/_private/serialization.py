@@ -1,0 +1,172 @@
+"""Serialization context: cloudpickle + zero-copy buffers for array data.
+
+Analog of the reference's ``SerializationContext``
+(``python/ray/_private/serialization.py:125``): cloudpickle for arbitrary
+Python, pickle protocol 5 out-of-band buffers for numpy (zero-copy
+deserialization from shared memory), and a device-array hook that moves JAX
+arrays through host RAM — the TPU equivalent of the reference's out-of-band
+torch tensor path. ObjectRefs found inside values are serialized by id and
+re-hydrated on the receiving side (ownership/borrowing metadata travels with
+them).
+
+Layout: an object is (inband pickle stream, extra buffers, oob buffers).
+``extra`` holds device-array payloads referenced by index; ``oob`` holds
+pickle-5 ``buffer_callback`` payloads consumed in order by ``pickle.loads``.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+from typing import Any, Callable
+
+import cloudpickle
+import numpy as np
+
+
+def _is_jax_array(value: Any) -> bool:
+    # Avoid importing jax at module load: the object plane must work in
+    # processes that never touch an accelerator.
+    cls = type(value)
+    return cls.__module__.startswith("jax") and cls.__name__ in ("ArrayImpl", "Array")
+
+
+class SerializedObject:
+    __slots__ = ("inband", "extra", "oob")
+
+    def __init__(self, inband: bytes, extra: list, oob: list):
+        self.inband = inband
+        self.extra = extra
+        self.oob = oob
+
+    def total_bytes(self) -> int:
+        return (
+            len(self.inband)
+            + sum(len(memoryview(b)) for b in self.extra)
+            + sum(len(memoryview(b)) for b in self.oob)
+        )
+
+    def to_bytes(self) -> bytes:
+        """Flatten to one contiguous buffer (header + inband + buffers)."""
+        out = io.BytesIO()
+        header = pickle.dumps(
+            (
+                len(self.inband),
+                [len(memoryview(b)) for b in self.extra],
+                [len(memoryview(b)) for b in self.oob],
+            ),
+            protocol=5,
+        )
+        out.write(len(header).to_bytes(8, "little"))
+        out.write(header)
+        out.write(self.inband)
+        for b in self.extra:
+            out.write(b)
+        for b in self.oob:
+            out.write(b)
+        return out.getvalue()
+
+    def write_into(self, mv: memoryview) -> int:
+        data = self.to_bytes()
+        mv[: len(data)] = data
+        return len(data)
+
+    @classmethod
+    def from_buffer(cls, buf) -> "SerializedObject":
+        """Reconstruct from a flat buffer; payloads stay zero-copy memoryviews."""
+        mv = memoryview(buf)
+        hlen = int.from_bytes(bytes(mv[:8]), "little")
+        inband_len, extra_lens, oob_lens = pickle.loads(mv[8 : 8 + hlen])
+        offset = 8 + hlen
+        inband = bytes(mv[offset : offset + inband_len])
+        offset += inband_len
+        extra, oob = [], []
+        for ln in extra_lens:
+            extra.append(mv[offset : offset + ln])
+            offset += ln
+        for ln in oob_lens:
+            oob.append(mv[offset : offset + ln])
+            offset += ln
+        return cls(inband, extra, oob)
+
+
+_thread_state = threading.local()
+
+
+class SerializationContext:
+    def __init__(
+        self,
+        ref_serializer: Callable | None = None,
+        ref_deserializer: Callable | None = None,
+    ):
+        # Hooks so the worker layer can track ObjectRefs crossing process
+        # boundaries (borrowed references; reference: reference_count.h:73).
+        self._ref_serializer = ref_serializer
+        self._ref_deserializer = ref_deserializer
+        self._custom: dict[type, tuple[Callable, Callable]] = {}
+
+    def register_custom_serializer(self, cls, serializer, deserializer):
+        self._custom[cls] = (serializer, deserializer)
+
+    def serialize(self, value: Any) -> SerializedObject:
+        extra: list = []
+        oob: list = []
+        ctx = self
+
+        class Pickler(cloudpickle.CloudPickler):
+            def reducer_override(self, obj):
+                from ray_tpu.object_ref import ObjectRef
+
+                if isinstance(obj, ObjectRef):
+                    if ctx._ref_serializer is not None:
+                        ctx._ref_serializer(obj)
+                    return (_deserialize_object_ref, (obj.id_binary(),))
+                if _is_jax_array(obj):
+                    arr = np.asarray(obj)  # device→host copy
+                    if not arr.flags["C_CONTIGUOUS"]:
+                        arr = np.ascontiguousarray(arr)
+                    idx = len(extra)
+                    extra.append(arr.data.cast("B"))
+                    return (_rebuild_jax_array, (idx, arr.shape, arr.dtype.str))
+                reducer = ctx._custom.get(type(obj))
+                if reducer is not None:
+                    ser, deser = reducer
+                    return (deser, (ser(obj),))
+                return NotImplemented
+
+        sink = io.BytesIO()
+        p = Pickler(sink, protocol=5, buffer_callback=lambda b: oob.append(b.raw()))
+        p.dump(value)
+        return SerializedObject(sink.getvalue(), extra, oob)
+
+    def deserialize(self, obj: SerializedObject) -> Any:
+        _thread_state.table = {
+            "extra": obj.extra,
+            "ref_deserializer": self._ref_deserializer,
+        }
+        try:
+            return pickle.loads(obj.inband, buffers=iter(obj.oob))
+        finally:
+            _thread_state.table = None
+
+
+def _rebuild_jax_array(idx: int, shape, dtype_str):
+    buffers = _thread_state.table["extra"]
+    arr = np.frombuffer(buffers[idx], dtype=np.dtype(dtype_str)).reshape(shape)
+    try:
+        import jax
+
+        return jax.numpy.asarray(arr)
+    except ImportError:  # object plane without jax installed
+        return arr
+
+
+def _deserialize_object_ref(id_binary: bytes):
+    from ray_tpu.object_ref import ObjectRef
+
+    table = getattr(_thread_state, "table", None)
+    deser = table.get("ref_deserializer") if table else None
+    if deser is not None:
+        return deser(id_binary)
+    return ObjectRef.from_binary(id_binary)

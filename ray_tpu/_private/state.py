@@ -1,0 +1,22 @@
+"""Global state queries (reference: ``python/ray/_private/state.py`` and the
+state API ``python/ray/util/state/api.py``)."""
+
+from __future__ import annotations
+
+
+def cluster_resources() -> dict:
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller_call("cluster_resources")
+
+
+def available_resources() -> dict:
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller_call("available_resources")
+
+
+def nodes() -> list[dict]:
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller_call("nodes")

@@ -1,0 +1,71 @@
+"""Task specifications — the unit handed from API → scheduler → worker.
+
+Analog of the reference's ``TaskSpecification`` (``src/ray/common/task/``):
+one spec type covers normal tasks, actor-creation tasks, and actor method
+calls, carrying serialized function/args, resource demands, retry policy, and
+scheduling strategy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclasses.dataclass
+class SchedulingStrategy:
+    """Resolved scheduling strategy attached to a spec."""
+
+    kind: str = "default"  # default | spread | node_affinity | placement_group
+    node_id: Optional[NodeID] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: TaskID
+    task_type: TaskType
+    name: str
+    # Serialized function (cloudpickle blob) for normal/creation tasks, or
+    # method name for actor tasks.
+    function_blob: Optional[bytes]
+    method_name: Optional[str]
+    # Args: list of either ("value", SerializedObject-bytes) or ("ref", ObjectID).
+    args: list
+    kwargs_included: bool  # args holds a single (args_tuple, kwargs_dict) payload
+    num_returns: int
+    resources: dict[str, float]
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    max_concurrency: int = 1
+    max_restarts: int = 0
+    is_async_actor: bool = False
+    # Scheduling
+    strategy: SchedulingStrategy = dataclasses.field(default_factory=SchedulingStrategy)
+    # Sequencing for ordered actor calls (reference: actor_task_submitter.h
+    # sequence numbers).
+    seq_no: int = 0
+    # Runtime env (env vars for now; full runtime-env plugins later).
+    runtime_env: Optional[dict] = None
+
+    def return_ids(self) -> list[ObjectID]:
+        return [ObjectID.for_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def is_actor_task(self) -> bool:
+        return self.task_type == TaskType.ACTOR_TASK
+
+    def is_actor_creation(self) -> bool:
+        return self.task_type == TaskType.ACTOR_CREATION_TASK

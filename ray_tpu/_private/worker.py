@@ -1,0 +1,519 @@
+"""Driver/worker global runtime and the public core API.
+
+Analog of the reference's ``python/ray/_private/worker.py``: the module-level
+``init/get/put/wait/remote`` surface (reference lines 1341/2722/2890/2955/3343)
+backed by either the in-process controller (driver) or the worker runtime's
+RPC channel (worker processes). Both sides expose one ``WorkerAPI`` so user
+code — including code running inside tasks and actors — can submit nested
+tasks, create actors, and touch the object store.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+from typing import Any, Optional, Sequence
+
+import cloudpickle
+
+from ray_tpu._private.config import Config, get_config, set_config
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.serialization import SerializationContext, SerializedObject
+from ray_tpu._private.task_spec import SchedulingStrategy, TaskSpec, TaskType
+from ray_tpu.exceptions import GetTimeoutError, RayTpuError, TaskError
+from ray_tpu.object_ref import ObjectRef
+
+_global_api = None
+_api_lock = threading.Lock()
+
+
+class _RefMarker:
+    """Placeholder for a top-level ObjectRef arg, substituted at execution."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __reduce__(self):
+        return (_resolve_marker, (self.index,))
+
+
+_marker_state = threading.local()
+
+
+def _resolve_marker(index: int):
+    return _marker_state.values[index]
+
+
+class WorkerAPI:
+    """Common task/object plane operations; subclasses bind the transport."""
+
+    def __init__(self):
+        self.job_id = JobID.next()
+        self.worker_id = WorkerID.from_random()
+        self._submit_counter = 0
+        self._put_counter = 0
+        self._counter_lock = threading.Lock()
+        self.serialization = SerializationContext(
+            ref_serializer=self._on_ref_serialized,
+            ref_deserializer=self._on_ref_deserialized,
+        )
+
+    def _next_submit_index(self) -> int:
+        """Submission index salted with this worker's identity so concurrent
+        submitters (driver + workers) can never derive colliding TaskIDs —
+        every process's counter starts at 1."""
+        with self._counter_lock:
+            self._submit_counter += 1
+            idx = self._submit_counter
+        salt = int.from_bytes(self.worker_id.binary()[:8], "little")
+        return (salt << 32) | idx
+
+    # transport hooks -------------------------------------------------------
+    def _submit(self, spec: TaskSpec, actor_name: Optional[str] = None):
+        raise NotImplementedError
+
+    def _get_serialized(self, object_ids, timeout):
+        raise NotImplementedError
+
+    def _put_serialized(self, object_id: ObjectID, sobj: SerializedObject):
+        raise NotImplementedError
+
+    def controller_call(self, op: str, payload=None):
+        raise NotImplementedError
+
+    def add_refs(self, object_ids: list[ObjectID]):
+        raise NotImplementedError
+
+    def remove_ref(self, object_id: ObjectID):
+        raise NotImplementedError
+
+    # ref tracking ----------------------------------------------------------
+    def _on_ref_serialized(self, ref: ObjectRef):
+        # Nested refs crossing a process boundary: pin on the owner so the
+        # payload outlives the sender's handle. (Round-1 simplification of the
+        # reference's borrower protocol, reference_count.h:73.)
+        self.add_refs([ref.id()])
+
+    def _on_ref_deserialized(self, id_binary: bytes) -> ObjectRef:
+        oid = ObjectID(id_binary)
+        self.add_refs([oid])
+        return ObjectRef(oid)
+
+    # public ops ------------------------------------------------------------
+    def submit_task(
+        self,
+        function,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: str,
+        num_returns: int = 1,
+        resources: dict[str, float] | None = None,
+        max_retries: int = 0,
+        strategy: SchedulingStrategy | None = None,
+        runtime_env: dict | None = None,
+        function_blob: bytes | None = None,
+    ) -> list[ObjectRef]:
+        idx = self._next_submit_index()
+        task_id = TaskID.for_task(self.job_id, None, idx)
+        spec_args = self._encode_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id,
+            task_type=TaskType.NORMAL_TASK,
+            name=name,
+            function_blob=function_blob or cloudpickle.dumps(function),
+            method_name=None,
+            args=spec_args,
+            kwargs_included=True,
+            num_returns=num_returns,
+            resources=resources or {"CPU": 1.0},
+            max_retries=max_retries,
+            strategy=strategy or SchedulingStrategy(),
+            runtime_env=runtime_env,
+        )
+        return_ids = spec.return_ids()
+        self.add_refs(return_ids)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        self._submit(spec)
+        return refs
+
+    def create_actor(
+        self,
+        cls,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: Optional[str],
+        actor_name_label: str,
+        resources: dict[str, float] | None,
+        max_concurrency: int,
+        max_restarts: int,
+        is_async: bool,
+        strategy: SchedulingStrategy | None = None,
+        runtime_env: dict | None = None,
+    ) -> ActorID:
+        actor_id = ActorID.from_random()
+        task_id = TaskID.for_actor_creation(actor_id)
+        spec = TaskSpec(
+            task_id=task_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            name=f"{actor_name_label}.__init__",
+            function_blob=cloudpickle.dumps(cls),
+            method_name=None,
+            args=self._encode_args(args, kwargs),
+            kwargs_included=True,
+            num_returns=1,
+            resources=resources if resources is not None else {"CPU": 1.0},
+            actor_id=actor_id,
+            max_concurrency=max_concurrency,
+            max_restarts=max_restarts,
+            is_async_actor=is_async,
+            strategy=strategy or SchedulingStrategy(),
+            runtime_env=runtime_env,
+        )
+        self.add_refs(spec.return_ids())
+        self._submit(spec, actor_name=name)
+        return actor_id
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: str,
+        num_returns: int = 1,
+        seq_no: int = 0,
+    ) -> list[ObjectRef]:
+        idx = self._next_submit_index()
+        task_id = TaskID.for_task(self.job_id, TaskID.for_actor_creation(actor_id), idx)
+        spec = TaskSpec(
+            task_id=task_id,
+            task_type=TaskType.ACTOR_TASK,
+            name=name,
+            function_blob=None,
+            method_name=method_name,
+            args=self._encode_args(args, kwargs),
+            kwargs_included=True,
+            num_returns=num_returns,
+            resources={},
+            actor_id=actor_id,
+            seq_no=seq_no,
+        )
+        return_ids = spec.return_ids()
+        self.add_refs(return_ids)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        self._submit(spec)
+        return refs
+
+    def _encode_args(self, args: tuple, kwargs: dict) -> list:
+        """Encode (args, kwargs) as a template + top-level ref dependencies."""
+        ref_entries: list = []
+
+        def sub(v):
+            if isinstance(v, ObjectRef):
+                ref_entries.append(("ref", v.id()))
+                return _RefMarker(len(ref_entries) - 1)
+            return v
+
+        template = (
+            tuple(sub(a) for a in args),
+            {k: sub(v) for k, v in kwargs.items()},
+        )
+        sobj = self.serialization.serialize(template)
+        return [("value", sobj.to_bytes())] + ref_entries
+
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put on an ObjectRef is not allowed")
+        with self._counter_lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        object_id = ObjectID.from_put(idx, self.worker_id)
+        self.add_refs([object_id])
+        ref = ObjectRef(object_id)
+        sobj = self.serialization.serialize(value)
+        self._put_serialized(object_id, sobj)
+        return ref
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"ray_tpu.get takes ObjectRefs, got {type(r)}")
+        sobjs = self._get_serialized([r.id() for r in ref_list], timeout)
+        values = []
+        for r, item in zip(ref_list, sobjs):
+            if item is None:
+                raise GetTimeoutError(f"get timed out waiting for {r}")
+            kind, sobj = item
+            value = self.serialization.deserialize(sobj)
+            if kind == "error" or isinstance(value, TaskError):
+                if isinstance(value, TaskError):
+                    raise value.as_instanceof_cause()
+                raise value
+            values.append(value)
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns=1, timeout=None):
+        if not refs:
+            return [], []
+        ids = [r.id() for r in refs]
+        by_id = {r.id(): r for r in refs}
+        ready_ids, not_ready_ids = self.controller_call("wait", (ids, num_returns, timeout))
+        return [by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids]
+
+
+class DriverAPI(WorkerAPI):
+    """Driver-side: direct in-process calls into the controller."""
+
+    def __init__(self, controller):
+        super().__init__()
+        self.controller = controller
+
+    def _submit(self, spec: TaskSpec, actor_name: Optional[str] = None):
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            self.controller.register_actor(spec, name=actor_name)
+        else:
+            self.controller.submit_task(spec)
+
+    def _get_serialized(self, object_ids, timeout):
+        entries = self.controller.get_entries(object_ids, timeout=timeout)
+        out = []
+        for e in entries:
+            if e is None:
+                out.append(None)
+            else:
+                out.append((e[0], self.controller.resolve_object(e)))
+        return out
+
+    def _put_serialized(self, object_id, sobj):
+        self.controller.put_serialized(object_id, sobj)
+
+    def controller_call(self, op, payload=None):
+        return self.controller._dispatch_request(op, payload)
+
+    def add_refs(self, object_ids):
+        for oid in object_ids:
+            self.controller.add_ref(oid)
+
+    def remove_ref(self, object_id):
+        self.controller.remove_ref(object_id)
+
+
+class WorkerProcAPI(WorkerAPI):
+    """Worker-side: RPC through the worker runtime's controller channel."""
+
+    def __init__(self, runtime):
+        super().__init__()
+        self.runtime = runtime
+        self.worker_id = runtime.worker_id
+        # Route the runtime's task-arg deserialization through this API's
+        # context so nested refs in args get tracked.
+        runtime.serialization = self.serialization
+
+    def _submit(self, spec, actor_name: Optional[str] = None):
+        self.runtime.call_controller("submit_task", (spec, actor_name))
+
+    def _get_serialized(self, object_ids, timeout):
+        try:
+            results = self.runtime.get_objects(object_ids, timeout=timeout)
+        except TimeoutError:
+            raise GetTimeoutError("ray_tpu.get timed out")
+        out = []
+        for sobj, kind in results:
+            out.append((kind, sobj))
+        return out
+
+    def _put_serialized(self, object_id, sobj):
+        self.runtime.put_serialized(object_id, sobj)
+
+    def controller_call(self, op, payload=None):
+        return self.runtime.call_controller(op, payload)
+
+    def add_refs(self, object_ids):
+        self.runtime.call_controller("add_ref", list(object_ids), fire_and_forget=True)
+
+    def remove_ref(self, object_id):
+        from ray_tpu._private import protocol as P
+
+        try:
+            self.runtime._send(P.FreeObjects([object_id]))
+        except (OSError, EOFError):
+            pass
+
+
+class RuntimeContext:
+    def __init__(self, api: WorkerAPI):
+        self._api = api
+
+    def get_job_id(self) -> str:
+        return self._api.job_id.hex()
+
+    def get_worker_id(self) -> str:
+        return self._api.worker_id.hex()
+
+    def get_node_id(self) -> str:
+        infos = self._api.controller_call("nodes")
+        return infos[0]["NodeID"] if infos else ""
+
+    def get_task_name(self) -> Optional[str]:
+        rt = getattr(self._api, "runtime", None)
+        return rt.current_task_name if rt is not None else None
+
+
+# ---------------------------------------------------------------- module API
+
+
+def global_worker() -> WorkerAPI:
+    if _global_api is None:
+        raise RayTpuError("ray_tpu.init() has not been called")
+    return _global_api
+
+
+def _set_worker_runtime(runtime):
+    """Called by WorkerRuntime in worker processes before the task loop."""
+    global _global_api
+    _global_api = WorkerProcAPI(runtime)
+    _install_ref_hooks(_global_api)
+
+
+def _install_ref_hooks(api: WorkerAPI):
+    ObjectRef._on_delete = lambda oid: api.remove_ref(oid)
+
+
+def is_initialized() -> bool:
+    return _global_api is not None
+
+
+def init(
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    mode: str = "process",
+    config: Optional[dict] = None,
+    ignore_reinit_error: bool = False,
+    _system_config: Optional[dict] = None,
+):
+    """Start the single-host runtime (head node).
+
+    Reference: ``ray.init`` (``python/ray/_private/worker.py:1341``) →
+    ``Node.start_head_processes`` (``node.py:1426``). Here the control plane
+    runs as threads in the driver; workers are spawned processes (or threads
+    with ``mode="thread"`` — the ``local_mode`` analog for fast tests).
+    """
+    global _global_api
+    with _api_lock:
+        if _global_api is not None:
+            if ignore_reinit_error:
+                return _global_api
+            raise RayTpuError("ray_tpu.init() called twice")
+        if os.environ.get("RAY_TPU_WORKER") == "1":
+            raise RayTpuError("init() must not be called inside a worker")
+
+        cfg = Config.from_env(_system_config or config)
+        if object_store_memory is not None:
+            cfg.object_store_memory = object_store_memory
+        set_config(cfg)
+
+        head_resources = dict(resources or {})
+        if num_cpus is None:
+            num_cpus = os.cpu_count() or 1
+        head_resources.setdefault("CPU", float(num_cpus))
+        head_resources.setdefault("memory", float(2 * 1024**3))
+        if num_tpus is None:
+            from ray_tpu.tpu.accelerator import TPUAcceleratorManager
+
+            detected = TPUAcceleratorManager.get_current_node_num_accelerators()
+            if detected:
+                head_resources.setdefault("TPU", float(detected))
+        else:
+            head_resources["TPU"] = float(num_tpus)
+
+        from ray_tpu._private.controller import Controller
+
+        controller = Controller(cfg, head_resources, mode=mode)
+        api = DriverAPI(controller)
+        _global_api = api
+        _install_ref_hooks(api)
+        atexit.register(shutdown)
+        return api
+
+
+def shutdown():
+    global _global_api
+    with _api_lock:
+        api = _global_api
+        if api is None:
+            return
+        _global_api = None
+        ObjectRef._on_delete = None
+        controller = getattr(api, "controller", None)
+        if controller is not None:
+            controller.shutdown()
+
+
+def get(refs, *, timeout: Optional[float] = None):
+    return global_worker().get(refs, timeout=timeout)
+
+
+async def get_async(ref):
+    """Async get (used by ``await ref``); polls the store without blocking
+    the event loop thread."""
+    import asyncio
+
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, lambda: global_worker().get(ref))
+
+
+def put(value) -> ObjectRef:
+    return global_worker().put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None):
+    return global_worker().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor_handle, *, no_restart: bool = True):
+    from ray_tpu.actor import ActorHandle
+
+    if not isinstance(actor_handle, ActorHandle):
+        raise TypeError("ray_tpu.kill takes an ActorHandle")
+    global_worker().controller_call("kill_actor", (actor_handle._actor_id, no_restart))
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    global_worker().controller_call("cancel", ref.id())
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(global_worker())
+
+
+def remote(*args, **kwargs):
+    """The ``@remote`` decorator (reference: ``worker.py:3343``)."""
+    from ray_tpu.actor import make_actor_class
+    from ray_tpu.remote_function import RemoteFunction
+
+    def make(target, options):
+        if isinstance(target, type):
+            return make_actor_class(target, options)
+        return RemoteFunction(target, options)
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return make(args[0], {})
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_tpus=1)")
+
+    def decorator(target):
+        return make(target, dict(kwargs))
+
+    return decorator

@@ -1,0 +1,34 @@
+"""Dedicated worker process entry point.
+
+Analog of the reference's ``python/ray/_private/workers/default_worker.py``:
+worker processes are exec'd fresh (never forked/spawned from driver state, so
+the driver's ``__main__`` is never re-imported) and connect back to the
+controller over the node's unix socket.
+
+Usage: ``python -m ray_tpu._private.worker_main <socket> <worker_id_hex>``
+with ``RAY_TPU_AUTHKEY`` in the environment.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main():
+    address = sys.argv[1]
+    worker_id_hex = sys.argv[2]
+    authkey = bytes.fromhex(os.environ.pop("RAY_TPU_AUTHKEY"))
+
+    from multiprocessing.connection import Client
+
+    from ray_tpu._private.ids import WorkerID
+    from ray_tpu._private.worker_runtime import WorkerRuntime
+
+    conn = Client(address, family="AF_UNIX", authkey=authkey)
+    runtime = WorkerRuntime(WorkerID(bytes.fromhex(worker_id_hex)), conn, in_process=False)
+    runtime.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,355 @@
+"""Worker-side runtime: the task execution loop.
+
+Analog of the reference's worker path: ``worker.main_loop``
+(``python/ray/_private/worker.py:964``) → ``CoreWorker.run_task_loop``
+(``_raylet.pyx:3050``) → ``CoreWorkerProcess::RunTaskExecutionLoop``
+(``core_worker_process.cc:103``). One runtime per worker process (or thread in
+thread mode): receives ``ExecuteTask`` messages, deserializes args (reading
+large payloads zero-copy out of shared memory), runs the function, and stores
+returns — small results inline through the control plane, large results as new
+shared-memory segments (``PutInLocalPlasmaStore`` analog,
+``core_worker.cc:1565``). Actor instances live in this process for their
+lifetime; ordered execution and ``max_concurrency`` mirror the reference's
+``ActorSchedulingQueue`` / ``ConcurrencyGroupManager``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+import queue
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu._private import protocol as P
+from ray_tpu._private.ids import ObjectID, WorkerID
+from ray_tpu._private.serialization import SerializationContext, SerializedObject
+from ray_tpu._private.task_spec import TaskSpec, TaskType
+from ray_tpu.exceptions import TaskError
+
+_INLINE_LIMIT_ENV = "RAY_TPU_MAX_INLINE_OBJECT_SIZE"
+
+
+class InProcessChannel:
+    """Duplex in-process channel with the multiprocessing.Connection API
+    subset (send/recv/close) — used for thread-mode workers."""
+
+    def __init__(self, inbox: "queue.Queue", outbox: "queue.Queue"):
+        self._inbox = inbox
+        self._outbox = outbox
+        self._closed = False
+
+    @classmethod
+    def pair(cls):
+        a, b = queue.Queue(), queue.Queue()
+        return cls(a, b), cls(b, a)
+
+    def send(self, msg):
+        if self._closed:
+            raise OSError("channel closed")
+        self._outbox.put(msg)
+
+    def recv(self):
+        msg = self._inbox.get()
+        if msg is _CLOSE:
+            raise EOFError
+        return msg
+
+    def close(self):
+        self._closed = True
+        self._inbox.put(_CLOSE)
+        self._outbox.put(_CLOSE)
+
+
+_CLOSE = object()
+
+
+class WorkerRuntime:
+    def __init__(self, worker_id: WorkerID, conn, in_process: bool = False):
+        self.worker_id = worker_id
+        self.conn = conn
+        self.in_process = in_process
+        self.serialization = SerializationContext()
+        self.actors: dict[bytes, Any] = {}  # actor_id binary -> instance
+        self.actor_pools: dict[bytes, ThreadPoolExecutor] = {}
+        self.actor_loops: dict[bytes, asyncio.AbstractEventLoop] = {}
+        self._get_replies: dict[int, Any] = {}
+        self._get_cv = threading.Condition()
+        self._req_counter = itertools.count(1)
+        self._send_lock = threading.Lock()
+        self._put_counter = itertools.count(1)
+        self._shm_client = None
+        self._shutdown = False
+        self.max_inline = int(os.environ.get(_INLINE_LIMIT_ENV, 100 * 1024))
+        self.current_task_name: Optional[str] = None
+        # The reader loop must never block on task execution (tasks make
+        # controller calls — get/submit — whose replies arrive on the reader).
+        self._task_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+
+    # ------------------------------------------------------------- transport
+
+    def _send(self, msg):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def run(self):
+        # Register with the controller, then serve the task loop.
+        if self.in_process:
+            # Thread mode: the driver's API is already the global one; share
+            # its serialization context so ref tracking stays consistent.
+            from ray_tpu._private import worker as worker_mod
+
+            if worker_mod.is_initialized():
+                self.serialization = worker_mod.global_worker().serialization
+        else:
+            self._install_worker_api()
+        self._send(P.RegisterWorker(self.worker_id, os.getpid()))
+        while not self._shutdown:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                break
+            if isinstance(msg, P.ExecuteTask):
+                self._route_task(msg)
+            elif isinstance(msg, (P.GetReply, P.PutAck, P.Reply)):
+                with self._get_cv:
+                    if isinstance(msg, P.GetReply):
+                        self._get_replies[msg.req_id] = msg.results
+                    elif isinstance(msg, P.PutAck):
+                        self._get_replies[msg.req_id] = True
+                    else:
+                        self._get_replies[msg.req_id] = msg
+                    self._get_cv.notify_all()
+            elif isinstance(msg, P.KillActor):
+                break
+            elif isinstance(msg, P.Shutdown):
+                break
+        self._shutdown = True
+        if not self.in_process:
+            os._exit(0)
+
+    def _route_task(self, msg: P.ExecuteTask):
+        spec = msg.spec
+        if spec.task_type == TaskType.ACTOR_TASK and spec.max_concurrency > 1:
+            pool = self.actor_pools.get(spec.actor_id.binary())
+            if pool is not None:
+                pool.submit(self._execute_task, msg)
+                return
+        if spec.task_type == TaskType.ACTOR_TASK and spec.is_async_actor:
+            loop = self.actor_loops.get(spec.actor_id.binary())
+            if loop is not None:
+                asyncio.run_coroutine_threadsafe(self._execute_async(msg), loop)
+                return
+        self._task_pool.submit(self._execute_task, msg)
+
+    # -------------------------------------------------------- object plane
+
+    def get_objects(self, object_ids: list[ObjectID], timeout=None) -> list:
+        """Returns [(SerializedObject, kind)] parallel to object_ids."""
+        req_id = next(self._req_counter)
+        self._send(P.GetObjects(req_id, object_ids))
+        results = self._await_reply(req_id, timeout)
+        return [(self._materialize(kind, payload), kind) for _, kind, payload in results]
+
+    def _await_reply(self, req_id: int, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._get_cv:
+            while req_id not in self._get_replies:
+                if self._shutdown:
+                    raise OSError("worker shutting down")
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("controller reply timed out")
+                self._get_cv.wait(timeout=remaining if remaining is not None else 1.0)
+            return self._get_replies.pop(req_id)
+
+    def call_controller(self, op: str, payload=None, fire_and_forget: bool = False):
+        req_id = next(self._req_counter)
+        self._send(P.Request(req_id, op, payload))
+        if fire_and_forget:
+            # Still consume the reply asynchronously to keep the table clean.
+            def drain():
+                try:
+                    self._await_reply(req_id)
+                except (OSError, TimeoutError):
+                    pass
+
+            threading.Thread(target=drain, daemon=True).start()
+            return None
+        reply = self._await_reply(req_id)
+        if reply.error is not None:
+            raise RuntimeError(f"controller call {op} failed: {reply.error}")
+        return reply.payload
+
+    def _materialize(self, kind, payload) -> SerializedObject:
+        if kind in ("inline", "error"):
+            return SerializedObject.from_buffer(payload)
+        shm_name, size = payload
+        return self._plasma().read(shm_name, size)
+
+    def _plasma(self):
+        if self._shm_client is None:
+            from ray_tpu._private.object_store import PlasmaClient
+
+            self._shm_client = PlasmaClient()
+        return self._shm_client
+
+    def put_serialized(self, object_id: ObjectID, sobj: SerializedObject):
+        req_id = next(self._req_counter)
+        if sobj.total_bytes() <= self.max_inline:
+            self._send(P.PutObject(req_id, object_id, "inline", sobj.to_bytes()))
+        else:
+            name, size = self._write_shm(object_id, sobj)
+            self._send(P.PutObject(req_id, object_id, "plasma", (name, size)))
+        self._await_reply(req_id)
+
+    def _write_shm(self, object_id: ObjectID, sobj: SerializedObject):
+        from multiprocessing import shared_memory
+
+        data = sobj.to_bytes()
+        name = f"rt_{object_id.hex()[:20]}_{os.getpid() & 0xFFFF:x}"
+        seg = shared_memory.SharedMemory(create=True, size=max(len(data), 1), name=name)
+        seg.buf[: len(data)] = data
+        # Hand lifecycle ownership to the controller: stop this process's
+        # resource tracker from unlinking the segment at exit.
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(seg._name, "shared_memory")  # type: ignore[attr-defined]
+        except Exception:
+            pass
+        seg.close()
+        return name, len(data)
+
+    # -------------------------------------------------------------- execution
+
+    def _deserialize_args(self, spec: TaskSpec, resolved_args: list):
+        """Decode the (args, kwargs) template + resolved top-level refs.
+
+        ``resolved_args[0]`` is the serialized template; the rest are the
+        resolved payloads of top-level ObjectRef args, in marker order
+        (see WorkerAPI._encode_args).
+        """
+        from ray_tpu._private.worker import _marker_state
+
+        ref_values = []
+        for kind, payload in resolved_args[1:]:
+            sobj = self._materialize(kind, payload)
+            value = self.serialization.deserialize(sobj)
+            if kind == "error":
+                if isinstance(value, TaskError):
+                    raise value.as_instanceof_cause()
+                raise value
+            ref_values.append(value)
+        _marker_state.values = ref_values
+        try:
+            template = self.serialization.deserialize(
+                SerializedObject.from_buffer(resolved_args[0][1])
+            )
+        finally:
+            _marker_state.values = None
+        args, kwargs = template
+        return list(args), dict(kwargs)
+
+    def _execute_task(self, msg: P.ExecuteTask):
+        spec = msg.spec
+        start = time.monotonic()
+        results = []
+        try:
+            args, kwargs = self._deserialize_args(spec, msg.resolved_args)
+            value = self._invoke(spec, args, kwargs)
+            results = self._store_returns(spec, value)
+        except BaseException as e:  # noqa: BLE001 — task errors must not kill the worker
+            results = self._store_error(spec, e)
+        exec_ms = (time.monotonic() - start) * 1e3
+        actor_id = spec.actor_id if spec.task_type != TaskType.NORMAL_TASK else None
+        self._send(P.TaskDone(spec.task_id, results, actor_id=actor_id, exec_ms=exec_ms))
+
+    async def _execute_async(self, msg: P.ExecuteTask):
+        spec = msg.spec
+        start = time.monotonic()
+        try:
+            args, kwargs = self._deserialize_args(spec, msg.resolved_args)
+            instance = self.actors[spec.actor_id.binary()]
+            method = getattr(instance, spec.method_name)
+            value = method(*args, **kwargs)
+            if asyncio.iscoroutine(value):
+                value = await value
+            results = self._store_returns(spec, value)
+        except BaseException as e:  # noqa: BLE001
+            results = self._store_error(spec, e)
+        exec_ms = (time.monotonic() - start) * 1e3
+        self._send(P.TaskDone(spec.task_id, results, actor_id=spec.actor_id, exec_ms=exec_ms))
+
+    def _invoke(self, spec: TaskSpec, args, kwargs):
+        self.current_task_name = spec.name
+        if spec.task_type == TaskType.NORMAL_TASK:
+            fn = cloudpickle.loads(spec.function_blob)
+            return fn(*args, **kwargs)
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            cls = cloudpickle.loads(spec.function_blob)
+            instance = cls(*args, **kwargs)
+            key = spec.actor_id.binary()
+            self.actors[key] = instance
+            if spec.max_concurrency > 1:
+                self.actor_pools[key] = ThreadPoolExecutor(
+                    max_workers=spec.max_concurrency, thread_name_prefix="actor"
+                )
+            if spec.is_async_actor:
+                loop = asyncio.new_event_loop()
+                self.actor_loops[key] = loop
+                threading.Thread(target=loop.run_forever, daemon=True, name="actor-loop").start()
+            return None
+        # ACTOR_TASK
+        instance = self.actors[spec.actor_id.binary()]
+        method = getattr(instance, spec.method_name)
+        return method(*args, **kwargs)
+
+    def _store_returns(self, spec: TaskSpec, value) -> list:
+        return_ids = spec.return_ids()
+        if spec.num_returns == 1:
+            values = [value]
+        else:
+            values = list(value)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns={spec.num_returns} "
+                    f"but returned {len(values)} values"
+                )
+        results = []
+        for oid, v in zip(return_ids, values):
+            sobj = self.serialization.serialize(v)
+            if sobj.total_bytes() <= self.max_inline:
+                results.append((oid, "inline", sobj.to_bytes()))
+            else:
+                name, size = self._write_shm(oid, sobj)
+                results.append((oid, "plasma", (name, size)))
+        return results
+
+    def _store_error(self, spec: TaskSpec, exc: BaseException) -> list:
+        if isinstance(exc, TaskError):
+            err = exc
+        else:
+            tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+            err = TaskError(spec.name, exc, remote_tb=tb)
+        try:
+            sobj = self.serialization.serialize(err)
+        except Exception:
+            # Unpicklable cause: degrade to a string-only error.
+            fallback = TaskError(spec.name, RuntimeError(repr(exc)), remote_tb=err.remote_tb)
+            sobj = self.serialization.serialize(fallback)
+        return [(oid, "error", sobj.to_bytes()) for oid in spec.return_ids()]
+
+    # ---------------------------------------------------------- in-task API
+
+    def _install_worker_api(self):
+        """Give user code running in this worker access to get/put/remote."""
+        from ray_tpu._private import worker as worker_mod
+
+        worker_mod._set_worker_runtime(self)

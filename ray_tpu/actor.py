@@ -1,0 +1,163 @@
+"""Actors (reference: ``python/ray/actor.py`` — ActorClass ``:1111``,
+``_remote`` ``:1402``, ActorMethod ``:784``)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.task_spec import SchedulingStrategy
+from ray_tpu.remote_function import _resources_from_options, _strategy_from_options
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(
+            self._method_name, args, kwargs, num_returns=self._num_returns
+        )
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name}() cannot be called directly; "
+            f"use .{self._method_name}.remote()."
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str, method_names: list[str]):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_names = set(method_names)
+        self._seq_lock = threading.Lock()
+        self._seq = 0
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        if item not in self._method_names:
+            raise AttributeError(
+                f"Actor class {self._class_name} has no method {item!r}"
+            )
+        return ActorMethod(self, item)
+
+    def _submit_method(self, method_name, args, kwargs, num_returns=1):
+        from ray_tpu._private.worker import global_worker
+
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        refs = global_worker().submit_actor_task(
+            self._actor_id,
+            method_name,
+            args,
+            kwargs,
+            name=f"{self._class_name}.{method_name}",
+            num_returns=num_returns,
+            seq_no=seq,
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._class_name, sorted(self._method_names)),
+        )
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: dict):
+        self._cls = cls
+        self._options = dict(options)
+        self.__name__ = cls.__name__
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self.__name__} cannot be instantiated directly; "
+            f"use {self.__name__}.remote()."
+        )
+
+    def options(self, **new_options):
+        merged = dict(self._options)
+        merged.update(new_options)
+        return ActorClass(self._cls, merged)
+
+    def _method_names(self) -> list[str]:
+        import inspect
+
+        return [
+            n
+            for n, m in inspect.getmembers(self._cls, predicate=callable)
+            if not n.startswith("__") or n == "__call__"
+        ]
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.worker import global_worker
+
+        opts = self._options
+        # Actors default to 0 CPU required when idle in the reference; we keep
+        # 1 CPU default for creation unless overridden, matching `@ray.remote`
+        # actor defaults (num_cpus=1 at creation, 0 for methods).
+        resources = _resources_from_options(opts)
+        is_async = _class_is_async(self._cls)
+        actor_id = global_worker().create_actor(
+            self._cls,
+            args,
+            kwargs,
+            name=opts.get("name"),
+            actor_name_label=self.__name__,
+            resources=resources,
+            max_concurrency=opts.get("max_concurrency", 1),
+            max_restarts=opts.get("max_restarts", 0),
+            is_async=is_async,
+            strategy=_strategy_from_options(opts),
+            runtime_env=opts.get("runtime_env"),
+        )
+        return ActorHandle(actor_id, self.__name__, self._method_names())
+
+
+def _class_is_async(cls) -> bool:
+    import inspect
+
+    return any(
+        inspect.iscoroutinefunction(m)
+        for _, m in inspect.getmembers(cls, predicate=inspect.isfunction)
+    )
+
+
+def make_actor_class(cls: type, options: dict) -> ActorClass:
+    return ActorClass(cls, options)
+
+
+def get_actor(name: str) -> ActorHandle:
+    """Look up a named actor (reference: ``ray.get_actor``)."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.exceptions import RayTpuError
+
+    result = global_worker().controller_call("get_named_actor", name)
+    if result is None:
+        raise ValueError(f"Failed to look up actor with name '{name}'")
+    actor_id, _ = result
+    # Method names unknown across processes; allow any attribute.
+    return _AnyMethodActorHandle(actor_id, name)
+
+
+class _AnyMethodActorHandle(ActorHandle):
+    def __init__(self, actor_id: ActorID, class_name: str):
+        super().__init__(actor_id, class_name, [])
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)

@@ -1,0 +1,44 @@
+"""Multi-node-on-one-host test cluster (reference:
+``python/ray/cluster_utils.py:135`` ``Cluster.add_node``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Cluster:
+    """Drives the controller's fake-node API: each added node is a scheduling
+    domain with its own resources; workers for it still run locally."""
+
+    def __init__(self, initialize_head: bool = True, head_node_args: Optional[dict] = None):
+        import ray_tpu
+
+        self._node_ids = []
+        head_node_args = head_node_args or {}
+        if initialize_head:
+            if not ray_tpu.is_initialized():
+                ray_tpu.init(**head_node_args)
+
+    def add_node(self, num_cpus: float = 1, num_tpus: float = 0, resources: Optional[dict] = None, labels=None):
+        from ray_tpu._private.worker import global_worker
+
+        res = dict(resources or {})
+        res.setdefault("CPU", float(num_cpus))
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        controller = global_worker().controller
+        node_id = controller.add_node(res, labels)
+        self._node_ids.append(node_id)
+        return node_id
+
+    def remove_node(self, node_id):
+        from ray_tpu._private.worker import global_worker
+
+        global_worker().controller.remove_node(node_id)
+        if node_id in self._node_ids:
+            self._node_ids.remove(node_id)
+
+    def shutdown(self):
+        import ray_tpu
+
+        ray_tpu.shutdown()

@@ -1,0 +1,96 @@
+"""Exception types mirroring the reference's ``python/ray/exceptions.py``."""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all ray_tpu errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution.
+
+    Analog of the reference's ``RayTaskError``: wraps the original exception
+    and its remote traceback; re-raised at every ``get`` on the task's
+    results.
+    """
+
+    def __init__(self, function_name: str, cause: BaseException, remote_tb: str | None = None):
+        self.function_name = function_name
+        self.cause = cause
+        self.remote_tb = remote_tb or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        super().__init__(f"task {function_name} failed: {cause!r}\nRemote traceback:\n{self.remote_tb}")
+
+    def __reduce__(self):
+        return (TaskError, (self.function_name, self.cause, self.remote_tb))
+
+    def as_instanceof_cause(self) -> BaseException:
+        """Return an exception that is both a TaskError and the cause type."""
+        cause_cls = type(self.cause)
+        if issubclass(cause_cls, TaskError):
+            return self.cause
+        try:
+            cls = type(
+                "TaskError_" + cause_cls.__name__,
+                (TaskError, cause_cls),
+                {"__init__": lambda s: None, "__reduce__": lambda s: (_rebuild_dual, (self,))},
+            )
+            err = cls()
+            err.function_name = self.function_name
+            err.cause = self.cause
+            err.remote_tb = self.remote_tb
+            err.args = self.args
+            return err
+        except TypeError:
+            return self
+
+
+def _rebuild_dual(task_error: TaskError):
+    return task_error.as_instanceof_cause()
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id_hex: str, reason: str = "actor died"):
+        self.actor_id_hex = actor_id_hex
+        super().__init__(f"Actor {actor_id_hex}: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id_hex: str):
+        super().__init__(f"Object {object_id_hex} was lost and could not be reconstructed")
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupSchedulingError(RayTpuError):
+    pass

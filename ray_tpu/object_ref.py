@@ -1,0 +1,90 @@
+"""ObjectRef: a future handle to an immutable object in the object store.
+
+Analog of the reference's ``ObjectRef`` (``python/ray/_raylet.pyx`` ObjectRef
+cdef class). Refs are owned by the worker that created them; the ref-counting
+hooks here feed the owner's reference table so objects are freed when the last
+Python handle (local or borrowed) goes away.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    _on_delete: Optional[Callable] = None  # installed by the worker runtime
+
+    __slots__ = ("_id", "_owner_hint", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_hint: str | None = None):
+        self._id = object_id
+        self._owner_hint = owner_hint
+        if ObjectRef._on_create is not None:
+            ObjectRef._on_create(self)
+
+    _on_create: Optional[Callable] = None
+
+    @classmethod
+    def from_binary(cls, binary: bytes) -> "ObjectRef":
+        return cls(ObjectID(binary))
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def id_binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def task_id(self):
+        return self._id.task_id()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __del__(self):
+        cb = ObjectRef._on_delete
+        if cb is not None:
+            try:
+                cb(self._id)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Plain pickling (outside the SerializationContext) loses ownership
+        # tracking but keeps the id intact — same contract as the reference.
+        return (ObjectRef.from_binary, (self._id.binary(),))
+
+    # Allow `await ref` in async actors / drivers.
+    def __await__(self):
+        from ray_tpu._private.worker import get_async
+
+        return get_async(self).__await__()
+
+    def future(self):
+        """A concurrent.futures.Future resolving to this object's value."""
+        import concurrent.futures
+        import threading
+
+        from ray_tpu._private.worker import global_worker
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        api = global_worker()
+
+        def resolve():
+            try:
+                fut.set_result(api.get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=resolve, daemon=True).start()
+        return fut

@@ -1,0 +1,40 @@
+"""TPU-native parallelism layer.
+
+The accelerator "communication backend": where the reference wires NCCL
+process groups (``python/ray/util/collective``, ``train/torch/config.py``),
+ray_tpu emits XLA collectives (psum / all_gather / ppermute / all_to_all)
+inside jit-compiled SPMD programs over a ``jax.sharding.Mesh`` — the compiler
+schedules them onto ICI. This package provides:
+
+- ``mesh``: named device meshes (dp/fsdp/ep/pp/sp/tp axes) + logical sharding rules
+- ``collectives``: out-of-band-style collective API for host-level code
+- ``ring_attention``: blockwise ring attention over an ICI ring (sequence/context parallelism)
+- ``ulysses``: all-to-all head/sequence parallelism (the SP alternative)
+- ``pipeline``: collective-permute GPipe pipeline parallelism
+- ``moe``: expert-parallel mixture-of-experts with all_to_all token routing
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    logical_sharding,
+    with_sharding,
+    DEFAULT_RULES,
+)
+from ray_tpu.parallel.ring_attention import ring_attention
+from ray_tpu.parallel.ulysses import ulysses_attention
+from ray_tpu.parallel.pipeline import pipeline_apply
+from ray_tpu.parallel.moe import moe_layer, moe_init
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "logical_sharding",
+    "with_sharding",
+    "DEFAULT_RULES",
+    "ring_attention",
+    "ulysses_attention",
+    "pipeline_apply",
+    "moe_layer",
+    "moe_init",
+]

@@ -1,0 +1,161 @@
+"""Named device meshes + logical sharding rules.
+
+The sharding backbone (replaces the reference's NCCL group bootstrap,
+``util/collective/collective.py:150`` — on TPU the "group" is a mesh axis and
+the "backend" is the XLA compiler). Axis vocabulary, in canonical order:
+
+- ``dp``   data parallel (batch split, gradient psum)
+- ``fsdp`` fully-sharded data parallel (params/optimizer sharded over data axis — ZeRO analog)
+- ``ep``   expert parallel (MoE experts)
+- ``pp``   pipeline parallel (layer stages)
+- ``sp``   sequence/context parallel (ring attention / Ulysses)
+- ``tp``   tensor parallel (Megatron-style within-layer sharding)
+
+Logical dimension names ('batch', 'seq', 'embed', ...) map to mesh axes via
+rules, so model code annotates *meaning* and deployment picks the mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_ORDER = ("dp", "fsdp", "ep", "pp", "sp", "tp")
+
+# logical dim -> mesh axis (or tuple of axes, tried in order; None = replicate)
+DEFAULT_RULES: dict[str, Any] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",  # fsdp shards params along embed
+    "mlp": "tp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "vocab": "tp",
+    "expert": "ep",
+    "stage": "pp",
+    "norm": None,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh: axis sizes (use -1 for one inferred axis)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def total(self) -> int:
+        t = 1
+        for v in self.sizes().values():
+            t *= v
+        return t
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = self.sizes()
+        negs = [a for a, v in sizes.items() if v == -1]
+        if len(negs) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if negs:
+            known = 1
+            for a, v in sizes.items():
+                if v != -1:
+                    known *= v
+            if n_devices % known:
+                raise ValueError(
+                    f"cannot infer axis {negs[0]}: {n_devices} devices not divisible by {known}"
+                )
+            sizes[negs[0]] = n_devices // known
+            return MeshSpec(**sizes)
+        if self.total() != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {self.total()} devices, have {n_devices}"
+            )
+        return self
+
+
+def build_mesh(
+    spec: MeshSpec | None = None,
+    devices: Optional[Sequence] = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` with named axes in canonical order.
+
+    Axis order maps the innermost axes (tp, sp) to the fastest/nearest ICI
+    neighbors — XLA's device assignment for TPU favors trailing mesh dims for
+    adjacency, which is where tensor-parallel collectives must live.
+    """
+    if spec is None:
+        spec = MeshSpec(**{a: axis_sizes.get(a, 1) for a in AXIS_ORDER})
+    if devices is None:
+        devices = jax.devices()
+    spec = spec.resolve(len(devices))
+    sizes = spec.sizes()
+    arr = np.asarray(devices).reshape([sizes[a] for a in AXIS_ORDER])
+    return Mesh(arr, AXIS_ORDER)
+
+
+def _axes_for(logical: str, rules: dict, mesh: Mesh, taken: set) -> Any:
+    rule = rules.get(logical, None)
+    if rule is None:
+        return None
+    candidates = rule if isinstance(rule, tuple) else (rule,)
+    chosen = []
+    for axis in candidates:
+        if axis in mesh.axis_names and mesh.shape[axis] > 1 and axis not in taken:
+            chosen.append(axis)
+    if not chosen:
+        return None
+    for a in chosen:
+        taken.add(a)
+    return tuple(chosen) if len(chosen) > 1 else chosen[0]
+
+
+def logical_sharding(
+    mesh: Mesh, *logical_dims: Optional[str], rules: Optional[dict] = None
+) -> NamedSharding:
+    """NamedSharding for an array whose dims have the given logical names."""
+    rules = rules or DEFAULT_RULES
+    taken: set = set()
+    parts = [_axes_for(d, rules, mesh, taken) if d else None for d in logical_dims]
+    return NamedSharding(mesh, PartitionSpec(*parts))
+
+
+def logical_pspec(
+    mesh: Mesh, *logical_dims: Optional[str], rules: Optional[dict] = None
+) -> PartitionSpec:
+    return logical_sharding(mesh, *logical_dims, rules=rules).spec
+
+
+def with_sharding(mesh: Mesh, x, *logical_dims, rules: Optional[dict] = None):
+    """``jax.lax.with_sharding_constraint`` by logical dim names."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, *logical_dims, rules=rules)
+    )
+
+
+def shard_params(mesh: Mesh, params, param_logical_fn, rules=None):
+    """Apply NamedShardings to a param pytree.
+
+    ``param_logical_fn(path, leaf) -> tuple of logical dim names``.
+    """
+    rules = rules or DEFAULT_RULES
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        dims = param_logical_fn(path, leaf)
+        sh = logical_sharding(mesh, *dims, rules=rules)
+        out.append(jax.device_put(leaf, sh))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,152 @@
+"""Expert-parallel mixture-of-experts with all_to_all token routing.
+
+The reference has no native MoE/expert parallelism (delegated to vLLM engine
+kwargs, SURVEY §2.4). Here: experts are sharded over the ``ep`` mesh axis;
+tokens are routed top-k with a fixed capacity (static shapes for XLA), shipped
+to their experts with ``jax.lax.all_to_all`` over ICI, transformed, and
+combined back weighted by router probabilities. Switch-Transformer style
+dispatch/combine, dense-einsum formulation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def moe_init(key, num_experts: int, d_model: int, d_ff: int, dtype=jnp.float32):
+    """Params for a SwiGLU expert bank + router."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    scale_in = d_model**-0.5
+    scale_out = d_ff**-0.5
+    return {
+        "router": jax.random.normal(k1, (d_model, num_experts), dtype) * scale_in,
+        "w_gate": jax.random.normal(k2, (num_experts, d_model, d_ff), dtype) * scale_in,
+        "w_up": jax.random.normal(k3, (num_experts, d_model, d_ff), dtype) * scale_in,
+        "w_down": jax.random.normal(k4, (num_experts, d_ff, d_model), dtype) * scale_out,
+    }
+
+
+def _expert_ffn(params, x):
+    """x: [E_local, C_total, d] — SwiGLU per expert."""
+    gate = jnp.einsum("ecd,edf->ecf", x, params["w_gate"])
+    up = jnp.einsum("ecd,edf->ecf", x, params["w_up"])
+    return jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, params["w_down"])
+
+
+def _moe_local(params, x, *, axis_name: str, num_experts: int, top_k: int, capacity: int, token_axes: tuple = ()):
+    """Per-device body under shard_map.
+
+    x: [G_local, d] local tokens; experts sharded over ``axis_name``
+    (params' leading expert dim is E_local = E / ep locally).
+    """
+    ep = jax.lax.psum(1, axis_name)
+    G, d = x.shape
+    E = num_experts
+    C = capacity
+
+    E_l = E // ep
+
+    logits = x @ params["router"]  # [G, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # [G, k]
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # Position of each (token, choice) within its expert's capacity buffer.
+    onehot_e = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [G, k, E]
+    flat = onehot_e.reshape(G * top_k, E)
+    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(G, top_k, E)
+    pos = (pos_in_expert * onehot_e).sum(-1)  # [G, k]
+    keep = (pos < C).astype(x.dtype)  # drop overflow beyond capacity
+
+    oe = onehot_e.astype(x.dtype)  # [G, k, E]
+    oc = jax.nn.one_hot(pos, C, dtype=x.dtype)  # [G, k, C]
+    # dispatch[g,e,c]: token g occupies slot c of expert e.
+    disp = jnp.einsum("gke,gkc,gk->gec", oe, oc, keep)
+    # combine[g,e,c]: dispatch weighted by (renormalized) gate value.
+    comb = jnp.einsum("gke,gkc,gk->gec", oe, oc, keep * gate_vals.astype(x.dtype))
+
+    expert_in = jnp.einsum("gd,gec->ecd", x, disp)  # [E, C, d]
+
+    # Ship buffers to expert owners over ICI. Symmetric untiled all_to_all on
+    # the leading (destination-device) dim is its own inverse.
+    a = expert_in.reshape(ep, E_l, C, d)
+    b = jax.lax.all_to_all(a, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    # b: [ep(src), E_l, C, d] -> [E_l, ep*C, d]
+    expert_tokens = b.transpose(1, 0, 2, 3).reshape(E_l, ep * C, d)
+
+    out = _expert_ffn(params, expert_tokens)  # [E_l, ep*C, d]
+
+    back = out.reshape(E_l, ep, C, d).transpose(1, 0, 2, 3)  # [ep(dst), E_l, C, d]
+    ret = jax.lax.all_to_all(back, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    returned = ret.reshape(E, C, d)
+
+    y = jnp.einsum("ecd,gec->gd", returned, comb)
+
+    # Aux load-balancing loss (Switch style): mean_prob · mean_assignment,
+    # psum'd over token shards so every device sees the global value.
+    me = probs.mean(axis=0)  # [E]
+    ce = onehot_e.astype(jnp.float32).sum(axis=1).mean(axis=0)  # [E]
+    aux = (me * ce).sum() * E
+    if token_axes:
+        aux = jax.lax.pmean(aux, axis_name=token_axes)
+    return y, aux
+
+
+def moe_layer(
+    params,
+    x,
+    mesh: Mesh,
+    *,
+    axis_name: str = "ep",
+    num_experts: int,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    x_spec: Optional[P] = None,
+    tokens_axis_names: tuple = ("dp", "sp"),
+):
+    """Apply an expert-parallel MoE FFN.
+
+    Args:
+      params: from ``moe_init`` — expert dim sharded over ``axis_name``.
+      x: [tokens, d_model] (token dim sharded over ``tokens_axis_names``).
+    Returns (y: [tokens, d_model], aux_loss scalar).
+    """
+    ep = mesh.shape[axis_name]
+    if num_experts % ep:
+        raise ValueError(f"num_experts {num_experts} not divisible by ep={ep}")
+    token_axes = tuple(a for a in tokens_axis_names if a in mesh.axis_names and mesh.shape[a] > 1)
+    if x_spec is None:
+        x_spec = P(token_axes if token_axes else None, None)
+    n_token_shards = 1
+    for a in token_axes:
+        n_token_shards *= mesh.shape[a]
+    local_tokens = x.shape[0] // max(n_token_shards, 1)
+    capacity = max(1, int(capacity_factor * local_tokens * top_k / num_experts))
+
+    params_spec = {
+        "router": P(None, None),
+        "w_gate": P(axis_name, None, None),
+        "w_up": P(axis_name, None, None),
+        "w_down": P(axis_name, None, None),
+    }
+    fn = jax.shard_map(
+        functools.partial(
+            _moe_local,
+            axis_name=axis_name,
+            num_experts=num_experts,
+            top_k=top_k,
+            capacity=capacity,
+            token_axes=token_axes,
+        ),
+        mesh=mesh,
+        in_specs=(params_spec, x_spec),
+        out_specs=(x_spec, P()),
+        check_vma=False,
+    )
+    y, aux = fn(params, x)
+    return y, aux

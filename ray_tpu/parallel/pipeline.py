@@ -1,0 +1,113 @@
+"""Pipeline parallelism: GPipe schedule over a collective-permute ring.
+
+The reference only gets PP by delegating to vLLM / compiled-graph actor
+pipelines (SURVEY §2.4). On TPU, a pipeline stage boundary inside one XLA
+program is a ``ppermute`` to the next ``pp`` mesh neighbor: every device holds
+one stage's weights; microbatches flow stage-to-stage; the scan body overlaps
+compute with neighbor transfer (XLA schedules the collective-permute
+asynchronously against the stage computation).
+
+Schedule: plain GPipe — T = num_microbatches + pp - 1 ticks; stage s computes
+microbatch (t - s) at tick t. Bubble fraction = (pp-1)/T, amortized by
+num_microbatches.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(params, x, *, stage_fn, axis_name, num_microbatches):
+    """Per-device body. params: this stage's weights (pp-sharded, leading
+    stage dim stripped by shard_map). x: [M, mb, ...] microbatched input
+    (every stage receives the same input array; only stage 0 reads it)."""
+    pp = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    M = num_microbatches
+    T = M + pp - 1
+
+    mb_shape = x.shape[1:]
+    state = jnp.zeros(mb_shape, x.dtype)  # activation currently in this stage
+    outputs = jnp.zeros((M,) + mb_shape, x.dtype)
+
+    shift_perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Receive previous stage's output (stage 0 receives garbage from the
+        # wrap-around edge and overwrites it with fresh input below).
+        incoming = jax.lax.ppermute(state, axis_name, shift_perm)
+        mb_idx = jnp.clip(t, 0, M - 1)
+        fresh = jax.lax.dynamic_index_in_dim(x, mb_idx, axis=0, keepdims=False)
+        inp = jnp.where(stage == 0, fresh, incoming)
+        out = stage_fn(params, inp)
+        # Last stage stores its result for microbatch (t - (pp-1)).
+        out_idx = jnp.clip(t - (pp - 1), 0, M - 1)
+        should_store = jnp.logical_and(stage == pp - 1, t >= pp - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(should_store, out, jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)), out_idx, 0
+        )
+        return (out, updated), None
+
+    (state, outputs), _ = jax.lax.scan(tick, (state, outputs), jnp.arange(T))
+    # Results live on the last stage; broadcast to all stages so the caller
+    # sees a replicated output (psum of a one-hot mask).
+    mask = (stage == pp - 1).astype(outputs.dtype)
+    outputs = jax.lax.psum(outputs * mask, axis_name)
+    return outputs
+
+
+def pipeline_apply(
+    stage_params,
+    x_microbatches,
+    stage_fn: Callable,
+    mesh: Mesh,
+    *,
+    axis_name: str = "pp",
+    params_spec=None,
+    x_spec: P | None = None,
+):
+    """Run a GPipe pipeline over the ``pp`` mesh axis.
+
+    Args:
+      stage_params: pytree whose leaves have a leading dim == pp (one slice
+        per stage).
+      x_microbatches: [M, mb, ...] input microbatches (replicated over pp).
+      stage_fn: (params_slice, activation) -> activation, same shape.
+    Returns [M, mb, ...] outputs, replicated over ``axis_name``.
+    """
+    pp = mesh.shape[axis_name]
+    M = x_microbatches.shape[0]
+    inner_fn = stage_fn
+    if params_spec is None:
+        params_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
+        inner_fn = _strip_stage_dim(stage_fn)
+    if x_spec is None:
+        x_spec = P()
+    local = functools.partial(
+        _pipeline_local, stage_fn=inner_fn, axis_name=axis_name, num_microbatches=M
+    )
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(params_spec, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )
+    return fn(stage_params, x_microbatches)
+
+
+def _strip_stage_dim(stage_fn):
+    """shard_map leaves a leading length-1 stage dim on pp-sharded params;
+    strip it before calling user code."""
+
+    def wrapped(params, x):
+        squeezed = jax.tree.map(lambda p: p[0] if p.ndim >= 1 and p.shape[0] == 1 else p, params)
+        return stage_fn(squeezed, x)
+
+    return wrapped

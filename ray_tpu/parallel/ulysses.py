@@ -1,0 +1,67 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The SP alternative to ring attention (DeepSpeed-Ulysses recipe, reimplemented
+on XLA): q/k/v arrive sequence-sharded [B, T/P, H, D]; an ``all_to_all`` over
+the ``sp`` axis regathers the full sequence while scattering heads
+[B, T, H/P, D]; each device runs *dense* attention for its head subset; a
+second all_to_all restores sequence sharding. Two all-to-alls ride ICI; the
+attention itself is local — best when H ≥ sp and T_local is small enough to
+hold the full sequence per device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel.ring_attention import full_attention_reference
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
+    # q: [B, T_local, H, D] -> all_to_all -> [B, T, H_local, D]
+    def seq_to_heads(x):
+        # split_axis=2 (heads), concat_axis=1 (seq)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = full_attention_reference(qh, kh, vh, causal=causal)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    qkv_spec: Optional[P] = None,
+):
+    """All-to-all sequence-parallel attention. Shapes as ``ring_attention``.
+
+    Requires num_heads % mesh.shape[axis_name] == 0.
+    """
+    sp = mesh.shape[axis_name]
+    if q.shape[2] % sp:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by {axis_name}={sp}"
+        )
+    if qkv_spec is None:
+        batch_axis = "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None
+        qkv_spec = P(batch_axis, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,77 @@
+"""Remote functions (reference: ``python/ray/remote_function.py:314``)."""
+
+from __future__ import annotations
+
+import cloudpickle
+
+from ray_tpu._private.task_spec import SchedulingStrategy
+
+
+def _resources_from_options(options: dict) -> dict[str, float]:
+    resources = dict(options.get("resources") or {})
+    if "num_cpus" in options and options["num_cpus"] is not None:
+        resources["CPU"] = float(options["num_cpus"])
+    else:
+        resources.setdefault("CPU", 1.0)
+    if options.get("num_tpus"):
+        resources["TPU"] = float(options["num_tpus"])
+    if options.get("num_gpus"):
+        resources["GPU"] = float(options["num_gpus"])
+    if options.get("memory"):
+        resources["memory"] = float(options["memory"])
+    return {k: v for k, v in resources.items() if v}
+
+
+def _strategy_from_options(options: dict) -> SchedulingStrategy:
+    strat = options.get("scheduling_strategy")
+    if strat is None:
+        return SchedulingStrategy()
+    if isinstance(strat, str):
+        return SchedulingStrategy(kind=strat.lower())
+    return strat.to_spec()
+
+
+class RemoteFunction:
+    def __init__(self, function, options: dict):
+        self._function = function
+        self._options = dict(options)
+        self._function_blob = None
+        self.__name__ = getattr(function, "__name__", "anonymous")
+        self.__doc__ = getattr(function, "__doc__", None)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self.__name__}() cannot be called directly; "
+            f"use {self.__name__}.remote()."
+        )
+
+    def options(self, **new_options):
+        merged = dict(self._options)
+        merged.update(new_options)
+        rf = RemoteFunction(self._function, merged)
+        rf._function_blob = self._function_blob
+        return rf
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.worker import global_worker
+
+        if self._function_blob is None:
+            self._function_blob = cloudpickle.dumps(self._function)
+        opts = self._options
+        num_returns = opts.get("num_returns", 1)
+        refs = global_worker().submit_task(
+            self._function,
+            args,
+            kwargs,
+            name=opts.get("name") or self.__name__,
+            num_returns=num_returns,
+            resources=_resources_from_options(opts),
+            max_retries=opts.get("max_retries", 0),
+            strategy=_strategy_from_options(opts),
+            runtime_env=opts.get("runtime_env"),
+            function_blob=self._function_blob,
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    # Convenience parity with reference `.bind()` omitted until compiled
+    # graphs land (ray_tpu.dag).

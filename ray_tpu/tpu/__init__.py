@@ -1,0 +1,4 @@
+from ray_tpu.tpu.accelerator import TPUAcceleratorManager
+from ray_tpu.tpu.topology import SliceTopology, TPU_GENERATIONS
+
+__all__ = ["TPUAcceleratorManager", "SliceTopology", "TPU_GENERATIONS"]

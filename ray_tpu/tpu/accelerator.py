@@ -1,0 +1,145 @@
+"""TPU accelerator manager: detection + per-task chip visibility.
+
+Analog of the reference's ``TPUAcceleratorManager``
+(``python/ray/_private/accelerators/tpu.py:110``): detects local chips (env
+first — GKE-style vars — then the JAX runtime if already loaded; GCE metadata
+needs network and is optional), names the ``TPU`` resource, and computes the
+``TPU_VISIBLE_CHIPS``/``TPU_CHIPS_PER_HOST_BOUNDS`` env for sub-host
+partitioning. Tests monkeypatch the env exactly like the reference's
+``tests/accelerators/test_tpu.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+from ray_tpu.tpu.topology import SliceTopology, TPU_GENERATIONS
+
+RESOURCE_NAME = "TPU"
+
+# GKE-style env vars (reference tpu.py:16-30).
+ENV_ACCEL_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_WORKER_ID = "TPU_WORKER_ID"
+ENV_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_CHIPS_PER_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
+ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_NAME = "TPU_NAME"
+
+
+class TPUAcceleratorManager:
+    @staticmethod
+    def get_resource_name() -> str:
+        return RESOURCE_NAME
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        accel = os.environ.get(ENV_ACCEL_TYPE)
+        if accel:
+            return accel
+        # JAX runtime (only if already imported — importing jax here would
+        # grab the chip in processes that shouldn't touch it).
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                devs = jax.local_devices()
+                if devs and devs[0].platform == "tpu":
+                    kind = devs[0].device_kind.lower()
+                    n = len(devs)
+                    for gen in ("v6e", "v5p", "v5e", "v5", "v4", "v3", "v2"):
+                        if gen in kind or gen in kind.replace(" ", ""):
+                            g = "v5e" if gen == "v5" and "lite" in kind else gen
+                            cores = TPU_GENERATIONS.get(g, (4, 1, 2))[1]
+                            return f"{g}-{n * cores}"
+            except Exception:
+                return None
+        return None
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        bounds = os.environ.get(ENV_CHIPS_PER_HOST_BOUNDS)
+        if bounds:
+            try:
+                dims = [int(x) for x in bounds.split(",")]
+                n = 1
+                for d in dims:
+                    n *= d
+                return n
+            except ValueError:
+                pass
+        accel = os.environ.get(ENV_ACCEL_TYPE)
+        if accel:
+            try:
+                topo = SliceTopology.from_accelerator_type(accel)
+                return topo.chips_per_host
+            except ValueError:
+                pass
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                devs = jax.local_devices()
+                if devs and devs[0].platform == "tpu":
+                    return len(devs)
+            except Exception:
+                return 0
+        return 0
+
+    @staticmethod
+    def get_current_slice() -> Optional[SliceTopology]:
+        accel = TPUAcceleratorManager.get_current_node_accelerator_type()
+        if accel is None:
+            return None
+        try:
+            return SliceTopology.from_accelerator_type(accel)
+        except ValueError:
+            return None
+
+    @staticmethod
+    def get_current_node_tpu_worker_id() -> Optional[int]:
+        v = os.environ.get(ENV_WORKER_ID)
+        return int(v) if v is not None and v.isdigit() else None
+
+    @staticmethod
+    def get_current_pod_name() -> Optional[str]:
+        return os.environ.get(ENV_NAME) or None
+
+    @staticmethod
+    def get_current_pod_worker_count() -> Optional[int]:
+        hostnames = os.environ.get(ENV_WORKER_HOSTNAMES)
+        if hostnames:
+            return len(hostnames.split(","))
+        slice_ = TPUAcceleratorManager.get_current_slice()
+        return slice_.num_hosts if slice_ else None
+
+    @staticmethod
+    def validate_resource_request_quantity(quantity: float) -> tuple[bool, Optional[str]]:
+        """Sub-host chip requests must be 1, 2, 4 or 8 so visibility bounds
+        tile the host (reference tpu.py:180)."""
+        if quantity != int(quantity):
+            return False, "TPU resource quantities must be whole chips"
+        if int(quantity) not in (1, 2, 4, 8):
+            return (
+                False,
+                f"got {int(quantity)} TPU chips; only 1, 2, 4 or 8 chips per "
+                f"task are schedulable on a single host",
+            )
+        return True, None
+
+    @staticmethod
+    def get_visibility_env(chip_ids: list[int]) -> dict[str, str]:
+        """Env for a worker restricted to ``chip_ids`` on this host
+        (reference tpu.py:194-229)."""
+        n = len(chip_ids)
+        env = {ENV_VISIBLE_CHIPS: ",".join(str(c) for c in chip_ids)}
+        if n == 1:
+            env[ENV_CHIPS_PER_HOST_BOUNDS] = "1,1,1"
+            env["TPU_HOST_BOUNDS"] = "1,1,1"
+        elif n == 2:
+            env[ENV_CHIPS_PER_HOST_BOUNDS] = "1,2,1"
+            env["TPU_HOST_BOUNDS"] = "1,1,1"
+        elif n == 4:
+            env[ENV_CHIPS_PER_HOST_BOUNDS] = "2,2,1"
+            env["TPU_HOST_BOUNDS"] = "1,1,1"
+        return env

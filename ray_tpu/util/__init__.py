@@ -1,0 +1,13 @@
+from ray_tpu.util.placement_group import (
+    placement_group,
+    remove_placement_group,
+    get_current_placement_group,
+    PlacementGroup,
+)
+
+__all__ = [
+    "placement_group",
+    "remove_placement_group",
+    "get_current_placement_group",
+    "PlacementGroup",
+]

@@ -1,0 +1,77 @@
+"""Placement groups — gang resource reservation.
+
+Reference: ``python/ray/util/placement_group.py:146`` (API),
+``src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h`` (2-phase bundle
+reservation), ``src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h``
+(PACK/SPREAD/STRICT_PACK/STRICT_SPREAD). On TPU, placement groups are the
+gang-scheduling primitive for pod slices: one bundle per slice host, placed
+STRICT_PACK-per-slice so an XLA program never spans a partial slice (see
+``ray_tpu.tpu.slices``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ray_tpu._private.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+_current_pg = threading.local()
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: list[dict], strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker().controller_call("pg_ready", (self.id, timeout))
+
+    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        return self.ready(timeout=timeout_seconds)
+
+    @property
+    def bundle_specs(self) -> list[dict]:
+        return self.bundles
+
+    def table(self) -> dict:
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker().controller_call("pg_table", self.id)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles, self.strategy))
+
+
+def placement_group(
+    bundles: list[dict],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    from ray_tpu._private.worker import global_worker
+
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}, got {strategy}")
+    if not bundles:
+        raise ValueError("placement group requires at least one bundle")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"invalid bundle: {b}")
+    pg_id = global_worker().controller_call("pg_create", (bundles, strategy, name))
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu._private.worker import global_worker
+
+    global_worker().controller_call("pg_remove", pg.id)
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    return getattr(_current_pg, "value", None)

@@ -1,0 +1,37 @@
+"""Scheduling strategies (reference: ``python/ray/util/scheduling_strategies.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.task_spec import SchedulingStrategy as _Spec
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group, placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = placement_group_capture_child_tasks
+
+    def to_spec(self) -> _Spec:
+        return _Spec(
+            kind="placement_group",
+            placement_group_id=self.placement_group.id,
+            bundle_index=self.placement_group_bundle_index,
+        )
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+    def to_spec(self) -> _Spec:
+        return _Spec(kind="node_affinity", node_id=NodeID(bytes.fromhex(self.node_id)), soft=self.soft)
+
+
+class SpreadSchedulingStrategy:
+    def to_spec(self) -> _Spec:
+        return _Spec(kind="spread")

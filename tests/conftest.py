@@ -1,0 +1,57 @@
+"""Test fixtures.
+
+- Forces JAX onto a virtual 8-device CPU mesh (multi-chip sharding tests run
+  without TPU hardware, mirroring the reference's mocked-accelerator strategy,
+  SURVEY §4 / tests/accelerators/*).
+- ``ray_start`` fixtures mirror the reference's ``ray_start_regular`` /
+  ``ray_start_cluster`` (``python/ray/tests/conftest.py:588/678``).
+"""
+
+import os
+
+# Must be set before jax import (workers inherit via env). Force CPU even if
+# the outer env points at a TPU — unit tests run on the virtual 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# jax may already be imported (site customization) with a TPU platform baked
+# into its config defaults; force CPU for the test session.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_thread():
+    """Thread-mode runtime: fast, in-process (local_mode analog)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, mode="thread")
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_process():
+    """Process-mode runtime: real worker processes + shared-memory objects."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, mode="process")
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-(fake-)node cluster fixture."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 4, "mode": "thread"})
+    yield cluster
+    cluster.shutdown()

@@ -1,0 +1,293 @@
+"""Core API tests: tasks, objects, actors, wait, errors.
+
+Modeled on the reference's ``python/ray/tests/test_basic.py`` coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(ray_start_thread):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_numpy_large(ray_start_thread):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_start_thread):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_kwargs_and_refs(ray_start_thread):
+    @ray_tpu.remote
+    def combine(a, b, c=0):
+        return a + b + c
+
+    x = ray_tpu.put(10)
+    y = combine.remote(1, b=x, c=2)
+    assert ray_tpu.get(y) == 13
+
+
+def test_task_chaining(ray_start_thread):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(9):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 10
+
+
+def test_num_returns(ray_start_thread):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_thread):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("broken")
+
+    with pytest.raises(ValueError, match="broken"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_propagates_through_chain(ray_start_thread):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("origin")
+
+    @ray_tpu.remote
+    def passthrough(x):
+        return x
+
+    with pytest.raises(Exception):
+        ray_tpu.get(passthrough.remote(boom.remote()))
+
+
+def test_wait(ray_start_thread):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(ray_start_thread):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_nested_tasks(ray_start_thread):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_actor_basic(ray_start_thread):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.value = start
+
+        def incr(self, by=1):
+            self.value += by
+            return self.value
+
+        def get_value(self):
+            return self.value
+
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.incr.remote()) == 6
+    assert ray_tpu.get(c.incr.remote(4)) == 10
+    assert ray_tpu.get(c.get_value.remote()) == 10
+
+
+def test_actor_ordering(ray_start_thread):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+            return list(self.items)
+
+    a = Appender.remote()
+    refs = [a.append.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs[-1]) == list(range(20))
+
+
+def test_actor_error(ray_start_thread):
+    @ray_tpu.remote
+    class Faulty:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return 42
+
+    f = Faulty.remote()
+    with pytest.raises(RuntimeError, match="actor method failed"):
+        ray_tpu.get(f.fail.remote())
+    # Actor survives method errors.
+    assert ray_tpu.get(f.ok.remote()) == 42
+
+
+def test_named_actor(ray_start_thread):
+    from ray_tpu.actor import get_actor
+
+    @ray_tpu.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="reg").remote()
+    handle = get_actor("reg")
+    assert ray_tpu.get(handle.ping.remote()) == "pong"
+
+
+def test_actor_handle_passing(ray_start_thread):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @ray_tpu.remote
+    def writer(store, v):
+        ray_tpu.get(store.set.remote(v))
+        return True
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s, 123))
+    assert ray_tpu.get(s.get.remote()) == 123
+
+
+def test_kill_actor(ray_start_thread):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "pong"
+    ray_tpu.kill(v)
+    time.sleep(0.2)
+    with pytest.raises(ray_tpu.ActorError):
+        ray_tpu.get(v.ping.remote(), timeout=5)
+
+
+def test_cluster_and_available_resources(ray_start_thread):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 8.0
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU") == 8.0
+
+
+def test_resource_gating(ray_start_thread):
+    # A task demanding more CPU than exists should never run.
+    @ray_tpu.remote(num_cpus=100)
+    def impossible():
+        return 1
+
+    ref = impossible.remote()
+    ready, not_ready = ray_tpu.wait([ref], timeout=0.5)
+    assert not ready
+
+
+def test_jax_array_roundtrip(ray_start_thread):
+    import jax.numpy as jnp
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    x = jnp.arange(16, dtype=jnp.float32)
+    out = ray_tpu.get(double.remote(x))
+    np.testing.assert_array_equal(np.asarray(out), np.arange(16) * 2)
+
+
+def test_actor_task_with_pending_dep_runs_once(ray_start_thread):
+    """Regression: a head-of-line actor call waiting on a dep must execute
+    exactly once when the dep arrives (no double-dispatch)."""
+
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(0.3)
+        return 7
+
+    @ray_tpu.remote
+    class Tally:
+        def __init__(self):
+            self.calls = 0
+
+        def add(self, v):
+            self.calls += 1
+            return (self.calls, v)
+
+    t = Tally.remote()
+    dep = slow_value.remote()
+    ref = t.add.remote(dep)
+    calls, v = ray_tpu.get(ref, timeout=30)
+    assert (calls, v) == (1, 7)
+    # A follow-up call must still be processed (inflight not leaked).
+    calls2, _ = ray_tpu.get(t.add.remote(0), timeout=30)
+    assert calls2 == 2
+
+
+def test_pg_becomes_ready_when_resources_free(ray_start_thread):
+    """Regression: a pending placement group must be placed when running
+    tasks release their resources — not only at creation time."""
+
+    @ray_tpu.remote(num_cpus=8)
+    def hog():
+        time.sleep(1.0)
+        return True
+
+    h = hog.remote()
+    time.sleep(0.2)  # let it occupy the node first
+    pg = ray_tpu.placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    assert ray_tpu.get(h, timeout=30)
