@@ -1,0 +1,93 @@
+"""Flagship benchmark: Llama train-step MFU on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: BASELINE.json north-star = 40% MFU (Llama DP train on v5e).
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    from ray_tpu.models import LlamaConfig
+    from ray_tpu.models.training import make_train_step, flops_per_token
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    if on_tpu:
+        # ~335M-param model: big enough to saturate the MXU, fits one v5e
+        # chip (16 GiB HBM) with fp32 adam moments + remat.
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            d_model=1024,
+            n_layers=16,
+            n_heads=16,
+            n_kv_heads=16,
+            d_ff=4096,
+            max_seq_len=2048,
+            dtype=jnp.bfloat16,
+            remat=True,
+        )
+        batch, seq, steps, warmup = 8, 2048, 10, 3
+        peak_flops = 197e12  # v5e bf16
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps, warmup = 4, 64, 3, 1
+        peak_flops = 1e12  # nominal; CPU numbers aren't the target
+
+    mesh = build_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+    init_fn, step_fn = make_train_step(cfg, mesh)
+    state = init_fn(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "tokens": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (batch, seq + 1)), dtype=jnp.int32
+        )
+    }
+
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    achieved_mfu = tokens_per_sec * flops_per_token(cfg) / peak_flops
+    baseline_mfu = 0.40  # BASELINE.json north-star target
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_mfu_1chip",
+                "value": round(achieved_mfu, 4),
+                "unit": "mfu_fraction",
+                "vs_baseline": round(achieved_mfu / baseline_mfu, 4),
+                "tokens_per_sec": round(tokens_per_sec, 1),
+                "platform": platform,
+                "model_params": cfg.num_params(),
+                "loss": float(metrics["loss"]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never leave the driver without a JSON line
+        print(json.dumps({"metric": "llama_train_mfu_1chip", "value": 0.0,
+                          "unit": "mfu_fraction", "vs_baseline": 0.0,
+                          "error": repr(e)}))
+        sys.exit(1)

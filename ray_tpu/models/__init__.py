@@ -1,0 +1,29 @@
+"""TPU-native model zoo.
+
+The reference ships no native model layer (its LLM path delegates to vLLM,
+``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py``); here
+models are first-class JAX programs so the framework's train/serve layers can
+shard them over a ``jax.sharding.Mesh`` directly.
+"""
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    init_params,
+    forward,
+    loss_fn,
+    param_logical_dims,
+    init_kv_cache,
+    prefill,
+    decode_step,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "param_logical_dims",
+    "init_kv_cache",
+    "prefill",
+    "decode_step",
+]

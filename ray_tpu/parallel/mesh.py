@@ -107,14 +107,23 @@ def build_mesh(
     return Mesh(arr, AXIS_ORDER)
 
 
-def _axes_for(logical: str, rules: dict, mesh: Mesh, taken: set) -> Any:
+def _axes_for(
+    logical: str, rules: dict, mesh: Mesh, taken: set, dim_size: Optional[int]
+) -> Any:
     rule = rules.get(logical, None)
     if rule is None:
         return None
     candidates = rule if isinstance(rule, tuple) else (rule,)
     chosen = []
+    shard_factor = 1
     for axis in candidates:
         if axis in mesh.axis_names and mesh.shape[axis] > 1 and axis not in taken:
+            # a dim can only shard over axes whose product divides its size
+            if dim_size is not None and dim_size % (
+                shard_factor * mesh.shape[axis]
+            ):
+                continue
+            shard_factor *= mesh.shape[axis]
             chosen.append(axis)
     if not chosen:
         return None
@@ -124,12 +133,22 @@ def _axes_for(logical: str, rules: dict, mesh: Mesh, taken: set) -> Any:
 
 
 def logical_sharding(
-    mesh: Mesh, *logical_dims: Optional[str], rules: Optional[dict] = None
+    mesh: Mesh,
+    *logical_dims: Optional[str],
+    rules: Optional[dict] = None,
+    shape: Optional[Sequence[int]] = None,
 ) -> NamedSharding:
-    """NamedSharding for an array whose dims have the given logical names."""
+    """NamedSharding for an array whose dims have the given logical names.
+
+    When ``shape`` is given, mesh axes that don't evenly divide a dim are
+    skipped for that dim (e.g. 2 KV heads can't shard over tp=4 → replicate).
+    """
     rules = rules or DEFAULT_RULES
     taken: set = set()
-    parts = [_axes_for(d, rules, mesh, taken) if d else None for d in logical_dims]
+    parts = []
+    for i, d in enumerate(logical_dims):
+        size = shape[i] if shape is not None else None
+        parts.append(_axes_for(d, rules, mesh, taken, size) if d else None)
     return NamedSharding(mesh, PartitionSpec(*parts))
 
 
@@ -142,7 +161,7 @@ def logical_pspec(
 def with_sharding(mesh: Mesh, x, *logical_dims, rules: Optional[dict] = None):
     """``jax.lax.with_sharding_constraint`` by logical dim names."""
     return jax.lax.with_sharding_constraint(
-        x, logical_sharding(mesh, *logical_dims, rules=rules)
+        x, logical_sharding(mesh, *logical_dims, rules=rules, shape=x.shape)
     )
 
 
