@@ -1,0 +1,49 @@
+"""ray_tpu.train — distributed training on TPU slices.
+
+Public surface mirrors the reference's ``ray.train`` (SURVEY §2.3):
+trainers (``JaxTrainer`` ≈ ``TorchTrainer``), configs, ``Checkpoint``,
+``Result``, and the in-loop session API (``report`` / ``get_checkpoint`` /
+``get_context`` / ``get_dataset_shard``).
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint, restore_pytree, save_pytree
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import TrainContext
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+    TorchTrainer,
+)
+
+__all__ = [
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TorchTrainer",
+    "TrainContext",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+    "restore_pytree",
+    "save_pytree",
+]

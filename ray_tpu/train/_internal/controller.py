@@ -1,0 +1,230 @@
+"""Train controller: the state machine that drives a worker group.
+
+Reference: ``train/v2/_internal/execution/controller/controller.py:94`` —
+INITIALIZING → SCHEDULING → RUNNING → (RESTARTING | RESIZING) → FINISHED /
+ERRORED, with pluggable scaling + failure policies.
+
+TPU-first delta (SURVEY §7 "hard parts"): the restart granularity is the
+whole worker group, not one worker — a failed host kills the SPMD program on
+every chip in the slice, so any worker failure tears down and reschedules the
+gang. Elastic policies resize between restart attempts.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import os
+import time
+from typing import Any, Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
+from ray_tpu.train._internal.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class RunState(enum.Enum):
+    INITIALIZING = "INITIALIZING"
+    SCHEDULING = "SCHEDULING"
+    RUNNING = "RUNNING"
+    RESTARTING = "RESTARTING"
+    FINISHED = "FINISHED"
+    ERRORED = "ERRORED"
+
+
+class ScalingPolicy:
+    """Decides group size for each (re)start. Fixed by default; elastic
+    subclass shrinks toward min_workers when restarts keep failing
+    (reference: ``train/v2/_internal/execution/scaling_policy/``)."""
+
+    def __init__(self, scaling: ScalingConfig):
+        self.scaling = scaling
+
+    def group_size(self, attempt: int) -> int:
+        return self.scaling.num_workers
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    def group_size(self, attempt: int) -> int:
+        n = self.scaling.num_workers
+        lo = self.scaling.min_workers or n
+        # back off by powers of two per failed attempt, never below min
+        for _ in range(attempt):
+            if n // 2 >= lo:
+                n //= 2
+        return max(n, lo)
+
+
+class FailurePolicy:
+    """max_failures accounting (reference: ``failure_handling/``)."""
+
+    def __init__(self, max_failures: int):
+        self.max_failures = max_failures
+        self.failures = 0
+
+    def should_retry(self) -> bool:
+        self.failures += 1
+        if self.max_failures < 0:
+            return True
+        return self.failures <= self.max_failures
+
+
+class TrainController:
+    """Runs one training job to completion."""
+
+    def __init__(
+        self,
+        train_fn: Callable,
+        train_fn_config: Optional[dict],
+        scaling: ScalingConfig,
+        run_config: RunConfig,
+        experiment_dir: str,
+        datasets: Optional[dict[str, Any]] = None,
+        trial_id: str = "",
+    ):
+        self.train_fn = train_fn
+        self.train_fn_config = train_fn_config
+        self.scaling = scaling
+        self.run_config = run_config
+        self.experiment_dir = experiment_dir
+        self.datasets = datasets or {}
+        self.trial_id = trial_id
+        self.state = RunState.INITIALIZING
+        self.checkpoint_manager = CheckpointManager(run_config.checkpoint_config)
+        self.scaling_policy = (
+            ElasticScalingPolicy(scaling) if scaling.elastic else ScalingPolicy(scaling)
+        )
+        self.failure_policy = FailurePolicy(run_config.failure_config.max_failures)
+        self.metrics_history: list[dict] = []
+        self.error: Optional[str] = None
+        self._attempt = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self, poll_interval: float = 0.05) -> "TrainResultInternal":
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        while True:
+            group = self._start_group()
+            if group is None:
+                # scheduling/setup failure (e.g. host preempted mid-setup) is
+                # retryable under the same budget as runtime failures
+                if self.failure_policy.should_retry():
+                    self.state = RunState.RESTARTING
+                    self._attempt += 1
+                    continue
+                self.state = RunState.ERRORED
+                break
+            outcome = self._run_until_done(group, poll_interval)
+            group.shutdown()
+            if outcome == "finished":
+                self.state = RunState.FINISHED
+                break
+            # worker failure: gang restart (slice granularity)
+            if not self.failure_policy.should_retry():
+                self.state = RunState.ERRORED
+                if self.error is None:
+                    self.error = "training failed and retry budget exhausted"
+                break
+            self.state = RunState.RESTARTING
+            self._attempt += 1
+            logger.warning(
+                "train worker group failed; restarting (attempt %d)", self._attempt
+            )
+        return TrainResultInternal(
+            metrics=self.metrics_history[-1] if self.metrics_history else {},
+            metrics_history=self.metrics_history,
+            checkpoint=self.checkpoint_manager.latest_checkpoint(),
+            best_checkpoint=self.checkpoint_manager.best_checkpoint(),
+            error=self.error,
+            state=self.state,
+        )
+
+    def _start_group(self) -> Optional[WorkerGroup]:
+        self.state = RunState.SCHEDULING
+        n = self.scaling_policy.group_size(self._attempt)
+        group = WorkerGroup(
+            self.scaling,
+            experiment_name=self.run_config.name or "train",
+            trial_id=self.trial_id,
+        )
+        try:
+            group.start(num_workers=n)
+            # attempt-scoped subdir: a gang restart must never reuse checkpoint
+            # directory names from the crashed attempt (clobber hazard)
+            group.setup(
+                storage_dir=os.path.join(
+                    self.experiment_dir, f"attempt_{self._attempt:03d}"
+                ),
+                latest_checkpoint=self.checkpoint_manager.latest_checkpoint(),
+            )
+            self._attach_datasets(group)
+            group.run(self.train_fn, self.train_fn_config)
+        except Exception as e:  # scheduling failure
+            group.shutdown()
+            self.error = f"failed to start worker group: {e!r}"
+            self.state = RunState.ERRORED
+            return None
+        self.state = RunState.RUNNING
+        return group
+
+    def _attach_datasets(self, group: WorkerGroup):
+        """Split datasets across ranks (DataConfig analog,
+        ``train/_internal/data_config.py``)."""
+        import ray_tpu
+
+        if not self.datasets:
+            return
+        n = group.num_workers
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                shards = ds.streaming_split(n, equal=True)
+            elif hasattr(ds, "split"):
+                shards = ds.split(n)
+            else:
+                shards = [ds] * n  # replicate plain iterables
+            ray_tpu.get(
+                [
+                    w.set_dataset_shard.remote(name, shard)
+                    for w, shard in zip(group.workers, shards)
+                ]
+            )
+
+    def _run_until_done(self, group: WorkerGroup, poll_interval: float) -> str:
+        """Poll loop. Returns 'finished' or 'failed'."""
+        stop = self.run_config.stop or {}
+        while True:
+            polls = group.poll()
+            if any(p is None for p in polls):
+                return "failed"  # a worker actor died
+            rank0 = polls[0]
+            for entry in rank0["results"]:
+                metrics = entry["metrics"]
+                self.metrics_history.append(metrics)
+                if entry["checkpoint_dir"]:
+                    self.checkpoint_manager.register(
+                        Checkpoint(entry["checkpoint_dir"]), metrics
+                    )
+                for key, bound in stop.items():
+                    if key in metrics and metrics[key] >= bound:
+                        return "finished"
+            errors = [p["error"] for p in polls if p and p["error"]]
+            if errors:
+                self.error = errors[0]
+                return "failed"
+            if all(p["done"] for p in polls):
+                # final drain already happened in this poll
+                return "finished"
+            time.sleep(poll_interval)
+
+
+class TrainResultInternal:
+    def __init__(self, metrics, metrics_history, checkpoint, best_checkpoint, error, state):
+        self.metrics = metrics
+        self.metrics_history = metrics_history
+        self.checkpoint = checkpoint
+        self.best_checkpoint = best_checkpoint
+        self.error = error
+        self.state = state

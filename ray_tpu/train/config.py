@@ -1,0 +1,108 @@
+"""Train/Tune shared configuration dataclasses.
+
+Analog of the reference's ``python/ray/air/config.py`` (``ScalingConfig``,
+``RunConfig``, ``CheckpointConfig``, ``FailureConfig``) re-derived for TPU:
+``ScalingConfig`` speaks in workers *and* TPU slice topology, because on TPU
+the schedulable unit is a pod slice (SURVEY §7 stage 3), not a GPU count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many training workers, with what resources, on what topology.
+
+    Reference contract: ``air/config.py`` ``ScalingConfig(num_workers,
+    use_gpu, resources_per_worker, placement_strategy)``. TPU-first deltas:
+
+    - ``use_tpu`` + ``topology`` (e.g. ``"v5e-16"``) instead of ``use_gpu``;
+      one worker per TPU *host*, chips attached via the slice resource.
+    - ``placement_strategy`` defaults to STRICT_PACK so a worker group lands
+      on one ICI domain; multi-slice jobs use one bundle per host with the
+      slice-head resource for gang admission.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    use_gpu: bool = False  # accepted for API parity; TPU path ignores it
+    topology: Optional[str] = None  # e.g. "v5e-16": gang-schedule a slice
+    resources_per_worker: Optional[dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # elastic range; None disables elasticity (fixed size = num_workers)
+    min_workers: Optional[int] = None
+    max_workers: Optional[int] = None
+
+    def __post_init__(self):
+        if self.num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        if self.min_workers is not None and self.min_workers > self.num_workers:
+            raise ValueError("min_workers must be <= num_workers")
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_workers is not None
+
+    def worker_resources(self) -> dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 4.0  # one v5e/v4 host = 4 chips by default
+        return res
+
+    def bundles(self) -> list[dict[str, float]]:
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Checkpoint retention policy (reference: ``air/config.py`` CheckpointConfig)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Retry policy for worker/trial failures (reference: ``air/config.py``).
+
+    ``max_failures``: -1 = infinite retries, 0 = fail fast, N = N restarts.
+    """
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Experiment-level config (reference: ``air/config.py`` RunConfig)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[dict[str, Any]] = None
+    verbose: int = 1
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = os.path.expanduser(
+                os.environ.get("RAY_TPU_STORAGE_PATH", "~/ray_tpu_results")
+            )
+        if self.failure_config is None:
+            self.failure_config = FailureConfig()
+        if self.checkpoint_config is None:
+            self.checkpoint_config = CheckpointConfig()

@@ -1,0 +1,133 @@
+"""In-loop training session: ``report`` / ``get_checkpoint`` / ``get_context``.
+
+Reference contract: ``python/ray/train/_internal/session.py`` —
+``ray.train.report(metrics, checkpoint=...)`` (``:672``),
+``get_checkpoint`` (``:786``), ``get_dataset_shard`` (``:1114``),
+``get_context`` (``context.py:117``).
+
+Mechanics here: the user's train loop runs in a background thread inside the
+TrainWorker actor; ``report`` persists the checkpoint to shared storage
+(rank-0 only, matching the reference's default), enqueues the result, and the
+controller drains the queue via actor calls. Reports are non-blocking — on
+TPU the train loop is a jit-step hot loop and must never wait on the control
+plane.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+from typing import Any, Iterable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.context import TrainContext
+
+_session_lock = threading.Lock()
+# keyed by train-loop thread ident so multiple in-process workers (thread-mode
+# runtime) each see their own session; None key = process-wide fallback
+_sessions: dict[Optional[int], "_TrainSession"] = {}
+
+
+class _TrainSession:
+    def __init__(
+        self,
+        context: TrainContext,
+        storage_dir: str,
+        latest_checkpoint: Optional[Checkpoint],
+        dataset_shards: Optional[dict[str, Any]] = None,
+    ):
+        self.context = context
+        self.storage_dir = storage_dir
+        self.latest_checkpoint = latest_checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.result_queue: "queue.Queue[dict]" = queue.Queue()
+        self.report_count = 0
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        entry: dict[str, Any] = {"metrics": dict(metrics), "checkpoint_dir": None}
+        if checkpoint is not None:
+            # persist rank-0 checkpoints into experiment storage; other ranks
+            # report metrics only (reference default: rank-0 checkpointing)
+            if self.context.world_rank == 0:
+                dst = os.path.join(
+                    self.storage_dir, f"checkpoint_{self.report_count:06d}"
+                )
+                if os.path.abspath(checkpoint.path) != os.path.abspath(dst):
+                    os.makedirs(dst, exist_ok=True)
+                    shutil.copytree(checkpoint.path, dst, dirs_exist_ok=True)
+                entry["checkpoint_dir"] = dst
+                self.latest_checkpoint = Checkpoint(dst)
+        self.report_count += 1
+        self.result_queue.put(entry)
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.latest_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        return self.dataset_shards.get(name)
+
+    def drain(self, max_items: int = 64) -> list[dict]:
+        out = []
+        try:
+            while len(out) < max_items:
+                out.append(self.result_queue.get_nowait())
+        except queue.Empty:
+            pass
+        return out
+
+
+def _set_session(s: Optional[_TrainSession], thread_ident: Optional[int] = None):
+    with _session_lock:
+        if s is None:
+            removed = _sessions.pop(thread_ident, None)
+            # only clear the fallback if it points at the session being
+            # removed — another in-process worker may still own it
+            if removed is not None and _sessions.get(None) is removed:
+                _sessions.pop(None, None)
+        else:
+            _sessions[thread_ident] = s
+            _sessions[None] = s  # fallback for helper threads
+
+
+def _get_session() -> Optional[_TrainSession]:
+    ident = threading.get_ident()
+    with _session_lock:
+        return _sessions.get(ident, _sessions.get(None))
+
+
+# -- public in-loop API ------------------------------------------------------
+
+
+def report(metrics: dict, *, checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from the train loop."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError(
+            "ray_tpu.train.report() called outside a training session"
+        )
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = _get_session()
+    if s is None:
+        return None
+    return s.get_checkpoint()
+
+
+def get_context() -> TrainContext:
+    s = _get_session()
+    if s is None:
+        return TrainContext()
+    return s.context
+
+
+def get_dataset_shard(dataset_name: str = "train"):
+    s = _get_session()
+    if s is None:
+        return None
+    return s.get_dataset_shard(dataset_name)

@@ -1,0 +1,171 @@
+"""Trainers: ``JaxTrainer`` — the ``TorchTrainer`` contract, JAX/TPU-native.
+
+Reference call stack (SURVEY §3.5): ``TorchTrainer(train_loop,
+scaling_config).fit()`` → ``BaseTrainer.fit`` (``base_trainer.py:127``) →
+``DataParallelTrainer._run`` (``data_parallel_trainer.py:26``) →
+``BackendExecutor`` placement group + worker group + process-group setup
+(``backend_executor.py:146/230``, ``torch/config.py:153``).
+
+Here the "backend" is JAX: workers don't need a NCCL process group — inside
+one host the SPMD program is jit-compiled over the local mesh; across hosts
+the controller brokers ``jax.distributed`` rendezvous (coordinator address in
+the worker env). The train loop is user code calling
+``ray_tpu.train.report``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train._internal.controller import RunState, TrainController
+
+
+class Result:
+    """Outcome of ``trainer.fit()`` (reference: ``air/result.py``)."""
+
+    def __init__(
+        self,
+        metrics: dict,
+        checkpoint: Optional[Checkpoint],
+        error: Optional[str],
+        path: str,
+        metrics_history: Optional[list[dict]] = None,
+        best_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.metrics = metrics
+        self.checkpoint = checkpoint
+        self.error = error
+        self.path = path
+        self.metrics_history = metrics_history or []
+        self.best_checkpoint = best_checkpoint
+
+    @property
+    def metrics_dataframe(self):
+        import pandas as pd  # optional; raises if pandas absent
+
+        return pd.DataFrame(self.metrics_history)
+
+    def __repr__(self):
+        return (
+            f"Result(metrics={self.metrics}, error={self.error!r}, "
+            f"path={self.path!r})"
+        )
+
+
+class BaseTrainer:
+    """Shared fit() plumbing (reference: ``train/base_trainer.py:127``)."""
+
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def _experiment_dir(self) -> str:
+        name = self.run_config.name or f"{type(self).__name__}_{uuid.uuid4().hex[:8]}"
+        self.run_config.name = name
+        d = os.path.join(os.path.expanduser(self.run_config.storage_path), name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Adapt this trainer into a Tune trainable (class) — the integration
+        point Tune uses to sweep over trainers (reference:
+        ``base_trainer.py`` Trainable conversion)."""
+        trainer = self
+
+        def _trainable(config: dict):
+            import copy
+
+            from ray_tpu.tune import report as tune_report
+
+            t = copy.copy(trainer)
+            # per-trial override: config may carry train_loop_config updates
+            if "train_loop_config" in config and hasattr(t, "train_loop_config"):
+                merged = dict(t.train_loop_config or {})
+                merged.update(config["train_loop_config"])
+                t.train_loop_config = merged
+            res = t.fit()
+            tune_report(res.metrics, checkpoint=res.checkpoint)
+
+        _trainable.__name__ = f"{type(self).__name__}_trainable"
+        return _trainable
+
+
+class DataParallelTrainer(BaseTrainer):
+    """Runs one train function on N ranks (reference:
+    ``train/data_parallel_trainer.py:26``)."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        super().__init__(
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+
+    def fit(self) -> Result:
+        exp_dir = self._experiment_dir()
+        controller = TrainController(
+            train_fn=self.train_loop_per_worker,
+            train_fn_config=self.train_loop_config,
+            scaling=self.scaling_config,
+            run_config=self.run_config,
+            experiment_dir=exp_dir,
+            datasets=self.datasets,
+            trial_id=uuid.uuid4().hex[:8],
+        )
+        if self.resume_from_checkpoint is not None:
+            controller.checkpoint_manager.register(
+                self.resume_from_checkpoint, {"resumed": True}, protected=True
+            )
+        internal = controller.run()
+        return Result(
+            metrics=internal.metrics,
+            checkpoint=internal.checkpoint,
+            best_checkpoint=internal.best_checkpoint,
+            error=internal.error if internal.state is RunState.ERRORED else None,
+            path=exp_dir,
+            metrics_history=internal.metrics_history,
+        )
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The flagship trainer: SPMD JAX training over TPU hosts.
+
+    Equivalent position to ``TorchTrainer`` (``train/torch/torch_trainer.py:11``)
+    but the data plane is the XLA compiler: the user train loop builds a mesh
+    (usually via ``ray_tpu.parallel.mesh``), jits a step with shardings, and
+    calls ``ray_tpu.train.report``. Multi-host: one worker per host, ICI
+    collectives inside the program, controller-brokered rendezvous.
+    """
+
+
+# torch users migrating from the reference get the same name
+TorchTrainer = JaxTrainer
