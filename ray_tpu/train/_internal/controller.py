@@ -197,9 +197,10 @@ class TrainController:
         stop = self.run_config.stop or {}
         while True:
             polls = group.poll()
-            if any(p is None for p in polls):
-                return "failed"  # a worker actor died
-            rank0 = polls[0]
+            # process rank-0's drained results FIRST: they exist only in this
+            # poll now, and may carry checkpoints already persisted to storage
+            # — a worker death must not lose the resume anchor
+            rank0 = polls[0] or {"results": [], "done": False, "error": None}
             for entry in rank0["results"]:
                 metrics = entry["metrics"]
                 self.metrics_history.append(metrics)
@@ -210,6 +211,8 @@ class TrainController:
                 for key, bound in stop.items():
                     if key in metrics and metrics[key] >= bound:
                         return "finished"
+            if any(p is None for p in polls):
+                return "failed"  # a worker actor died
             errors = [p["error"] for p in polls if p and p["error"]]
             if errors:
                 self.error = errors[0]

@@ -33,6 +33,10 @@ class Checkpoint:
 
     def __init__(self, path: str):
         self.path = os.path.abspath(path)
+        # set on checkpoints created in framework-owned tempdirs
+        # (from_dict/from_pytree): report() deletes the source after
+        # persisting it, so per-step checkpoints don't accumulate in /tmp
+        self._ephemeral = False
 
     # -- constructors -------------------------------------------------------
 
@@ -50,14 +54,18 @@ class Checkpoint:
         d = tempfile.mkdtemp(prefix="rtpu-chk-")
         with open(os.path.join(d, "_dict.pkl"), "wb") as f:
             cloudpickle.dump(data, f)
-        return cls(d)
+        chk = cls(d)
+        chk._ephemeral = True
+        return chk
 
     @classmethod
     def from_pytree(cls, tree: Any, path: Optional[str] = None) -> "Checkpoint":
         d = path or tempfile.mkdtemp(prefix="rtpu-chk-")
         os.makedirs(d, exist_ok=True)
         save_pytree(tree, d)
-        return cls(d)
+        chk = cls(d)
+        chk._ephemeral = path is None
+        return chk
 
     # -- accessors ----------------------------------------------------------
 

@@ -32,7 +32,7 @@ class ScalingConfig:
     use_gpu: bool = False  # accepted for API parity; TPU path ignores it
     topology: Optional[str] = None  # e.g. "v5e-16": gang-schedule a slice
     resources_per_worker: Optional[dict[str, float]] = None
-    placement_strategy: str = "PACK"
+    placement_strategy: str = "STRICT_PACK"  # gang on one ICI domain
     # elastic range; None disables elasticity (fixed size = num_workers)
     min_workers: Optional[int] = None
     max_workers: Optional[int] = None

@@ -61,6 +61,11 @@ class _TrainSession:
                     shutil.copytree(checkpoint.path, dst, dirs_exist_ok=True)
                 entry["checkpoint_dir"] = dst
                 self.latest_checkpoint = Checkpoint(dst)
+            if getattr(checkpoint, "_ephemeral", False):
+                # framework-owned tempdir, now persisted (or unused on
+                # non-zero ranks): reclaim it so per-step reports don't
+                # accumulate model-sized dirs in /tmp
+                shutil.rmtree(checkpoint.path, ignore_errors=True)
         self.report_count += 1
         self.result_queue.put(entry)
 

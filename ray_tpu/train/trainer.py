@@ -92,9 +92,15 @@ class BaseTrainer:
         def _trainable(config: dict):
             import copy
 
+            from ray_tpu.train.session import get_context
             from ray_tpu.tune import report as tune_report
 
-            t = copy.copy(trainer)
+            # deep copy: trials must not share RunConfig (a shared object
+            # would alias every trial's inner experiment dir)
+            t = copy.deepcopy(trainer)
+            trial_id = get_context().trial_id or uuid.uuid4().hex[:8]
+            base = t.run_config.name or type(t).__name__
+            t.run_config.name = f"{base}_{trial_id}"
             # per-trial override: config may carry train_loop_config updates
             if "train_loop_config" in config and hasattr(t, "train_loop_config"):
                 merged = dict(t.train_loop_config or {})

@@ -1,0 +1,70 @@
+"""ray_tpu.tune — hyperparameter search over TPU-backed trainables.
+
+Public surface mirrors the reference's ``ray.tune`` (SURVEY §2.3): ``Tuner``
++ ``TuneConfig``, search-space constructors, searchers, trial schedulers
+(ASHA/PBT/median-stopping), ``ResultGrid``. In-loop API is shared with Train:
+``tune.report`` is the same session report.
+"""
+
+from ray_tpu.train.session import get_checkpoint, report
+from ray_tpu.tune.result_grid import ExperimentAnalysis, ResultGrid, TrialResult
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.tuner import (
+    TuneConfig,
+    Tuner,
+    with_parameters,
+    with_resources,
+)
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "ConcurrencyLimiter",
+    "ExperimentAnalysis",
+    "FIFOScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "TrialResult",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "lograndint",
+    "loguniform",
+    "quniform",
+    "randint",
+    "randn",
+    "report",
+    "sample_from",
+    "uniform",
+    "with_parameters",
+    "with_resources",
+]

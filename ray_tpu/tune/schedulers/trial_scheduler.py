@@ -1,0 +1,53 @@
+"""Trial scheduler interface (reference: ``tune/schedulers/trial_scheduler.py``).
+
+Decisions returned from ``on_trial_result``:
+- CONTINUE: keep training
+- STOP: early-stop the trial (counts as completed, not failed)
+- PAUSE: suspend; controller may resume later
+- RESTART: tear down the trial actor and restart it with the trial's
+  (possibly mutated) ``config`` + ``restore_checkpoint`` — the primitive PBT
+  exploitation uses (reference pauses + restores; on TPU a restart is the
+  natural unit since the SPMD program must be rebuilt anyway).
+"""
+
+from __future__ import annotations
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+    PAUSE = "PAUSE"
+    RESTART = "RESTART"
+
+    def __init__(self, metric: str = None, mode: str = "max", time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+
+    def set_search_properties(self, metric, mode):
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+
+    def _score(self, result: dict) -> float:
+        v = result.get(self.metric)
+        if v is None:
+            return float("-inf")
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_add(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        return self.CONTINUE
+
+    def on_trial_complete(self, trial, result: dict) -> None:
+        pass
+
+    def on_trial_error(self, trial) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """No early stopping (reference default)."""

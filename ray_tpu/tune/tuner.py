@@ -1,0 +1,346 @@
+"""Tuner + trial controller.
+
+Reference call path: ``Tuner.fit`` (``tune/tuner.py:43/:312``) →
+``TuneController`` event loop (``tune/execution/tune_controller.py:68``)
+managing trials as actors. Here each trial is one TrainWorker actor (the same
+actor class Train uses — a trial *is* a 1-rank train run; trials over
+multi-worker trainers nest a TrainController inside the trial function via
+``trainer.as_trainable()``).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import os
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train._internal.worker_group import TrainWorker
+from ray_tpu.tune.result_grid import ResultGrid, TrialResult
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.search.searcher import Searcher
+
+logger = logging.getLogger(__name__)
+
+
+class TuneConfig:
+    """Reference: ``tune/tune_config.py`` TuneConfig."""
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        num_samples: int = 1,
+        max_concurrent_trials: Optional[int] = None,
+        search_alg: Optional[Searcher] = None,
+        scheduler: Optional[TrialScheduler] = None,
+        seed: Optional[int] = None,
+    ):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.max_concurrent_trials = max_concurrent_trials
+        self.search_alg = search_alg
+        self.scheduler = scheduler
+        self.seed = seed
+
+
+class TrialStatus(enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    TERMINATED = "TERMINATED"
+    ERROR = "ERROR"
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: dict, storage_dir: str, resources: dict):
+        self.trial_id = trial_id
+        self.config = config
+        self.storage_dir = storage_dir
+        self.resources = resources
+        self.status = TrialStatus.PENDING
+        self.actor = None
+        self.last_result: dict = {}
+        self.metrics_history: list[dict] = []
+        self.iteration = 0
+        self.checkpoint: Optional[Checkpoint] = None
+        self.restore_checkpoint: Optional[Checkpoint] = None
+        self.error: Optional[str] = None
+        self.num_failures = 0
+        self.num_starts = 0  # every (re)start gets a fresh storage subdir
+
+
+class TuneController:
+    """Event loop: launch trials up to the concurrency cap, poll, apply
+    scheduler decisions, feed the searcher."""
+
+    def __init__(
+        self,
+        trainable: Callable,
+        param_space: dict,
+        tune_config: TuneConfig,
+        run_config: RunConfig,
+        experiment_dir: str,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config
+        self.run_config = run_config
+        self.experiment_dir = experiment_dir
+        self.scheduler = tune_config.scheduler or FIFOScheduler()
+        self.scheduler.set_search_properties(tune_config.metric, tune_config.mode)
+        self.searcher = tune_config.search_alg or BasicVariantGenerator()
+        consumed = self.searcher.set_search_properties(
+            tune_config.metric, tune_config.mode, self.param_space,
+            tune_config.num_samples,
+        )
+        if not consumed and not isinstance(self.searcher, BasicVariantGenerator):
+            raise ValueError("search_alg did not accept the param_space")
+        self.trials: list[Trial] = []
+        self._exhausted = False
+        self.resources = dict(getattr(trainable, "_tune_resources", {"CPU": 1}))
+
+    # -- trial lifecycle ----------------------------------------------------
+
+    def _max_concurrent(self) -> int:
+        if self.tune_config.max_concurrent_trials:
+            return self.tune_config.max_concurrent_trials
+        avail = ray_tpu.cluster_resources().get("CPU", 1)
+        return max(1, int(avail // max(self.resources.get("CPU", 1), 1)))
+
+    def _maybe_create_trial(self) -> Optional[Trial]:
+        trial_id = f"trial_{len(self.trials):05d}_{uuid.uuid4().hex[:6]}"
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is None:
+            if not isinstance(self.searcher, BasicVariantGenerator):
+                return None  # limiter backpressure or exhausted
+            self._exhausted = True
+            return None
+        t = Trial(
+            trial_id,
+            cfg,
+            os.path.join(self.experiment_dir, trial_id),
+            self.resources,
+        )
+        self.trials.append(t)
+        self.scheduler.on_trial_add(t)
+        return t
+
+    def _start_trial(self, trial: Trial, restore: Optional[Checkpoint] = None):
+        import cloudpickle
+
+        cls = ray_tpu.remote(TrainWorker)
+        trial.actor = cls.options(
+            num_cpus=trial.resources.get("CPU", 1),
+            resources={k: v for k, v in trial.resources.items() if k != "CPU"},
+            name=f"tune-{trial.trial_id}-{time.time_ns()}",
+        ).remote()
+        chk = restore or trial.restore_checkpoint or trial.checkpoint
+        ctx = dict(
+            world_size=1,
+            world_rank=0,
+            experiment_name=self.run_config.name or "tune",
+            trial_name=trial.trial_id,
+            trial_id=trial.trial_id,
+        )
+        ray_tpu.get(
+            trial.actor.setup.remote(
+                ctx,
+                os.path.join(trial.storage_dir, f"run_{trial.num_starts:03d}"),
+                chk.path if chk else None,
+            )
+        )
+        trial.num_starts += 1
+        trial.actor.run.remote(cloudpickle.dumps(self.trainable), trial.config)
+        trial.restore_checkpoint = None
+        trial.status = TrialStatus.RUNNING
+
+    def _stop_trial(self, trial: Trial, status: TrialStatus, error: Optional[str] = None):
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        trial.status = status
+        trial.error = error
+        self.searcher.on_trial_complete(
+            trial.trial_id, trial.last_result, error=status is TrialStatus.ERROR
+        )
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+
+    # -- event loop ---------------------------------------------------------
+
+    def run(self, poll_interval: float = 0.05) -> list[Trial]:
+        while True:
+            running = [t for t in self.trials if t.status is TrialStatus.RUNNING]
+            # top up to the concurrency cap
+            while not self._exhausted and len(running) < self._max_concurrent():
+                t = self._maybe_create_trial()
+                if t is None:
+                    break
+                try:
+                    self._start_trial(t)
+                    running.append(t)
+                except Exception as e:
+                    # _stop_trial notifies searcher/scheduler so e.g. a
+                    # ConcurrencyLimiter slot is released
+                    self._stop_trial(
+                        t, TrialStatus.ERROR, f"failed to start: {e!r}"
+                    )
+            if not running:
+                if self._exhausted or all(
+                    t.status is not TrialStatus.PENDING for t in self.trials
+                ):
+                    break
+                time.sleep(poll_interval)
+                continue
+            self._poll_running(running)
+            time.sleep(poll_interval)
+        return self.trials
+
+    def _poll_running(self, running: list[Trial]):
+        refs = [t.actor.poll.remote() for t in running]
+        for trial, ref in zip(running, refs):
+            try:
+                poll = ray_tpu.get(ref, timeout=60)
+            except Exception as e:
+                self._handle_failure(trial, f"trial actor died: {e!r}")
+                continue
+            decision = TrialScheduler.CONTINUE
+            for entry in poll["results"]:
+                self.iteration_result(trial, entry)
+                if trial.status is not TrialStatus.RUNNING:
+                    break  # stop-criteria hit inside iteration_result
+                d = self.scheduler.on_trial_result(trial, trial.last_result)
+                if d != TrialScheduler.CONTINUE:
+                    # remaining queued entries are from after the cut point —
+                    # a slow (real) trial would never have produced them
+                    decision = d
+                    break
+            if trial.status is not TrialStatus.RUNNING:
+                continue  # already terminated by stop criteria
+            if poll["error"]:
+                self._handle_failure(trial, poll["error"])
+            elif decision == TrialScheduler.STOP:
+                self._stop_trial(trial, TrialStatus.TERMINATED)
+            elif decision == TrialScheduler.RESTART:
+                # PBT exploit: restart with mutated config + donor checkpoint
+                if trial.actor is not None:
+                    try:
+                        ray_tpu.kill(trial.actor)
+                    except Exception:
+                        pass
+                try:
+                    self._start_trial(trial)
+                except Exception as e:
+                    self._handle_failure(trial, f"restart failed: {e!r}")
+            elif poll["done"]:
+                self._stop_trial(trial, TrialStatus.TERMINATED)
+
+    def iteration_result(self, trial: Trial, entry: dict):
+        trial.iteration += 1
+        metrics = dict(entry["metrics"])
+        metrics.setdefault("training_iteration", trial.iteration)
+        metrics.setdefault("trial_id", trial.trial_id)
+        trial.last_result = metrics
+        trial.metrics_history.append(metrics)
+        if entry.get("checkpoint_dir"):
+            trial.checkpoint = Checkpoint(entry["checkpoint_dir"])
+        self.searcher.on_trial_result(trial.trial_id, metrics)
+        stop = self.run_config.stop or {}
+        for key, bound in stop.items():
+            if key in metrics and metrics[key] >= bound:
+                self._stop_trial(trial, TrialStatus.TERMINATED)
+
+    def _handle_failure(self, trial: Trial, error: str):
+        trial.num_failures += 1
+        max_f = self.run_config.failure_config.max_failures
+        if max_f < 0 or trial.num_failures <= max_f:
+            logger.warning("trial %s failed; restarting from last checkpoint", trial.trial_id)
+            if trial.actor is not None:
+                try:
+                    ray_tpu.kill(trial.actor)
+                except Exception:
+                    pass
+            try:
+                self._start_trial(trial)
+                return
+            except Exception as e:
+                error = f"{error}; restart failed: {e!r}"
+        self._stop_trial(trial, TrialStatus.ERROR, error)
+
+
+class Tuner:
+    """Public entry point (reference: ``tune/tuner.py:43``)."""
+
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[dict] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        # trainers adapt through as_trainable() (reference BaseTrainer path)
+        if hasattr(trainable, "as_trainable"):
+            trainable = trainable.as_trainable()
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+        self.run_config.name = name
+        exp_dir = os.path.join(os.path.expanduser(self.run_config.storage_path), name)
+        os.makedirs(exp_dir, exist_ok=True)
+        controller = TuneController(
+            self.trainable,
+            self.param_space,
+            self.tune_config,
+            self.run_config,
+            exp_dir,
+        )
+        trials = controller.run()
+        results = [
+            TrialResult(
+                metrics=t.last_result,
+                checkpoint=t.checkpoint,
+                error=t.error,
+                path=t.storage_dir,
+                metrics_history=t.metrics_history,
+                config=t.config,
+                trial_id=t.trial_id,
+            )
+            for t in trials
+        ]
+        return ResultGrid(
+            results, metric=self.tune_config.metric, mode=self.tune_config.mode
+        )
+
+
+def with_parameters(fn: Callable, **kwargs) -> Callable:
+    """Bind large objects to a trainable (reference: ``tune/trainable/util.py``)."""
+
+    def wrapped(config):
+        return fn(config, **kwargs)
+
+    wrapped.__name__ = getattr(fn, "__name__", "trainable")
+    if hasattr(fn, "_tune_resources"):
+        wrapped._tune_resources = fn._tune_resources
+    return wrapped
+
+
+def with_resources(fn: Callable, resources: dict) -> Callable:
+    """Attach per-trial resources (reference: ``tune/tune.py`` with_resources)."""
+    fn._tune_resources = dict(resources)
+    return fn

@@ -1,0 +1,222 @@
+"""Tune tests: variant generation, Tuner.fit, schedulers (ASHA/PBT), retries.
+
+Coverage modeled on the reference's ``tune/tests`` (``test_tune_*.py``,
+``test_trial_scheduler.py``, ``test_trial_scheduler_pbt.py``).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import Checkpoint, FailureConfig, RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.search.basic_variant import generate_variants
+
+pytestmark = pytest.mark.timeout(300) if hasattr(pytest.mark, "timeout") else []
+
+
+@pytest.fixture
+def run_cfg(tmp_path):
+    def make(**kw):
+        return RunConfig(storage_path=str(tmp_path / "results"), **kw)
+
+    return make
+
+
+def test_generate_variants_grid_and_random():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "mom": tune.uniform(0.0, 1.0),
+        "nested": {"units": tune.grid_search([32, 64])},
+        "fixed": "adam",
+    }
+    variants = list(generate_variants(space, num_samples=2, seed=0))
+    assert len(variants) == 8  # 2 grid * 2 grid * 2 samples
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert {v["nested"]["units"] for v in variants} == {32, 64}
+    assert all(0.0 <= v["mom"] <= 1.0 for v in variants)
+    assert all(v["fixed"] == "adam" for v in variants)
+
+
+def test_domains_sample_in_range():
+    import random
+
+    rng = random.Random(0)
+    assert 1 <= tune.randint(1, 10).sample(rng) < 10
+    assert 1e-4 <= tune.loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+    assert tune.choice(["a", "b"]).sample(rng) in ("a", "b")
+    v = tune.quniform(0, 1, 0.25).sample(rng)
+    assert abs(v / 0.25 - round(v / 0.25)) < 1e-9
+
+
+def test_tuner_grid_fit(ray_start_thread, run_cfg):
+    def trainable(config):
+        tune.report({"score": config["x"] ** 2})
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=run_cfg(name="grid"),
+    ).fit()
+    assert len(results) == 3
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    assert best.metrics["score"] == 9
+    assert best.config["x"] == 3
+
+
+def test_tuner_min_mode_and_num_samples(ray_start_thread, run_cfg):
+    def trainable(config):
+        tune.report({"loss": abs(config["x"] - 0.5)})
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=TuneConfig(metric="loss", mode="min", num_samples=5, seed=1),
+        run_config=run_cfg(name="rand"),
+    ).fit()
+    assert len(results) == 5
+    best = results.get_best_result()
+    assert best.metrics["loss"] == min(r.metrics["loss"] for r in results)
+
+
+def test_asha_stops_bad_trials(ray_start_thread, run_cfg):
+    def trainable(config):
+        import time
+
+        for i in range(20):
+            tune.report({"acc": config["quality"] * (i + 1)})
+            time.sleep(0.02)  # realistic cadence so polls interleave
+
+    results = Tuner(
+        trainable,
+        # strong trials first so rung records exist when weak ones arrive
+        param_space={"quality": tune.grid_search([2.0, 1.0, 0.02, 0.01])},
+        tune_config=TuneConfig(
+            metric="acc",
+            mode="max",
+            scheduler=tune.ASHAScheduler(
+                max_t=20, grace_period=2, reduction_factor=2
+            ),
+            max_concurrent_trials=4,
+        ),
+        run_config=run_cfg(name="asha"),
+    ).fit()
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    assert best.config["quality"] == 2.0
+    # weak trials should have been cut before 20 iterations
+    weak = [r for r in results if r.config["quality"] <= 0.02]
+    assert any(len(r.metrics_history) < 20 for r in weak)
+
+
+def test_pbt_exploits_and_mutates(ray_start_thread, run_cfg):
+    def trainable(config):
+        chk = tune.get_checkpoint()
+        score = chk.to_dict()["score"] if chk else 0.0
+        for _ in range(30):
+            score += config["lr"]
+            tune.report(
+                {"score": score, "lr": config["lr"]},
+                checkpoint=Checkpoint.from_dict({"score": score}),
+            )
+
+    results = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.001, 1.0])},
+        tune_config=TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=tune.PopulationBasedTraining(
+                perturbation_interval=5,
+                hyperparam_mutations={"lr": [0.001, 1.0]},
+                quantile_fraction=0.5,
+                seed=0,
+            ),
+            max_concurrent_trials=2,
+        ),
+        run_config=run_cfg(name="pbt"),
+    ).fit()
+    assert results.num_errors == 0
+    # the weak trial must have exploited the strong one's checkpoint: its
+    # final score reflects the donor's progress, impossible from lr=0.001 alone
+    scores = sorted(r.metrics.get("score", 0) for r in results)
+    assert scores[0] > 0.001 * 35
+
+
+def test_trial_failure_retry(ray_start_thread, run_cfg, tmp_path):
+    marker = str(tmp_path / "failed_once")
+
+    def trainable(config):
+        if config["x"] == 2 and not os.path.exists(marker):
+            open(marker, "w").write("x")
+            raise RuntimeError("flaky trial")
+        tune.report({"score": config["x"]})
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=run_cfg(name="retry", failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert results.num_errors == 0
+    assert results.get_best_result().metrics["score"] == 2
+
+
+def test_trial_failure_exhausted(ray_start_thread, run_cfg):
+    def trainable(config):
+        raise RuntimeError("always broken")
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=run_cfg(name="fail"),
+    ).fit()
+    assert results.num_errors == 1
+    assert "always broken" in results.errors[0]
+
+
+def test_with_parameters_and_resources(ray_start_thread, run_cfg):
+    big = list(range(100))
+
+    def trainable(config, data=None):
+        tune.report({"n": len(data) + config["x"]})
+
+    wrapped = tune.with_resources(
+        tune.with_parameters(trainable, data=big), {"CPU": 1}
+    )
+    results = Tuner(
+        wrapped,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(metric="n", mode="max"),
+        run_config=run_cfg(name="wp"),
+    ).fit()
+    assert results.get_best_result().metrics["n"] == 101
+
+
+def test_trainer_as_trainable(ray_start_thread, run_cfg):
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def loop(config):
+        import ray_tpu.train as train
+
+        train.report({"val": config["lr"] * 10})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"lr": 0.0},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=run_cfg(name="inner"),
+    )
+    results = Tuner(
+        trainer,
+        param_space={"train_loop_config": {"lr": tune.grid_search([1.0, 2.0])}},
+        tune_config=TuneConfig(metric="val", mode="max", max_concurrent_trials=1),
+        run_config=run_cfg(name="sweep"),
+    ).fit()
+    assert results.num_errors == 0, results.errors
+    assert results.get_best_result().metrics["val"] == 20.0
