@@ -449,12 +449,15 @@ class Controller:
         if worker is None:
             return False
         demand = pt.spec.resources
-        node.allocate(demand)
         pg_bundle = getattr(pt, "_pg_bundle", None)
         if pg_bundle is not None:
+            # bundle resources were debited from the node when the placement
+            # group committed; charging the node again would double-count
             pg, i = pg_bundle
             for k, v in demand.items():
                 pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) - v
+        else:
+            node.allocate(demand)
         pt._node = node  # type: ignore[attr-defined]
         self._dispatch_to_worker(worker, pt)
         return True
@@ -828,15 +831,17 @@ class Controller:
 
     def _release_task_resources(self, pt: PendingTask):
         node = getattr(pt, "_node", None)
-        if node is not None:
-            node.release(pt.spec.resources)
-            pt._node = None
         pg_bundle = getattr(pt, "_pg_bundle", None)
         if pg_bundle is not None:
+            # mirror of _try_place: bundle tasks never charged the node
             pg, i = pg_bundle
             for k, v in pt.spec.resources.items():
                 pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) + v
             pt._pg_bundle = None
+            pt._node = None
+        elif node is not None:
+            node.release(pt.spec.resources)
+            pt._node = None
 
     def _unpin(self, object_id: ObjectID):
         self.ref_counts[object_id] -= 1
@@ -919,12 +924,13 @@ class Controller:
             return
         node, pg_bundle, resources = actor.held
         actor.held = None
-        if node is not None:
-            node.release(resources)
         if pg_bundle is not None:
+            # bundle-scheduled actors never charged the node (see _try_place)
             pg, i = pg_bundle
             for k, v in resources.items():
                 pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) + v
+        elif node is not None:
+            node.release(resources)
 
     def _drain_actor_queue(self, actor: ActorState):
         while actor.queue:

@@ -133,7 +133,11 @@ class SerializationContext:
                 if reducer is not None:
                     ser, deser = reducer
                     return (deser, (ser(obj),))
-                return NotImplemented
+                # delegate to cloudpickle: its own function/class-by-value
+                # support lives in reducer_override, so returning
+                # NotImplemented here would silently disable it (local
+                # closures would fall back to pickle-by-reference and fail)
+                return super().reducer_override(obj)
 
         sink = io.BytesIO()
         p = Pickler(sink, protocol=5, buffer_callback=lambda b: oob.append(b.raw()))

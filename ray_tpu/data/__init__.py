@@ -1,0 +1,55 @@
+"""ray_tpu.data — streaming distributed datasets feeding TPU input pipelines.
+
+Public surface mirrors the reference's ``ray.data`` (SURVEY §2.3): lazy
+``Dataset`` over a logical plan, streaming execution with backpressure,
+~10 datasources, batch iteration — with ``iter_jax_batches`` (sharded
+device-put) as the TPU-native consumption path.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import (
+    Dataset,
+    GroupedData,
+    MaterializedDataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "Datasource",
+    "GroupedData",
+    "MaterializedDataset",
+    "ReadTask",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

@@ -1,0 +1,141 @@
+"""Block format + accessor.
+
+Reference: ``python/ray/data/block.py`` + ``_internal/arrow_block.py`` /
+``pandas_block.py``. TPU-first delta: the native block is a **columnar dict
+of numpy arrays** — the zero-copy feed format for ``jax.device_put`` — with
+Arrow/pandas as conversion boundaries rather than the internal
+representation. Rows are plain dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+# A Block is dict[str, np.ndarray]; all columns share length.
+Block = dict
+
+TENSOR_COLUMN = "data"  # single-tensor datasets use this column name
+
+
+def _normalize(value) -> np.ndarray:
+    arr = np.asarray(value)
+    return arr
+
+
+class BlockAccessor:
+    """Uniform view over a block (reference: ``BlockAccessor.for_block``)."""
+
+    def __init__(self, block: Block):
+        self._b = block
+
+    @staticmethod
+    def for_block(block) -> "BlockAccessor":
+        return BlockAccessor(BlockAccessor.normalize(block))
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def normalize(data) -> Block:
+        """Coerce rows/pandas/arrow/ndarray into the columnar numpy block."""
+        if isinstance(data, dict):
+            return {k: _normalize(v) for k, v in data.items()}
+        if isinstance(data, np.ndarray):
+            return {TENSOR_COLUMN: data}
+        if hasattr(data, "to_pydict"):  # pyarrow.Table
+            return {k: np.asarray(v) for k, v in data.to_pydict().items()}
+        if hasattr(data, "columns") and hasattr(data, "to_numpy"):  # DataFrame
+            return {c: data[c].to_numpy() for c in data.columns}
+        if isinstance(data, list):  # rows
+            return BlockAccessor.from_rows(data)
+        raise TypeError(f"cannot interpret {type(data)} as a block")
+
+    @staticmethod
+    def from_rows(rows: list) -> Block:
+        if not rows:
+            return {}
+        first = rows[0]
+        if isinstance(first, dict):
+            cols = {}
+            for k in first:
+                cols[k] = np.asarray([r[k] for r in rows])
+            return cols
+        return {TENSOR_COLUMN: np.asarray(rows)}
+
+    @staticmethod
+    def concat(blocks: list[Block]) -> Block:
+        blocks = [b for b in blocks if b and BlockAccessor(b).num_rows()]
+        if not blocks:
+            return {}
+        keys = blocks[0].keys()
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+    # -- inspection ---------------------------------------------------------
+
+    def num_rows(self) -> int:
+        if not self._b:
+            return 0
+        return len(next(iter(self._b.values())))
+
+    def size_bytes(self) -> int:
+        return sum(
+            v.nbytes if isinstance(v, np.ndarray) else 64
+            for v in self._b.values()
+        )
+
+    def schema(self) -> dict[str, str]:
+        return {k: str(v.dtype) for k, v in self._b.items()}
+
+    def columns(self) -> list[str]:
+        return list(self._b.keys())
+
+    # -- row/slice access ---------------------------------------------------
+
+    def row(self, i: int) -> dict:
+        return {k: v[i] for k, v in self._b.items()}
+
+    def iter_rows(self) -> Iterator[dict]:
+        for i in range(self.num_rows()):
+            yield self.row(i)
+
+    def slice(self, start: int, end: int) -> Block:
+        return {k: v[start:end] for k, v in self._b.items()}
+
+    def take_indices(self, idx: np.ndarray) -> Block:
+        return {k: v[idx] for k, v in self._b.items()}
+
+    # -- conversion ---------------------------------------------------------
+
+    def to_numpy(self) -> dict[str, np.ndarray]:
+        return dict(self._b)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(
+            {
+                k: (list(v) if v.ndim > 1 else v)
+                for k, v in self._b.items()
+            }
+        )
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        return pa.table({k: v for k, v in self._b.items()})
+
+    def to_batch(self, batch_format: Optional[str]):
+        if batch_format in (None, "numpy", "default"):
+            b = dict(self._b)
+            # single-tensor convenience: unwrap to the bare ndarray
+            if set(b.keys()) == {TENSOR_COLUMN}:
+                return b[TENSOR_COLUMN]
+            return b
+        if batch_format == "dict":
+            return dict(self._b)
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format == "pyarrow":
+            return self.to_arrow()
+        raise ValueError(f"unknown batch_format: {batch_format}")

@@ -1,0 +1,32 @@
+"""DataContext (reference: ``python/ray/data/context.py``)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    """Execution knobs, read once per plan execution."""
+
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    # backpressure: max concurrently running block tasks per stage
+    max_tasks_in_flight: int = 8
+    # rows per read task when a datasource doesn't decide for itself
+    default_read_block_size: int = 1000
+    preserve_order: bool = True
+    # resources attached to each block task
+    task_resources: Optional[dict] = None
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance

@@ -1,0 +1,406 @@
+"""Dataset: the lazy distributed data API.
+
+Reference: ``python/ray/data/dataset.py`` — an immutable chain of logical
+operators executed by the streaming executor (SURVEY §2.3 Ray Data row).
+Transformations return new Datasets; consumption (`take`, `iter_batches`,
+`materialize`) triggers streaming execution.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.execution import StreamingExecutor, _concat_blocks
+from ray_tpu.data.iterator import DataIterator
+
+
+class Dataset:
+    def __init__(self, plan: L.LogicalPlan):
+        self._plan = plan
+
+    # -- transformations (lazy) ---------------------------------------------
+
+    def _with(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(self._plan.with_op(op))
+
+    def map(self, fn: Callable[[dict], dict]) -> "Dataset":
+        return self._with(L.MapRows(fn))
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: Optional[str] = "numpy",
+        fn_kwargs: Optional[dict] = None,
+        **_ignored,
+    ) -> "Dataset":
+        return self._with(L.MapBatches(fn, batch_size, batch_format, fn_kwargs))
+
+    def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
+        return self._with(L.Filter(fn))
+
+    def flat_map(self, fn: Callable[[dict], list]) -> "Dataset":
+        return self._with(L.FlatMap(fn))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def _add(batch):
+            batch = dict(batch) if isinstance(batch, dict) else {"data": batch}
+            batch[name] = np.asarray(fn(batch))
+            return batch
+
+        return self.map_batches(_add, batch_format="dict")
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k not in cols},
+            batch_format="dict",
+        )
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {k: b[k] for k in cols}, batch_format="dict"
+        )
+
+    def rename_columns(self, mapping: dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {mapping.get(k, k): v for k, v in b.items()},
+            batch_format="dict",
+        )
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(L.Limit(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(L.Repartition(num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(L.RandomShuffle(seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with(L.Sort(key, descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(L.Union([o._plan for o in others]))
+
+    # -- consumption (eager) ------------------------------------------------
+
+    def _execute(self) -> Iterator[Any]:
+        return StreamingExecutor().execute(self._plan)
+
+    def materialize(self) -> "MaterializedDataset":
+        refs = list(self._execute())
+        return MaterializedDataset(refs)
+
+    def take(self, n: int = 20) -> list[dict]:
+        out: list[dict] = []
+        for ref in self.limit(n)._execute():
+            block = ray_tpu.get(ref)
+            out.extend(BlockAccessor.for_block(block).iter_rows())
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def take_all(self) -> list[dict]:
+        out: list[dict] = []
+        for ref in self._execute():
+            out.extend(BlockAccessor.for_block(ray_tpu.get(ref)).iter_rows())
+        return out
+
+    def take_batch(self, batch_size: int = 20, batch_format: str = "numpy"):
+        for batch in self.iterator().iter_batches(
+            batch_size=batch_size, batch_format=batch_format
+        ):
+            return batch
+        return {}
+
+    def count(self) -> int:
+        from ray_tpu.data.execution import _count_rows
+
+        refs = [_count_rows.remote(r) for r in self._execute()]
+        return sum(ray_tpu.get(refs))
+
+    def schema(self) -> Optional[dict[str, str]]:
+        for ref in self.limit(1)._execute():
+            return BlockAccessor.for_block(ray_tpu.get(ref)).schema()
+        return None
+
+    def columns(self) -> Optional[list[str]]:
+        s = self.schema()
+        return list(s) if s else None
+
+    # -- aggregates ---------------------------------------------------------
+
+    def _agg(self, col: str, block_fn, combine):
+        vals = []
+        for ref in self._execute():
+            block = ray_tpu.get(ref)
+            if block and BlockAccessor.for_block(block).num_rows():
+                vals.append(block_fn(np.asarray(block[col])))
+        if not vals:
+            return None
+        return combine(vals)
+
+    def sum(self, col: str):
+        return self._agg(col, np.sum, lambda v: float(np.sum(v)))
+
+    def min(self, col: str):
+        return self._agg(col, np.min, lambda v: float(np.min(v)))
+
+    def max(self, col: str):
+        return self._agg(col, np.max, lambda v: float(np.max(v)))
+
+    def mean(self, col: str):
+        total, count = 0.0, 0
+        for ref in self._execute():
+            block = ray_tpu.get(ref)
+            if block and BlockAccessor.for_block(block).num_rows():
+                arr = np.asarray(block[col])
+                total += float(arr.sum())
+                count += arr.size
+        return total / count if count else None
+
+    def std(self, col: str):
+        parts = []
+        for r in self._execute():
+            block = ray_tpu.get(r)
+            if block and BlockAccessor.for_block(block).num_rows():
+                parts.append(np.asarray(block[col]).ravel())
+        if not parts:
+            return None
+        rows = np.concatenate(parts)
+        return float(np.std(rows, ddof=1)) if rows.size > 1 else 0.0
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # -- iteration ----------------------------------------------------------
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(lambda: self._execute(), repr(self))
+
+    def iter_rows(self) -> Iterator[dict]:
+        return self.iterator().iter_rows()
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return self.iterator().iter_batches(**kwargs)
+
+    def iter_jax_batches(self, **kwargs) -> Iterator[Any]:
+        return self.iterator().iter_jax_batches(**kwargs)
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[Any]:
+        return self.iterator().iter_torch_batches(**kwargs)
+
+    # -- splitting (Train integration) --------------------------------------
+
+    def split(self, n: int, *, equal: bool = False) -> list["MaterializedDataset"]:
+        refs = self.repartition(n)._execute()
+        return [MaterializedDataset([r]) for r in refs]
+
+    def streaming_split(self, n: int, *, equal: bool = True) -> list[DataIterator]:
+        """N iterators over disjoint shards (reference:
+        ``Dataset.streaming_split`` used by Train's DataConfig)."""
+        shards = self.split(n, equal=equal)
+        return [s.iterator() for s in shards]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        rows = ds.take_all()
+        k = int(len(rows) * (1 - test_size))
+        return from_items(rows[:k]), from_items(rows[k:])
+
+    # -- writing ------------------------------------------------------------
+
+    def _write(self, path: str, writer: Callable[[Block, str], None], ext: str):
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            block = BlockAccessor.normalize(ray_tpu.get(ref))
+            if BlockAccessor(block).num_rows():
+                writer(block, os.path.join(path, f"part-{i:05d}.{ext}"))
+
+    def write_parquet(self, path: str):
+        def w(block, p):
+            import pyarrow.parquet as pq
+
+            pq.write_table(BlockAccessor(block).to_arrow(), p)
+
+        self._write(path, w, "parquet")
+
+    def write_csv(self, path: str):
+        self._write(
+            path, lambda b, p: BlockAccessor(b).to_pandas().to_csv(p, index=False), "csv"
+        )
+
+    def write_json(self, path: str):
+        self._write(
+            path,
+            lambda b, p: BlockAccessor(b)
+            .to_pandas()
+            .to_json(p, orient="records", lines=True),
+            "json",
+        )
+
+    def write_numpy(self, path: str, column: str = "data"):
+        self._write(path, lambda b, p: np.save(p, b[column]), "npy")
+
+    def __repr__(self):
+        return f"Dataset(plan={self._plan!r})"
+
+
+class MaterializedDataset(Dataset):
+    """Executed dataset: holds block refs (reference: MaterializedDataset)."""
+
+    def __init__(self, refs: list):
+        super().__init__(L.LogicalPlan([L.InputBlocks(refs)]))
+        self._refs = refs
+
+    def num_blocks(self) -> int:
+        return len(self._refs)
+
+    def get_internal_block_refs(self) -> list:
+        return list(self._refs)
+
+
+class GroupedData:
+    """Hash-grouped aggregation (reference: ``grouped_data.py``)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _grouped_rows(self) -> dict:
+        groups: dict = {}
+        for row in self._ds.take_all():
+            groups.setdefault(row[self._key], []).append(row)
+        return groups
+
+    def count(self) -> Dataset:
+        rows = [
+            {self._key: k, "count()": len(v)} for k, v in self._grouped_rows().items()
+        ]
+        return from_items(rows)
+
+    def _agg(self, col: str, fn, label: str) -> Dataset:
+        rows = [
+            {self._key: k, f"{label}({col})": float(fn([r[col] for r in v]))}
+            for k, v in self._grouped_rows().items()
+        ]
+        return from_items(rows)
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg(col, np.sum, "sum")
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg(col, np.mean, "mean")
+
+    def min(self, col: str) -> Dataset:
+        return self._agg(col, np.min, "min")
+
+    def max(self, col: str) -> Dataset:
+        return self._agg(col, np.max, "max")
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        out = []
+        for _, rows in self._grouped_rows().items():
+            res = fn(BlockAccessor.from_rows(rows))
+            out.append(BlockAccessor.normalize(res))
+        refs = [ray_tpu.put(b) for b in out]
+        return MaterializedDataset(refs)
+
+
+# -- constructors (read API) -------------------------------------------------
+
+
+def _from_source(source, parallelism=-1) -> Dataset:
+    return Dataset(L.LogicalPlan([L.Read(source, parallelism)]))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    from ray_tpu.data.datasource import RangeDatasource
+
+    return _from_source(RangeDatasource(n), parallelism)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasource import RangeDatasource
+
+    return _from_source(RangeDatasource(n, tensor_shape=tuple(shape)), parallelism)
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasource import ItemsDatasource
+
+    return _from_source(ItemsDatasource(items), parallelism)
+
+
+def from_numpy(arr) -> Dataset:
+    from ray_tpu.data.datasource import BlocksDatasource
+
+    if isinstance(arr, list):
+        return _from_source(BlocksDatasource(arr))
+    return _from_source(BlocksDatasource([arr]))
+
+
+def from_pandas(dfs) -> Dataset:
+    from ray_tpu.data.datasource import BlocksDatasource
+
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return _from_source(BlocksDatasource(dfs))
+
+
+def from_arrow(tables) -> Dataset:
+    from ray_tpu.data.datasource import BlocksDatasource
+
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _from_source(BlocksDatasource(tables))
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    from ray_tpu.data.datasource import CSVDatasource
+
+    return _from_source(CSVDatasource(paths, **kwargs))
+
+
+def read_json(paths, **kwargs) -> Dataset:
+    from ray_tpu.data.datasource import JSONDatasource
+
+    return _from_source(JSONDatasource(paths, **kwargs))
+
+
+def read_parquet(paths, **kwargs) -> Dataset:
+    from ray_tpu.data.datasource import ParquetDatasource
+
+    return _from_source(ParquetDatasource(paths, **kwargs))
+
+
+def read_numpy(paths, **kwargs) -> Dataset:
+    from ray_tpu.data.datasource import NumpyDatasource
+
+    return _from_source(NumpyDatasource(paths, **kwargs))
+
+
+def read_text(paths, **kwargs) -> Dataset:
+    from ray_tpu.data.datasource import TextDatasource
+
+    return _from_source(TextDatasource(paths, **kwargs))
+
+
+def read_binary_files(paths, **kwargs) -> Dataset:
+    from ray_tpu.data.datasource import BinaryDatasource
+
+    return _from_source(BinaryDatasource(paths, **kwargs))
+
+
+def read_datasource(source, *, parallelism: int = -1) -> Dataset:
+    return _from_source(source, parallelism)

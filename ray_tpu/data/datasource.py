@@ -1,0 +1,183 @@
+"""Datasources: lazily-evaluated read tasks.
+
+Reference: ``python/ray/data/datasource/`` + ``read_api.py`` — a
+``Datasource`` plans ``ReadTask``s (serializable thunks, one per output
+block); the executor runs them as tasks. File-based sources shard by file.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+class ReadTask:
+    """A serializable zero-arg callable producing one block."""
+
+    def __init__(self, fn: Callable[[], Block], metadata: Optional[dict] = None):
+        self._fn = fn
+        self.metadata = metadata or {}
+
+    def __call__(self) -> Block:
+        return BlockAccessor.normalize(self._fn())
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, tensor_shape: Optional[tuple] = None, column: str = "id"):
+        self.n = n
+        self.tensor_shape = tensor_shape
+        self.column = column
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        parallelism = max(1, min(parallelism, self.n or 1))
+        chunk = (self.n + parallelism - 1) // parallelism if self.n else 0
+        tasks = []
+        for start in range(0, self.n, max(chunk, 1)):
+            end = min(start + chunk, self.n)
+            shape = self.tensor_shape
+
+            def fn(start=start, end=end, shape=shape, col=self.column):
+                ids = np.arange(start, end)
+                if shape:
+                    data = np.broadcast_to(
+                        ids.reshape((-1,) + (1,) * len(shape)), (end - start,) + shape
+                    ).copy()
+                    return {"data": data}
+                return {col: ids}
+
+            tasks.append(ReadTask(fn, {"num_rows": end - start}))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: list):
+        self.items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        n = len(self.items)
+        parallelism = max(1, min(parallelism, n or 1))
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        tasks = []
+        for start in range(0, n, max(chunk, 1)):
+            part = self.items[start : start + chunk]
+
+            def fn(part=part):
+                if part and isinstance(part[0], dict):
+                    return BlockAccessor.from_rows(part)
+                return {"item": np.asarray(part)}
+
+            tasks.append(ReadTask(fn, {"num_rows": len(part)}))
+        return tasks
+
+
+class BlocksDatasource(Datasource):
+    """Pre-materialized blocks (from_numpy / from_pandas / from_arrow)."""
+
+    def __init__(self, blocks: list[Any]):
+        self.blocks = blocks
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        return [
+            ReadTask(lambda b=b: BlockAccessor.normalize(b)) for b in self.blocks
+        ]
+
+
+def _expand_paths(paths) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        p = os.path.expanduser(p)
+        if os.path.isdir(p):
+            out.extend(
+                sorted(
+                    os.path.join(p, f)
+                    for f in os.listdir(p)
+                    if not f.startswith(".")
+                )
+            )
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched: {paths}")
+    return out
+
+
+class FileBasedDatasource(Datasource):
+    """One read task per file (reference: ``file_based_datasource.py``)."""
+
+    def __init__(self, paths, **reader_kwargs):
+        self.paths = _expand_paths(paths)
+        self.reader_kwargs = reader_kwargs
+
+    def _read_file(self, path: str) -> Block:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        return [
+            ReadTask(lambda p=p: self._read_file(p), {"path": p}) for p in self.paths
+        ]
+
+
+class CSVDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Block:
+        import pandas as pd
+
+        return BlockAccessor.normalize(pd.read_csv(path, **self.reader_kwargs))
+
+
+class JSONDatasource(FileBasedDatasource):
+    """JSON-lines files (reference reads jsonl via pyarrow)."""
+
+    def _read_file(self, path: str) -> Block:
+        import json
+
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        return BlockAccessor.from_rows(rows)
+
+
+class ParquetDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Block:
+        import pyarrow.parquet as pq
+
+        return BlockAccessor.normalize(pq.read_table(path, **self.reader_kwargs))
+
+
+class NumpyDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Block:
+        arr = np.load(path, allow_pickle=False)
+        return {"data": arr}
+
+
+class BinaryDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Block:
+        with open(path, "rb") as f:
+            data = f.read()
+        return {"bytes": np.frombuffer(data, dtype=np.uint8).reshape(1, -1), }
+
+
+class TextDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Block:
+        with open(path) as f:
+            lines = [l.rstrip("\n") for l in f]
+        return {"text": np.asarray(lines, dtype=object)}

@@ -1,0 +1,305 @@
+"""Streaming executor.
+
+Reference: ``python/ray/data/_internal/execution/streaming_executor.py:52`` —
+blocks stream through operator stages as object refs; per-stage in-flight
+caps provide backpressure; all-to-all stages are barriers.
+
+Implementation: the plan compiles into alternating [per-block fused stage |
+all-to-all stage] segments. Per-block stages dispatch one task per block with
+at most ``DataContext.max_tasks_in_flight`` outstanding, yielding refs in
+submission order (preserve_order). Because the driver generator only advances
+when the consumer pulls, backpressure propagates naturally to the dispatch
+loop. All-to-all stages use the classic 2-phase map/reduce shuffle with
+``num_returns=n`` partition tasks.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data import logical as L
+
+# -- per-block transform chain ----------------------------------------------
+
+
+def _apply_transforms(block: Block, transforms: list) -> Block:
+    from ray_tpu.data.block import TENSOR_COLUMN
+
+    for op in transforms:
+        acc = BlockAccessor.for_block(block)
+        if isinstance(op, L.MapBatches):
+            n = acc.num_rows()
+            bs = op.batch_size or n or 1
+            outs = []
+            for s in range(0, n, bs):
+                batch = BlockAccessor(acc.slice(s, min(s + bs, n))).to_batch(
+                    op.batch_format
+                )
+                out = op.fn(batch, **op.fn_kwargs)
+                outs.append(BlockAccessor.normalize(out))
+            block = BlockAccessor.concat(outs) if outs else {}
+        elif isinstance(op, L.MapRows):
+            block = BlockAccessor.from_rows([op.fn(r) for r in acc.iter_rows()])
+        elif isinstance(op, L.Filter):
+            keep = [i for i, r in enumerate(acc.iter_rows()) if op.fn(r)]
+            block = acc.take_indices(np.asarray(keep, dtype=np.int64))
+        elif isinstance(op, L.FlatMap):
+            rows = []
+            for r in acc.iter_rows():
+                rows.extend(op.fn(r))
+            block = BlockAccessor.from_rows(rows)
+        else:
+            raise TypeError(f"not a per-block op: {op}")
+    return block
+
+
+@ray_tpu.remote
+def _read_block(read_task, transforms):
+    return _apply_transforms(read_task(), transforms)
+
+
+@ray_tpu.remote
+def _transform_block(block, transforms):
+    return _apply_transforms(block, transforms)
+
+
+@ray_tpu.remote
+def _count_rows(block):
+    return BlockAccessor.for_block(block).num_rows()
+
+
+@ray_tpu.remote
+def _slice_block(block, start, end):
+    return BlockAccessor.for_block(block).slice(start, end)
+
+
+@ray_tpu.remote
+def _concat_blocks(*blocks):
+    return BlockAccessor.concat([BlockAccessor.normalize(b) for b in blocks])
+
+
+@ray_tpu.remote
+def _concat_sort(key, descending, *blocks):
+    merged = BlockAccessor.concat([BlockAccessor.normalize(b) for b in blocks])
+    if not merged:
+        return merged
+    order = np.argsort(merged[key], kind="stable")
+    if descending:
+        order = order[::-1]
+    return BlockAccessor(merged).take_indices(order)
+
+
+def _shuffle_partition(block, n, seed):
+    """Map phase of random shuffle: rows → n random buckets."""
+    acc = BlockAccessor.for_block(block)
+    rows = acc.num_rows()
+    rng = np.random.default_rng(seed)
+    assignment = rng.integers(0, n, size=rows)
+    out = [acc.take_indices(np.nonzero(assignment == i)[0]) for i in range(n)]
+    return tuple(out) if n > 1 else out[0]
+
+
+def _range_partition(block, key, boundaries):
+    """Map phase of sort: rows → len(boundaries)+1 key-range buckets."""
+    acc = BlockAccessor.for_block(block)
+    n = len(boundaries) + 1
+    if not acc.num_rows():
+        return tuple({} for _ in range(n)) if n > 1 else {}
+    keys = block[key]
+    assignment = np.searchsorted(np.asarray(boundaries), keys, side="right")
+    out = [acc.take_indices(np.nonzero(assignment == i)[0]) for i in range(n)]
+    return tuple(out) if n > 1 else out[0]
+
+
+def _sample_block(block, key, k):
+    acc = BlockAccessor.for_block(block)
+    rows = acc.num_rows()
+    if not rows:
+        return np.asarray([])
+    idx = np.linspace(0, rows - 1, min(k, rows)).astype(np.int64)
+    return np.sort(np.asarray(block[key])[idx])
+
+
+# -- streaming driver --------------------------------------------------------
+
+
+def _read_submits(tasks, transforms):
+    """Submit thunks with `transforms` bound NOW — the executor's loop
+    variable gets rebound per stage, and these generators run lazily."""
+    for t in tasks:
+        yield lambda t=t: _read_block.remote(t, transforms)
+
+
+def _transform_submits(refs, transforms):
+    for r in refs:
+        yield lambda r=r: _transform_block.remote(r, transforms)
+
+
+class StreamingExecutor:
+    def __init__(self, ctx: Optional[DataContext] = None):
+        self.ctx = ctx or DataContext.get_current()
+
+    # .. per-block stage ....................................................
+
+    def _stream_stage(
+        self, submit_iter: Iterator[Callable[[], Any]]
+    ) -> Iterator[Any]:
+        """Dispatch tasks with an in-flight cap; yield refs in order."""
+        cap = self.ctx.max_tasks_in_flight
+        pending: deque = deque()
+        exhausted = False
+        it = iter(submit_iter)
+        while pending or not exhausted:
+            while not exhausted and len(pending) < cap:
+                try:
+                    pending.append(next(it)())
+                except StopIteration:
+                    exhausted = True
+            if pending:
+                yield pending.popleft()
+
+    def execute(self, plan: L.LogicalPlan) -> Iterator[Any]:
+        """Returns an iterator of block refs."""
+        stream: Optional[Iterator[Any]] = None
+        ops = plan.ops
+        i = 0
+        while i < len(ops):
+            op = ops[i]
+            if isinstance(op, (L.Read, L.InputBlocks)):
+                # fuse following per-block ops into the read tasks
+                transforms, i = self._collect_fused(ops, i + 1)
+                if isinstance(op, L.Read):
+                    parallelism = op.parallelism
+                    if parallelism in (-1, None):
+                        parallelism = max(
+                            int(ray_tpu.cluster_resources().get("CPU", 4)) * 2, 8
+                        )
+                    tasks = op.datasource.get_read_tasks(parallelism)
+                    stream = self._stream_stage(_read_submits(tasks, transforms))
+                else:
+                    refs = op.refs
+                    if transforms:
+                        stream = self._stream_stage(
+                            _transform_submits(refs, transforms)
+                        )
+                    else:
+                        stream = iter(refs)
+            elif op.is_per_block():
+                transforms, i = self._collect_fused(ops, i)
+                stream = self._stream_stage(_transform_submits(stream, transforms))
+            elif isinstance(op, L.Limit):
+                stream = self._apply_limit(stream, op.n)
+                i += 1
+            elif isinstance(op, L.Repartition):
+                stream = iter(self._repartition(list(stream), op.num_blocks))
+                i += 1
+            elif isinstance(op, L.RandomShuffle):
+                stream = iter(self._random_shuffle(list(stream), op.seed))
+                i += 1
+            elif isinstance(op, L.Sort):
+                stream = iter(self._sort(list(stream), op.key, op.descending))
+                i += 1
+            elif isinstance(op, L.Union):
+                head = stream
+
+                def _chain(head=head, others=op.others):
+                    if head is not None:
+                        yield from head
+                    for other in others:
+                        yield from StreamingExecutor(self.ctx).execute(other)
+
+                stream = _chain()
+                i += 1
+            else:
+                raise TypeError(f"unknown logical op: {op}")
+        return stream if stream is not None else iter(())
+
+    def _collect_fused(self, ops, start) -> tuple[list, int]:
+        transforms = []
+        i = start
+        while i < len(ops) and ops[i].is_per_block():
+            transforms.append(ops[i])
+            i += 1
+        return transforms, i
+
+    # .. all-to-all stages ..................................................
+
+    def _apply_limit(self, stream, n: int) -> Iterator[Any]:
+        """Driver-side row budget: truncate and stop dispatching early."""
+        remaining = n
+        for ref in stream:
+            if remaining <= 0:
+                break
+            block = ray_tpu.get(ref)
+            rows = BlockAccessor.for_block(block).num_rows()
+            if rows <= remaining:
+                remaining -= rows
+                yield ref
+            else:
+                yield ray_tpu.put(
+                    BlockAccessor.for_block(block).slice(0, remaining)
+                )
+                remaining = 0
+
+    def _repartition(self, refs: list, n: int) -> list:
+        counts = ray_tpu.get([_count_rows.remote(r) for r in refs])
+        total = sum(counts)
+        # target row ranges per output block
+        bounds = [round(total * j / n) for j in range(n + 1)]
+        pieces: list[list] = [[] for _ in range(n)]
+        offset = 0
+        for ref, cnt in zip(refs, counts):
+            for j in range(n):
+                s = max(bounds[j] - offset, 0)
+                e = min(bounds[j + 1] - offset, cnt)
+                if e > s:
+                    pieces[j].append(_slice_block.remote(ref, s, e))
+            offset += cnt
+        return [_concat_blocks.remote(*p) if p else ray_tpu.put({}) for p in pieces]
+
+    def _random_shuffle(self, refs: list, seed: Optional[int]) -> list:
+        n = max(len(refs), 1)
+        base = seed if seed is not None else np.random.randint(0, 2**31)
+        part = ray_tpu.remote(_shuffle_partition).options(num_returns=n)
+        bucket_refs = [
+            part.remote(ref, n, base + i) for i, ref in enumerate(refs)
+        ]
+        if n == 1:
+            return [_concat_blocks.remote(*bucket_refs)]
+        return [
+            _concat_blocks.remote(*[bucket_refs[m][r] for m in range(len(refs))])
+            for r in range(n)
+        ]
+
+    def _sort(self, refs: list, key: str, descending: bool) -> list:
+        if not refs:
+            return []
+        n = len(refs)
+        samples = ray_tpu.get(
+            [ray_tpu.remote(_sample_block).remote(r, key, 20) for r in refs]
+        )
+        nonempty = [s for s in samples if len(s)]
+        if not nonempty:
+            return refs  # all blocks empty: nothing to sort
+        allkeys = np.sort(np.concatenate(nonempty))
+        # n-1 boundaries at even quantiles
+        bidx = [int(len(allkeys) * j / n) for j in range(1, n)]
+        boundaries = [allkeys[min(i, len(allkeys) - 1)] for i in bidx]
+        part = ray_tpu.remote(_range_partition).options(num_returns=n)
+        bucket_refs = [part.remote(r, key, boundaries) for r in refs]
+        if n == 1:
+            out = [_concat_sort.remote(key, descending, *bucket_refs)]
+        else:
+            out = [
+                _concat_sort.remote(
+                    key, descending, *[bucket_refs[m][r] for m in range(len(refs))]
+                )
+                for r in range(n)
+            ]
+        return list(reversed(out)) if descending else out

@@ -1,0 +1,130 @@
+"""Logical plan: operators + plan object.
+
+Reference: ``python/ray/data/_internal/logical/`` — a ``Dataset`` wraps an
+immutable chain of logical operators; execution compiles it to physical
+stages. The key optimization (mirroring the reference's
+``OperatorFusionRule`` — and XLA's fusion philosophy) is that consecutive
+per-block operators fuse into ONE task per block; only all-to-all operators
+(repartition/shuffle/sort) and the read boundary break fusion.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ray_tpu.data.datasource import Datasource
+
+
+class LogicalOp:
+    name = "op"
+
+    def is_per_block(self) -> bool:
+        return False
+
+
+class Read(LogicalOp):
+    name = "Read"
+
+    def __init__(self, datasource: Datasource, parallelism: int = -1):
+        self.datasource = datasource
+        self.parallelism = parallelism
+
+
+class InputBlocks(LogicalOp):
+    """Already-materialized refs (e.g. after .materialize())."""
+
+    name = "InputBlocks"
+
+    def __init__(self, refs: list):
+        self.refs = refs
+
+
+class MapBatches(LogicalOp):
+    name = "MapBatches"
+
+    def __init__(self, fn: Callable, batch_size: Optional[int], batch_format: Optional[str],
+                 fn_kwargs: Optional[dict] = None):
+        self.fn = fn
+        self.batch_size = batch_size
+        self.batch_format = batch_format
+        self.fn_kwargs = fn_kwargs or {}
+
+    def is_per_block(self) -> bool:
+        return True
+
+
+class MapRows(LogicalOp):
+    name = "Map"
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def is_per_block(self) -> bool:
+        return True
+
+
+class Filter(LogicalOp):
+    name = "Filter"
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def is_per_block(self) -> bool:
+        return True
+
+
+class FlatMap(LogicalOp):
+    name = "FlatMap"
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def is_per_block(self) -> bool:
+        return True
+
+
+class Limit(LogicalOp):
+    name = "Limit"
+
+    def __init__(self, n: int):
+        self.n = n
+
+
+class Repartition(LogicalOp):
+    name = "Repartition"
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+
+
+class RandomShuffle(LogicalOp):
+    name = "RandomShuffle"
+
+    def __init__(self, seed: Optional[int] = None):
+        self.seed = seed
+
+
+class Sort(LogicalOp):
+    name = "Sort"
+
+    def __init__(self, key: str, descending: bool = False):
+        self.key = key
+        self.descending = descending
+
+
+class Union(LogicalOp):
+    name = "Union"
+
+    def __init__(self, others: list):  # list[LogicalPlan]
+        self.others = others
+
+
+class LogicalPlan:
+    def __init__(self, ops: list[LogicalOp]):
+        self.ops = ops
+
+    def with_op(self, op: LogicalOp) -> "LogicalPlan":
+        return LogicalPlan(self.ops + [op])
+
+    def __repr__(self):
+        return " -> ".join(op.name for op in self.ops)

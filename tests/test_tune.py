@@ -115,6 +115,8 @@ def test_asha_stops_bad_trials(ray_start_thread, run_cfg):
 
 def test_pbt_exploits_and_mutates(ray_start_thread, run_cfg):
     def trainable(config):
+        import time
+
         chk = tune.get_checkpoint()
         score = chk.to_dict()["score"] if chk else 0.0
         for _ in range(30):
@@ -123,6 +125,7 @@ def test_pbt_exploits_and_mutates(ray_start_thread, run_cfg):
                 {"score": score, "lr": config["lr"]},
                 checkpoint=Checkpoint.from_dict({"score": score}),
             )
+            time.sleep(0.02)  # realistic cadence so PBT sees both trials
 
     results = Tuner(
         trainable,
