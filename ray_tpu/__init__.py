@@ -26,7 +26,7 @@ from ray_tpu._private.worker import (
     get_runtime_context,
     remote,
 )
-from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu.object_ref import ObjectRef
 from ray_tpu.exceptions import (
@@ -63,6 +63,7 @@ __all__ = [
     "get_runtime_context",
     "ActorClass",
     "ActorHandle",
+    "get_actor",
     "RemoteFunction",
     "ObjectRef",
     "RayTpuError",

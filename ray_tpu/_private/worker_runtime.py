@@ -136,12 +136,16 @@ class WorkerRuntime:
 
     def _route_task(self, msg: P.ExecuteTask):
         spec = msg.spec
-        if spec.task_type == TaskType.ACTOR_TASK and spec.max_concurrency > 1:
+        if spec.task_type == TaskType.ACTOR_TASK:
+            # concurrency is a property of the ACTOR (set at creation), not of
+            # the method-call spec — always route through the actor's pool
             pool = self.actor_pools.get(spec.actor_id.binary())
             if pool is not None:
                 pool.submit(self._execute_task, msg)
                 return
-        if spec.task_type == TaskType.ACTOR_TASK and spec.is_async_actor:
+        if spec.task_type == TaskType.ACTOR_TASK:
+            # async-ness is likewise an actor property; method-call specs
+            # don't carry is_async_actor
             loop = self.actor_loops.get(spec.actor_id.binary())
             if loop is not None:
                 asyncio.run_coroutine_threadsafe(self._execute_async(msg), loop)

@@ -105,6 +105,19 @@ def _shuffle_partition(block, n, seed):
     return tuple(out) if n > 1 else out[0]
 
 
+@ray_tpu.remote
+def _concat_permute(seed, *blocks):
+    """Reduce phase of random shuffle: concat buckets THEN permute rows —
+    without this, rows inside each bucket keep their original order and a
+    single-block shuffle would be a no-op."""
+    merged = BlockAccessor.concat([BlockAccessor.normalize(b) for b in blocks])
+    acc = BlockAccessor.for_block(merged)
+    if not acc.num_rows():
+        return merged
+    rng = np.random.default_rng(seed)
+    return acc.take_indices(rng.permutation(acc.num_rows()))
+
+
 def _range_partition(block, key, boundaries):
     """Map phase of sort: rows → len(boundaries)+1 key-range buckets."""
     acc = BlockAccessor.for_block(block)
@@ -271,9 +284,12 @@ class StreamingExecutor:
             part.remote(ref, n, base + i) for i, ref in enumerate(refs)
         ]
         if n == 1:
-            return [_concat_blocks.remote(*bucket_refs)]
+            return [_concat_permute.remote(base + 1_000_003, *bucket_refs)]
         return [
-            _concat_blocks.remote(*[bucket_refs[m][r] for m in range(len(refs))])
+            _concat_permute.remote(
+                base + 1_000_003 + r,
+                *[bucket_refs[m][r] for m in range(len(refs))],
+            )
             for r in range(n)
         ]
 

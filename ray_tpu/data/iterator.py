@@ -118,8 +118,11 @@ class DataIterator:
                 out = {}
                 for k, v in batch.items():
                     a = np.asarray(v)
-                    if dtypes and k in (dtypes or {}):
-                        a = a.astype(dtypes[k])
+                    if dtypes is not None:
+                        # per-column dict, or one dtype applied to all columns
+                        dt = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
+                        if dt is not None:
+                            a = a.astype(dt)
                     out[k] = (
                         jax.device_put(a, sharding)
                         if sharding is not None

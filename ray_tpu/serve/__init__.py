@@ -1,0 +1,44 @@
+"""ray_tpu.serve — online model serving on actor replicas.
+
+Public surface mirrors the reference's ``ray.serve`` (SURVEY §2.3):
+``@serve.deployment`` + ``serve.run``, controller/proxy/router/replica
+quartet, ``DeploymentHandle`` composition, queue-depth autoscaling, dynamic
+batching, model multiplexing.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.proxy import ProxyActor, Request, start_proxy
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "ProxyActor",
+    "Request",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
+    "run",
+    "shutdown",
+    "start_proxy",
+    "status",
+]

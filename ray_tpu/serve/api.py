@@ -1,0 +1,149 @@
+"""serve.run / serve.shutdown / status — the public control API.
+
+Reference: ``python/ray/serve/api.py`` (``serve.run``), SURVEY §3.6 request
+path. ``serve.run(app)`` ensures the controller actor exists, walks the bound
+application graph (dependencies first), registers every deployment, and
+returns a handle to the ingress deployment.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeControllerActor
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle, _HandleMarker
+
+_controller_handle = None
+
+
+def _get_controller_handle(create: bool = False):
+    global _controller_handle
+    if _controller_handle is not None:
+        try:
+            ray_tpu.get(_controller_handle.ping.remote(), timeout=10)
+            return _controller_handle
+        except Exception:
+            _controller_handle = None
+    try:
+        _controller_handle = ray_tpu.get_actor(CONTROLLER_NAME)
+        return _controller_handle
+    except Exception:
+        if not create:
+            raise RuntimeError(
+                "serve is not running (no controller); call serve.run first"
+            )
+    cls = ray_tpu.remote(ServeControllerActor)
+    _controller_handle = cls.options(
+        name=CONTROLLER_NAME, num_cpus=0.1, max_concurrency=16
+    ).remote()
+    ray_tpu.get(_controller_handle.ping.remote(), timeout=60)
+    return _controller_handle
+
+
+def run(
+    app: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = None,
+    blocking: bool = False,
+    _wait_for_ready_s: float = 60.0,
+) -> DeploymentHandle:
+    if isinstance(app, Deployment):
+        app = app.bind()
+    if not isinstance(app, Application):
+        raise TypeError("serve.run expects a bound Application (use .bind())")
+    controller = _get_controller_handle(create=True)
+
+    specs = []
+    order = app.walk()
+    for node in order:
+        d = node.deployment
+        # composition: nested Applications become handle markers
+        args = tuple(
+            _HandleMarker(a.deployment.name) if isinstance(a, Application) else a
+            for a in node.args
+        )
+        kwargs = {
+            k: (_HandleMarker(v.deployment.name) if isinstance(v, Application) else v)
+            for k, v in node.kwargs.items()
+        }
+        cfg = d.config
+        specs.append(
+            {
+                "name": d.name,
+                "serialized_target": cloudpickle.dumps(d.func_or_class),
+                "init_args_payload": cloudpickle.dumps((args, kwargs)),
+                "initial_replicas": cfg.initial_replicas(),
+                "max_ongoing_requests": cfg.max_ongoing_requests,
+                "autoscaling_config": (
+                    cfg.autoscaling_config.__dict__ if cfg.autoscaling_config else None
+                ),
+                "ray_actor_options": cfg.ray_actor_options,
+                "health_check_timeout_s": cfg.health_check_timeout_s,
+                "user_config": cfg.user_config,
+            }
+        )
+    ingress = app.deployment.name
+    prefix = route_prefix or app.deployment.route_prefix or "/"
+    ray_tpu.get(
+        controller.deploy_application.remote(name, prefix, specs, ingress),
+        timeout=120,
+    )
+    handle = DeploymentHandle(ingress)
+    # wait until the ingress deployment has live replicas
+    deadline = time.time() + _wait_for_ready_s
+    while time.time() < deadline:
+        names = ray_tpu.get(
+            controller.get_replica_names.remote(ingress), timeout=30
+        )
+        if names:
+            break
+        time.sleep(0.1)
+    if blocking:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def delete(name: str):
+    controller = _get_controller_handle()
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+
+
+def status() -> dict:
+    controller = _get_controller_handle()
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name)
+
+
+def get_app_handle(app_name: str = "default") -> DeploymentHandle:
+    controller = _get_controller_handle()
+    app = ray_tpu.get(controller.get_app_route.remote(app_name), timeout=30)
+    if app is None:
+        raise RuntimeError(f"no application named {app_name!r}")
+    return DeploymentHandle(app["ingress"])
+
+
+def shutdown():
+    global _controller_handle
+    try:
+        controller = _get_controller_handle()
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    _controller_handle = None

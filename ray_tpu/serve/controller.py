@@ -1,0 +1,301 @@
+"""ServeController: reconciles target state into replica actors.
+
+Reference: ``python/ray/serve/_private/controller.py:92`` (ServeController)
++ ``deployment_state.py:1391`` (replica rollout/scaling state machines) +
+``autoscaling_state.py`` (queue-metric autoscaling). One controller actor per
+cluster, named ``serve-controller``; a background reconcile loop:
+
+  target replicas  ->  start/stop replica actors (rolling, health-checked)
+  replica metrics  ->  autoscaling decisions between min/max
+
+TPU delta: a replica can be gang-scheduled on a pod slice via
+``ray_actor_options={"resources": {"TPU": n}}`` — the scheduler's
+slice-aware placement does the rest; multi-host replicas come from the LLM
+layer building a placement group per engine replica.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import traceback
+from typing import Any, Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "serve-controller"
+
+
+class _DeploymentState:
+    def __init__(self, name: str, spec: dict):
+        self.name = name
+        self.spec = spec  # serialized target, init payload, config fields
+        self.replicas: dict[str, Any] = {}  # replica_name -> actor handle
+        self.target = spec["initial_replicas"]
+        self.next_replica_id = 0
+        self.last_scale_t = 0.0
+        self.metric_window: list[tuple[float, float]] = []  # (ts, ongoing)
+        self.status = "UPDATING"
+
+
+class ServeControllerActor:
+    def __init__(self):
+        self._deployments: dict[str, _DeploymentState] = {}
+        self._apps: dict[str, dict] = {}  # app name -> {ingress, route_prefix}
+        self._lock = threading.RLock()
+        # serializes whole reconcile passes: deploy_application's inline pass
+        # must not interleave with the background loop (both would observe the
+        # same replica deficit and start duplicates)
+        self._reconcile_mutex = threading.Lock()
+        self._stop = threading.Event()
+        self._loop = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile"
+        )
+        self._loop.start()
+
+    # -- deploy API ---------------------------------------------------------
+
+    def deploy_application(self, app_name: str, route_prefix: str,
+                           deployments: list[dict], ingress_name: str):
+        with self._lock:
+            for spec in deployments:
+                name = spec["name"]
+                existing = self._deployments.get(name)
+                if existing is None:
+                    self._deployments[name] = _DeploymentState(name, spec)
+                else:
+                    existing.spec = spec
+                    existing.target = spec["initial_replicas"]
+                    existing.status = "UPDATING"
+                    # config rollout: reconfigure live replicas in place
+                    for h in list(existing.replicas.values()):
+                        try:
+                            h.reconfigure.remote(spec.get("user_config"))
+                        except Exception:
+                            pass
+            self._apps[app_name] = {
+                "ingress": ingress_name,
+                "route_prefix": route_prefix,
+                "deployments": [d["name"] for d in deployments],
+            }
+        self._reconcile_once()
+        return True
+
+    def delete_application(self, app_name: str):
+        with self._lock:
+            app = self._apps.pop(app_name, None)
+            if not app:
+                return False
+            still_used = {
+                d for a in self._apps.values() for d in a["deployments"]
+            }
+            for dname in app["deployments"]:
+                if dname in still_used:
+                    continue
+                state = self._deployments.pop(dname, None)
+                if state:
+                    for h in state.replicas.values():
+                        self._kill_replica(h)
+        return True
+
+    def shutdown(self):
+        with self._lock:
+            for state in self._deployments.values():
+                for h in state.replicas.values():
+                    self._kill_replica(h)
+            self._deployments.clear()
+            self._apps.clear()
+        self._stop.set()
+        return True
+
+    # -- introspection ------------------------------------------------------
+
+    def get_replica_names(self, deployment_name: str) -> list[str]:
+        with self._lock:
+            state = self._deployments.get(deployment_name)
+            return list(state.replicas.keys()) if state else []
+
+    def get_app_route(self, app_name: str) -> Optional[dict]:
+        with self._lock:
+            return self._apps.get(app_name)
+
+    def list_routes(self) -> dict:
+        with self._lock:
+            return {
+                a["route_prefix"]: {"app": name, "ingress": a["ingress"]}
+                for name, a in self._apps.items()
+            }
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "applications": {
+                    name: {
+                        "route_prefix": a["route_prefix"],
+                        "deployments": {
+                            d: {
+                                "status": self._deployments[d].status,
+                                "replicas": len(self._deployments[d].replicas),
+                                "target": self._deployments[d].target,
+                            }
+                            for d in a["deployments"]
+                            if d in self._deployments
+                        },
+                    }
+                    for name, a in self._apps.items()
+                }
+            }
+
+    def ping(self):
+        return "pong"
+
+    # -- reconciliation -----------------------------------------------------
+
+    def _reconcile_loop(self):
+        while not self._stop.wait(0.5):
+            try:
+                self._reconcile_once()
+                self._autoscale()
+            except Exception:
+                logger.error("serve reconcile error:\n%s", traceback.format_exc())
+
+    def _reconcile_once(self):
+        with self._reconcile_mutex:
+            with self._lock:
+                states = list(self._deployments.values())
+            for state in states:
+                self._health_check(state)
+                with self._lock:
+                    delta = state.target - len(state.replicas)
+                if delta > 0:
+                    for _ in range(delta):
+                        self._start_replica(state)
+                elif delta < 0:
+                    with self._lock:
+                        victims = list(state.replicas.items())[delta:]
+                        for name, h in victims:
+                            del state.replicas[name]
+                    for _, h in victims:
+                        self._graceful_stop(h)
+                with self._lock:
+                    state.status = (
+                        "RUNNING"
+                        if len(state.replicas) == state.target
+                        else "UPDATING"
+                    )
+
+    def _start_replica(self, state: _DeploymentState):
+        spec = state.spec
+        with self._lock:
+            replica_name = f"serve:{state.name}#{state.next_replica_id}"
+            state.next_replica_id += 1
+        opts = dict(spec.get("ray_actor_options") or {})
+        resources = opts.pop("resources", None)
+        from ray_tpu.serve.replica import ReplicaActor
+
+        cls = ray_tpu.remote(ReplicaActor)
+        try:
+            h = cls.options(
+                name=replica_name,
+                num_cpus=opts.get("num_cpus", 1),
+                resources=resources,
+                max_concurrency=spec.get("max_ongoing_requests", 8),
+                max_restarts=0,  # controller owns restarts
+            ).remote(
+                spec["serialized_target"],
+                spec["init_args_payload"],
+                state.name,
+                replica_name,
+            )
+        except Exception:
+            logger.error("replica start failed:\n%s", traceback.format_exc())
+            return
+        with self._lock:
+            state.replicas[replica_name] = h
+
+    def _health_check(self, state: _DeploymentState):
+        with self._lock:
+            replicas = list(state.replicas.items())
+        if not replicas:
+            return
+        dead = []
+        # one shared deadline for the whole gang — a single hung replica must
+        # not stall the reconcile loop for timeout × num_replicas
+        timeout = state.spec.get("health_check_timeout_s", 30)
+        refs = [(name, h, h.check_health.remote()) for name, h in replicas]
+        deadline = time.time() + timeout
+        for name, h, ref in refs:
+            try:
+                ray_tpu.get(ref, timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                dead.append((name, h))
+        for name, h in dead:
+            logger.warning("replica %s unhealthy; replacing", name)
+            with self._lock:
+                state.replicas.pop(name, None)
+            self._kill_replica(h)
+
+    def _autoscale(self):
+        with self._lock:
+            states = list(self._deployments.values())
+        for state in states:
+            ac_dict = state.spec.get("autoscaling_config")
+            if not ac_dict:
+                continue
+            from ray_tpu.serve.config import AutoscalingConfig
+
+            ac = AutoscalingConfig(**ac_dict)
+            with self._lock:
+                replicas = list(state.replicas.values())
+            total = 0.0
+            for h in replicas:
+                try:
+                    m = ray_tpu.get(h.get_metrics.remote(), timeout=5)
+                    total += m["ongoing"]
+                except Exception:
+                    pass
+            now = time.time()
+            state.metric_window.append((now, total))
+            state.metric_window = [
+                (t, v) for t, v in state.metric_window if now - t < 60
+            ]
+            desired = ac.desired_replicas(total, len(replicas) or 1)
+            if desired > state.target:
+                # upscale only after sustained pressure
+                window = [
+                    v for t, v in state.metric_window if now - t <= ac.upscale_delay_s
+                ]
+                if window and min(window) / max(len(replicas), 1) > ac.target_ongoing_requests:
+                    state.target = desired
+                    state.last_scale_t = now
+            elif desired < state.target:
+                window = [
+                    v
+                    for t, v in state.metric_window
+                    if now - t <= ac.downscale_delay_s
+                ]
+                sustained = len(window) >= 2 and all(
+                    v / max(len(replicas), 1) < ac.target_ongoing_requests
+                    for v in window
+                )
+                if sustained and now - state.last_scale_t > ac.downscale_delay_s:
+                    state.target = desired
+                    state.last_scale_t = now
+
+    # -- teardown helpers ---------------------------------------------------
+
+    def _graceful_stop(self, h):
+        try:
+            ray_tpu.get(h.prepare_shutdown.remote(), timeout=10)
+        except Exception:
+            pass
+        self._kill_replica(h)
+
+    def _kill_replica(self, h):
+        try:
+            ray_tpu.kill(h)
+        except Exception:
+            pass

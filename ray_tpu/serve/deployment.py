@@ -1,0 +1,134 @@
+"""@serve.deployment + application graph.
+
+Reference: ``python/ray/serve/api.py`` (``@serve.deployment``),
+``serve/deployment.py`` (``Deployment.bind`` building a ``Application``
+DAG whose nodes become ``DeploymentHandle``s at deploy time — the model
+composition substrate, ``handle.py:639``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Union
+
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+
+
+class Deployment:
+    def __init__(
+        self,
+        target: Union[type, Callable],
+        name: str,
+        config: DeploymentConfig,
+        route_prefix: Optional[str] = None,
+    ):
+        self._target = target
+        self.name = name
+        self.config = config
+        self.route_prefix = route_prefix
+
+    def options(self, **kwargs) -> "Deployment":
+        import copy
+
+        cfg = copy.deepcopy(self.config)
+        name = kwargs.pop("name", self.name)
+        route_prefix = kwargs.pop("route_prefix", self.route_prefix)
+        if "autoscaling_config" in kwargs:
+            ac = kwargs.pop("autoscaling_config")
+            cfg.autoscaling_config = (
+                AutoscalingConfig(**ac) if isinstance(ac, dict) else ac
+            )
+        for k, v in kwargs.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+            else:
+                raise ValueError(f"unknown deployment option: {k}")
+        return Deployment(self._target, name, cfg, route_prefix)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    @property
+    def func_or_class(self):
+        return self._target
+
+    def __repr__(self):
+        return f"Deployment(name={self.name!r})"
+
+
+class Application:
+    """A bound deployment node; arguments may contain other Applications
+    (composition edges resolved to handles at deploy time)."""
+
+    def __init__(self, deployment: Deployment, args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    def walk(self) -> list["Application"]:
+        """Topological order, dependencies first, dedup by deployment name."""
+        seen: dict[str, Application] = {}
+        order: list[Application] = []
+
+        def visit(app: Application):
+            for a in list(app.args) + list(app.kwargs.values()):
+                if isinstance(a, Application):
+                    visit(a)
+            prev = seen.get(app.deployment.name)
+            if prev is None:
+                seen[app.deployment.name] = app
+                order.append(app)
+            elif prev is not app:
+                # same deployment bound twice with (possibly) different args:
+                # ambiguous — reference requires unique names via .options(name=)
+                raise ValueError(
+                    f"deployment name {app.deployment.name!r} bound more than "
+                    f"once in the application graph; use "
+                    f".options(name=...) to disambiguate"
+                )
+
+        visit(self)
+        return order
+
+
+def deployment(
+    _target: Optional[Union[type, Callable]] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Optional[Union[int, str]] = None,
+    max_ongoing_requests: int = 8,
+    autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
+    ray_actor_options: Optional[dict] = None,
+    health_check_period_s: float = 2.0,
+    health_check_timeout_s: float = 30.0,
+    user_config: Optional[Any] = None,
+    route_prefix: Optional[str] = None,
+) -> Union[Deployment, Callable[..., Deployment]]:
+    """Decorator turning a class or function into a Deployment."""
+
+    if num_replicas == "auto" and autoscaling_config is None:
+        autoscaling_config = AutoscalingConfig()
+        num_replicas = None
+
+    def build(target) -> Deployment:
+        if isinstance(autoscaling_config, dict):
+            ac = AutoscalingConfig(**autoscaling_config)
+        else:
+            ac = autoscaling_config
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas or 1,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=ac,
+            ray_actor_options=ray_actor_options,
+            health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
+            user_config=user_config,
+        )
+        return Deployment(
+            target, name or getattr(target, "__name__", "deployment"), cfg,
+            route_prefix,
+        )
+
+    if _target is not None:
+        return build(_target)
+    return build

@@ -1,0 +1,205 @@
+"""DeploymentHandle: the client for calling a deployment.
+
+Reference: ``python/ray/serve/handle.py:639`` (``DeploymentHandle``,
+``.remote()`` → ``DeploymentResponse`` at ``:715``) and the router's
+power-of-two-choices replica scheduler (``_private/router.py:357``,
+``request_router/``).
+
+The handle keeps a cached replica list (refreshed from the controller — the
+long-poll config-push analog) and client-side in-flight counts; each
+``.remote`` samples two replicas and picks the less loaded (P2C).
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import time
+from typing import Any, Optional
+
+import ray_tpu
+
+_REFRESH_PERIOD_S = 1.0
+
+
+class _HandleMarker:
+    """Serialization marker: an Application arg becomes a handle in the
+    replica (composition edge)."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+
+
+def _resolve_handle_markers(args: tuple, kwargs: dict):
+    def conv(v):
+        return (
+            DeploymentHandle(v.deployment_name)
+            if isinstance(v, _HandleMarker)
+            else v
+        )
+
+    return tuple(conv(a) for a in args), {k: conv(v) for k, v in kwargs.items()}
+
+
+class DeploymentResponse:
+    """Future for one deployment call (reference: ``handle.py``
+    DeploymentResponse). Passing it to another ``.remote`` forwards the
+    underlying ObjectRef, so the value flows replica→replica through the
+    object plane without a driver round-trip."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None):
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self):
+        return self._ref
+
+    def __reduce__(self):
+        # serializing a response (e.g. as a task arg) sends the ref itself
+        return (DeploymentResponse, (self._ref,))
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._call(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._replicas: list = []
+        self._inflight: dict[str, int] = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+        self._done_queue: "queue.Queue" = queue.Queue()
+        self._drainer: Optional[threading.Thread] = None
+
+    # -- replica cache ------------------------------------------------------
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < _REFRESH_PERIOD_S:
+            return
+        from ray_tpu.serve.api import _get_controller_handle
+
+        controller = _get_controller_handle()
+        names = ray_tpu.get(
+            controller.get_replica_names.remote(self.deployment_name), timeout=30
+        )
+        replicas = []
+        for n in names:
+            try:
+                replicas.append((n, ray_tpu.get_actor(n)))
+            except Exception:
+                pass
+        with self._lock:
+            self._replicas = replicas
+            self._inflight = {n: self._inflight.get(n, 0) for n, _ in replicas}
+            self._last_refresh = now
+
+    # -- routing ------------------------------------------------------------
+
+    def _pick_replica(self):
+        """Power-of-two-choices on client-side in-flight counts."""
+        self._refresh()
+        deadline = time.monotonic() + 30.0
+        while True:
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for deployment {self.deployment_name!r}"
+                )
+            time.sleep(0.1)
+            self._refresh(force=True)
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        with self._lock:
+            return a if self._inflight.get(a[0], 0) <= self._inflight.get(b[0], 0) else b
+
+    def _call(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+        name, actor = self._pick_replica()
+        with self._lock:
+            self._inflight[name] = self._inflight.get(name, 0) + 1
+
+        args = tuple(
+            a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+            for a in args
+        )
+        kwargs = {
+            k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v)
+            for k, v in kwargs.items()
+        }
+        try:
+            ref = actor.handle_request.remote(method, *args, **kwargs)
+        except Exception:
+            with self._lock:
+                self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
+            raise
+        resp = DeploymentResponse(ref)
+        # decrement in-flight when the result lands (single drainer thread)
+        self._done_queue.put((name, ref))
+        with self._lock:
+            if self._drainer is None or not self._drainer.is_alive():
+                self._drainer = threading.Thread(
+                    target=self._drain_loop, daemon=True,
+                    name=f"handle-drain-{self.deployment_name}",
+                )
+                self._drainer.start()
+        return resp
+
+    def _drain_loop(self):
+        """Decrement in-flight counts as requests finish. All pending refs
+        are waited on together — a slow request must not head-of-line-block
+        the accounting for fast ones (P2C routes on these counts)."""
+        pending: dict = {}  # ref -> replica name
+        while True:
+            block = not pending
+            try:
+                name, ref = self._done_queue.get(block=block, timeout=None)
+                pending[ref] = name
+                # opportunistically drain whatever else is queued
+                while True:
+                    name, ref = self._done_queue.get_nowait()
+                    pending[ref] = name
+            except queue.Empty:
+                pass
+            if not pending:
+                continue
+            try:
+                ready, _ = ray_tpu.wait(
+                    list(pending), num_returns=1, timeout=0.5
+                )
+            except Exception:
+                ready = []
+            for ref in ready:
+                name = pending.pop(ref)
+                with self._lock:
+                    self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._call("__call__", args, kwargs)
+
+    def __getattr__(self, item: str) -> _MethodCaller:
+        if item.startswith("_") or item in ("deployment_name", "remote"):
+            raise AttributeError(item)
+        return _MethodCaller(self, item)
+
+    def options(self, **_kwargs) -> "DeploymentHandle":
+        return self  # API parity (stream=False etc.)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self.deployment_name!r})"

@@ -1,0 +1,186 @@
+"""HTTP proxy: routes requests to application ingress handles.
+
+Reference: ``python/ray/serve/_private/proxy.py:1009`` (``ProxyActor``;
+``HTTPProxy`` ``:697`` is uvicorn/ASGI there). Here: a stdlib
+``ThreadingHTTPServer`` running inside an actor (its handler threads call
+deployment handles concurrently; the worker RPC channel is thread-safe).
+
+Request contract: the ingress callable receives a ``Request`` object with
+``.method``, ``.path``, ``.query_params``, ``.headers``, ``.body``,
+``.json()``. Its return value is JSON-encoded (dict/list/str/numbers) or
+sent raw for ``bytes``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
+
+import ray_tpu
+
+
+class Request:
+    def __init__(self, method: str, path: str, query: dict, headers: dict, body: bytes):
+        self.method = method
+        self.path = path
+        self.query_params = query
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"null")
+
+    def __reduce__(self):
+        return (
+            Request,
+            (self.method, self.path, self.query_params, self.headers, self.body),
+        )
+
+
+class ProxyActor:
+    """Runs the HTTP server; one per node in a real cluster (here: one)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        self._routes: dict[str, DeploymentHandle] = {}
+        self._routes_lock = threading.Lock()
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _handle(self):
+                try:
+                    parsed = urlparse(self.path)
+                    if parsed.path == "/-/healthz":
+                        return self._respond(200, b"ok", "text/plain")
+                    if parsed.path == "/-/routes":
+                        return self._respond(
+                            200,
+                            json.dumps(proxy._route_table()).encode(),
+                            "application/json",
+                        )
+                    handle, rest = proxy._match(parsed.path)
+                    if handle is None:
+                        return self._respond(404, b"no route", "text/plain")
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    req = Request(
+                        self.command,
+                        rest,
+                        {k: v[-1] for k, v in parse_qs(parsed.query).items()},
+                        dict(self.headers.items()),
+                        body,
+                    )
+                    result = handle.remote(req).result(timeout_s=120)
+                    if isinstance(result, bytes):
+                        return self._respond(200, result, "application/octet-stream")
+                    return self._respond(
+                        200, json.dumps(result).encode(), "application/json"
+                    )
+                except Exception:
+                    return self._respond(
+                        500, traceback.format_exc().encode(), "text/plain"
+                    )
+
+            def _respond(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _handle
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="serve-http"
+        )
+        self._thread.start()
+        self._refresher = threading.Thread(
+            target=self._refresh_loop, daemon=True, name="serve-routes"
+        )
+        self._refresher.start()
+
+    # -- routing table ------------------------------------------------------
+
+    def _refresh_loop(self):
+        import time
+
+        from ray_tpu.serve.api import _get_controller_handle
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        while True:
+            try:
+                controller = _get_controller_handle()
+                routes = ray_tpu.get(controller.list_routes.remote(), timeout=10)
+                with self._routes_lock:
+                    self._routes = {
+                        prefix: DeploymentHandle(info["ingress"])
+                        for prefix, info in routes.items()
+                    }
+            except Exception:
+                pass
+            time.sleep(1.0)
+
+    def _route_table(self) -> dict:
+        with self._routes_lock:
+            return {p: h.deployment_name for p, h in self._routes.items()}
+
+    def _match(self, path: str):
+        with self._routes_lock:
+            routes = dict(self._routes)
+        best = None
+        for prefix, handle in routes.items():
+            norm = prefix.rstrip("/") or ""
+            if path == norm or path.startswith(norm + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, handle)
+        if best is None:
+            return None, path
+        rest = path[len(best[0].rstrip("/")) :] or "/"
+        return best[1], rest
+
+    # -- control ------------------------------------------------------------
+
+    def get_port(self) -> int:
+        return self._port
+
+    def ready(self) -> bool:
+        return True
+
+    def shutdown(self):
+        self._server.shutdown()
+        return True
+
+
+_proxy_handle = None
+
+
+def start_proxy(port: int = 8000):
+    """Ensure the proxy actor is running; returns (handle, port)."""
+    global _proxy_handle
+    if _proxy_handle is not None:
+        try:
+            return _proxy_handle, ray_tpu.get(_proxy_handle.get_port.remote(), timeout=5)
+        except Exception:
+            _proxy_handle = None
+    try:
+        _proxy_handle = ray_tpu.get_actor("serve-proxy")
+    except Exception:
+        cls = ray_tpu.remote(ProxyActor)
+        _proxy_handle = cls.options(
+            name="serve-proxy", num_cpus=0.1, max_concurrency=32
+        ).remote(port=port)
+    real_port = ray_tpu.get(_proxy_handle.get_port.remote(), timeout=60)
+    return _proxy_handle, real_port

@@ -1,0 +1,97 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Reference: ``python/ray/serve/_private/replica.py:858`` (``Replica`` +
+``UserCallableWrapper`` ``:1164``): construct the user class, count ongoing
+requests, expose health checks and metrics. Runs with
+``max_concurrency = max_ongoing_requests`` so concurrent requests share the
+replica (TPU replicas batch inside the callable — continuous batching lives
+in the LLM layer's engine loop, not here).
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+from typing import Any, Optional
+
+
+class ReplicaActor:
+    def __init__(
+        self,
+        serialized_target: bytes,
+        init_args_payload: bytes,
+        deployment_name: str,
+        replica_id: str,
+    ):
+        import cloudpickle
+
+        from ray_tpu.serve.handle import _resolve_handle_markers
+
+        target = cloudpickle.loads(serialized_target)
+        args, kwargs = cloudpickle.loads(init_args_payload)
+        args, kwargs = _resolve_handle_markers(args, kwargs)
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if inspect.isclass(target):
+            self._callable = target(*args, **kwargs)
+        else:
+            # function deployment: the function IS the handler
+            self._callable = target
+        self._user_health_check = getattr(self._callable, "check_health", None)
+        # reconfigure(user_config) support (reference: user_config rollouts)
+        self._user_config = None
+
+    # -- data plane ---------------------------------------------------------
+
+    def handle_request(self, method: str, *args, **kwargs):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if inspect.isfunction(self._callable) or inspect.isbuiltin(
+                self._callable
+            ):
+                fn = self._callable  # function deployment: one entry point
+            else:
+                fn = getattr(self._callable, method)
+            return fn(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # -- control plane ------------------------------------------------------
+
+    def reconfigure(self, user_config):
+        self._user_config = user_config
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        return True
+
+    def check_health(self) -> bool:
+        if self._user_health_check is not None:
+            self._user_health_check()  # raises if unhealthy
+        return True
+
+    def get_metrics(self) -> dict:
+        with self._lock:
+            return {
+                "ongoing": self._ongoing,
+                "total": self._total,
+                "ts": time.time(),
+            }
+
+    def prepare_shutdown(self) -> bool:
+        """Graceful drain hook (reference: graceful_shutdown_timeout_s)."""
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            with self._lock:
+                if self._ongoing == 0:
+                    break
+            time.sleep(0.05)
+        if hasattr(self._callable, "__del__"):
+            pass  # actor teardown runs destructors
+        return True

@@ -88,6 +88,13 @@ def test_random_shuffle(ray_start_thread):
     assert ids != list(range(200))
 
 
+def test_random_shuffle_single_block(ray_start_thread):
+    # regression: bucket-order shuffle was a no-op for one block
+    ids = [r["id"] for r in rd.range(100, parallelism=1).random_shuffle(seed=0).take_all()]
+    assert sorted(ids) == list(range(100))
+    assert ids != list(range(100))
+
+
 def test_sort(ray_start_thread):
     rng = np.random.default_rng(0)
     vals = rng.permutation(500)

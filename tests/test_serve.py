@@ -1,0 +1,243 @@
+"""Serve tests.
+
+Coverage modeled on the reference's ``python/ray/serve/tests``
+(``test_api.py``, ``test_handle.py``, ``test_batching.py``,
+``test_autoscaling_policy.py``, ``test_proxy.py``).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+pytestmark = pytest.mark.timeout(300) if hasattr(pytest.mark, "timeout") else []
+
+
+@pytest.fixture
+def serve_instance(ray_start_thread):
+    yield
+    serve.shutdown()
+
+
+def test_function_deployment(serve_instance):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind(), name="fn")
+    assert handle.remote(21).result() == 42
+
+
+def test_class_deployment_state(serve_instance):
+    @serve.deployment
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def incr(self, k):
+            self.n += k
+            return self.n
+
+        def __call__(self, req):
+            return self.n
+
+    handle = serve.run(Counter.bind(10), name="counter")
+    assert handle.incr.remote(5).result() == 15
+    assert handle.incr.remote(5).result() == 20
+    assert handle.remote(None).result() == 20
+
+
+def test_multiple_replicas_roundrobin(serve_instance):
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __init__(self):
+            import os
+            import threading
+
+            self.ident = f"{os.getpid()}-{id(self)}"
+
+        def __call__(self, req):
+            return self.ident
+
+    handle = serve.run(WhoAmI.bind(), name="who")
+    idents = {handle.remote(None).result() for _ in range(20)}
+    assert len(idents) == 2  # both replicas served
+
+
+def test_composition(serve_instance):
+    @serve.deployment
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+    @serve.deployment
+    class Combiner:
+        def __init__(self, a, b):
+            self.a = a
+            self.b = b
+
+        def __call__(self, x):
+            ra = self.a.remote(x)
+            rb = self.b.remote(x)
+            return ra.result() + rb.result()
+
+    app = Combiner.bind(
+        Adder.options(name="add1").bind(1),
+        Adder.options(name="add100").bind(100),
+    )
+    handle = serve.run(app, name="comp")
+    assert handle.remote(0).result() == 101
+
+    # binding the same name twice with different args is an explicit error
+    with pytest.raises(ValueError, match="bound more than once"):
+        Combiner.bind(Adder.bind(1), Adder.bind(2)).walk()
+
+
+def test_deployment_options_override(serve_instance):
+    @serve.deployment
+    def f(x):
+        return x
+
+    d = f.options(num_replicas=2, name="renamed")
+    assert d.name == "renamed"
+    assert d.config.num_replicas == 2
+
+
+def test_status_and_delete(serve_instance):
+    @serve.deployment
+    def g(x):
+        return x
+
+    serve.run(g.bind(), name="app1")
+    st = serve.status()
+    assert "app1" in st["applications"]
+    assert st["applications"]["app1"]["deployments"]["g"]["replicas"] == 1
+    serve.delete("app1")
+    st = serve.status()
+    assert "app1" not in st["applications"]
+
+
+def test_batching(serve_instance):
+    @serve.deployment(max_ongoing_requests=16)
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def handle_batch(self, xs):
+            # whole batch processed at once; size recorded in result
+            return [(x, len(xs)) for x in xs]
+
+        def __call__(self, x):
+            return self.handle_batch(x)
+
+    handle = serve.run(Batched.bind(), name="batched")
+    # fire 4 concurrent requests: they should coalesce into one batch
+    responses = [handle.remote(i) for i in range(4)]
+    results = [r.result() for r in responses]
+    assert sorted(x for x, _ in results) == [0, 1, 2, 3]
+    assert max(bs for _, bs in results) >= 2  # at least some batching happened
+
+
+def test_multiplex(serve_instance):
+    @serve.deployment
+    class MultiModel:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            return {"id": model_id, "loaded_at": time.time()}
+
+        def __call__(self, model_id):
+            m = self.get_model(model_id)
+            return (m["id"], serve.get_multiplexed_model_id())
+
+    handle = serve.run(MultiModel.bind(), name="mm")
+    assert handle.remote("a").result() == ("a", "a")
+    assert handle.remote("b").result() == ("b", "b")
+    assert handle.remote("a").result() == ("a", "a")
+
+
+def test_replica_failure_recovery(serve_instance):
+    @serve.deployment
+    class Fragile:
+        def __call__(self, req):
+            if req == "die":
+                import os
+
+                os._exit(1) if False else None  # thread mode: don't kill proc
+                raise SystemExit
+            return "ok"
+
+    handle = serve.run(Fragile.bind(), name="fragile")
+    assert handle.remote("x").result() == "ok"
+    # kill the replica actor directly; controller should replace it
+    controller = ray_tpu.get_actor("serve-controller")
+    names = ray_tpu.get(controller.get_replica_names.remote("Fragile"))
+    ray_tpu.kill(ray_tpu.get_actor(names[0]))
+    deadline = time.time() + 30
+    ok = False
+    while time.time() < deadline:
+        try:
+            new_names = ray_tpu.get(
+                controller.get_replica_names.remote("Fragile"), timeout=10
+            )
+            if new_names and new_names != names:
+                ok = True
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    assert ok, "controller did not replace the killed replica"
+    # traffic works again (handle refreshes its cache)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            assert handle.remote("x").result(timeout_s=10) == "ok"
+            break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        raise AssertionError("traffic did not recover")
+
+
+def test_http_proxy_end_to_end(serve_instance):
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            data = request.json()
+            return {"path": request.path, "echo": data}
+
+    serve.run(Echo.bind(), name="echo", route_prefix="/echo")
+    _, port = serve.start_proxy(port=0)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/-/routes", timeout=5
+            ) as r:
+                routes = json.loads(r.read())
+            if "/echo" in routes:
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo/predict",
+        data=json.dumps({"x": 1}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out == {"path": "/predict", "echo": {"x": 1}}
+
+
+def test_autoscaling_config_math():
+    ac = serve.AutoscalingConfig(
+        min_replicas=1, max_replicas=8, target_ongoing_requests=2
+    )
+    assert ac.desired_replicas(total_ongoing=8, current=2) == 4
+    assert ac.desired_replicas(total_ongoing=0, current=4) == 1
+    assert ac.desired_replicas(total_ongoing=100, current=4) == 8
