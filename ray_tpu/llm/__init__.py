@@ -1,0 +1,33 @@
+"""ray_tpu.llm — TPU-native LLM serving + batch inference.
+
+Public surface mirrors the reference's ``ray.llm`` / ``ray.serve.llm``
+(SURVEY §2.3): ``LLMConfig``, ``build_openai_app`` (OpenAI-compatible
+serving), batch ``build_llm_processor`` — with the vLLM dependency replaced
+by the in-repo ``JaxEngine`` (static-slot continuous batching compiled by
+XLA; see ``engine.py``).
+"""
+
+from ray_tpu.llm.batch import ProcessorConfig, build_llm_processor
+from ray_tpu.llm.builders import build_llm_deployment, build_openai_app
+from ray_tpu.llm.config import (
+    EngineConfig,
+    LLMConfig,
+    ModelConfig,
+    SamplingParams,
+)
+from ray_tpu.llm.engine import JaxEngine, RequestOutput
+from ray_tpu.llm.server import LLMServer
+
+__all__ = [
+    "EngineConfig",
+    "JaxEngine",
+    "LLMConfig",
+    "LLMServer",
+    "ModelConfig",
+    "ProcessorConfig",
+    "RequestOutput",
+    "SamplingParams",
+    "build_llm_deployment",
+    "build_llm_processor",
+    "build_openai_app",
+]

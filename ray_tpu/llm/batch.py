@@ -1,0 +1,93 @@
+"""Offline batch inference over ray_tpu.data.
+
+Reference: ``python/ray/llm/_internal/batch/processor/`` (``Processor``
+stages; ``vllm_engine_proc.py``). ``build_llm_processor(config)`` returns a
+callable Dataset→Dataset that tokenizes, runs the engine over each block,
+and detokenizes — the engine is constructed once per worker process and
+cached (actor-pool analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+
+_ENGINE_CACHE: dict = {}
+
+
+def _get_engine(config: LLMConfig):
+    """Per-process engine cache (map tasks reuse worker processes)."""
+    key = (
+        config.model.model_id,
+        config.model.checkpoint_path,
+        config.engine.max_num_seqs,
+        config.engine.max_seq_len,
+    )
+    eng = _ENGINE_CACHE.get(key)
+    if eng is None:
+        from ray_tpu.llm.engine import JaxEngine
+
+        eng = JaxEngine(config)
+        _ENGINE_CACHE[key] = eng
+    return eng
+
+
+@dataclasses.dataclass
+class ProcessorConfig:
+    llm_config: LLMConfig
+    batch_size: int = 16
+    prompt_column: str = "prompt"
+    output_column: str = "generated_text"
+    sampling_params: Optional[dict] = None
+
+
+def build_llm_processor(
+    config: ProcessorConfig,
+    preprocess: Optional[Callable[[dict], dict]] = None,
+    postprocess: Optional[Callable[[dict], dict]] = None,
+) -> Callable:
+    """Returns fn(Dataset) -> Dataset adding generated text per row."""
+
+    llm_config = config.llm_config
+    sp = dict(config.sampling_params or {})
+    prompt_col = config.prompt_column
+    out_col = config.output_column
+
+    def _infer(batch: dict) -> dict:
+        import numpy as np
+
+        from ray_tpu.llm.batch import _get_engine, _sampling
+
+        engine = _get_engine(llm_config)
+        prompts = [str(p) for p in batch[prompt_col]]
+        reqs = [
+            engine.submit(p, sampling_params=_sampling(sp)) for p in prompts
+        ]
+        texts = []
+        for r in reqs:
+            r.done.wait()
+            if r.error is not None:
+                raise r.error
+            texts.append(engine.tokenizer.decode(r.out_tokens))
+        out = dict(batch)
+        out[out_col] = np.asarray(texts, dtype=object)
+        return out
+
+    def apply(ds):
+        if preprocess is not None:
+            ds = ds.map(preprocess)
+        ds = ds.map_batches(
+            _infer, batch_size=config.batch_size, batch_format="dict"
+        )
+        if postprocess is not None:
+            ds = ds.map(postprocess)
+        return ds
+
+    return apply
+
+
+def _sampling(d: dict) -> SamplingParams:
+    allowed = {f for f in SamplingParams.__dataclass_fields__}
+    return SamplingParams(**{k: v for k, v in d.items() if k in allowed})

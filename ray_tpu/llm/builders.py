@@ -1,0 +1,36 @@
+"""Application builders (reference:
+``llm/_internal/serve/builders/application_builders.py:55``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from ray_tpu import serve
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.llm.openai_api import OpenAIRouter
+from ray_tpu.llm.server import LLMServer
+
+
+def build_llm_deployment(llm_config: LLMConfig) -> "serve.Application":
+    d = serve.deployment(
+        LLMServer,
+        name=f"llm:{llm_config.served_name}",
+        num_replicas=llm_config.num_replicas,
+        max_ongoing_requests=llm_config.engine.max_num_seqs * 2,
+        ray_actor_options=llm_config.ray_actor_options,
+        autoscaling_config=llm_config.autoscaling_config,
+    )
+    return d.bind(llm_config)
+
+
+def build_openai_app(llm_configs: Union[LLMConfig, list[LLMConfig]]) -> "serve.Application":
+    """One OpenAI-compatible app over N model deployments."""
+    if isinstance(llm_configs, LLMConfig):
+        llm_configs = [llm_configs]
+    handles = {
+        cfg.served_name: build_llm_deployment(cfg) for cfg in llm_configs
+    }
+    router = serve.deployment(
+        OpenAIRouter, name="openai-router", max_ongoing_requests=64
+    )
+    return router.bind(**handles)

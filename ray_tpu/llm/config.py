@@ -1,0 +1,57 @@
+"""LLM configs.
+
+Reference: ``python/ray/llm/_internal/serve/configs/`` (``LLMConfig``,
+engine kwargs incl. ``tensor_parallel_degree`` — ``vllm_models.py:176-190``).
+TPU delta: parallelism is expressed as a mesh spec (tp/sp axes) applied to
+the JAX engine's params, not forwarded to an external engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0  # 0 = greedy
+    top_k: int = 50
+    stop_token_ids: Optional[list[int]] = None
+    ignore_eos: bool = False
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Engine shape knobs (static: they size compiled programs)."""
+
+    max_num_seqs: int = 8  # decode slot count (continuous batching width)
+    max_seq_len: int = 512
+    prefill_buckets: tuple = (32, 64, 128, 256, 512)
+    tensor_parallel_degree: int = 1
+    sequence_parallel_degree: int = 1
+    dtype: str = "bfloat16"
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    model_id: str = "tiny"  # "tiny" | "llama2-7b" | "llama3-8b" | path
+    tokenizer: str = "byte"  # "byte" | transformers tokenizer path
+    checkpoint_path: Optional[str] = None  # ray_tpu.train pytree checkpoint
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    # serve-level options
+    name: Optional[str] = None
+    num_replicas: int = 1
+    ray_actor_options: Optional[dict] = None
+    autoscaling_config: Optional[dict] = None
+
+    @property
+    def served_name(self) -> str:
+        return self.name or self.model.model_id

@@ -1,0 +1,365 @@
+"""JaxEngine: continuous-batching LLM inference on TPU.
+
+The TPU-native replacement for the reference's delegated vLLM engine
+(``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py``).
+Where vLLM's paged attention uses dynamic block tables (a GPU-pointer idiom),
+the TPU engine keeps everything static for XLA:
+
+- a fixed decode batch of ``max_num_seqs`` SLOTS, each owning a
+  ``max_seq_len`` stripe of the KV cache — one compiled decode program,
+  [slots, 1] tokens/step, runs forever regardless of admission/eviction;
+- prompt prefill compiles once per length BUCKET (powers of two) and
+  scatters the resulting K/V into the idle slot's stripe;
+- continuous batching = host-side slot bookkeeping between device steps:
+  finished slots free instantly, waiting requests prefill into free slots
+  while other slots keep decoding (no global barrier on admission);
+- sampling (greedy / temperature / top-k) runs in-program; only sampled
+  token ids cross back to the host each step.
+
+TP/SP: params and cache shard over a mesh via the model's logical rules
+(``parallel/mesh.py``) when ``tensor_parallel_degree > 1``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from ray_tpu.llm.config import EngineConfig, LLMConfig, ModelConfig, SamplingParams
+from ray_tpu.llm.tokenizer import get_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    prompt_token_ids: list
+    token_ids: list
+    text: str
+    finish_reason: str  # "stop" | "length"
+    metrics: dict
+
+
+class _Request:
+    def __init__(self, request_id: str, token_ids: list[int], params: SamplingParams):
+        self.request_id = request_id
+        self.prompt_token_ids = token_ids
+        self.params = params
+        self.out_tokens: list[int] = []
+        self.finish_reason: Optional[str] = None
+        self.done = threading.Event()
+        self.stream_queue: "queue.Queue" = queue.Queue()
+        self.submitted_t = time.time()
+        self.first_token_t: Optional[float] = None
+        self.error: Optional[BaseException] = None
+
+
+class JaxEngine:
+    def __init__(self, config: LLMConfig, mesh=None):
+        import jax
+
+        self.config = config
+        self.tokenizer = get_tokenizer(config.model.tokenizer)
+        self._mesh = mesh
+        self._build_model()
+        self._compile()
+        self._waiting: "queue.Queue[_Request]" = queue.Queue()
+        self._slots: list[Optional[_Request]] = [None] * config.engine.max_num_seqs
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._engine_loop, daemon=True, name="llm-engine"
+        )
+        self._thread.start()
+
+    # -- model setup --------------------------------------------------------
+
+    def _build_model(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import (
+            LlamaConfig,
+            init_kv_cache,
+            init_params,
+        )
+        from ray_tpu.train.checkpoint import restore_pytree
+
+        mc, ec = self.config.model, self.config.engine
+        presets = {
+            "tiny": LlamaConfig.tiny,
+            "llama2-7b": LlamaConfig.llama2_7b,
+            "llama3-8b": LlamaConfig.llama3_8b,
+            "llama3-70b": LlamaConfig.llama3_70b,
+        }
+        kw = dict(
+            max_seq_len=ec.max_seq_len,
+            dtype=jnp.bfloat16 if ec.dtype == "bfloat16" else jnp.float32,
+        )
+        if mc.model_id in presets:
+            self.model_cfg = presets[mc.model_id](**kw)
+        else:
+            raise ValueError(f"unknown model_id: {mc.model_id}")
+        if self.model_cfg.vocab_size < self.tokenizer.vocab_size:
+            self.model_cfg = dataclasses.replace(
+                self.model_cfg, vocab_size=self.tokenizer.vocab_size
+            )
+        if ec.tensor_parallel_degree > 1 or ec.sequence_parallel_degree > 1:
+            from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+            if self._mesh is None:
+                self._mesh = build_mesh(
+                    MeshSpec(
+                        tp=ec.tensor_parallel_degree,
+                        sp=ec.sequence_parallel_degree,
+                    )
+                )
+        if mc.checkpoint_path:
+            self.params = restore_pytree(mc.checkpoint_path)
+        else:
+            self.params = init_params(
+                jax.random.PRNGKey(mc.seed), self.model_cfg, mesh=self._mesh
+            )
+        self.cache = init_kv_cache(
+            self.model_cfg, ec.max_num_seqs, ec.max_seq_len
+        )
+
+    def _compile(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import decode_step, prefill
+
+        cfg = self.model_cfg
+        ec = self.config.engine
+
+        def decode_fn(params, cache, tokens, temps, key):
+            """Decode + in-program sampling: greedy where temp<=0, else
+            top-50/temperature categorical, per row."""
+            logits, cache = decode_step(params, cache, tokens, cfg)
+            greedy = jnp.argmax(logits, axis=-1)
+            vals, idxs = jax.lax.top_k(logits, min(50, cfg.vocab_size))
+            scaled = vals / jnp.maximum(temps, 1e-6)[:, None]
+            choice = jax.random.categorical(key, scaled, axis=-1)
+            sampled = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
+            next_tokens = jnp.where(temps <= 0.0, greedy, sampled)
+            return next_tokens, cache
+
+        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+
+        def prefill_one(params, cache, tokens, length, slot):
+            """Prefill a single sequence (B=1) and scatter into `slot`."""
+            from ray_tpu.models.llama import init_kv_cache
+
+            one = init_kv_cache(cfg, 1, ec.max_seq_len)
+            last_logits, one = prefill(params, one, tokens, cfg, lengths=length)
+            cache = {
+                "k": cache["k"].at[:, slot].set(one["k"][:, 0]),
+                "v": cache["v"].at[:, slot].set(one["v"][:, 0]),
+                "length": cache["length"].at[slot].set(length[0]),
+            }
+            return last_logits[0], cache
+
+        self._prefill = jax.jit(prefill_one, donate_argnums=(1,))
+        self._rng_key = jax.random.PRNGKey(self.config.model.seed)
+
+    # -- public API ---------------------------------------------------------
+
+    def generate(
+        self,
+        prompt: Optional[str] = None,
+        *,
+        prompt_token_ids: Optional[list[int]] = None,
+        sampling_params: Optional[SamplingParams] = None,
+    ) -> RequestOutput:
+        req = self.submit(
+            prompt, prompt_token_ids=prompt_token_ids, sampling_params=sampling_params
+        )
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+        return self._output(req)
+
+    def generate_stream(
+        self,
+        prompt: Optional[str] = None,
+        *,
+        prompt_token_ids: Optional[list[int]] = None,
+        sampling_params: Optional[SamplingParams] = None,
+    ) -> Iterator[dict]:
+        """Yields {'token_id', 'text', 'done'} increments."""
+        req = self.submit(
+            prompt, prompt_token_ids=prompt_token_ids, sampling_params=sampling_params
+        )
+        while True:
+            item = req.stream_queue.get()
+            if item is None:
+                break
+            yield item
+        if req.error is not None:
+            raise req.error
+
+    def submit(self, prompt=None, *, prompt_token_ids=None, sampling_params=None) -> _Request:
+        if prompt_token_ids is None:
+            if prompt is None:
+                raise ValueError("prompt or prompt_token_ids required")
+            prompt_token_ids = self.tokenizer.encode(prompt)
+        max_prompt = self.config.engine.max_seq_len - 1
+        if len(prompt_token_ids) > max_prompt:
+            prompt_token_ids = prompt_token_ids[-max_prompt:]
+        req = _Request(
+            uuid.uuid4().hex[:12], list(prompt_token_ids),
+            sampling_params or SamplingParams(),
+        )
+        self._waiting.put(req)
+        return req
+
+    def _output(self, req: _Request) -> RequestOutput:
+        return RequestOutput(
+            request_id=req.request_id,
+            prompt_token_ids=req.prompt_token_ids,
+            token_ids=list(req.out_tokens),
+            text=self.tokenizer.decode(req.out_tokens),
+            finish_reason=req.finish_reason or "stop",
+            metrics={
+                "ttft_s": (req.first_token_t or time.time()) - req.submitted_t,
+                "num_generated": len(req.out_tokens),
+            },
+        )
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def get_stats(self) -> dict:
+        return {
+            "active_slots": sum(s is not None for s in self._slots),
+            "waiting": self._waiting.qsize(),
+            "max_num_seqs": len(self._slots),
+        }
+
+    # -- engine loop --------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.config.engine.prefill_buckets:
+            if n <= b and b <= self.config.engine.max_seq_len:
+                return b
+        return self.config.engine.max_seq_len
+
+    def _engine_loop(self):
+        import jax
+        import jax.numpy as jnp
+
+        ec = self.config.engine
+        temps = np.zeros((ec.max_num_seqs,), np.float32)
+        self._pending_first: dict[int, int] = {}  # slot -> first sampled token
+        pending_first = self._pending_first
+
+        while not self._stop.is_set():
+            # 1) admit waiting requests into free slots (prefill)
+            admitted = False
+            for slot in range(ec.max_num_seqs):
+                if self._slots[slot] is not None:
+                    continue
+                try:
+                    req = self._waiting.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    ids = req.prompt_token_ids
+                    bucket = self._bucket(len(ids))
+                    toks = np.zeros((1, bucket), np.int32)
+                    toks[0, : len(ids)] = ids
+                    last_logits, self.cache = self._prefill(
+                        self.params,
+                        self.cache,
+                        jnp.asarray(toks),
+                        jnp.asarray([len(ids)], jnp.int32),
+                        slot,
+                    )
+                    # sample the first generated token from prefill logits
+                    first = int(np.argmax(np.asarray(last_logits)))
+                    if req.params.temperature > 0:
+                        self._rng_key, sub = jax.random.split(self._rng_key)
+                        l = jnp.asarray(last_logits)
+                        k = min(req.params.top_k, self.model_cfg.vocab_size)
+                        v, ix = jax.lax.top_k(l, k)
+                        c = jax.random.categorical(
+                            sub, v / max(req.params.temperature, 1e-6)
+                        )
+                        first = int(ix[c])
+                    self._slots[slot] = req
+                    temps[slot] = req.params.temperature
+                    pending_first[slot] = first
+                    req.first_token_t = time.time()
+                    self._emit(slot, first)
+                    admitted = True
+                except BaseException as e:  # noqa: BLE001
+                    req.error = e
+                    req.done.set()
+                    req.stream_queue.put(None)
+
+            active = [s for s, r in enumerate(self._slots) if r is not None]
+            if not active:
+                time.sleep(0.002 if admitted else 0.005)
+                continue
+
+            # 2) one decode step over ALL slots (static shape)
+            tokens = np.zeros((ec.max_num_seqs,), np.int32)
+            for slot in active:
+                req = self._slots[slot]
+                tokens[slot] = (
+                    pending_first.pop(slot)
+                    if slot in pending_first
+                    else req.out_tokens[-1]
+                )
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            next_tokens, self.cache = self._decode(
+                self.params, self.cache, jnp.asarray(tokens), jnp.asarray(temps), sub
+            )
+            next_np = np.asarray(next_tokens)
+
+            # 3) bookkeeping: emit tokens, finish slots
+            for slot in active:
+                req = self._slots[slot]
+                tok = int(next_np[slot])
+                self._emit(slot, tok)
+
+    def _emit(self, slot: int, token: int):
+        """Record a generated token for the request in `slot`; finish on
+        eos/max_tokens/cache-full."""
+        req = self._slots[slot]
+        if req is None:
+            return
+        p = req.params
+        eos = self.tokenizer.eos_id
+        stop_ids = set(p.stop_token_ids or [])
+        if not p.ignore_eos:
+            stop_ids.add(eos)
+        is_stop = token in stop_ids
+        if not is_stop:
+            req.out_tokens.append(token)
+            req.stream_queue.put(
+                {
+                    "token_id": token,
+                    "text": self.tokenizer.decode([token]),
+                    "done": False,
+                }
+            )
+        total = len(req.prompt_token_ids) + len(req.out_tokens)
+        out_of_room = total >= self.config.engine.max_seq_len
+        if is_stop or len(req.out_tokens) >= p.max_tokens or out_of_room:
+            req.finish_reason = "stop" if is_stop else "length"
+            self._slots[slot] = None
+            # a request can finish at admission (max_tokens=1): its queued
+            # first token must not leak into the slot's next occupant
+            getattr(self, "_pending_first", {}).pop(slot, None)
+            req.stream_queue.put(None)
+            req.done.set()

@@ -1,0 +1,53 @@
+"""OpenAI-compatible HTTP router over LLMServer deployments.
+
+Reference: ``python/ray/llm/_internal/serve/routers/`` (OpenAI router) +
+``builders/application_builders.py:55`` (``build_openai_app``). The router is
+itself a serve deployment (ingress): it owns handles to one or more
+LLMServer deployments keyed by model name and translates
+``/v1/chat/completions`` / ``/v1/completions`` / ``/v1/models``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+
+class OpenAIRouter:
+    """Ingress deployment: routes OpenAI API requests to model deployments."""
+
+    def __init__(self, **model_handles):
+        # kwargs: model name -> DeploymentHandle of an LLMServer
+        self._models = model_handles
+
+    def __call__(self, request) -> Any:
+        path = request.path
+        if path.endswith("/v1/models") or path == "/models":
+            return {
+                "object": "list",
+                "data": [
+                    {"id": name, "object": "model", "owned_by": "ray_tpu"}
+                    for name in self._models
+                ],
+            }
+        try:
+            body = request.json()
+        except Exception:
+            return {"error": {"message": "invalid JSON body", "code": 400}}
+        model = (body or {}).get("model")
+        handle = self._models.get(model)
+        if handle is None:
+            if len(self._models) == 1 and model is None:
+                handle = next(iter(self._models.values()))
+            else:
+                return {
+                    "error": {
+                        "message": f"model {model!r} not found",
+                        "code": 404,
+                    }
+                }
+        if path.endswith("/chat/completions"):
+            return handle.chat.remote(body).result(timeout_s=600)
+        if path.endswith("/completions"):
+            return handle.completions.remote(body).result(timeout_s=600)
+        return {"error": {"message": f"unknown route {path}", "code": 404}}

@@ -1,0 +1,112 @@
+"""LLMServer: the serve deployment hosting one JaxEngine replica.
+
+Reference: ``python/ray/llm/_internal/serve/deployments/llm/llm_server.py:410``
+(LLMServer wrapping a vLLM engine). A replica = one engine = one TPU host (or
+slice via ray_actor_options resources); multi-replica = data parallel serving
+behind the serve router.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.engine import JaxEngine
+
+
+def _sampling_from_dict(d: Optional[dict]) -> SamplingParams:
+    d = dict(d or {})
+    allowed = {f for f in SamplingParams.__dataclass_fields__}
+    return SamplingParams(**{k: v for k, v in d.items() if k in allowed})
+
+
+class LLMServer:
+    def __init__(self, llm_config: LLMConfig):
+        self.llm_config = llm_config
+        self.engine = JaxEngine(llm_config)
+
+    # -- OpenAI-shaped methods ----------------------------------------------
+
+    def completions(self, body: dict) -> dict:
+        prompt = body.get("prompt", "")
+        params = _sampling_from_dict(
+            {
+                "max_tokens": body.get("max_tokens", 64),
+                "temperature": body.get("temperature", 0.0),
+                "top_k": body.get("top_k", 50),
+            }
+        )
+        out = self.engine.generate(prompt, sampling_params=params)
+        return {
+            "id": f"cmpl-{out.request_id}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.llm_config.served_name,
+            "choices": [
+                {
+                    "index": 0,
+                    "text": out.text,
+                    "finish_reason": out.finish_reason,
+                }
+            ],
+            "usage": {
+                "prompt_tokens": len(out.prompt_token_ids),
+                "completion_tokens": len(out.token_ids),
+                "total_tokens": len(out.prompt_token_ids) + len(out.token_ids),
+            },
+        }
+
+    def chat(self, body: dict) -> dict:
+        messages = body.get("messages", [])
+        prompt = self._render_chat(messages)
+        params = _sampling_from_dict(
+            {
+                "max_tokens": body.get("max_tokens", 64),
+                "temperature": body.get("temperature", 0.0),
+                "top_k": body.get("top_k", 50),
+            }
+        )
+        out = self.engine.generate(prompt, sampling_params=params)
+        return {
+            "id": f"chatcmpl-{out.request_id}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.llm_config.served_name,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": out.text},
+                    "finish_reason": out.finish_reason,
+                }
+            ],
+            "usage": {
+                "prompt_tokens": len(out.prompt_token_ids),
+                "completion_tokens": len(out.token_ids),
+                "total_tokens": len(out.prompt_token_ids) + len(out.token_ids),
+            },
+        }
+
+    @staticmethod
+    def _render_chat(messages: list[dict]) -> str:
+        parts = []
+        for m in messages:
+            parts.append(f"<|{m.get('role', 'user')}|>{m.get('content', '')}")
+        parts.append("<|assistant|>")
+        return "".join(parts)
+
+    # -- ops ----------------------------------------------------------------
+
+    def model_info(self) -> dict:
+        return {
+            "id": self.llm_config.served_name,
+            "object": "model",
+            "owned_by": "ray_tpu",
+        }
+
+    def stats(self) -> dict:
+        return self.engine.get_stats()
+
+    def check_health(self):
+        if not self.engine._thread.is_alive():
+            raise RuntimeError("engine loop died")

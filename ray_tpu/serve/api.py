@@ -85,6 +85,8 @@ def run(
                 ),
                 "ray_actor_options": cfg.ray_actor_options,
                 "health_check_timeout_s": cfg.health_check_timeout_s,
+                "health_check_period_s": cfg.health_check_period_s,
+                "graceful_shutdown_timeout_s": cfg.graceful_shutdown_timeout_s,
                 "user_config": cfg.user_config,
             }
         )
@@ -97,12 +99,19 @@ def run(
     handle = DeploymentHandle(ingress)
     # wait until the ingress deployment has live replicas
     deadline = time.time() + _wait_for_ready_s
-    while time.time() < deadline:
+    while True:
         names = ray_tpu.get(
             controller.get_replica_names.remote(ingress), timeout=30
         )
         if names:
             break
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"application {name!r} failed to become ready within "
+                f"{_wait_for_ready_s}s: ingress {ingress!r} has no live "
+                f"replicas (replica __init__ may be failing; see controller "
+                f"logs)"
+            )
         time.sleep(0.1)
     if blocking:
         try:

@@ -37,6 +37,7 @@ class _DeploymentState:
         self.target = spec["initial_replicas"]
         self.next_replica_id = 0
         self.last_scale_t = 0.0
+        self.last_health_t = 0.0
         self.metric_window: list[tuple[float, float]] = []  # (ts, ongoing)
         self.status = "UPDATING"
 
@@ -85,7 +86,9 @@ class ServeControllerActor:
         return True
 
     def delete_application(self, app_name: str):
-        with self._lock:
+        # exclude reconcile passes: a concurrent pass could otherwise start a
+        # replica for the deployment we are deleting (orphan actor)
+        with self._reconcile_mutex, self._lock:
             app = self._apps.pop(app_name, None)
             if not app:
                 return False
@@ -102,13 +105,13 @@ class ServeControllerActor:
         return True
 
     def shutdown(self):
-        with self._lock:
+        self._stop.set()
+        with self._reconcile_mutex, self._lock:
             for state in self._deployments.values():
                 for h in state.replicas.values():
                     self._kill_replica(h)
             self._deployments.clear()
             self._apps.clear()
-        self._stop.set()
         return True
 
     # -- introspection ------------------------------------------------------
@@ -178,8 +181,9 @@ class ServeControllerActor:
                         victims = list(state.replicas.items())[delta:]
                         for name, h in victims:
                             del state.replicas[name]
+                    grace = state.spec.get("graceful_shutdown_timeout_s", 20.0)
                     for _, h in victims:
-                        self._graceful_stop(h)
+                        self._graceful_stop(h, grace)
                 with self._lock:
                     state.status = (
                         "RUNNING"
@@ -197,12 +201,22 @@ class ServeControllerActor:
         from ray_tpu.serve.replica import ReplicaActor
 
         cls = ray_tpu.remote(ReplicaActor)
+        known = {"num_cpus", "max_concurrency", "max_restarts", "name"}
+        dropped = [k for k in opts if k not in known]
+        if dropped:
+            logger.warning(
+                "ray_actor_options keys %s are not supported by this runtime "
+                "and were dropped for replica %s", dropped, replica_name,
+            )
         try:
             h = cls.options(
                 name=replica_name,
                 num_cpus=opts.get("num_cpus", 1),
                 resources=resources,
-                max_concurrency=spec.get("max_ongoing_requests", 8),
+                # +2 headroom so control-plane calls (check_health,
+                # get_metrics, reconfigure) can't starve behind a saturated
+                # request pool and get a healthy replica killed
+                max_concurrency=spec.get("max_ongoing_requests", 8) + 2,
                 max_restarts=0,  # controller owns restarts
             ).remote(
                 spec["serialized_target"],
@@ -217,6 +231,10 @@ class ServeControllerActor:
             state.replicas[replica_name] = h
 
     def _health_check(self, state: _DeploymentState):
+        now = time.time()
+        if now - state.last_health_t < state.spec.get("health_check_period_s", 2.0):
+            return
+        state.last_health_t = now
         with self._lock:
             replicas = list(state.replicas.items())
         if not replicas:
@@ -287,9 +305,9 @@ class ServeControllerActor:
 
     # -- teardown helpers ---------------------------------------------------
 
-    def _graceful_stop(self, h):
+    def _graceful_stop(self, h, grace_s: float = 20.0):
         try:
-            ray_tpu.get(h.prepare_shutdown.remote(), timeout=10)
+            ray_tpu.get(h.prepare_shutdown.remote(grace_s), timeout=grace_s + 5)
         except Exception:
             pass
         self._kill_replica(h)

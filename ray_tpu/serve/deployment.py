@@ -33,6 +33,10 @@ class Deployment:
         cfg = copy.deepcopy(self.config)
         name = kwargs.pop("name", self.name)
         route_prefix = kwargs.pop("route_prefix", self.route_prefix)
+        if kwargs.get("num_replicas") == "auto":
+            # mirror the decorator's special case
+            kwargs.pop("num_replicas")
+            kwargs.setdefault("autoscaling_config", AutoscalingConfig())
         if "autoscaling_config" in kwargs:
             ac = kwargs.pop("autoscaling_config")
             cfg.autoscaling_config = (

@@ -84,9 +84,9 @@ class ReplicaActor:
                 "ts": time.time(),
             }
 
-    def prepare_shutdown(self) -> bool:
+    def prepare_shutdown(self, grace_s: float = 20.0) -> bool:
         """Graceful drain hook (reference: graceful_shutdown_timeout_s)."""
-        deadline = time.time() + 5.0
+        deadline = time.time() + grace_s
         while time.time() < deadline:
             with self._lock:
                 if self._ongoing == 0:

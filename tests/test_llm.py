@@ -1,0 +1,180 @@
+"""LLM layer tests.
+
+Coverage modeled on the reference's ``python/ray/llm/tests`` (engine
+behavior, OpenAI API shape, batch processor) — engine correctness checks
+(decode vs full forward) follow the serve/llm test strategy of tiny models
+on mocked/virtual hardware (SURVEY §4).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu.llm import (
+    EngineConfig,
+    JaxEngine,
+    LLMConfig,
+    ModelConfig,
+    SamplingParams,
+)
+
+pytestmark = pytest.mark.timeout(600) if hasattr(pytest.mark, "timeout") else []
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = LLMConfig(
+        model=ModelConfig(model_id="tiny", tokenizer="byte", seed=0),
+        engine=EngineConfig(max_num_seqs=4, max_seq_len=128, prefill_buckets=(16, 32, 64, 128)),
+    )
+    eng = JaxEngine(cfg)
+    yield eng
+    eng.shutdown()
+
+
+def test_greedy_generation_deterministic(engine):
+    p = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    out1 = engine.generate("hello", sampling_params=p)
+    out2 = engine.generate("hello", sampling_params=p)
+    assert out1.token_ids == out2.token_ids
+    assert len(out1.token_ids) == 8
+    assert out1.finish_reason == "length"
+
+
+def test_greedy_matches_full_forward(engine):
+    """Incremental decode must agree with teacher-forced full forward."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import forward
+
+    p = SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)
+    prompt_ids = engine.tokenizer.encode("abc")
+    out = engine.generate(prompt_token_ids=prompt_ids, sampling_params=p)
+
+    # teacher-forced re-run: greedily extend with full forward each step
+    seq = list(prompt_ids)
+    for _ in range(5):
+        logits = forward(
+            engine.params, jnp.asarray([seq], jnp.int32), engine.model_cfg
+        )
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    assert out.token_ids == seq[len(prompt_ids):]
+
+
+def test_concurrent_requests_interleave(engine):
+    """More requests than slots: continuous batching must serve all."""
+    p = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    results = [None] * 10
+    def worker(i):
+        results[i] = engine.generate(f"prompt-{i}", sampling_params=p)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert all(r is not None for r in results)
+    assert all(len(r.token_ids) == 6 for r in results)
+    # same prompt -> same tokens regardless of slot/batch composition
+    again = engine.generate("prompt-3", sampling_params=p)
+    assert again.token_ids == results[3].token_ids
+
+
+def test_streaming(engine):
+    p = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    chunks = list(engine.generate_stream("stream me", sampling_params=p))
+    assert len(chunks) == 4
+    assert all(not c["done"] for c in chunks)
+
+
+def test_temperature_sampling_varies(engine):
+    p1 = SamplingParams(max_tokens=12, temperature=1.5, ignore_eos=True)
+    outs = {tuple(engine.generate("x", sampling_params=p1).token_ids) for _ in range(5)}
+    assert len(outs) > 1  # hot sampling should not be constant
+
+
+def test_stop_token(engine):
+    greedy = engine.generate(
+        "q", sampling_params=SamplingParams(max_tokens=20, temperature=0.0, ignore_eos=True)
+    )
+    stop_at = greedy.token_ids[2]
+    out = engine.generate(
+        "q",
+        sampling_params=SamplingParams(
+            max_tokens=20, temperature=0.0, stop_token_ids=[stop_at], ignore_eos=True
+        ),
+    )
+    assert out.token_ids == greedy.token_ids[:2]
+    assert out.finish_reason == "stop"
+
+
+def test_engine_stats(engine):
+    s = engine.get_stats()
+    assert s["max_num_seqs"] == 4
+    assert s["active_slots"] == 0
+
+
+def test_llm_server_openai_shapes(engine):
+    from ray_tpu.llm.server import LLMServer
+
+    # reuse the module fixture's engine by monkeying a server around it
+    server = LLMServer.__new__(LLMServer)
+    server.llm_config = engine.config
+    server.engine = engine
+    resp = server.completions({"prompt": "hi", "max_tokens": 3})
+    assert resp["object"] == "text_completion"
+    assert resp["usage"]["completion_tokens"] <= 3
+    chat = server.chat(
+        {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 3}
+    )
+    assert chat["object"] == "chat.completion"
+    assert chat["choices"][0]["message"]["role"] == "assistant"
+
+
+def test_batch_processor(ray_start_thread):
+    from ray_tpu import data as rd
+    from ray_tpu.llm import ProcessorConfig, build_llm_processor
+
+    cfg = LLMConfig(
+        model=ModelConfig(model_id="tiny", tokenizer="byte"),
+        engine=EngineConfig(max_num_seqs=4, max_seq_len=64, prefill_buckets=(16, 32, 64)),
+    )
+    proc = build_llm_processor(
+        ProcessorConfig(
+            llm_config=cfg,
+            batch_size=4,
+            sampling_params={"max_tokens": 3, "temperature": 0.0, "ignore_eos": True},
+        )
+    )
+    ds = rd.from_items([{"prompt": f"p{i}"} for i in range(8)], parallelism=2)
+    rows = proc(ds).take_all()
+    assert len(rows) == 8
+    assert all(isinstance(r["generated_text"], str) for r in rows)
+
+
+def test_openai_router_routing():
+    from ray_tpu.llm.openai_api import OpenAIRouter
+    from ray_tpu.serve.proxy import Request
+
+    class FakeHandle:
+        class chat:
+            @staticmethod
+            def remote(body):
+                class R:
+                    @staticmethod
+                    def result(timeout_s=None):
+                        return {"ok": True, "got": body["model"]}
+
+                return R()
+
+    router = OpenAIRouter(m1=FakeHandle())
+    req = Request("GET", "/v1/models", {}, {}, b"")
+    out = router(req)
+    assert out["data"][0]["id"] == "m1"
+    req = Request(
+        "POST", "/v1/chat/completions", {}, {}, b'{"model": "m1", "messages": []}'
+    )
+    assert router(req)["ok"] is True
+    req = Request("POST", "/v1/chat/completions", {}, {}, b'{"model": "nope"}')
+    assert router(req)["error"]["code"] == 404
