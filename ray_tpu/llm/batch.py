@@ -22,8 +22,13 @@ def _get_engine(config: LLMConfig):
     key = (
         config.model.model_id,
         config.model.checkpoint_path,
+        config.model.tokenizer,
+        config.model.seed,
         config.engine.max_num_seqs,
         config.engine.max_seq_len,
+        config.engine.dtype,
+        config.engine.tensor_parallel_degree,
+        config.engine.sequence_parallel_degree,
     )
     eng = _ENGINE_CACHE.get(key)
     if eng is None:

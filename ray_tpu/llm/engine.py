@@ -140,17 +140,29 @@ class JaxEngine:
         cfg = self.model_cfg
         ec = self.config.engine
 
-        def decode_fn(params, cache, tokens, temps, key):
+        K = min(64, cfg.vocab_size)
+
+        def decode_fn(params, cache, tokens, temps, top_ks, keys):
             """Decode + in-program sampling: greedy where temp<=0, else
-            top-50/temperature categorical, per row."""
+            per-row top-k/temperature categorical with per-slot PRNG keys
+            (per-request seeds stay reproducible across batch compositions)."""
             logits, cache = decode_step(params, cache, tokens, cfg)
             greedy = jnp.argmax(logits, axis=-1)
-            vals, idxs = jax.lax.top_k(logits, min(50, cfg.vocab_size))
-            scaled = vals / jnp.maximum(temps, 1e-6)[:, None]
-            choice = jax.random.categorical(key, scaled, axis=-1)
+            vals, idxs = jax.lax.top_k(logits, K)
+            # per-row k: mask ranks >= k to -inf before the categorical
+            rank_ok = jnp.arange(K)[None, :] < top_ks[:, None]
+            scaled = jnp.where(
+                rank_ok, vals / jnp.maximum(temps, 1e-6)[:, None], -jnp.inf
+            )
+            new_keys, sample_keys = jnp.split(
+                jax.vmap(lambda k: jax.random.split(k, 2))(keys), 2, axis=1
+            )
+            choice = jax.vmap(
+                lambda k, s: jax.random.categorical(k, s)
+            )(sample_keys[:, 0], scaled)
             sampled = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
             next_tokens = jnp.where(temps <= 0.0, greedy, sampled)
-            return next_tokens, cache
+            return next_tokens, cache, new_keys[:, 0]
 
         self._decode = jax.jit(decode_fn, donate_argnums=(1,))
 
@@ -259,6 +271,10 @@ class JaxEngine:
 
         ec = self.config.engine
         temps = np.zeros((ec.max_num_seqs,), np.float32)
+        top_ks = np.full((ec.max_num_seqs,), 50, np.int32)
+        slot_keys = jax.random.split(
+            jax.random.PRNGKey(self.config.model.seed ^ 0x5EED), ec.max_num_seqs
+        )
         self._pending_first: dict[int, int] = {}  # slot -> first sampled token
         pending_first = self._pending_first
 
@@ -297,6 +313,11 @@ class JaxEngine:
                         first = int(ix[c])
                     self._slots[slot] = req
                     temps[slot] = req.params.temperature
+                    top_ks[slot] = max(1, req.params.top_k)
+                    if req.params.seed is not None:
+                        slot_keys = slot_keys.at[slot].set(
+                            jax.random.PRNGKey(req.params.seed)
+                        )
                     pending_first[slot] = first
                     req.first_token_t = time.time()
                     self._emit(slot, first)
@@ -320,11 +341,33 @@ class JaxEngine:
                     if slot in pending_first
                     else req.out_tokens[-1]
                 )
-            self._rng_key, sub = jax.random.split(self._rng_key)
-            next_tokens, self.cache = self._decode(
-                self.params, self.cache, jnp.asarray(tokens), jnp.asarray(temps), sub
-            )
-            next_np = np.asarray(next_tokens)
+            try:
+                next_tokens, self.cache, slot_keys = self._decode(
+                    self.params,
+                    self.cache,
+                    jnp.asarray(tokens),
+                    jnp.asarray(temps),
+                    jnp.asarray(top_ks),
+                    slot_keys,
+                )
+                next_np = np.asarray(next_tokens)
+            except BaseException as e:  # noqa: BLE001 — device/runtime failure
+                # fail every in-flight request (callers must never hang on a
+                # dead engine loop) and keep the loop alive for new work
+                logger.error("decode step failed: %r", e)
+                for slot in active:
+                    req = self._slots[slot]
+                    self._slots[slot] = None
+                    pending_first.pop(slot, None)
+                    req.error = e
+                    req.stream_queue.put(None)
+                    req.done.set()
+                from ray_tpu.models.llama import init_kv_cache
+
+                self.cache = init_kv_cache(
+                    self.model_cfg, ec.max_num_seqs, ec.max_seq_len
+                )
+                continue
 
             # 3) bookkeeping: emit tokens, finish slots
             for slot in active:

@@ -1,0 +1,26 @@
+"""ray_tpu.rllib — RL on the new API stack (SURVEY §2.3 RLlib row).
+
+Mirrors the reference's new-stack quartet: RLModule (JAX) / Learner /
+LearnerGroup / EnvRunnerGroup, with PPO as the first algorithm
+(``rllib/algorithms/ppo/ppo.py:388`` is the spec).
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "EnvRunnerGroup",
+    "JaxLearner",
+    "LearnerGroup",
+    "PPO",
+    "PPOConfig",
+    "RLModule",
+    "RLModuleSpec",
+    "SingleAgentEnvRunner",
+]

@@ -1,0 +1,203 @@
+"""Algorithm + AlgorithmConfig: the trainer surface.
+
+Reference: ``rllib/algorithms/algorithm.py:207`` (``Algorithm`` is a Tune
+Trainable; ``train()`` runs one ``training_step``) +
+``algorithm_config.py`` (fluent builder: ``.environment().training()
+.env_runners().learners()``).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Optional, Type
+
+import numpy as np
+
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.env_runner import EnvRunnerGroup, env_dims
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self.env: Optional[str] = None
+        self.seed = 0
+        # env runners
+        self.num_env_runners = 0
+        self.num_envs_per_env_runner = 1
+        self.rollout_fragment_length = 200
+        # training
+        self.gamma = 0.99
+        self.lr = 3e-4
+        self.train_batch_size = 4000
+        self.minibatch_size = 128
+        self.num_epochs = 10
+        self.model: dict = {"hidden": (64, 64)}
+        # learners
+        self.num_learners = 0
+        self.resources_per_learner: Optional[dict] = None
+
+    # -- fluent builder (reference API names) -------------------------------
+
+    def environment(self, env: str, **_) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def env_runners(
+        self,
+        num_env_runners: Optional[int] = None,
+        num_envs_per_env_runner: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+        **_,
+    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option: {k}")
+            setattr(self, k, v)
+        return self
+
+    def learners(
+        self,
+        num_learners: Optional[int] = None,
+        resources_per_learner: Optional[dict] = None,
+        **_,
+    ) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if resources_per_learner is not None:
+            self.resources_per_learner = resources_per_learner
+        return self
+
+    def debugging(self, seed: Optional[int] = None, **_) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class")
+        return self.algo_class(self.copy())
+
+    # back-compat alias used by reference examples
+    build_algo = build
+
+
+class Algorithm:
+    """Base trainer: owns env-runner group + learner group."""
+
+    learner_hparam_keys = ("lr",)
+
+    def __init__(self, config: AlgorithmConfig):
+        if config.env is None:
+            raise ValueError("config.environment(env=...) is required")
+        self.config = config
+        obs_dim, act_dim = env_dims(config.env)
+        self.module_spec = RLModuleSpec(
+            observation_dim=obs_dim,
+            action_dim=act_dim,
+            hidden=tuple(config.model.get("hidden", (64, 64))),
+        )
+        self.learner_group = LearnerGroup(
+            self.module_spec,
+            num_learners=config.num_learners,
+            learner_kwargs=self._learner_kwargs(),
+            resources_per_learner=config.resources_per_learner,
+        )
+        self.env_runner_group = EnvRunnerGroup(
+            config.env,
+            self.module_spec,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_env_runner,
+            rollout_fragment_length=config.rollout_fragment_length,
+            gamma=config.gamma,
+            lambda_=getattr(config, "lambda_", 0.95),
+            seed=config.seed,
+        )
+        self.iteration = 0
+        self._total_env_steps = 0
+
+    def _learner_kwargs(self) -> dict:
+        return {"lr": self.config.lr, "seed": self.config.seed}
+
+    # -- the Tune-facing API ------------------------------------------------
+
+    def train(self) -> dict:
+        t0 = time.time()
+        result = self.training_step()
+        self.iteration += 1
+        self._total_env_steps += result.get("num_env_steps_sampled", 0)
+        result.update(
+            {
+                "training_iteration": self.iteration,
+                "num_env_steps_sampled_lifetime": self._total_env_steps,
+                "time_this_iter_s": time.time() - t0,
+            }
+        )
+        return result
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def stop(self):
+        self.env_runner_group.shutdown()
+        self.learner_group.shutdown()
+
+    # -- checkpointing (Checkpointable contract) ----------------------------
+
+    def get_state(self) -> dict:
+        return {
+            "learner": self.learner_group.get_state(),
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state: dict):
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state.get("iteration", 0)
+        self._total_env_steps = state.get("total_env_steps", 0)
+
+    def save(self, path: str) -> str:
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        return Checkpoint.from_pytree(self.get_state(), path).path
+
+    def restore(self, path: str):
+        from ray_tpu.train.checkpoint import restore_pytree
+
+        self.set_state(restore_pytree(path))
+
+    @classmethod
+    def as_trainable(cls, base_config: AlgorithmConfig):
+        """Adapter for ray_tpu.tune (reference: Algorithm IS a Trainable)."""
+
+        def _trainable(config: dict):
+            from ray_tpu import tune
+
+            c = base_config.copy()
+            for k, v in (config or {}).items():
+                if hasattr(c, k):
+                    setattr(c, k, v)
+            algo = c.build()
+            try:
+                stop_iters = (config or {}).get("stop_iters", 10)
+                for _ in range(stop_iters):
+                    tune.report(algo.train())
+            finally:
+                algo.stop()
+
+        _trainable.__name__ = f"{cls.__name__}_trainable"
+        return _trainable

@@ -1,0 +1,176 @@
+"""JaxLearner: the PPO gradient step, jit-compiled for TPU.
+
+Reference: ``rllib/core/learner/learner.py:107`` + ``torch_learner.py:67``
+(DDP there). TPU delta: data parallelism inside one learner is XLA sharding
+over the mesh's dp axis (batch sharded in, gradients psum'd by the
+compiler); multi-host DP is LearnerGroup's job.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+
+
+class JaxLearner:
+    def __init__(
+        self,
+        module_spec: RLModuleSpec,
+        *,
+        lr: float = 3e-4,
+        clip_param: float = 0.2,
+        vf_coeff: float = 0.5,
+        entropy_coeff: float = 0.0,
+        grad_clip: float = 0.5,
+        vf_clip_param: float = 10.0,
+        seed: int = 0,
+        mesh=None,
+    ):
+        import jax
+        import optax
+
+        self.module = module_spec.build(seed)
+        self.spec = module_spec
+        self.mesh = mesh
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr)
+        )
+        self.opt_state = self.optimizer.init(self.module.params)
+        self.hparams = dict(
+            clip_param=clip_param,
+            vf_coeff=vf_coeff,
+            entropy_coeff=entropy_coeff,
+            vf_clip_param=vf_clip_param,
+        )
+        self._update = self._build_update()
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        n_hidden = len(self.spec.hidden)
+        hp = self.hparams
+        optimizer = self.optimizer
+
+        def loss_fn(params, batch):
+            logits, value = RLModule.forward(params, batch["obs"], n_hidden)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            ratio = jnp.exp(logp - batch["logp_old"])
+            adv = batch["advantages"]
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - hp["clip_param"], 1 + hp["clip_param"]) * adv,
+            )
+            policy_loss = -jnp.mean(surr)
+            vf_err = jnp.clip(
+                value - batch["value_targets"],
+                -hp["vf_clip_param"],
+                hp["vf_clip_param"],
+            )
+            vf_loss = jnp.mean(vf_err**2)
+            entropy = -jnp.mean(
+                jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1)
+            )
+            total = (
+                policy_loss
+                + hp["vf_coeff"] * vf_loss
+                - hp["entropy_coeff"] * entropy
+            )
+            stats = {
+                "policy_loss": policy_loss,
+                "vf_loss": vf_loss,
+                "entropy": entropy,
+                "mean_kl": jnp.mean(batch["logp_old"] - logp),
+                "total_loss": total,
+            }
+            return total, stats
+
+        def update(params, opt_state, batch):
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            import optax
+
+            params = optax.apply_updates(params, updates)
+            stats["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, stats
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            batch_sharding = NamedSharding(self.mesh, PartitionSpec("dp"))
+            replicated = NamedSharding(self.mesh, PartitionSpec())
+            return jax.jit(
+                update,
+                in_shardings=(
+                    replicated,
+                    replicated,
+                    {
+                        k: batch_sharding
+                        for k in (
+                            "obs",
+                            "actions",
+                            "logp_old",
+                            "advantages",
+                            "value_targets",
+                        )
+                    },
+                ),
+                out_shardings=(replicated, replicated, None),
+                donate_argnums=(0, 1),
+            )
+        return jax.jit(update, donate_argnums=(0, 1))
+
+    # -- public -------------------------------------------------------------
+
+    def update_from_batch(
+        self, batch: dict, minibatch_size: Optional[int] = None, num_epochs: int = 1
+    ) -> dict:
+        import jax.numpy as jnp
+
+        n = len(batch["obs"])
+        if n == 0:
+            return {}
+        minibatch_size = min(minibatch_size or n, n)
+        rng = np.random.default_rng(0)
+        stats = {}
+        for _ in range(num_epochs):
+            perm = rng.permutation(n)
+            for s in range(0, n - minibatch_size + 1, minibatch_size):
+                idx = perm[s : s + minibatch_size]
+                mb = {
+                    k: jnp.asarray(np.asarray(v)[idx]) for k, v in batch.items()
+                }
+                self.module.params, self.opt_state, stats = self._update(
+                    self.module.params, self.opt_state, mb
+                )
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self) -> dict:
+        return self.module.get_state()
+
+    def set_weights(self, weights: dict):
+        import jax.numpy as jnp
+
+        self.module.set_state(
+            {k: jnp.asarray(v) for k, v in weights.items()}
+        )
+
+    def get_state(self) -> dict:
+        import jax
+
+        return {
+            "weights": self.get_weights(),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def set_state(self, state: dict):
+        self.set_weights(state["weights"])
+        self.opt_state = state["opt_state"]

@@ -1,0 +1,102 @@
+"""RLModule: the neural-net policy/value module.
+
+Reference: ``rllib/core/rl_module/rl_module.py:258`` — framework-specific NN
+module with ``forward_exploration`` / ``forward_inference`` /
+``forward_train``. Here the framework is JAX: params are a plain pytree, the
+forward is a pure function (jit-able on TPU for the learner, run on CPU
+devices inside env-runner actors).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Reference: ``rllib/core/rl_module/rl_module.py`` RLModuleSpec."""
+
+    observation_dim: int = 4
+    action_dim: int = 2
+    hidden: Sequence[int] = (64, 64)
+    # discrete only for now (PPO on classic control / Atari-ram scale)
+    free_log_std: bool = False
+
+    def build(self, seed: int = 0) -> "RLModule":
+        return RLModule(self, seed)
+
+
+class RLModule:
+    """Shared-torso MLP with policy-logit and value heads."""
+
+    def __init__(self, spec: RLModuleSpec, seed: int = 0):
+        self.spec = spec
+        import jax
+
+        self.params = self.init_params(jax.random.PRNGKey(seed))
+        n_hidden = len(spec.hidden)
+        self._jit_fwd = jax.jit(
+            lambda p, o: RLModule.forward(p, o, n_hidden)
+        )
+
+    def init_params(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.spec
+        sizes = [spec.observation_dim, *spec.hidden]
+        params: dict[str, Any] = {}
+        keys = jax.random.split(key, len(sizes) + 2)
+        for i in range(len(sizes) - 1):
+            fan_in = sizes[i]
+            params[f"w{i}"] = (
+                jax.random.normal(keys[i], (sizes[i], sizes[i + 1])) / np.sqrt(fan_in)
+            ).astype(jnp.float32)
+            params[f"b{i}"] = jnp.zeros((sizes[i + 1],), jnp.float32)
+        h = sizes[-1]
+        params["w_pi"] = (
+            jax.random.normal(keys[-2], (h, spec.action_dim)) * 0.01
+        ).astype(jnp.float32)
+        params["b_pi"] = jnp.zeros((spec.action_dim,), jnp.float32)
+        params["w_v"] = (jax.random.normal(keys[-1], (h, 1)) * 0.01).astype(
+            jnp.float32
+        )
+        params["b_v"] = jnp.zeros((1,), jnp.float32)
+        return params
+
+    @staticmethod
+    def forward(params: dict, obs, n_hidden: int):
+        """(logits [B, A], value [B]) — pure, jit-able."""
+        import jax.numpy as jnp
+
+        x = obs
+        for i in range(n_hidden):
+            x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+        logits = x @ params["w_pi"] + params["b_pi"]
+        value = (x @ params["w_v"] + params["b_v"])[:, 0]
+        return logits, value
+
+    # -- inference-side API (env runners) -----------------------------------
+
+    def forward_inference(self, obs: np.ndarray):
+        return self._fwd(obs)
+
+    def forward_exploration(self, obs: np.ndarray):
+        return self._fwd(obs)
+
+    def _fwd(self, obs: np.ndarray):
+        import jax.numpy as jnp
+
+        logits, value = self._jit_fwd(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(logits), np.asarray(value)
+
+    def get_state(self) -> dict:
+        import jax
+
+        return jax.device_get(self.params)
+
+    def set_state(self, state: dict):
+        self.params = state

@@ -1,0 +1,285 @@
+"""Env runners: collect experience with the current policy.
+
+Reference: ``rllib/env/env_runner.py:33`` (EnvRunner),
+``single_agent_env_runner.py:68``, ``env_runner_group.py:71`` (fault-aware
+fan-out). Policy inference inside a runner is host-side numpy/CPU-jax — TPU
+chips stay dedicated to the learner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+
+
+def _make_env(env_id: str, seed: Optional[int] = None):
+    if env_id == "CartPole-v1":
+        try:
+            import gymnasium as gym
+
+            return gym.make("CartPole-v1")
+        except ImportError:
+            from ray_tpu.rllib.env.cartpole import CartPole
+
+            return CartPole()
+    import gymnasium as gym
+
+    return gym.make(env_id)
+
+
+def env_dims(env_id: str) -> tuple[int, int]:
+    env = _make_env(env_id)
+    obs_dim = int(np.prod(env.observation_space.shape))
+    act_dim = int(env.action_space.n)
+    env.close() if hasattr(env, "close") else None
+    return obs_dim, act_dim
+
+
+class SingleAgentEnvRunner:
+    """Steps ``num_envs`` environments with the current module weights."""
+
+    def __init__(
+        self,
+        env_id: str,
+        module_spec_payload: bytes,
+        *,
+        num_envs: int = 1,
+        rollout_fragment_length: int = 200,
+        gamma: float = 0.99,
+        lambda_: float = 0.95,
+        seed: int = 0,
+    ):
+        import cloudpickle
+
+        spec: RLModuleSpec = cloudpickle.loads(module_spec_payload)
+        self.module = spec.build(seed)
+        self.envs = [_make_env(env_id) for _ in range(num_envs)]
+        self.rollout_fragment_length = rollout_fragment_length
+        self.gamma = gamma
+        self.lambda_ = lambda_
+        self._rng = np.random.default_rng(seed)
+        self._obs = []
+        for i, e in enumerate(self.envs):
+            obs, _ = e.reset(seed=seed + i)
+            self._obs.append(np.asarray(obs, np.float32))
+        self._ep_return = np.zeros(num_envs)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self.completed_returns: list[float] = []
+        self.completed_lengths: list[int] = []
+
+    def set_weights(self, weights: dict) -> bool:
+        self.module.set_state(weights)
+        return True
+
+    def sample(self) -> dict:
+        """Collect one fragment per env; returns a GAE-processed batch plus
+        episode metrics."""
+        T, N = self.rollout_fragment_length, len(self.envs)
+        obs_buf = np.zeros((T, N, self._obs[0].shape[0]), np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        rew_buf = np.zeros((T, N), np.float32)
+        term_buf = np.zeros((T, N), np.float32)  # true termination: boot 0
+        end_buf = np.zeros((T, N), np.float32)  # term OR trunc: cuts GAE
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T + 1, N), np.float32)
+        # value of the pre-reset final obs for truncated episodes
+        trunc_bootstrap: list[tuple[int, int, np.ndarray]] = []
+
+        for t in range(T):
+            obs = np.stack(self._obs)
+            logits, values = self.module.forward_exploration(obs)
+            probs = _softmax(logits)
+            actions = np.array(
+                [self._rng.choice(len(p), p=p) for p in probs], np.int64
+            )
+            logp = np.log(probs[np.arange(N), actions] + 1e-10)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            val_buf[t] = values
+            for i, env in enumerate(self.envs):
+                o2, r, term, trunc, _ = env.step(int(actions[i]))
+                rew_buf[t, i] = r
+                self._ep_return[i] += r
+                self._ep_len[i] += 1
+                done = term or trunc
+                term_buf[t, i] = float(term)
+                end_buf[t, i] = float(done)
+                if trunc and not term:
+                    # bootstrap from the PRE-reset obs, not the next episode's
+                    trunc_bootstrap.append((t, i, np.asarray(o2, np.float32)))
+                if done:
+                    self.completed_returns.append(float(self._ep_return[i]))
+                    self.completed_lengths.append(int(self._ep_len[i]))
+                    self._ep_return[i] = 0.0
+                    self._ep_len[i] = 0
+                    o2, _ = env.reset()
+                self._obs[i] = np.asarray(o2, np.float32)
+        # bootstrap values for the final obs
+        _, last_vals = self.module.forward_inference(np.stack(self._obs))
+        val_buf[T] = last_vals
+
+        # next-step value per transition: V(s_{t+1}) by default; for episode
+        # ends it must NOT come from the next episode — 0 on termination,
+        # V(pre-reset obs) on truncation
+        next_val = val_buf[1:].copy()
+        if trunc_bootstrap:
+            _, boot_vals = self.module.forward_inference(
+                np.stack([o for _, _, o in trunc_bootstrap])
+            )
+            for (t, i, _), v in zip(trunc_bootstrap, boot_vals):
+                next_val[t, i] = v
+        next_val = next_val * (1.0 - term_buf)
+        # a step that ends an episode mid-fragment must use its own-episode
+        # bootstrap, not val_buf[t+1]; term handled above, non-end steps keep
+        # val_buf[t+1] which IS the same episode's next state
+
+        adv = np.zeros((T, N), np.float32)
+        last_gae = np.zeros(N, np.float32)
+        for t in reversed(range(T)):
+            not_end = 1.0 - end_buf[t]
+            delta = rew_buf[t] + self.gamma * next_val[t] - val_buf[t]
+            last_gae = delta + self.gamma * self.lambda_ * not_end * last_gae
+            adv[t] = last_gae
+        value_targets = adv + val_buf[:T]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        metrics = {
+            "episode_return_mean": (
+                float(np.mean(self.completed_returns[-100:]))
+                if self.completed_returns
+                else float("nan")
+            ),
+            "episode_len_mean": (
+                float(np.mean(self.completed_lengths[-100:]))
+                if self.completed_lengths
+                else float("nan")
+            ),
+            "num_env_steps": T * N,
+            "num_episodes": len(self.completed_returns),
+        }
+        return {
+            "batch": {
+                "obs": obs_buf.reshape(T * N, -1),
+                "actions": act_buf.reshape(-1),
+                "logp_old": logp_buf.reshape(-1),
+                "advantages": adv.reshape(-1),
+                "value_targets": value_targets.reshape(-1),
+            },
+            "metrics": metrics,
+        }
+
+    def ping(self) -> bool:
+        return True
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    z = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+class EnvRunnerGroup:
+    """Fault-aware fan-out over remote env-runner actors."""
+
+    def __init__(
+        self,
+        env_id: str,
+        module_spec: RLModuleSpec,
+        *,
+        num_env_runners: int = 0,
+        num_envs_per_runner: int = 1,
+        rollout_fragment_length: int = 200,
+        gamma: float = 0.99,
+        lambda_: float = 0.95,
+        seed: int = 0,
+    ):
+        import cloudpickle
+
+        self._payload = cloudpickle.dumps(module_spec)
+        self._env_id = env_id
+        self._kwargs = dict(
+            num_envs=num_envs_per_runner,
+            rollout_fragment_length=rollout_fragment_length,
+            gamma=gamma,
+            lambda_=lambda_,
+        )
+        self._seed = seed
+        self.num_env_runners = num_env_runners
+        if num_env_runners <= 0:
+            self._local = SingleAgentEnvRunner(
+                env_id, self._payload, seed=seed, **self._kwargs
+            )
+            self._remote = []
+        else:
+            self._local = None
+            self._remote = [
+                self._spawn(i) for i in range(num_env_runners)
+            ]
+
+    def _spawn(self, index: int):
+        cls = ray_tpu.remote(SingleAgentEnvRunner)
+        return cls.options(num_cpus=1).remote(
+            self._env_id, self._payload, seed=self._seed + index, **self._kwargs
+        )
+
+    def sample(self, weights: Optional[dict] = None) -> tuple[dict, dict]:
+        """Returns (concatenated batch, aggregated metrics)."""
+        if self._local is not None:
+            if weights is not None:
+                self._local.set_weights(weights)
+            out = self._local.sample()
+            return out["batch"], out["metrics"]
+        if weights is not None:
+            weights_ref = ray_tpu.put(weights)
+            ray_tpu.get(
+                [r.set_weights.remote(weights_ref) for r in self._remote]
+            )
+        refs = [r.sample.remote() for r in self._remote]
+        outs: list[Optional[dict]] = []
+        for i, ref in enumerate(refs):
+            try:
+                outs.append(ray_tpu.get(ref, timeout=300))
+            except Exception:
+                # fault tolerance: replace the dead runner, drop its sample
+                self._remote[i] = self._spawn(i)
+                if weights is not None:
+                    try:
+                        ray_tpu.get(
+                            self._remote[i].set_weights.remote(weights), timeout=60
+                        )
+                    except Exception:
+                        pass
+                outs.append(None)
+        good = [o for o in outs if o is not None]
+        if not good:
+            raise RuntimeError("all env runners failed")
+        batch = {
+            k: np.concatenate([o["batch"][k] for o in good])
+            for k in good[0]["batch"]
+        }
+        ms = [o["metrics"] for o in good]
+        metrics = {
+            "episode_return_mean": float(
+                np.nanmean([m["episode_return_mean"] for m in ms])
+            ),
+            "episode_len_mean": float(
+                np.nanmean([m["episode_len_mean"] for m in ms])
+            ),
+            "num_env_steps": int(sum(m["num_env_steps"] for m in ms)),
+            "num_episodes": int(sum(m["num_episodes"] for m in ms)),
+            "num_healthy_runners": len(good),
+        }
+        return batch, metrics
+
+    def shutdown(self):
+        for r in self._remote:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -201,12 +201,14 @@ class ServeControllerActor:
         from ray_tpu.serve.replica import ReplicaActor
 
         cls = ray_tpu.remote(ReplicaActor)
-        known = {"num_cpus", "max_concurrency", "max_restarts", "name"}
-        dropped = [k for k in opts if k not in known]
+        # only num_cpus and resources are honored; max_concurrency/name/
+        # max_restarts are controller-owned and user values would be ignored
+        dropped = [k for k in opts if k != "num_cpus"]
         if dropped:
             logger.warning(
-                "ray_actor_options keys %s are not supported by this runtime "
-                "and were dropped for replica %s", dropped, replica_name,
+                "ray_actor_options keys %s are not honored for serve replicas "
+                "(controller owns concurrency/name/restarts); dropped for %s",
+                dropped, replica_name,
             )
         try:
             h = cls.options(
