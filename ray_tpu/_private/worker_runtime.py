@@ -35,6 +35,18 @@ from ray_tpu.exceptions import TaskError
 
 _INLINE_LIMIT_ENV = "RAY_TPU_MAX_INLINE_OBJECT_SIZE"
 
+# Per-thread execution context: which actor's task is running on this thread.
+# Tasks execute wholly on one thread (worker loop thread, actor pool thread,
+# or thread-mode worker thread), so a threading.local is exact — unlike
+# process-global state, which is wrong for in-process (thread-mode) actors
+# and concurrent actor pools.
+_exec_ctx = threading.local()
+
+
+def current_actor_id() -> Optional[bytes]:
+    """Binary ActorID of the actor whose task is executing on this thread."""
+    return getattr(_exec_ctx, "actor_id", None)
+
 
 class InProcessChannel:
     """Duplex in-process channel with the multiprocessing.Connection API
@@ -293,6 +305,11 @@ class WorkerRuntime:
 
     def _invoke(self, spec: TaskSpec, args, kwargs):
         self.current_task_name = spec.name
+        _exec_ctx.actor_id = (
+            spec.actor_id.binary()
+            if spec.task_type != TaskType.NORMAL_TASK and spec.actor_id
+            else None
+        )
         if spec.task_type == TaskType.NORMAL_TASK:
             fn = cloudpickle.loads(spec.function_blob)
             return fn(*args, **kwargs)

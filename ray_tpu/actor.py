@@ -25,6 +25,12 @@ class ActorMethod:
             self._method_name, args, kwargs, num_returns=self._num_returns
         )
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node (reference: ``dag/dag_node.py`` bind API)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor method {self._method_name}() cannot be called directly; "

@@ -1,0 +1,17 @@
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.dag.compiled_dag import CompiledDAG
+
+__all__ = [
+    "ClassMethodNode",
+    "CompiledDAG",
+    "DAGNode",
+    "FunctionNode",
+    "InputNode",
+    "MultiOutputNode",
+]

@@ -1,0 +1,154 @@
+"""DAG nodes: lazily-bound task/actor-method call graphs.
+
+Reference: ``python/ray/dag/dag_node.py:34`` (DAGNode), ``input_node.py``
+(InputNode context manager), ``class_node.py`` — built via ``.bind()`` on
+remote functions / actor methods, executed with ``dag.execute(input)``, or
+compiled (``compiled_dag.py``) into a reusable schedule.
+
+This is the substrate the reference's GPU stack uses for pipeline-parallel
+inference; on TPU the per-edge payloads ride the shared-memory object plane
+(the NCCL channel analog is in-program ICI, SURVEY §2.5).
+"""
+
+from __future__ import annotations
+
+
+from typing import Any, Callable, Optional
+
+import ray_tpu
+
+
+class DAGNode:
+    """Base: a node owns (args, kwargs) that may contain other DAGNodes."""
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- graph traversal ----------------------------------------------------
+
+    def _children(self) -> list["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def topological(self) -> list["DAGNode"]:
+        order: list[DAGNode] = []
+        seen: set[int] = set()
+
+        def visit(node: DAGNode):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for c in node._children():
+                visit(c)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self, *input_args, **input_kwargs):
+        """Eager execution: walk the graph, submit tasks, return ref(s)."""
+        results: dict[int, Any] = {}
+        for node in self.topological():
+            results[id(node)] = node._execute_node(results, input_args, input_kwargs)
+        return results[id(self)]
+
+    def _resolve(self, results: dict, value):
+        if isinstance(value, DAGNode):
+            return results[id(value)]
+        return value
+
+    def _execute_node(self, results: dict, input_args: tuple, input_kwargs: dict):
+        raise NotImplementedError
+
+    def experimental_compile(self) -> "CompiledDAGRef":
+        from ray_tpu.dag.compiled_dag import CompiledDAG
+
+        return CompiledDAG(self)
+
+
+class InputNode(DAGNode):
+    """The DAG's input placeholder (context manager, reference API)."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self) -> "InputNode":
+        # context-manager form is API parity with the reference; binding
+        # happens through the node object itself
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+    def __getattr__(self, key):
+        if key.startswith("_"):
+            raise AttributeError(key)
+        return InputAttributeNode(self, key)
+
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key)
+
+    def _execute_node(self, results, input_args, input_kwargs):
+        if len(input_args) == 1 and not input_kwargs:
+            return input_args[0]
+        if input_kwargs and not input_args:
+            return dict(input_kwargs)
+        return input_args
+
+
+class InputAttributeNode(DAGNode):
+    """InputNode[...] / InputNode.attr accessor."""
+
+    def __init__(self, parent: InputNode, key):
+        super().__init__((parent,), {})
+        self._key = key
+
+    def _execute_node(self, results, input_args, input_kwargs):
+        base = self._resolve(results, self._bound_args[0])
+        if isinstance(base, dict):
+            return base[self._key]
+        if isinstance(self._key, int):
+            return base[self._key]
+        return getattr(base, self._key)
+
+
+class FunctionNode(DAGNode):
+    """A bound remote-function call."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_node(self, results, input_args, input_kwargs):
+        args = tuple(self._resolve(results, a) for a in self._bound_args)
+        kwargs = {k: self._resolve(results, v) for k, v in self._bound_kwargs.items()}
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    """A bound actor-method call."""
+
+    def __init__(self, actor_method, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._actor_method = actor_method
+
+    def _execute_node(self, results, input_args, input_kwargs):
+        args = tuple(self._resolve(results, a) for a in self._bound_args)
+        kwargs = {k: self._resolve(results, v) for k, v in self._bound_kwargs.items()}
+        return self._actor_method.remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Fan-in terminal returning a list of refs (reference API)."""
+
+    def __init__(self, outputs: list):
+        super().__init__(tuple(outputs), {})
+
+    def _execute_node(self, results, input_args, input_kwargs):
+        return [self._resolve(results, a) for a in self._bound_args]

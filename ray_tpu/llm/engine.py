@@ -301,11 +301,19 @@ class JaxEngine:
                         slot,
                     )
                     # sample the first generated token from prefill logits
+                    # (same top-K truncation as the decode program, and the
+                    # request's own PRNG chain when seeded, so seeded
+                    # generations reproduce regardless of batch composition)
                     first = int(np.argmax(np.asarray(last_logits)))
+                    K = min(64, self.model_cfg.vocab_size)
+                    if req.params.seed is not None:
+                        req_key = jax.random.PRNGKey(req.params.seed)
+                    else:
+                        self._rng_key, req_key = jax.random.split(self._rng_key)
+                    req_key, sub = jax.random.split(req_key)
                     if req.params.temperature > 0:
-                        self._rng_key, sub = jax.random.split(self._rng_key)
                         l = jnp.asarray(last_logits)
-                        k = min(req.params.top_k, self.model_cfg.vocab_size)
+                        k = min(max(1, req.params.top_k), K)
                         v, ix = jax.lax.top_k(l, k)
                         c = jax.random.categorical(
                             sub, v / max(req.params.temperature, 1e-6)
@@ -313,11 +321,10 @@ class JaxEngine:
                         first = int(ix[c])
                     self._slots[slot] = req
                     temps[slot] = req.params.temperature
-                    top_ks[slot] = max(1, req.params.top_k)
-                    if req.params.seed is not None:
-                        slot_keys = slot_keys.at[slot].set(
-                            jax.random.PRNGKey(req.params.seed)
-                        )
+                    # decode truncates to the program's static top-K; clamp
+                    # here so first token and all later tokens agree
+                    top_ks[slot] = min(max(1, req.params.top_k), K)
+                    slot_keys = slot_keys.at[slot].set(req_key)
                     pending_first[slot] = first
                     req.first_token_t = time.time()
                     self._emit(slot, first)

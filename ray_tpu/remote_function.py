@@ -73,5 +73,8 @@ class RemoteFunction:
         )
         return refs[0] if num_returns == 1 else refs
 
-    # Convenience parity with reference `.bind()` omitted until compiled
-    # graphs land (ray_tpu.dag).
+    def bind(self, *args, **kwargs):
+        """Build a DAG node (reference: ``dag/dag_node.py`` bind API)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)

@@ -45,6 +45,7 @@ class JaxLearner:
             entropy_coeff=entropy_coeff,
             vf_clip_param=vf_clip_param,
         )
+        self._rng = np.random.default_rng(seed)
         self._update = self._build_update()
 
     def _build_update(self):
@@ -139,7 +140,7 @@ class JaxLearner:
         if n == 0:
             return {}
         minibatch_size = min(minibatch_size or n, n)
-        rng = np.random.default_rng(0)
+        rng = self._rng  # persistent: fresh permutations every iteration
         stats = {}
         for _ in range(num_epochs):
             perm = rng.permutation(n)

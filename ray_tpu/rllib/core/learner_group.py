@@ -39,6 +39,13 @@ class _RemoteLearner:
         self.learner.set_weights(weights)
         return True
 
+    def get_state(self) -> dict:
+        return self.learner.get_state()
+
+    def set_state(self, state: dict) -> bool:
+        self.learner.set_state(state)
+        return True
+
 
 class LearnerGroup:
     def __init__(
@@ -120,11 +127,15 @@ class LearnerGroup:
     def get_state(self) -> dict:
         if self._local is not None:
             return self._local.get_state()
-        return {"weights": self.get_weights()}
+        # full state (incl. optimizer moments) so remote-group checkpoints
+        # restore into local groups and vice versa
+        return ray_tpu.get(self._remote[0].get_state.remote())
 
     def set_state(self, state: dict):
         if self._local is not None:
             self._local.set_state(state)
+        elif "opt_state" in state:
+            ray_tpu.get([l.set_state.remote(state) for l in self._remote])
         else:
             self.set_weights(state["weights"])
 

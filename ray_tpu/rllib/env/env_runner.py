@@ -67,10 +67,14 @@ class SingleAgentEnvRunner:
         for i, e in enumerate(self.envs):
             obs, _ = e.reset(seed=seed + i)
             self._obs.append(np.asarray(obs, np.float32))
+        from collections import deque
+
         self._ep_return = np.zeros(num_envs)
         self._ep_len = np.zeros(num_envs, np.int64)
-        self.completed_returns: list[float] = []
-        self.completed_lengths: list[int] = []
+        # bounded: long runs must not grow runner memory per episode
+        self.completed_returns: "deque[float]" = deque(maxlen=500)
+        self.completed_lengths: "deque[int]" = deque(maxlen=500)
+        self._episodes_this_sample = 0
 
     def set_weights(self, weights: dict) -> bool:
         self.module.set_state(weights)
@@ -116,6 +120,7 @@ class SingleAgentEnvRunner:
                 if done:
                     self.completed_returns.append(float(self._ep_return[i]))
                     self.completed_lengths.append(int(self._ep_len[i]))
+                    self._episodes_this_sample += 1
                     self._ep_return[i] = 0.0
                     self._ep_len[i] = 0
                     o2, _ = env.reset()
@@ -149,19 +154,19 @@ class SingleAgentEnvRunner:
         value_targets = adv + val_buf[:T]
         adv = (adv - adv.mean()) / (adv.std() + 1e-8)
 
+        recent_returns = list(self.completed_returns)[-100:]
+        recent_lengths = list(self.completed_lengths)[-100:]
+        episodes_this_sample = self._episodes_this_sample
+        self._episodes_this_sample = 0
         metrics = {
             "episode_return_mean": (
-                float(np.mean(self.completed_returns[-100:]))
-                if self.completed_returns
-                else float("nan")
+                float(np.mean(recent_returns)) if recent_returns else float("nan")
             ),
             "episode_len_mean": (
-                float(np.mean(self.completed_lengths[-100:]))
-                if self.completed_lengths
-                else float("nan")
+                float(np.mean(recent_lengths)) if recent_lengths else float("nan")
             ),
             "num_env_steps": T * N,
-            "num_episodes": len(self.completed_returns),
+            "num_episodes": episodes_this_sample,  # per-fragment, not lifetime
         }
         return {
             "batch": {

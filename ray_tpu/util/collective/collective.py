@@ -1,0 +1,255 @@
+"""Out-of-band collectives between actors.
+
+Reference: ``python/ray/util/collective/collective.py:150-652``
+(init_collective_group / allreduce / allgather / reducescatter / broadcast /
+send / recv), whose GPU backend is NCCL with a named-actor rendezvous
+(``nccl_collective_group.py:128``).
+
+TPU mapping (SURVEY §2.5): *in-program* collectives are XLA's job (psum over
+ICI inside jitted steps — see ``ray_tpu.parallel``); THIS module is the
+out-of-band path between actors that the reference uses NCCL for — here
+host-mediated through a coordinator actor + the object store. It is the
+control-plane-bandwidth path (weight sync, eval gather), not the
+gradient path; docs steer hot loops to the mesh.
+
+Per-process group registry: each actor calls ``init_collective_group`` with
+its own rank, then calls collectives with its declared group name.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_COORDINATOR_NAME = "collective-coordinator:{}"
+# Keyed by the EXECUTING ACTOR (via the worker runtime's per-thread exec
+# context), not by thread or process: an actor's group must be visible from
+# every pool thread that runs its methods (max_concurrency>1), while two
+# in-process actors (thread-mode runtime) must not clobber each other's rank.
+_registry: dict[tuple, "_GroupHandle"] = {}
+_registry_lock = threading.Lock()
+
+
+def _owner_key() -> bytes:
+    from ray_tpu._private.worker_runtime import current_actor_id
+
+    return current_actor_id() or b"driver"
+
+
+class _OwnerView:
+    """dict-like view of the registry scoped to the current actor."""
+
+    def __setitem__(self, group_name, handle):
+        _registry[(_owner_key(), group_name)] = handle
+
+    def get(self, group_name):
+        return _registry.get((_owner_key(), group_name))
+
+    def pop(self, group_name, default=None):
+        return _registry.pop((_owner_key(), group_name), default)
+
+
+def _groups() -> "_OwnerView":
+    return _OwnerView()
+
+
+class _Coordinator:
+    """Named actor: rendezvous + reduction point for one group."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._lock = threading.Lock()
+        self._rounds: dict[tuple, dict] = {}  # (op_key, round) -> {rank: value}
+        self._results: dict[tuple, Any] = {}
+        self._mailbox: dict[tuple, Any] = {}  # (src, dst, tag) -> value
+
+    def contribute(self, op_key: str, round_id: int, rank: int, value) -> bool:
+        """Deposit a rank's tensor; True once all ranks have arrived."""
+        key = (op_key, round_id)
+        with self._lock:
+            slot = self._rounds.setdefault(key, {})
+            slot[rank] = value
+            if len(slot) == self.world_size:
+                self._results[key] = self._combine(op_key, slot)
+                del self._rounds[key]
+            return key in self._results
+
+    def fetch(self, op_key: str, round_id: int, rank: int):
+        key = (op_key, round_id)
+        with self._lock:
+            res = self._results.get(key)
+            if res is None:
+                return None
+            out = res["per_rank"][rank] if "per_rank" in res else res["value"]
+            res["fetched"] += 1
+            if res["fetched"] >= self.world_size:
+                del self._results[key]
+            return [out]
+
+    def _combine(self, op_key: str, slot: dict) -> dict:
+        kind, _, detail = op_key.partition(":")
+        arrays = [np.asarray(slot[r]) for r in range(self.world_size)]
+        if kind == "allreduce":
+            ops = {"sum": np.sum, "prod": np.prod, "min": np.min, "max": np.max}
+            value = ops[detail](np.stack(arrays), axis=0)
+            return {"value": value, "fetched": 0}
+        if kind == "allgather":
+            return {"value": arrays, "fetched": 0}
+        if kind == "reducescatter":
+            total = np.sum(np.stack(arrays), axis=0)
+            shards = np.array_split(total, self.world_size)
+            return {"per_rank": {r: shards[r] for r in range(self.world_size)}, "fetched": 0}
+        if kind == "broadcast":
+            src = int(detail)
+            return {"value": np.asarray(slot[src]), "fetched": 0}
+        if kind == "barrier":
+            return {"value": True, "fetched": 0}
+        raise ValueError(f"unknown collective {op_key}")
+
+    # -- p2p ----------------------------------------------------------------
+
+    def post(self, src: int, dst: int, tag: int, value) -> bool:
+        # FIFO per (src, dst, tag): back-to-back sends must not overwrite
+        with self._lock:
+            self._mailbox.setdefault((src, dst, tag), []).append(value)
+        return True
+
+    def take(self, src: int, dst: int, tag: int):
+        with self._lock:
+            q = self._mailbox.get((src, dst, tag))
+            if q:
+                value = q.pop(0)
+                if not q:
+                    del self._mailbox[(src, dst, tag)]
+                return [value]
+            return None
+
+
+class _GroupHandle:
+    def __init__(self, name: str, world_size: int, rank: int, coordinator):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.coordinator = coordinator
+        self._round = 0
+        self._round_lock = threading.Lock()
+
+    def next_round(self) -> int:
+        with self._round_lock:
+            r = self._round
+            self._round += 1
+            return r
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "object_store",
+    group_name: str = "default",
+) -> None:
+    """Join (creating if needed) a collective group. Call once per actor."""
+    name = _COORDINATOR_NAME.format(group_name)
+    try:
+        coord = ray_tpu.get_actor(name)
+    except Exception:
+        cls = ray_tpu.remote(_Coordinator)
+        try:
+            coord = cls.options(
+                name=name, num_cpus=0.01, max_concurrency=32
+            ).remote(world_size)
+        except Exception:
+            coord = ray_tpu.get_actor(name)  # racer created it first
+    with _registry_lock:
+        _groups()[group_name] = _GroupHandle(group_name, world_size, rank, coord)
+
+
+create_collective_group = init_collective_group
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    g = _groups().pop(group_name, None)
+    if g is not None and g.rank == 0:
+        try:
+            ray_tpu.kill(g.coordinator)
+        except Exception:
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _get(group_name).world_size
+
+
+def _get(group_name: str) -> _GroupHandle:
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this process"
+        )
+    return g
+
+
+def _run(g: _GroupHandle, op_key: str, value, timeout: float = 120.0):
+    rnd = g.next_round()
+    ray_tpu.get(
+        g.coordinator.contribute.remote(op_key, rnd, g.rank, value), timeout=timeout
+    )
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = ray_tpu.get(
+            g.coordinator.fetch.remote(op_key, rnd, g.rank), timeout=timeout
+        )
+        if out is not None:
+            return out[0]
+        time.sleep(0.002)
+    raise TimeoutError(f"collective {op_key} round {rnd} timed out")
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    """Returns the reduced tensor (pure-functional: jax-friendly)."""
+    return _run(_get(group_name), f"allreduce:{op}", tensor)
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    return _run(_get(group_name), "allgather:", tensor)
+
+
+def reducescatter(tensor, group_name: str = "default"):
+    return _run(_get(group_name), "reducescatter:", tensor)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _run(_get(group_name), f"broadcast:{src_rank}", tensor)
+
+
+def barrier(group_name: str = "default") -> None:
+    _run(_get(group_name), "barrier:", 0)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0) -> None:
+    g = _get(group_name)
+    ray_tpu.get(
+        g.coordinator.post.remote(g.rank, dst_rank, tag, np.asarray(tensor)),
+        timeout=120,
+    )
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0, timeout: float = 120.0):
+    g = _get(group_name)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = ray_tpu.get(
+            g.coordinator.take.remote(src_rank, g.rank, tag), timeout=timeout
+        )
+        if out is not None:
+            return out[0]
+        time.sleep(0.002)
+    raise TimeoutError(f"recv from rank {src_rank} timed out")

@@ -94,6 +94,17 @@ def test_temperature_sampling_varies(engine):
     assert len(outs) > 1  # hot sampling should not be constant
 
 
+def test_seeded_sampling_reproducible(engine):
+    p = SamplingParams(max_tokens=8, temperature=1.0, seed=42, ignore_eos=True)
+    out1 = engine.generate("seed me", sampling_params=p)
+    # interleave unrelated hot requests to shift the engine-global RNG
+    engine.generate(
+        "noise", sampling_params=SamplingParams(max_tokens=3, temperature=1.5, ignore_eos=True)
+    )
+    out2 = engine.generate("seed me", sampling_params=p)
+    assert out1.token_ids == out2.token_ids
+
+
 def test_stop_token(engine):
     greedy = engine.generate(
         "q", sampling_params=SamplingParams(max_tokens=20, temperature=0.0, ignore_eos=True)
