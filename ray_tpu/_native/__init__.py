@@ -1,0 +1,1 @@
+"""Native (C++) components, built on demand with the in-image toolchain."""

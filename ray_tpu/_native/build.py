@@ -1,0 +1,63 @@
+"""On-demand native build: compile .cc sources to .so with the image's g++.
+
+The wheel-less analog of the reference's bazel build (SURVEY §2.1 L0): the
+library is compiled once per source change into the package directory (or a
+cache dir if the package is read-only) and loaded via ctypes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_CACHE: dict[str, Optional[str]] = {}
+
+
+def _source_hash(src: str) -> str:
+    with open(src, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def build_library(source_name: str) -> Optional[str]:
+    """Compile ray_tpu/_native/<source_name>.cc → .so; returns path or None."""
+    with _lock:
+        if source_name in _CACHE:
+            return _CACHE[source_name]
+        here = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(here, f"{source_name}.cc")
+        if not os.path.exists(src):
+            _CACHE[source_name] = None
+            return None
+        tag = _source_hash(src)
+        out_dirs = [here, os.path.join(os.path.expanduser("~"), ".cache", "ray_tpu")]
+        lib_name = f"lib{source_name}-{tag}.so"
+        for d in out_dirs:
+            candidate = os.path.join(d, lib_name)
+            if os.path.exists(candidate):
+                _CACHE[source_name] = candidate
+                return candidate
+        for d in out_dirs:
+            try:
+                os.makedirs(d, exist_ok=True)
+                out = os.path.join(d, lib_name)
+                tmp = out + f".tmp{os.getpid()}"
+                subprocess.run(
+                    [
+                        "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+                        "-pthread", src, "-o", tmp, "-lrt",
+                    ],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(tmp, out)
+                _CACHE[source_name] = out
+                return out
+            except (OSError, subprocess.SubprocessError):
+                continue
+        _CACHE[source_name] = None
+        return None

@@ -1,0 +1,160 @@
+"""ctypes binding for the native plasma store (plasma_store.cc).
+
+One mapped arena per node session; objects are (offset, size) spans inside
+it. Readers get zero-copy memoryviews over the mapping — the plasma client
+contract (reference: ``plasma/client.cc`` mmap + fd passing; here the arena
+is a named POSIX shm segment every process attaches once).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+from ray_tpu._native.build import build_library
+
+
+class NativePlasmaError(RuntimeError):
+    pass
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = build_library("plasma_store")
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.ps_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.ps_create.restype = ctypes.c_int
+        lib.ps_attach.argtypes = [ctypes.c_char_p]
+        lib.ps_attach.restype = ctypes.c_int
+        lib.ps_base.argtypes = [ctypes.c_int]
+        lib.ps_base.restype = ctypes.c_void_p
+        for fn in ("ps_capacity", "ps_used", "ps_num_objects", "ps_total_size"):
+            getattr(lib, fn).argtypes = [ctypes.c_int]
+            getattr(lib, fn).restype = ctypes.c_uint64
+        lib.ps_alloc.argtypes = [
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.ps_alloc.restype = ctypes.c_int
+        for fn in ("ps_seal", "ps_pin", "ps_unpin", "ps_delete"):
+            getattr(lib, fn).argtypes = [ctypes.c_int, ctypes.c_char_p]
+            getattr(lib, fn).restype = ctypes.c_int
+        lib.ps_lookup.argtypes = [
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.ps_lookup.restype = ctypes.c_int
+        lib.ps_close.argtypes = [ctypes.c_int]
+        lib.ps_close.restype = None
+        lib.ps_unlink.argtypes = [ctypes.c_char_p]
+        lib.ps_unlink.restype = ctypes.c_int
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return load_lib() is not None
+
+
+def _id20(object_id_bytes: bytes) -> bytes:
+    b = object_id_bytes[:20]
+    return b + b"\x00" * (20 - len(b))
+
+
+class NativeArena:
+    """A handle (creator or attached) to the node's arena segment."""
+
+    def __init__(self, name: str, capacity: Optional[int] = None):
+        lib = load_lib()
+        if lib is None:
+            raise NativePlasmaError("native plasma library unavailable")
+        self._lib = lib
+        self.name = name
+        self.owner = capacity is not None
+        if capacity is not None:
+            self._h = lib.ps_create(name.encode(), capacity)
+        else:
+            self._h = lib.ps_attach(name.encode())
+        if self._h < 0:
+            raise NativePlasmaError(
+                f"failed to {'create' if self.owner else 'attach'} arena {name!r}"
+            )
+        base = lib.ps_base(self._h)
+        # offsets from alloc/lookup are mapping-relative, so the view spans
+        # the entire mapping (header + arena)
+        self._map_len = int(lib.ps_total_size(self._h))
+        self._view = memoryview(
+            (ctypes.c_ubyte * self._map_len).from_address(base)
+        ).cast("B")
+        self._closed = False
+
+    # -- store-authority ops -------------------------------------------------
+
+    def alloc(self, object_id: bytes, size: int) -> int:
+        off = ctypes.c_uint64()
+        rc = self._lib.ps_alloc(self._h, _id20(object_id), size, ctypes.byref(off))
+        if rc == -2:
+            raise NativePlasmaError("object already exists")
+        if rc != 0:
+            raise NativePlasmaError("out of shared memory (after eviction)")
+        return int(off.value)
+
+    def seal(self, object_id: bytes) -> None:
+        self._lib.ps_seal(self._h, _id20(object_id))
+
+    def lookup(self, object_id: bytes) -> Optional[tuple[int, int]]:
+        off, size = ctypes.c_uint64(), ctypes.c_uint64()
+        rc = self._lib.ps_lookup(
+            self._h, _id20(object_id), ctypes.byref(off), ctypes.byref(size)
+        )
+        if rc != 0:
+            return None
+        return int(off.value), int(size.value)
+
+    def pin(self, object_id: bytes) -> None:
+        self._lib.ps_pin(self._h, _id20(object_id))
+
+    def unpin(self, object_id: bytes) -> None:
+        self._lib.ps_unpin(self._h, _id20(object_id))
+
+    def delete(self, object_id: bytes) -> None:
+        self._lib.ps_delete(self._h, _id20(object_id))
+
+    def used_bytes(self) -> int:
+        return int(self._lib.ps_used(self._h))
+
+    def num_objects(self) -> int:
+        return int(self._lib.ps_num_objects(self._h))
+
+    # -- data plane ----------------------------------------------------------
+
+    def view(self, offset: int, size: int) -> memoryview:
+        """Zero-copy window over an object's payload."""
+        return self._view[offset : offset + size]
+
+    def write(self, offset: int, data) -> None:
+        mv = memoryview(data)
+        self._view[offset : offset + len(mv)] = mv
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self._view.release()
+            except Exception:
+                pass
+            self._lib.ps_close(self._h)

@@ -1,0 +1,476 @@
+// Native shared-memory object store ("plasma" analog).
+//
+// Reference design: src/ray/object_manager/plasma/ — one store authority per
+// node, objects in shared memory mapped zero-copy by every worker process,
+// dlmalloc-on-mmap allocator (dlmalloc.cc, plasma_allocator.cc), LRU
+// eviction of unpinned sealed objects (eviction_policy.h).
+//
+// This implementation: a single POSIX shm segment per node session holding
+//   [ Header | object table (open addressing) | data arena ]
+// - allocator: boundary-tag first-fit free list with physical coalescing
+//   (the dlmalloc role, sized for few large tensor objects rather than many
+//   tiny ones — object payloads here are >64KiB serialized arrays)
+// - concurrency: one process-shared robust pthread mutex in the header
+//   (the store-authority serialization point, like the plasma store's
+//   single event loop)
+// - eviction: LRU clock over sealed, unpinned entries
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <fcntl.h>
+#include <algorithm>
+#include <mutex>
+#include <utility>
+#include <vector>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055504C534DULL;  // "RTPUPLSM"
+constexpr uint32_t kSlots = 1 << 16;                // object table capacity
+constexpr uint64_t kAlign = 64;
+
+enum EntryState : uint32_t {
+  kEmpty = 0,
+  kCreated = 1,
+  kSealed = 2,
+  kTombstone = 3,
+};
+
+struct Entry {
+  uint8_t id[20];
+  uint32_t state;
+  uint64_t offset;  // data offset within the arena (past block header)
+  uint64_t size;    // payload size
+  uint32_t pins;
+  uint64_t lru;
+};
+
+// Boundary-tag block header, resident in the arena.
+struct Block {
+  uint64_t size;      // total block size incl. header
+  uint64_t prev_off;  // physical predecessor offset (0 if first)
+  uint32_t free;
+  uint32_t _pad;
+  // free-list links (valid only when free)
+  uint64_t next_free;  // offset or 0
+  uint64_t prev_free;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t total_size;   // whole mapping
+  uint64_t arena_off;    // start of data arena
+  uint64_t arena_size;
+  uint64_t used;         // bytes in live blocks (incl. headers)
+  uint64_t lru_clock;
+  uint64_t free_head;    // offset of first free block (0 = none)
+  uint64_t num_objects;
+  pthread_mutex_t lock;
+  Entry table[kSlots];
+};
+
+struct Store {
+  Header* hdr;
+  uint8_t* base;
+  uint64_t map_size;
+  bool owner;
+  char name[256];
+};
+
+constexpr int kMaxStores = 64;
+Store* g_stores[kMaxStores];
+std::mutex g_stores_mu;  // guards the in-process handle table
+
+uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+Block* block_at(Store* s, uint64_t off) {
+  return reinterpret_cast<Block*>(s->base + off);
+}
+
+uint64_t hash_id(const uint8_t* id) {
+  uint64_t h = 1469598103934665603ULL;
+  for (int i = 0; i < 20; i++) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+Entry* find_entry(Store* s, const uint8_t* id, bool for_insert) {
+  Header* h = s->hdr;
+  uint64_t idx = hash_id(id) & (kSlots - 1);
+  Entry* first_tomb = nullptr;
+  for (uint32_t probe = 0; probe < kSlots; probe++) {
+    Entry* e = &h->table[(idx + probe) & (kSlots - 1)];
+    if (e->state == kEmpty) {
+      if (for_insert) return first_tomb ? first_tomb : e;
+      return nullptr;
+    }
+    if (e->state == kTombstone) {
+      if (for_insert && !first_tomb) first_tomb = e;
+      continue;
+    }
+    if (memcmp(e->id, id, 20) == 0) return e;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+// -- free list ---------------------------------------------------------------
+
+void freelist_remove(Store* s, Block* b, uint64_t off) {
+  Header* h = s->hdr;
+  if (b->prev_free)
+    block_at(s, b->prev_free)->next_free = b->next_free;
+  else
+    h->free_head = b->next_free;
+  if (b->next_free) block_at(s, b->next_free)->prev_free = b->prev_free;
+  b->next_free = b->prev_free = 0;
+}
+
+void freelist_push(Store* s, Block* b, uint64_t off) {
+  Header* h = s->hdr;
+  b->free = 1;
+  b->next_free = h->free_head;
+  b->prev_free = 0;
+  if (h->free_head) block_at(s, h->free_head)->prev_free = off;
+  h->free_head = off;
+}
+
+uint64_t phys_next(Store* s, uint64_t off) {
+  Block* b = block_at(s, off);
+  uint64_t next = off + b->size;
+  if (next >= s->hdr->arena_off + s->hdr->arena_size) return 0;
+  return next;
+}
+
+// merge b with free physical neighbors; b must already be marked free and
+// OUT of the free list; returns the (possibly moved) block offset, pushed.
+void free_block(Store* s, uint64_t off) {
+  Block* b = block_at(s, off);
+  s->hdr->used -= b->size;
+  // coalesce with next
+  uint64_t next = phys_next(s, off);
+  if (next) {
+    Block* nb = block_at(s, next);
+    if (nb->free) {
+      freelist_remove(s, nb, next);
+      b->size += nb->size;
+      uint64_t nn = phys_next(s, off);
+      if (nn) block_at(s, nn)->prev_off = off;
+    }
+  }
+  // coalesce with prev
+  if (b->prev_off) {
+    Block* pb = block_at(s, b->prev_off);
+    if (pb->free) {
+      uint64_t poff = b->prev_off;
+      freelist_remove(s, pb, poff);
+      pb->size += b->size;
+      uint64_t nn = phys_next(s, poff);
+      if (nn) block_at(s, nn)->prev_off = poff;
+      freelist_push(s, pb, poff);
+      return;
+    }
+  }
+  freelist_push(s, b, off);
+}
+
+// first-fit allocation; returns block offset or 0
+uint64_t alloc_block(Store* s, uint64_t need) {
+  Header* h = s->hdr;
+  uint64_t total = align_up(need + sizeof(Block), kAlign);
+  uint64_t off = h->free_head;
+  while (off) {
+    Block* b = block_at(s, off);
+    if (b->size >= total) {
+      freelist_remove(s, b, off);
+      if (b->size >= total + sizeof(Block) + kAlign) {
+        // split: remainder becomes a new free block
+        uint64_t rem_off = off + total;
+        Block* rem = block_at(s, rem_off);
+        rem->size = b->size - total;
+        rem->prev_off = off;
+        rem->free = 1;
+        uint64_t after = rem_off + rem->size;
+        if (after < h->arena_off + h->arena_size)
+          block_at(s, after)->prev_off = rem_off;
+        freelist_push(s, rem, rem_off);
+        b->size = total;
+      }
+      b->free = 0;
+      h->used += b->size;
+      return off;
+    }
+    off = b->next_free;
+  }
+  return 0;
+}
+
+void evict_entry(Store* s, Entry* victim) {
+  uint64_t block_off = victim->offset - sizeof(Block);
+  victim->state = kTombstone;
+  s->hdr->num_objects--;
+  free_block(s, block_off);
+}
+
+// allocate, evicting LRU sealed+unpinned objects as needed. ONE table scan
+// collects every candidate (instead of a full rescan per victim — that was
+// O(victims * kSlots) under the store-wide mutex); victims are then freed
+// oldest-first until the allocation fits or candidates run out.
+uint64_t alloc_with_eviction(Store* s, uint64_t need) {
+  uint64_t off = alloc_block(s, need);
+  if (off) return off;
+  Header* h = s->hdr;
+  std::vector<std::pair<uint64_t, uint32_t>> cands;  // (lru, slot)
+  cands.reserve(256);
+  for (uint32_t i = 0; i < kSlots; i++) {
+    Entry* e = &h->table[i];
+    if (e->state == kSealed && e->pins == 0) cands.emplace_back(e->lru, i);
+  }
+  std::sort(cands.begin(), cands.end());
+  for (auto& [lru, slot] : cands) {
+    evict_entry(s, &h->table[slot]);
+    off = alloc_block(s, need);
+    if (off) return off;
+  }
+  return 0;
+}
+
+int put_handle(Store* s) {
+  std::lock_guard<std::mutex> g(g_stores_mu);
+  for (int i = 0; i < kMaxStores; i++) {
+    if (!g_stores[i]) {
+      g_stores[i] = s;
+      return i;
+    }
+  }
+  return -1;
+}
+
+Store* get_store(int handle) {
+  if (handle < 0 || handle >= kMaxStores) return nullptr;
+  std::lock_guard<std::mutex> g(g_stores_mu);
+  return g_stores[handle];
+}
+
+struct Guard {
+  pthread_mutex_t* m;
+  explicit Guard(pthread_mutex_t* mu) : m(mu) {
+    int rc = pthread_mutex_lock(m);
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(m);  // robust recovery
+  }
+  ~Guard() { pthread_mutex_unlock(m); }
+};
+
+}  // namespace
+
+extern "C" {
+
+// create a new store segment; returns handle or -1
+int ps_create(const char* name, uint64_t capacity) {
+  uint64_t arena = align_up(capacity, kAlign);
+  uint64_t total = align_up(sizeof(Header), kAlign) + arena;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return -1;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return -1;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return -1;
+  }
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(mem);
+  s->hdr = reinterpret_cast<Header*>(mem);
+  s->map_size = total;
+  s->owner = true;
+  snprintf(s->name, sizeof(s->name), "%s", name);
+
+  Header* h = s->hdr;
+  memset(h, 0, sizeof(Header));
+  h->total_size = total;
+  h->arena_off = align_up(sizeof(Header), kAlign);
+  h->arena_size = arena;
+  h->used = 0;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->lock, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  // one big free block spans the arena
+  Block* b = block_at(s, h->arena_off);
+  b->size = arena;
+  b->prev_off = 0;
+  b->free = 1;
+  b->next_free = b->prev_free = 0;
+  h->free_head = h->arena_off;
+  h->magic = kMagic;  // published last
+  return put_handle(s);
+}
+
+int ps_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return -1;
+  }
+  void* mem =
+      mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return -1;
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(mem);
+  s->hdr = reinterpret_cast<Header*>(mem);
+  s->map_size = (uint64_t)st.st_size;
+  s->owner = false;
+  snprintf(s->name, sizeof(s->name), "%s", name);
+  if (s->hdr->magic != kMagic) {
+    munmap(mem, s->map_size);
+    delete s;
+    return -1;
+  }
+  return put_handle(s);
+}
+
+void* ps_base(int handle) {
+  Store* s = get_store(handle);
+  return s ? s->base : nullptr;
+}
+
+uint64_t ps_capacity(int handle) {
+  Store* s = get_store(handle);
+  return s ? s->hdr->arena_size : 0;
+}
+
+uint64_t ps_total_size(int handle) {
+  Store* s = get_store(handle);
+  return s ? s->hdr->total_size : 0;
+}
+
+uint64_t ps_used(int handle) {
+  Store* s = get_store(handle);
+  return s ? s->hdr->used : 0;
+}
+
+uint64_t ps_num_objects(int handle) {
+  Store* s = get_store(handle);
+  return s ? s->hdr->num_objects : 0;
+}
+
+// allocate an object; out_off receives the PAYLOAD offset from base.
+// returns 0 ok, -1 no space (after eviction), -2 already exists, -3 bad args
+int ps_alloc(int handle, const uint8_t* id, uint64_t size, uint64_t* out_off) {
+  Store* s = get_store(handle);
+  if (!s || size == 0) return -3;
+  Guard g(&s->hdr->lock);
+  Entry* existing = find_entry(s, id, false);
+  if (existing) return -2;
+  uint64_t block_off = alloc_with_eviction(s, size);
+  if (block_off == 0) return -1;
+  Entry* e = find_entry(s, id, true);
+  if (!e) {  // table full
+    free_block(s, block_off);
+    return -1;
+  }
+  memcpy(e->id, id, 20);
+  e->state = kCreated;
+  e->offset = block_off + sizeof(Block);
+  e->size = size;
+  e->pins = 0;
+  e->lru = ++s->hdr->lru_clock;
+  s->hdr->num_objects++;
+  *out_off = e->offset;
+  return 0;
+}
+
+int ps_seal(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  Guard g(&s->hdr->lock);
+  Entry* e = find_entry(s, id, false);
+  if (!e) return -1;
+  e->state = kSealed;
+  e->lru = ++s->hdr->lru_clock;
+  return 0;
+}
+
+// lookup a sealed object; bumps LRU. returns 0 ok, -1 missing
+int ps_lookup(int handle, const uint8_t* id, uint64_t* out_off, uint64_t* out_size) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  Guard g(&s->hdr->lock);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state != kSealed) return -1;
+  e->lru = ++s->hdr->lru_clock;
+  *out_off = e->offset;
+  *out_size = e->size;
+  return 0;
+}
+
+int ps_pin(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  Guard g(&s->hdr->lock);
+  Entry* e = find_entry(s, id, false);
+  if (!e) return -1;
+  e->pins++;
+  return 0;
+}
+
+int ps_unpin(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  Guard g(&s->hdr->lock);
+  Entry* e = find_entry(s, id, false);
+  if (!e) return -1;
+  if (e->pins > 0) e->pins--;
+  return 0;
+}
+
+int ps_delete(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  Guard g(&s->hdr->lock);
+  Entry* e = find_entry(s, id, false);
+  if (!e) return -1;
+  uint64_t block_off = e->offset - sizeof(Block);
+  e->state = kTombstone;
+  s->hdr->num_objects--;
+  free_block(s, block_off);
+  return 0;
+}
+
+void ps_close(int handle) {
+  Store* s;
+  {
+    std::lock_guard<std::mutex> g(g_stores_mu);
+    if (handle < 0 || handle >= kMaxStores) return;
+    s = g_stores[handle];
+    if (!s) return;
+    g_stores[handle] = nullptr;
+  }
+  munmap(s->base, s->map_size);
+  if (s->owner) shm_unlink(s->name);
+  delete s;
+}
+
+int ps_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

@@ -48,6 +48,9 @@ class Config:
     # (reference: max_direct_call_object_size, ray_config_def.h).
     max_inline_object_size: int = 100 * 1024
     object_store_memory: int = 2 * 1024**3
+    # C++ arena store (ray_tpu/_native/plasma_store.cc); falls back to the
+    # Python per-segment store when the native build is unavailable.
+    use_native_plasma: bool = True
     object_store_full_delay_ms: int = 100
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_bytes: int = 8 * 1024**2

@@ -146,9 +146,28 @@ class Controller:
         self.lock = threading.RLock()
         self.shutting_down = False
 
-        # Object plane.
+        # Object plane. Prefer the native (C++) arena store; fall back to the
+        # Python per-segment store if the toolchain can't build it.
         self.memory_store = MemoryStore()  # object_id -> (kind, payload)
-        self.plasma = PlasmaStore(config.object_store_memory)
+        self.plasma = None
+        if config.use_native_plasma:
+            try:
+                from ray_tpu._native import plasma as native_plasma
+                from ray_tpu._private.object_store import NativePlasmaStore
+
+                if native_plasma.available():
+                    arena_name = f"/rtpu-{os.getpid()}-{time.time_ns() & 0xFFFFFF:x}"
+                    self.plasma = NativePlasmaStore(
+                        config.object_store_memory, arena_name
+                    )
+                    # workers inherit the controller's environ at spawn
+                    os.environ["RAY_TPU_ARENA"] = arena_name
+            except Exception:
+                logger.warning("native plasma unavailable; using Python store",
+                               exc_info=True)
+        if self.plasma is None:
+            os.environ.pop("RAY_TPU_ARENA", None)
+            self.plasma = PlasmaStore(config.object_store_memory)
         self.plasma_client = PlasmaClient()
 
         # Cluster state.
@@ -685,6 +704,11 @@ class Controller:
                 return None
             actor = self.actors[actor_id]
             return (actor_id, actor.creation_spec.max_concurrency)
+        if op == "shm_create":
+            # native-arena allocation for a worker (the plasma-create RPC;
+            # reference: plasma client protocol CreateRequest)
+            object_id, size = payload
+            return self.plasma.create_remote(object_id, size)
         if op == "kill_actor":
             actor_id, no_restart = payload
             self.kill_actor(actor_id, no_restart)

@@ -211,6 +211,104 @@ class PlasmaStore:
             self._segments.clear()
 
 
+class _ArenaWriter:
+    """Write handle matching SharedMemory's ``.buf`` contract."""
+
+    __slots__ = ("buf",)
+
+    def __init__(self, view: memoryview):
+        self.buf = view
+
+
+class NativePlasmaStore:
+    """Store authority backed by the C++ arena (``_native/plasma_store.cc``).
+
+    Same contract as :class:`PlasmaStore`; object locations are encoded as
+    ``"@<arena>#<offset>"`` strings so they travel through the existing
+    control-plane payloads unchanged. Allocation, LRU eviction of unpinned
+    sealed objects, pinning, and the free-list allocator all live in native
+    code under one process-shared robust mutex (reference:
+    ``plasma/plasma_allocator.cc`` + ``eviction_policy.h``).
+    """
+
+    def __init__(self, capacity_bytes: int, arena_name: str):
+        from ray_tpu._native.plasma import NativeArena
+
+        self.arena = NativeArena(arena_name, capacity=capacity_bytes)
+        self.arena_name = arena_name
+        self._capacity = capacity_bytes
+
+    def _name_for(self, offset: int) -> str:
+        return f"@{self.arena_name}#{offset}"
+
+    def _alloc(self, object_id: ObjectID, size: int) -> int:
+        from ray_tpu._native.plasma import NativePlasmaError
+
+        try:
+            return self.arena.alloc(object_id.binary(), max(size, 1))
+        except NativePlasmaError as e:
+            if "exists" in str(e):
+                # task retry re-creating its return object: the previous
+                # attempt's entry (worker died mid-write) is stale — replace
+                self.arena.unpin(object_id.binary())
+                self.arena.delete(object_id.binary())
+                return self.arena.alloc(object_id.binary(), max(size, 1))
+            raise ObjectStoreFullError(
+                f"object of size {size} does not fit in the arena "
+                f"(capacity {self._capacity}, used {self.arena.used_bytes()}): {e}"
+            ) from e
+
+    def create(self, object_id: ObjectID, size: int):
+        off = self._alloc(object_id, size)
+        return _ArenaWriter(self.arena.view(off, size)), self._name_for(off)
+
+    def create_remote(self, object_id: ObjectID, size: int) -> str:
+        """Allocation RPC for workers: returns the location string; the
+        worker writes through its own attached mapping."""
+        return self._name_for(self._alloc(object_id, size))
+
+    def seal(self, object_id: ObjectID, shm_name: str, size: int):
+        self.arena.seal(object_id.binary())
+        # liveness pin: the controller's ref counting owns this object's
+        # lifetime now — LRU eviction must never reclaim an object that
+        # still has ObjectRefs (its location string would silently point at
+        # reused memory). Released in delete() when the last ref drops.
+        self.arena.pin(object_id.binary())
+
+    def lookup(self, object_id: ObjectID):
+        got = self.arena.lookup(object_id.binary())
+        if got is None:
+            return None
+        return self._name_for(got[0]), got[1]
+
+    def pin(self, object_id: ObjectID):
+        self.arena.pin(object_id.binary())
+
+    def unpin(self, object_id: ObjectID):
+        self.arena.unpin(object_id.binary())
+
+    def delete(self, object_id: ObjectID):
+        self.arena.unpin(object_id.binary())
+        self.arena.delete(object_id.binary())
+
+    def used_bytes(self) -> int:
+        return self.arena.used_bytes()
+
+    def num_objects(self) -> int:
+        return self.arena.num_objects()
+
+    def shutdown(self):
+        self.arena.close()
+
+
+def parse_arena_location(shm_name: str):
+    """'@<arena>#<offset>' -> (arena_name, offset) or None for legacy names."""
+    if not shm_name.startswith("@"):
+        return None
+    arena, _, off = shm_name[1:].rpartition("#")
+    return arena, int(off)
+
+
 class PlasmaClient:
     """Per-process client: write objects into / map objects out of shm.
 
@@ -222,9 +320,32 @@ class PlasmaClient:
 
     def __init__(self):
         self._attached: dict[str, object] = {}
+        self._arenas: dict[str, object] = {}
         self._lock = threading.Lock()
 
+    def _arena(self, name: str):
+        with self._lock:
+            a = self._arenas.get(name)
+            if a is None:
+                from ray_tpu._native.plasma import NativeArena
+
+                a = NativeArena(name)  # attach (not owner)
+                self._arenas[name] = a
+            return a
+
     def read(self, shm_name: str, size: int) -> SerializedObject:
+        loc = parse_arena_location(shm_name)
+        if loc is not None:
+            arena_name, offset = loc
+            # COPY out of the arena: deserialized arrays (pickle-5 oob
+            # buffers) alias the returned buffer, and arena blocks are
+            # REUSED after delete/eviction — aliasing them would corrupt
+            # live user arrays. (The per-segment path below stays zero-copy
+            # because unlinked segments remain valid while attached; a
+            # client release protocol can restore zero-copy here later.)
+            return SerializedObject.from_buffer(
+                bytes(self._arena(arena_name).view(offset, size))
+            )
         from multiprocessing import shared_memory
 
         with self._lock:
@@ -233,6 +354,10 @@ class PlasmaClient:
                 seg = shared_memory.SharedMemory(name=shm_name)
                 self._attached[shm_name] = seg
         return SerializedObject.from_buffer(seg.buf[:size])
+
+    def write_arena(self, shm_name: str, data: bytes) -> None:
+        arena_name, offset = parse_arena_location(shm_name)
+        self._arena(arena_name).write(offset, data)
 
     def detach(self, shm_name: str):
         with self._lock:
@@ -252,3 +377,9 @@ class PlasmaClient:
                 except BufferError:
                     pass
             self._attached.clear()
+            for a in self._arenas.values():
+                try:
+                    a.close()
+                except Exception:
+                    pass
+            self._arenas.clear()

@@ -226,9 +226,15 @@ class WorkerRuntime:
         self._await_reply(req_id)
 
     def _write_shm(self, object_id: ObjectID, sobj: SerializedObject):
+        data = sobj.to_bytes()
+        if os.environ.get("RAY_TPU_ARENA"):
+            # native arena: allocate via the store authority, write through
+            # this process's mapping (plasma create/seal protocol)
+            name = self.call_controller("shm_create", (object_id, len(data)))
+            self._plasma().write_arena(name, data)
+            return name, len(data)
         from multiprocessing import shared_memory
 
-        data = sobj.to_bytes()
         name = f"rt_{object_id.hex()[:20]}_{os.getpid() & 0xFFFF:x}"
         seg = shared_memory.SharedMemory(create=True, size=max(len(data), 1), name=name)
         seg.buf[: len(data)] = data

@@ -95,9 +95,14 @@ class InputNode(DAGNode):
         return InputAttributeNode(self, key)
 
     def _execute_node(self, results, input_args, input_kwargs):
-        if len(input_args) == 1 and not input_kwargs:
+        if input_args and input_kwargs:
+            raise ValueError(
+                "dag.execute() takes positional OR keyword inputs, not both "
+                "(keyword inputs are read via InputNode['key'])"
+            )
+        if len(input_args) == 1:
             return input_args[0]
-        if input_kwargs and not input_args:
+        if input_kwargs:
             return dict(input_kwargs)
         return input_args
 

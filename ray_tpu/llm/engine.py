@@ -140,7 +140,9 @@ class JaxEngine:
         cfg = self.model_cfg
         ec = self.config.engine
 
-        K = min(64, cfg.vocab_size)
+        # one static top-K for the decode program AND the prefill first-token
+        # sampler — they must agree or seeded runs diverge at token 2
+        self._top_k_static = K = min(64, cfg.vocab_size)
 
         def decode_fn(params, cache, tokens, temps, top_ks, keys):
             """Decode + in-program sampling: greedy where temp<=0, else
@@ -305,7 +307,7 @@ class JaxEngine:
                     # request's own PRNG chain when seeded, so seeded
                     # generations reproduce regardless of batch composition)
                     first = int(np.argmax(np.asarray(last_logits)))
-                    K = min(64, self.model_cfg.vocab_size)
+                    K = self._top_k_static
                     if req.params.seed is not None:
                         req_key = jax.random.PRNGKey(req.params.seed)
                     else:
