@@ -201,6 +201,8 @@ class Controller:
 
         # Observability: task events ring buffer.
         self.task_events: deque[dict] = deque(maxlen=config.event_buffer_size)
+        # resource-shape -> last-seen timestamp of unfulfilled demand
+        self.pending_demand: dict[tuple, float] = {}
 
         self.serialization = SerializationContext()
         self._reply_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="ctrl-reply")
@@ -478,12 +480,17 @@ class Controller:
         else:
             node.allocate(demand)
         pt._node = node  # type: ignore[attr-defined]
+        # demand satisfied: stop advertising this shape to the autoscaler
+        # (otherwise a scaled-down group relaunches for stale demand)
+        self.pending_demand.pop(tuple(sorted(demand.items())), None)
         self._dispatch_to_worker(worker, pt)
         return True
 
     def _maybe_autoscale_hint(self, pt: PendingTask):
-        # Hook point for the autoscaler (resource demand snapshot).
-        pass
+        """Record unfulfilled demand for the autoscaler (reference:
+        GcsAutoscalerStateManager fed by scheduler backlog)."""
+        shape = tuple(sorted(pt.spec.resources.items()))
+        self.pending_demand[shape] = time.time()
 
     @staticmethod
     def _env_fingerprint(spec: TaskSpec):
@@ -757,6 +764,130 @@ class Controller:
         if op == "actor_state":
             actor = self.actors.get(payload)
             return actor.state if actor else None
+        # ---- state API (reference: util/state/api.py over GcsTaskManager
+        #      and per-entity GCS tables) ----
+        if op == "list_actors":
+            with self.lock:
+                return [
+                    {
+                        "actor_id": a.actor_id.hex(),
+                        "class_name": a.creation_spec.name.split(".")[0],
+                        "state": a.state,
+                        "name": a.name or "",
+                        "pending_tasks": len(a.queue),
+                        "restarts_left": a.restarts_left,
+                        "death_cause": a.death_cause,
+                    }
+                    for a in self.actors.values()
+                ]
+        if op == "list_tasks":
+            limit = payload or 1000
+            with self.lock:
+                running = [
+                    {
+                        "task_id": pt.spec.task_id.hex(),
+                        "name": pt.spec.name,
+                        "state": "RUNNING",
+                        "worker_id": w.worker_id.hex(),
+                    }
+                    for w in self.workers.values()
+                    for pt in w.running.values()
+                ]
+                queued = [
+                    {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
+                     "state": "PENDING_SCHEDULING", "worker_id": None}
+                    for pt in self.ready_queue
+                ]
+                ready_ids = {pt.spec.task_id for pt in self.ready_queue}
+                running_ids = {
+                    pt.spec.task_id
+                    for w in self.workers.values()
+                    for pt in w.running.values()
+                }
+                blocked = [
+                    {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
+                     "state": "PENDING_ARGS_AVAIL", "worker_id": None}
+                    for pt in self.pending_by_id.values()
+                    if pt.spec.task_id not in ready_ids
+                    and pt.spec.task_id not in running_ids
+                ]
+                actor_queued = [
+                    {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
+                     "state": "PENDING_ACTOR", "worker_id": None}
+                    for a in self.actors.values()
+                    for pt in a.queue
+                ]
+            return (running + queued + blocked + actor_queued)[:limit]
+        if op == "list_objects":
+            with self.lock:
+                return {
+                    "num_objects_in_memory_store": self.memory_store.size(),
+                    "num_plasma_objects": (
+                        self.plasma.num_objects()
+                        if hasattr(self.plasma, "num_objects")
+                        else len(getattr(self.plasma, "_sealed", {}))
+                    ),
+                    "plasma_used_bytes": self.plasma.used_bytes(),
+                    "ref_counted": len(self.ref_counts),
+                }
+        if op == "list_placement_groups":
+            with self.lock:
+                return [
+                    {
+                        "placement_group_id": pg_id.hex(),
+                        "strategy": pg.strategy,
+                        "bundles": pg.bundles,
+                        "state": (
+                            "REMOVED" if pg.removed
+                            else "CREATED" if pg.ready.is_set() else "PENDING"
+                        ),
+                    }
+                    for pg_id, pg in self.placement_groups.items()
+                ]
+        if op == "list_workers":
+            with self.lock:
+                return [
+                    {
+                        "worker_id": w.worker_id.hex(),
+                        "node_id": w.node_id.hex(),
+                        "pid": getattr(getattr(w, "proc", None), "pid", None),
+                        "running_tasks": len(w.running),
+                        "idle": not w.running,
+                    }
+                    for w in self.workers.values()
+                ]
+        if op == "task_events":
+            return list(self.task_events)
+        if op == "autoscaler_state":
+            # demand younger than 60s + per-node utilization snapshot
+            now = time.time()
+            with self.lock:
+                self.pending_demand = {
+                    k: t for k, t in self.pending_demand.items() if now - t < 60
+                }
+                demand = [dict(shape) for shape in self.pending_demand]
+                nodes = [
+                    {
+                        "node_id": n.node_id.hex(),
+                        "total": dict(n.total),
+                        "available": dict(n.available),
+                        "idle": all(
+                            abs(n.available.get(k, 0) - v) < 1e-9
+                            for k, v in n.total.items()
+                        ),
+                        "alive": n.alive,
+                    }
+                    for n in self.nodes.values()
+                ]
+            return {"pending_demand": demand, "nodes": nodes}
+        if op == "add_node":
+            resources, labels = payload
+            return self.add_node(resources, labels).hex()
+        if op == "remove_node":
+            from ray_tpu._private.ids import NodeID as _NodeID
+
+            self.remove_node(_NodeID(bytes.fromhex(payload)))
+            return True
         raise ValueError(f"unknown controller op: {op}")
 
     # ------------------------------------------------------------ dispatching

@@ -1,0 +1,15 @@
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    FakeNodeProvider,
+    NodeGroup,
+    NodeProvider,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "FakeNodeProvider",
+    "NodeGroup",
+    "NodeProvider",
+]

@@ -1,0 +1,183 @@
+"""Autoscaler: declarative node groups reconciled against resource demand.
+
+Reference: ``python/ray/autoscaler/v2/autoscaler.py:47`` + ``scheduler.py``
+(bin-packing over ``autoscaler.proto`` cluster state) + the instance-manager
+lifecycle; the fake provider mirrors ``autoscaler/_private/fake_multi_node``
+(SURVEY §4 — multi-node autoscaling tested on one host).
+
+TPU-first delta (SURVEY §7 stage 9): the scaling unit of a TPU node group is
+the whole pod SLICE — ``NodeGroup(nodes_per_group=hosts_per_slice)`` adds or
+removes all hosts of a slice atomically, never a partial slice (partial-slice
+allocation cannot run an SPMD program).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class NodeGroup:
+    """One scalable pool of identical nodes (a TPU slice type or CPU pool)."""
+
+    name: str
+    resources_per_node: dict[str, float]
+    nodes_per_group: int = 1  # hosts per slice: scale-ups are atomic groups
+    min_groups: int = 0
+    max_groups: int = 10
+
+    def can_satisfy(self, shape: dict[str, float]) -> bool:
+        return all(
+            self.resources_per_node.get(k, 0.0) >= v for k, v in shape.items()
+        )
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    node_groups: list[NodeGroup] = dataclasses.field(default_factory=list)
+    idle_timeout_s: float = 60.0
+    poll_interval_s: float = 1.0
+
+
+class NodeProvider:
+    """Reference: ``autoscaler/node_provider.py`` plugin API."""
+
+    def create_node_group(self, group: NodeGroup) -> list[str]:
+        raise NotImplementedError
+
+    def terminate_nodes(self, node_ids: list[str]) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> list[str]:
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Nodes are controller-registered scheduling domains on this host
+    (reference: ``fake_multi_node``)."""
+
+    def __init__(self):
+        self._nodes: list[str] = []
+
+    @staticmethod
+    def _call(op, payload=None):
+        from ray_tpu.util.state.api import _call
+
+        return _call(op, payload)
+
+    def create_node_group(self, group: NodeGroup) -> list[str]:
+        created = []
+        for _ in range(group.nodes_per_group):
+            nid = self._call(
+                "add_node", (dict(group.resources_per_node), {"group": group.name})
+            )
+            created.append(nid)
+            self._nodes.append(nid)
+        return created
+
+    def terminate_nodes(self, node_ids: list[str]) -> None:
+        for nid in node_ids:
+            self._call("remove_node", nid)
+            if nid in self._nodes:
+                self._nodes.remove(nid)
+
+    def non_terminated_nodes(self) -> list[str]:
+        return list(self._nodes)
+
+
+class Autoscaler:
+    """Reconcile loop: unfulfilled demand → scale up matching groups;
+    fully-idle groups past the idle timeout → scale down (atomic per group)."""
+
+    def __init__(self, config: AutoscalerConfig, provider: Optional[NodeProvider] = None):
+        self.config = config
+        self.provider = provider or FakeNodeProvider()
+        # group name -> list of "launches", each a list of node ids
+        self.launched: dict[str, list[list[str]]] = {
+            g.name: [] for g in config.node_groups
+        }
+        self._idle_since: dict[str, float] = {}  # launch key -> first idle t
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- control ------------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="autoscaler"
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _loop(self):
+        import logging
+
+        logger = logging.getLogger(__name__)
+        while not self._stop.wait(self.config.poll_interval_s):
+            try:
+                self.update()
+            except Exception:
+                logger.warning("autoscaler reconcile failed", exc_info=True)
+
+    @staticmethod
+    def _call(op, payload=None):
+        from ray_tpu.util.state.api import _call
+
+        return _call(op, payload)
+
+    # -- one reconcile pass ---------------------------------------------------
+
+    def update(self) -> dict:
+        state = self._call("autoscaler_state")
+        actions: dict[str, Any] = {"scaled_up": [], "scaled_down": []}
+        nodes_by_id = {n["node_id"]: n for n in state["nodes"]}
+
+        # ensure minimums
+        for g in self.config.node_groups:
+            while len(self.launched[g.name]) < g.min_groups:
+                self.launched[g.name].append(self.provider.create_node_group(g))
+                actions["scaled_up"].append(g.name)
+
+        # scale up for unfulfilled demand
+        for shape in state["pending_demand"]:
+            if self._satisfiable(shape, nodes_by_id):
+                continue
+            for g in self.config.node_groups:
+                if g.can_satisfy(shape) and len(self.launched[g.name]) < g.max_groups:
+                    self.launched[g.name].append(self.provider.create_node_group(g))
+                    actions["scaled_up"].append(g.name)
+                    break
+
+        # scale down idle groups (whole slices only)
+        now = time.time()
+        for g in self.config.node_groups:
+            for launch in list(self.launched[g.name]):
+                if len(self.launched[g.name]) <= g.min_groups:
+                    break
+                key = ",".join(launch)
+                infos = [nodes_by_id.get(nid) for nid in launch]
+                if all(i and i["idle"] and i["alive"] for i in infos):
+                    since = self._idle_since.setdefault(key, now)
+                    if now - since >= self.config.idle_timeout_s:
+                        self.provider.terminate_nodes(launch)
+                        self.launched[g.name].remove(launch)
+                        self._idle_since.pop(key, None)
+                        actions["scaled_down"].append(g.name)
+                else:
+                    self._idle_since.pop(key, None)
+        return actions
+
+    def _satisfiable(self, shape: dict, nodes_by_id: dict) -> bool:
+        for n in nodes_by_id.values():
+            if n["alive"] and all(
+                n["total"].get(k, 0.0) >= v for k, v in shape.items()
+            ):
+                return True
+        return False

@@ -1,0 +1,8 @@
+from ray_tpu.job_submission.job_manager import (
+    JobInfo,
+    JobManager,
+    JobStatus,
+    JobSubmissionClient,
+)
+
+__all__ = ["JobInfo", "JobManager", "JobStatus", "JobSubmissionClient"]

@@ -1,0 +1,132 @@
+"""ray-tpu CLI (reference: ``python/ray/scripts/scripts.py`` click commands).
+
+Usage: ``python -m ray_tpu.scripts.cli <command> ...``
+
+Commands: status, microbenchmark, timeline, job {submit,list,status,logs,stop}.
+Cluster-attached subcommands (status/timeline) start an ephemeral local
+instance when none is running in this process — the CLI is a driver, matching
+how our control plane is driver-hosted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _ensure_init(args):
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=getattr(args, "num_cpus", 4), mode="thread")
+
+
+def cmd_status(args):
+    import ray_tpu
+
+    _ensure_init(args)
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print("Cluster resources:")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):g} / {total[k]:g} available")
+    nodes = ray_tpu.nodes()
+    print(f"Nodes: {len(nodes)}")
+    for n in nodes:
+        print(f"  {n['NodeID'][:12]} alive={n['Alive']} {n['Resources']}")
+
+
+def cmd_microbenchmark(args):
+    from ray_tpu.scripts.microbenchmark import main
+
+    main(mode=args.mode, num_cpus=args.num_cpus)
+
+
+def cmd_timeline(args):
+    from ray_tpu.util.state.api import timeline
+
+    _ensure_init(args)
+    trace = timeline(args.output)
+    print(f"wrote {len(trace)} trace events to {args.output}")
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        # pass argv through as a list: joining+resplitting would corrupt
+        # arguments containing spaces
+        entrypoint = [a for a in args.entrypoint if a != "--"]
+        job_id = client.submit_job(
+            entrypoint=entrypoint,
+            runtime_env=(
+                {"working_dir": args.working_dir} if args.working_dir else None
+            ),
+        )
+        print(f"submitted: {job_id}")
+        if not args.no_wait:
+            status = client._manager.wait_until_finished(job_id, timeout=args.timeout)
+            print(client.get_job_logs(job_id), end="")
+            print(f"status: {status.value}")
+            sys.exit(0 if status.value == "SUCCEEDED" else 1)
+    elif args.job_cmd == "list":
+        for j in client.list_jobs():
+            print(json.dumps(j))
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id).value)
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+    elif args.job_cmd == "stop":
+        ok = client.stop_job(args.job_id)
+        print("stopped" if ok else "not running")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("status", help="cluster resources + nodes")
+    s.add_argument("--num-cpus", type=int, default=4)
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("microbenchmark", help="core throughput suite")
+    s.add_argument("--mode", default="thread", choices=["thread", "process"])
+    s.add_argument("--num-cpus", type=int, default=8)
+    s.set_defaults(fn=cmd_microbenchmark)
+
+    s = sub.add_parser("timeline", help="export chrome trace of task events")
+    s.add_argument("--output", "-o", default="timeline.json")
+    s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("job", help="job submission")
+    jsub = s.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--working-dir", default=None)
+    js.add_argument("--no-wait", action="store_true")
+    js.add_argument("--timeout", type=float, default=3600)
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    jl = jsub.add_parser("list")
+    jst = jsub.add_parser("status")
+    jst.add_argument("job_id")
+    jlo = jsub.add_parser("logs")
+    jlo.add_argument("job_id")
+    jx = jsub.add_parser("stop")
+    jx.add_argument("job_id")
+    s.set_defaults(fn=cmd_job)
+
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    try:
+        args.fn(args)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Core-runtime microbenchmark suite.
+
+Reference: ``python/ray/_private/ray_perf.py:95-324`` (the ``ray
+microbenchmark`` CLI) — the standard task/actor/object throughput suite
+(SURVEY §6). Prints one line per benchmark plus a JSON summary.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+import numpy as np
+
+
+def timeit(name: str, fn: Callable, multiplier: int = 1, min_time: float = 1.0) -> dict:
+    # warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time:
+        fn()
+        count += 1
+    dur = time.perf_counter() - start
+    rate = count * multiplier / dur
+    print(f"{name:<42s} {rate:>12.1f} /s")
+    return {"name": name, "rate_per_s": rate}
+
+
+def main(mode: str = "thread", num_cpus: int = 8) -> list[dict]:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=num_cpus, mode=mode)
+    results = []
+
+    @ray_tpu.remote
+    def nullary():
+        return None
+
+    @ray_tpu.remote
+    def ident(x):
+        return x
+
+    @ray_tpu.remote
+    class Actor:
+        def method(self, x=None):
+            return x
+
+    small = b"x" * 100
+    big = np.zeros(1024 * 1024, dtype=np.uint8)  # 1 MB -> plasma path
+
+    results.append(
+        timeit("single client put (small)", lambda: ray_tpu.put(small))
+    )
+    results.append(
+        timeit("single client put+get 1MB (plasma)", lambda: ray_tpu.get(ray_tpu.put(big)))
+    )
+
+    def submit_batch_tasks():
+        ray_tpu.get([nullary.remote() for _ in range(100)])
+
+    results.append(timeit("tasks submit+get, batch 100", submit_batch_tasks, 100))
+
+    def task_chain():
+        ref = ident.remote(0)
+        for _ in range(10):
+            ref = ident.remote(ref)
+        ray_tpu.get(ref)
+
+    results.append(timeit("chained task pipeline (depth 10)", task_chain, 10))
+
+    actor = Actor.remote()
+    results.append(
+        timeit("1:1 actor calls sync", lambda: ray_tpu.get(actor.method.remote()))
+    )
+
+    def actor_async_batch():
+        ray_tpu.get([actor.method.remote() for _ in range(100)])
+
+    results.append(timeit("1:1 actor calls async, batch 100", actor_async_batch, 100))
+
+    actors = [Actor.remote() for _ in range(4)]
+
+    def scatter():
+        ray_tpu.get([a.method.remote() for a in actors for _ in range(25)])
+
+    results.append(timeit("1:n actor calls async (4 actors)", scatter, 100))
+
+    def pg_cycle():
+        pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+        pg.ready(timeout=10)
+        ray_tpu.remove_placement_group(pg)
+
+    results.append(timeit("placement group create/remove", pg_cycle))
+
+    ray_tpu.shutdown()
+    print(json.dumps({"microbenchmark": results}))
+    return results
+
+
+if __name__ == "__main__":
+    main()

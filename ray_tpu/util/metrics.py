@@ -1,0 +1,125 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+Reference: ``python/ray/util/metrics.py`` (the app-facing API over the C++
+OpenCensus registry, ``src/ray/stats/metric.h:28``). Here: an in-process
+registry with Prometheus text exposition (``export_prometheus``) — the
+dashboard-agent scrape surface.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Optional, Sequence
+
+_registry: dict[str, "Metric"] = {}
+_registry_lock = threading.Lock()
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        self._default_tags: dict[str, str] = {}
+        with _registry_lock:
+            _registry[name] = self
+
+    def set_default_tags(self, tags: dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[dict]) -> tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return tuple(merged.get(k, "") for k in self.tag_keys)
+
+    def _samples(self):
+        with self._lock:
+            return dict(self._values)
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[dict] = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries: Sequence[float] = (), tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or [0.1, 1, 10, 100, 1000]
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def observe(self, value: float, tags: Optional[dict] = None):
+        k = self._key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * (len(self.boundaries) + 1))
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+
+    def _hist_samples(self):
+        with self._lock:
+            return (
+                {k: list(v) for k, v in self._counts.items()},
+                dict(self._sums),
+            )
+
+
+def export_prometheus() -> str:
+    """All registered metrics in Prometheus text format."""
+    lines = []
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, Histogram):
+            counts, sums = m._hist_samples()
+            for key, bucket_counts in counts.items():
+                base = _fmt_tags(m.tag_keys, key)
+                cum = 0
+                for b, c in zip(m.boundaries + [float("inf")], bucket_counts):
+                    cum += c
+                    le = "+Inf" if b == float("inf") else repr(b)
+                    tag_str = _fmt_tags(m.tag_keys + ("le",), key + (le,))
+                    lines.append(f"{m.name}_bucket{tag_str} {cum}")
+                lines.append(f"{m.name}_sum{base} {sums.get(key, 0.0)}")
+                lines.append(f"{m.name}_count{base} {cum}")
+        else:
+            for key, v in m._samples().items():
+                lines.append(f"{m.name}{_fmt_tags(m.tag_keys, key)} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt_tags(keys: tuple, values: tuple) -> str:
+    if not keys:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in zip(keys, values))
+    return "{" + inner + "}"
+
+
+def _clear_registry():
+    with _registry_lock:
+        _registry.clear()

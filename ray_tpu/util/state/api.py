@@ -1,0 +1,84 @@
+"""State API: live cluster introspection.
+
+Reference: ``python/ray/util/state/api.py:110`` (``StateApiClient``,
+``list_actors``/``list_tasks``/``list_objects``/``list_nodes`` at
+``:783/1010``), backed there by ``GcsTaskManager`` + raylet RPCs; here by
+controller introspection ops over the same entity tables.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Optional
+
+
+def _call(op: str, payload=None):
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller_call(op, payload)
+
+
+def list_actors(limit: int = 1000) -> list[dict]:
+    return _call("list_actors")[:limit]
+
+
+def list_tasks(limit: int = 1000) -> list[dict]:
+    return _call("list_tasks", limit)
+
+
+def list_objects() -> dict:
+    return _call("list_objects")
+
+
+def list_placement_groups(limit: int = 1000) -> list[dict]:
+    return _call("list_placement_groups")[:limit]
+
+
+def list_workers(limit: int = 1000) -> list[dict]:
+    return _call("list_workers")[:limit]
+
+
+def list_nodes() -> list[dict]:
+    return _call("nodes")
+
+
+def summarize_tasks() -> dict:
+    """Event counts per task name (``ray summary tasks`` analog)."""
+    events = _call("task_events")
+    by_name: dict[str, Counter] = {}
+    for e in events:
+        by_name.setdefault(e["name"], Counter())[e["event"]] += 1
+    return {name: dict(c) for name, c in by_name.items()}
+
+
+def timeline(path: Optional[str] = None) -> list[dict]:
+    """Chrome-trace export of task events (``ray timeline`` analog;
+    reference: task events buffered per worker → GcsTaskManager)."""
+    events = _call("task_events")
+    # pair DISPATCHED/FINISHED per task id into complete events
+    starts: dict[str, dict] = {}
+    trace: list[dict] = []
+    for e in events:
+        if e["event"] == "DISPATCHED":
+            starts[e["task_id"]] = e
+        elif e["event"] in ("FINISHED", "FAILED"):
+            s = starts.pop(e["task_id"], None)
+            begin = s["t"] if s else e["t"] - e.get("exec_ms", 0) / 1e3
+            trace.append(
+                {
+                    "name": e["name"],
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": begin * 1e6,
+                    "dur": max((e["t"] - begin) * 1e6, 1),
+                    "pid": 1,
+                    "tid": hash(e["task_id"]) % 64,
+                    "args": {"task_id": e["task_id"], "status": e["event"]},
+                }
+            )
+    if path:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -1,0 +1,111 @@
+"""Tracing: lightweight spans + chrome-trace export.
+
+Reference: ``python/ray/util/tracing/tracing_helper.py`` wraps every task and
+actor invocation in OpenTelemetry spans. Here: core task lifecycle events are
+ALWAYS collected by the controller (``task_events`` → ``ray_tpu.util.state.
+api.timeline``); this module adds app-level spans that merge into the same
+chrome trace, without an OTel dependency (exporters can be attached via
+``set_exporter``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Optional
+
+_spans: list[dict] = []
+_lock = threading.Lock()
+_exporter: Optional[Callable[[dict], None]] = None
+_tls = threading.local()
+
+
+def set_exporter(fn: Optional[Callable[[dict], None]]):
+    """Attach a per-span callback (e.g. an OTLP bridge)."""
+    global _exporter
+    _exporter = fn
+
+
+@contextmanager
+def span(name: str, **attributes):
+    parent = getattr(_tls, "current", None)
+    sid = f"{time.time_ns():x}"
+    _tls.current = sid
+    start = time.time()
+    try:
+        yield
+    finally:
+        _tls.current = parent
+        rec = {
+            "name": name,
+            "span_id": sid,
+            "parent_id": parent,
+            "start": start,
+            "end": time.time(),
+            "attributes": attributes,
+        }
+        with _lock:
+            _spans.append(rec)
+        if _exporter is not None:
+            try:
+                _exporter(rec)
+            except Exception:
+                pass
+
+
+def traced(name: Optional[str] = None):
+    """Decorator form of ``span``."""
+
+    def wrap(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with span(name or fn.__qualname__):
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+def get_spans() -> list[dict]:
+    with _lock:
+        return list(_spans)
+
+
+def clear():
+    with _lock:
+        _spans.clear()
+
+
+def export_chrome_trace(path: Optional[str] = None, include_tasks: bool = True) -> list[dict]:
+    """App spans (+ core task events) as one chrome trace."""
+    trace = []
+    for s in get_spans():
+        trace.append(
+            {
+                "name": s["name"],
+                "cat": "span",
+                "ph": "X",
+                "ts": s["start"] * 1e6,
+                "dur": max((s["end"] - s["start"]) * 1e6, 1),
+                "pid": 0,
+                "tid": 0,
+                "args": s["attributes"],
+            }
+        )
+    if include_tasks:
+        try:
+            from ray_tpu.util.state.api import timeline
+
+            trace.extend(timeline())
+        except Exception:
+            pass
+    if path:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace
