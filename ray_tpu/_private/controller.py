@@ -496,8 +496,13 @@ class Controller:
     def _env_fingerprint(spec: TaskSpec):
         """Workers are only reusable by tasks with the same environment needs
         (TPU visibility is baked in at spawn; runtime_env vars likewise)."""
-        env_vars = (spec.runtime_env or {}).get("env_vars") or {}
-        return (bool(spec.resources.get("TPU")), tuple(sorted(env_vars.items())))
+        rt = spec.runtime_env or {}
+        env_vars = rt.get("env_vars") or {}
+        return (
+            bool(spec.resources.get("TPU")),
+            tuple(sorted(env_vars.items())),
+            rt.get("working_dir"),
+        )
 
     def _acquire_worker(self, node: NodeState, pt: PendingTask) -> Optional[WorkerHandle]:
         idle = self.idle_workers.get(node.node_id, [])
@@ -559,9 +564,23 @@ class Controller:
             env.setdefault("JAX_PLATFORMS", "cpu")
         env_overrides = spec_hint.runtime_env.get("env_vars", {}) if spec_hint.runtime_env else {}
         env.update({k: str(v) for k, v in env_overrides.items()})
+        # runtime_env working_dir (reference: working_dir packaging; local
+        # dirs only here — no URI upload): worker runs with cwd + import
+        # path in the requested directory
+        working_dir = (
+            spec_hint.runtime_env.get("working_dir")
+            if spec_hint.runtime_env
+            else None
+        )
+        if working_dir:
+            working_dir = os.path.abspath(os.path.expanduser(working_dir))
+            env["PYTHONPATH"] = os.pathsep.join(
+                [working_dir, env.get("PYTHONPATH", "")]
+            )
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main", self.address, worker_id.hex()],
             env=env,
+            cwd=working_dir or None,
             stdout=None,
             stderr=None,
         )
@@ -804,12 +823,18 @@ class Controller:
                     for w in self.workers.values()
                     for pt in w.running.values()
                 }
+                actor_queued_ids = {
+                    pt.spec.task_id
+                    for a in self.actors.values()
+                    for pt in a.queue
+                }
                 blocked = [
                     {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
                      "state": "PENDING_ARGS_AVAIL", "worker_id": None}
                     for pt in self.pending_by_id.values()
                     if pt.spec.task_id not in ready_ids
                     and pt.spec.task_id not in running_ids
+                    and pt.spec.task_id not in actor_queued_ids
                 ]
                 actor_queued = [
                     {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,

@@ -177,6 +177,9 @@ class JobManager:
     def _supervise(self, job_id: str, proc: subprocess.Popen, log_f):
         rc = proc.wait()
         log_f.close()
+        # another PROCESS may have persisted STOPPED (cross-process stop by
+        # pid) — adopt any terminal persisted state before deciding ours
+        self._load_persisted_one(job_id)
         with self._lock:
             info = self._jobs[job_id]
             info.return_code = rc

@@ -9,6 +9,7 @@ XLA; see ``engine.py``).
 
 from ray_tpu.llm.batch import ProcessorConfig, build_llm_processor
 from ray_tpu.llm.builders import build_llm_deployment, build_openai_app
+from ray_tpu.llm.disagg import build_pd_disagg_app
 from ray_tpu.llm.config import (
     EngineConfig,
     LLMConfig,
@@ -30,4 +31,5 @@ __all__ = [
     "build_llm_deployment",
     "build_llm_processor",
     "build_openai_app",
+    "build_pd_disagg_app",
 ]

@@ -57,8 +57,10 @@ def cmd_job(args):
     client = JobSubmissionClient()
     if args.job_cmd == "submit":
         # pass argv through as a list: joining+resplitting would corrupt
-        # arguments containing spaces
-        entrypoint = [a for a in args.entrypoint if a != "--"]
+        # arguments containing spaces; drop only a LEADING "--" separator
+        entrypoint = list(args.entrypoint)
+        if entrypoint and entrypoint[0] == "--":
+            entrypoint = entrypoint[1:]
         job_id = client.submit_job(
             entrypoint=entrypoint,
             runtime_env=(

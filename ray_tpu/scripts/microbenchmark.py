@@ -79,13 +79,28 @@ def main(mode: str = "thread", num_cpus: int = 8) -> list[dict]:
         ray_tpu.get([actor.method.remote() for _ in range(100)])
 
     results.append(timeit("1:1 actor calls async, batch 100", actor_async_batch, 100))
+    # free the 1:1 actor's CPU before the fan-out gang: the scatter actors
+    # must all fit or the benchmark deadlocks on an unschedulable actor
+    ray_tpu.kill(actor)
 
-    actors = [Actor.remote() for _ in range(4)]
+    n_actors = max(2, min(4, num_cpus - 1))
+    actors = [Actor.remote() for _ in range(n_actors)]
+    calls_per_actor = 100 // n_actors
 
     def scatter():
-        ray_tpu.get([a.method.remote() for a in actors for _ in range(25)])
+        ray_tpu.get(
+            [a.method.remote() for a in actors for _ in range(calls_per_actor)]
+        )
 
-    results.append(timeit("1:n actor calls async (4 actors)", scatter, 100))
+    results.append(
+        timeit(
+            f"1:n actor calls async ({n_actors} actors)",
+            scatter,
+            n_actors * calls_per_actor,
+        )
+    )
+    for a in actors:
+        ray_tpu.kill(a)
 
     def pg_cycle():
         pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")

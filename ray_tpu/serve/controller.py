@@ -38,6 +38,8 @@ class _DeploymentState:
         self.next_replica_id = 0
         self.last_scale_t = 0.0
         self.last_health_t = 0.0
+        self.replica_started_t: dict[str, float] = {}
+        self.replica_healthy_once: set[str] = set()
         self.metric_window: list[tuple[float, float]] = []  # (ts, ongoing)
         self.status = "UPDATING"
 
@@ -181,6 +183,8 @@ class ServeControllerActor:
                         victims = list(state.replicas.items())[delta:]
                         for name, h in victims:
                             del state.replicas[name]
+                            state.replica_started_t.pop(name, None)
+                            state.replica_healthy_once.discard(name)
                     grace = state.spec.get("graceful_shutdown_timeout_s", 20.0)
                     for _, h in victims:
                         self._graceful_stop(h, grace)
@@ -231,6 +235,7 @@ class ServeControllerActor:
             return
         with self._lock:
             state.replicas[replica_name] = h
+            state.replica_started_t[replica_name] = time.time()
 
     def _health_check(self, state: _DeploymentState):
         now = time.time()
@@ -247,15 +252,31 @@ class ServeControllerActor:
         timeout = state.spec.get("health_check_timeout_s", 30)
         refs = [(name, h, h.check_health.remote()) for name, h in replicas]
         deadline = time.time() + timeout
+        from ray_tpu.exceptions import GetTimeoutError
+
         for name, h, ref in refs:
             try:
                 ray_tpu.get(ref, timeout=max(0.1, deadline - time.time()))
+                state.replica_healthy_once.add(name)
+            except GetTimeoutError:
+                # a replica still running __init__ (model build / first jit
+                # can take minutes on TPU) must not be killed for slow
+                # startup — pre-healthy replicas get a long grace on TIMEOUT
+                # only; a dead actor (below) is replaced immediately
+                started = state.replica_started_t.get(name, 0.0)
+                if name not in state.replica_healthy_once and (
+                    time.time() - started < max(120.0, timeout * 4)
+                ):
+                    continue
+                dead.append((name, h))
             except Exception:
                 dead.append((name, h))
         for name, h in dead:
             logger.warning("replica %s unhealthy; replacing", name)
             with self._lock:
                 state.replicas.pop(name, None)
+                state.replica_started_t.pop(name, None)
+                state.replica_healthy_once.discard(name)
             self._kill_replica(h)
 
     def _autoscale(self):

@@ -113,10 +113,22 @@ def export_prometheus() -> str:
     return "\n".join(lines) + "\n"
 
 
+def _escape_label(v) -> str:
+    # Prometheus exposition format: backslash, quote, newline must be escaped
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_tags(keys: tuple, values: tuple) -> str:
     if not keys:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in zip(keys, values))
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in zip(keys, values)
+    )
     return "{" + inner + "}"
 
 

@@ -164,6 +164,30 @@ def test_batch_processor(ray_start_thread):
     assert all(isinstance(r["generated_text"], str) for r in rows)
 
 
+def test_prefill_decode_disagg(ray_start_thread):
+    """Disagg path must produce the same greedy tokens as the unified engine."""
+    from ray_tpu import serve
+    from ray_tpu.llm import build_pd_disagg_app
+
+    cfg = LLMConfig(
+        model=ModelConfig(model_id="tiny", tokenizer="byte", seed=0),
+        engine=EngineConfig(max_num_seqs=2, max_seq_len=64, prefill_buckets=(16, 32, 64)),
+    )
+    app = build_pd_disagg_app(cfg)
+    handle = serve.run(app, name="pd")
+    out = handle.remote({"prompt": "abc", "max_tokens": 5}).result(timeout_s=300)
+    assert out["num_tokens"] == 5
+
+    # unified engine reference for the same model/prompt
+    eng = JaxEngine(cfg)
+    ref = eng.generate(
+        "abc", sampling_params=SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)
+    )
+    eng.shutdown()
+    assert out["text"] == eng.tokenizer.decode(ref.token_ids)
+    serve.shutdown()
+
+
 def test_openai_router_routing():
     from ray_tpu.llm.openai_api import OpenAIRouter
     from ray_tpu.serve.proxy import Request

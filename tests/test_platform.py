@@ -172,6 +172,29 @@ def test_autoscaler_scales_up_and_down():
     ray_tpu.shutdown()
 
 
+def test_runtime_env_working_dir(tmp_path):
+    """Tasks with runtime_env working_dir run with cwd + import path there."""
+    mod = tmp_path / "my_wd_module.py"
+    mod.write_text("VALUE = 'from-working-dir'\n")
+
+    ray_tpu.init(num_cpus=2, mode="process")
+    try:
+
+        @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+        def probe():
+            import os
+
+            import my_wd_module
+
+            return my_wd_module.VALUE, os.getcwd()
+
+        value, cwd = ray_tpu.get(probe.remote(), timeout=120)
+        assert value == "from-working-dir"
+        assert cwd == str(tmp_path)
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_job_visibility_across_processes(tmp_path):
     """CLI use case: submit in one process, query from another."""
     import subprocess
