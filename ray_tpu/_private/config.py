@@ -10,6 +10,7 @@ and the resolved table is pickled into worker bootstrap messages.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 import json
 import os
 from typing import Any
@@ -51,6 +52,16 @@ class Config:
     # C++ arena store (ray_tpu/_native/plasma_store.cc); falls back to the
     # Python per-segment store when the native build is unavailable.
     use_native_plasma: bool = True
+    # spill target when the store is full (reference: object spilling,
+    # local_object_manager.h:43); None -> /tmp
+    spill_directory: Optional[str] = None
+    # --- OOM protection (reference: memory_monitor.h:52) ---
+    memory_monitor_enabled: bool = True
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 1.0
+    # KV persistence across controller restarts (GCS Redis-FT analog,
+    # redis_store_client.h:111); None disables
+    gcs_snapshot_path: Optional[str] = None
     object_store_full_delay_ms: int = 100
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_bytes: int = 8 * 1024**2

@@ -111,6 +111,7 @@ class PendingTask:
         self.retries_left = spec.max_retries
         self.worker: Optional[WorkerHandle] = None
         self.cancelled = False
+        self.dispatch_t: float = 0.0  # set when handed to a worker
 
 
 class ActorState:
@@ -198,14 +199,61 @@ class Controller:
 
         # Internal KV (GCS KV analog).
         self.kv: dict[tuple[str, bytes], bytes] = {}
+        # GCS fault-tolerance analog (reference: RedisStoreClient +
+        # gcs_init_data reload): KV table persisted to disk when configured
+        self._kv_snapshot_path = config.gcs_snapshot_path
+        self._kv_dirty = threading.Event()
+        self._kv_flusher: Optional[threading.Thread] = None
+        # serializes snapshot+rename: without it an in-flight background
+        # write (stale snapshot) can land AFTER the shutdown flush
+        self._kv_write_lock = threading.Lock()
+        if self._kv_snapshot_path and os.path.exists(self._kv_snapshot_path):
+            try:
+                import pickle as _pickle
+
+                with open(self._kv_snapshot_path, "rb") as f:
+                    self.kv.update(_pickle.load(f))
+                logger.info(
+                    "restored %d KV entries from %s",
+                    len(self.kv), self._kv_snapshot_path,
+                )
+            except Exception:
+                logger.warning("KV snapshot restore failed", exc_info=True)
 
         # Observability: task events ring buffer.
         self.task_events: deque[dict] = deque(maxlen=config.event_buffer_size)
+        # spilling: plasma-resident objects in seal order (LRU-ish) + the
+        # on-disk spill directory (reference: external_storage.py
+        # FileSystemStorage at :271)
+        from collections import OrderedDict as _OD
+
+        self.plasma_resident: "_OD[ObjectID, tuple[str, int]]" = _OD()
+        self._spill_lock = threading.Lock()
+        # spilled objects' plasma blocks are reclaimed after a grace period
+        # (in-flight readers may hold the already-sent shm location)
+        self._spill_trash: deque[tuple[float, ObjectID]] = deque()
+        self._spill_grace_s = 2.0
+        self.spill_dir = os.path.join(
+            config.spill_directory or "/tmp",
+            f"ray_tpu_spill_{os.getpid()}",
+        )
         # resource-shape -> last-seen timestamp of unfulfilled demand
         self.pending_demand: dict[tuple, float] = {}
 
         self.serialization = SerializationContext()
         self._reply_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="ctrl-reply")
+
+        # OOM protection (reference: memory_monitor.h + worker_killing_policy)
+        self.memory_monitor = None
+        if config.memory_monitor_enabled and mode == "process":
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor(
+                self,
+                threshold=config.memory_usage_threshold,
+                poll_interval_s=config.memory_monitor_interval_s,
+            )
+            self.memory_monitor.start()
 
         # Control-plane listener for worker processes.
         self.address = None
@@ -223,6 +271,92 @@ class Controller:
         t = threading.Thread(target=self._schedule_loop, daemon=True, name="ctrl-sched")
         t.start()
         self._threads.append(t)
+
+    def _persist_kv(self):
+        """Mark the KV table dirty; a background flusher writes the snapshot
+        (inline per-put writes would be O(table) on every connection thread
+        and racy on the shared tmp path)."""
+        if not self._kv_snapshot_path:
+            return
+        self._kv_dirty.set()
+        if self._kv_flusher is None:
+            self._kv_flusher = threading.Thread(
+                target=self._kv_flush_loop, daemon=True, name="kv-flusher"
+            )
+            self._kv_flusher.start()
+
+    def _kv_flush_loop(self):
+        import pickle as _pickle
+
+        while not self.shutting_down:
+            self._kv_dirty.wait(timeout=1.0)
+            if not self._kv_dirty.is_set():
+                continue
+            self._kv_dirty.clear()
+            try:
+                with self._kv_write_lock:
+                    with self.lock:
+                        snapshot = dict(self.kv)
+                    tmp = (
+                        self._kv_snapshot_path
+                        + f".tmp{os.getpid()}-{threading.get_ident()}"
+                    )
+                    with open(tmp, "wb") as f:
+                        _pickle.dump(snapshot, f)
+                    os.replace(tmp, self._kv_snapshot_path)
+            except Exception:
+                logger.warning("KV snapshot write failed", exc_info=True)
+            time.sleep(0.2)  # batch bursts of puts
+
+    def flush_kv_now(self):
+        """Synchronous flush (used at shutdown so the last writes persist)."""
+        if not self._kv_snapshot_path:
+            return
+        import pickle as _pickle
+
+        try:
+            with self._kv_write_lock:
+                with self.lock:
+                    snapshot = dict(self.kv)
+                tmp = self._kv_snapshot_path + f".final{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    _pickle.dump(snapshot, f)
+                os.replace(tmp, self._kv_snapshot_path)
+                self._kv_dirty.clear()
+        except Exception:
+            logger.warning("final KV snapshot failed", exc_info=True)
+
+    # -------------------------------------------------------- memory monitor
+
+    def kill_one_task_for_memory(self, usage: float) -> bool:
+        """Kill the worker running the most recently dispatched RETRIABLE
+        normal task (reference: retriable-FIFO worker killing policy,
+        ``worker_killing_policy.h:39``). Returns True if a victim was killed."""
+        with self.lock:
+            candidates = []  # (dispatch_time, worker, task)
+            for w in self.workers.values():
+                if w.dead or w.proc is None:
+                    continue
+                for pt in w.running.values():
+                    if (
+                        pt.spec.task_type == TaskType.NORMAL_TASK
+                        and pt.retries_left > 0
+                    ):
+                        candidates.append((pt.dispatch_t, w, pt))
+            if not candidates:
+                return False
+            # newest dispatch = cheapest work to redo
+            _, victim, pt = max(candidates, key=lambda c: c[0])
+        logger.warning(
+            "memory usage %.2f >= threshold: killing worker %s (task %s, "
+            "%d retries left)",
+            usage, victim.worker_id.hex()[:8], pt.spec.name, pt.retries_left,
+        )
+        try:
+            victim.proc.kill()
+        except OSError:
+            return False
+        return True
 
     # ------------------------------------------------------------------ nodes
 
@@ -253,16 +387,105 @@ class Controller:
             self.memory_store.put(object_id, ("error" if is_error else "inline", sobj))
         else:
             data = sobj.to_bytes()
-            seg, name = self.plasma.create(object_id, len(data))
+            seg, name = self._plasma_create_with_spill(object_id, len(data))
             seg.buf[: len(data)] = data
-            self.plasma.seal(object_id, name, len(data))
-            self.memory_store.put(object_id, ("plasma", (name, len(data))))
+            self._seal_plasma(object_id, name, len(data))
         self._on_object_sealed(object_id)
+
+    # ------------------------------------------------------------- spilling
+
+    def _create_with_spill_retry(self, create_fn, object_id: ObjectID, size: int):
+        """Run a plasma create, spilling cold resident objects on
+        ObjectStoreFullError (reference: LocalObjectManager::SpillObjects +
+        the store-full delay/retry loop, object_store_full_delay_ms).
+
+        The retry matters beyond spilling: under concurrent producers the
+        arena can be full of CREATED-but-not-yet-SEALED allocations (their
+        seal messages are in flight) — nothing is spillable *yet*, but will
+        be milliseconds later."""
+        from ray_tpu.exceptions import ObjectStoreFullError
+
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                return create_fn(object_id, size)
+            except ObjectStoreFullError:
+                if self._spill_objects(size):
+                    continue
+                if time.time() > deadline:
+                    raise
+                time.sleep(self.config.object_store_full_delay_ms / 1000.0)
+
+    def _plasma_create_with_spill(self, object_id: ObjectID, size: int):
+        return self._create_with_spill_retry(self.plasma.create, object_id, size)
+
+    def _seal_plasma(self, object_id: ObjectID, name: str, size: int):
+        self.plasma.seal(object_id, name, size)
+        self.memory_store.put(object_id, ("plasma", (name, size)))
+        with self.lock:
+            self.plasma_resident[object_id] = (name, size)
+            self.plasma_resident.move_to_end(object_id)
+
+    def _spill_objects(self, need_bytes: int) -> bool:
+        """Move the coldest plasma-resident objects to disk files until
+        ``need_bytes`` is freed; their store entries become ('spilled', ...).
+
+        Serialized by ``_spill_lock``: concurrent allocation RPCs must not
+        spill the same object (one would delete the arena block while the
+        other is still reading it — torn spill files)."""
+        os.makedirs(self.spill_dir, exist_ok=True)
+        freed = 0
+        with self._spill_lock:
+            # reclaim matured trash first: blocks of previously-spilled
+            # objects whose in-flight-reader grace has passed
+            now = time.time()
+            while self._spill_trash and now - self._spill_trash[0][0] >= self._spill_grace_s:
+                _, old_oid = self._spill_trash.popleft()
+                self.plasma.delete(old_oid)
+                freed += 1  # freed space is reflected by the store itself
+            with self.lock:
+                candidates = list(self.plasma_resident.items())
+            spilled_bytes = 0
+            for oid, (name, size) in candidates:
+                if spilled_bytes >= need_bytes:
+                    break
+                with self.lock:
+                    if oid not in self.plasma_resident:
+                        continue  # freed/spilled meanwhile
+                try:
+                    sobj = self.plasma_client.read(name, size)
+                    path = os.path.join(self.spill_dir, f"{oid.hex()}.bin")
+                    with open(path, "wb") as f:
+                        f.write(sobj.to_bytes())
+                except Exception:
+                    logger.warning("spill failed for %s", oid.hex(), exc_info=True)
+                    continue
+                # commit atomically vs _free_object: the object must still be
+                # tracked, or the put would resurrect a freed object
+                with self.lock:
+                    if oid not in self.plasma_resident:
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+                        continue
+                    self.plasma_resident.pop(oid, None)
+                    self.memory_store.put(oid, ("spilled", (path, size)))
+                    # plasma block reclaimed AFTER the reader grace period —
+                    # workers may already hold the old plasma location
+                    self._spill_trash.append((time.time(), oid))
+                spilled_bytes += size
+                logger.info("spilled %s (%d bytes) to %s", oid.hex(), size, path)
+        return freed > 0 or spilled_bytes >= need_bytes
 
     def resolve_object(self, entry) -> SerializedObject:
         kind, payload = entry
         if kind in ("inline", "error"):
             return payload
+        if kind == "spilled":
+            path, size = payload
+            with open(path, "rb") as f:
+                return SerializedObject.from_buffer(f.read())
         shm_name, size = payload
         return self.plasma_client.read(shm_name, size)
 
@@ -303,8 +526,16 @@ class Controller:
                 self._free_object(object_id)
 
     def _free_object(self, object_id: ObjectID):
+        entry = self.memory_store.get([object_id], timeout=0)[0]
         self.memory_store.delete([object_id])
         self.plasma.delete(object_id)
+        with self.lock:
+            self.plasma_resident.pop(object_id, None)
+        if entry is not None and entry[0] == "spilled":
+            try:
+                os.unlink(entry[1][0])
+            except OSError:
+                pass
 
     # ------------------------------------------------------------- submission
 
@@ -679,7 +910,7 @@ class Controller:
             if kind in ("inline", "error"):
                 results.append((oid, kind, payload.to_bytes()))
             else:
-                results.append((oid, "plasma", payload))
+                results.append((oid, kind, payload))  # plasma | spilled
         try:
             handle.send(P.GetReply(msg.req_id, results))
         except (OSError, EOFError):
@@ -690,8 +921,7 @@ class Controller:
             self.memory_store.put(msg.object_id, ("inline", SerializedObject.from_buffer(msg.payload)))
         else:
             shm_name, size = msg.payload
-            self.plasma.seal(msg.object_id, shm_name, size)
-            self.memory_store.put(msg.object_id, ("plasma", (shm_name, size)))
+            self._seal_plasma(msg.object_id, shm_name, size)
         self._on_object_sealed(msg.object_id)
         try:
             handle.send(P.PutAck(msg.req_id))
@@ -732,9 +962,12 @@ class Controller:
             return (actor_id, actor.creation_spec.max_concurrency)
         if op == "shm_create":
             # native-arena allocation for a worker (the plasma-create RPC;
-            # reference: plasma client protocol CreateRequest)
+            # reference: plasma client protocol CreateRequest), spilling
+            # cold objects to disk when the arena is full
             object_id, size = payload
-            return self.plasma.create_remote(object_id, size)
+            return self._create_with_spill_retry(
+                self.plasma.create_remote, object_id, size
+            )
         if op == "kill_actor":
             actor_id, no_restart = payload
             self.kill_actor(actor_id, no_restart)
@@ -770,13 +1003,17 @@ class Controller:
         if op == "kv_put":
             ns, key, value = payload
             self.kv[(ns, key)] = value
+            self._persist_kv()
             return None
         if op == "kv_get":
             ns, key = payload
             return self.kv.get((ns, key))
         if op == "kv_del":
             ns, key = payload
-            return self.kv.pop((ns, key), None) is not None
+            existed = self.kv.pop((ns, key), None) is not None
+            if existed:
+                self._persist_kv()
+            return existed
         if op == "kv_keys":
             ns, prefix = payload
             return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
@@ -938,13 +1175,14 @@ class Controller:
                 if kind in ("inline", "error"):
                     resolved_args.append((kind, payload.to_bytes()))
                 else:
-                    resolved_args.append(("plasma", payload))
+                    resolved_args.append((kind, payload))  # plasma | spilled
             else:
                 resolved_args.append(a)
         pt.worker = worker
+        pt.dispatch_t = time.time()
         worker.running[spec.task_id] = pt
         self.task_events.append(
-            {"task_id": spec.task_id.hex(), "name": spec.name, "event": "DISPATCHED", "t": time.time()}
+            {"task_id": spec.task_id.hex(), "name": spec.name, "event": "DISPATCHED", "t": pt.dispatch_t}
         )
         try:
             worker.send(P.ExecuteTask(spec, resolved_args))
@@ -961,8 +1199,7 @@ class Controller:
         for oid, kind, payload in msg.results:
             if kind == "plasma":
                 shm_name, size = payload
-                self.plasma.seal(oid, shm_name, size)
-                self.memory_store.put(oid, ("plasma", (shm_name, size)))
+                self._seal_plasma(oid, shm_name, size)
             else:
                 if kind == "error":
                     failed = True
@@ -1325,6 +1562,9 @@ class Controller:
             self.shutting_down = True
             workers = list(self.workers.values())
             self.sched_cv.notify_all()
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
+        self.flush_kv_now()
         for w in workers:
             try:
                 if w.conn is not None:

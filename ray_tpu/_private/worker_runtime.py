@@ -206,6 +206,10 @@ class WorkerRuntime:
     def _materialize(self, kind, payload) -> SerializedObject:
         if kind in ("inline", "error"):
             return SerializedObject.from_buffer(payload)
+        if kind == "spilled":
+            path, size = payload
+            with open(path, "rb") as f:
+                return SerializedObject.from_buffer(f.read())
         shm_name, size = payload
         return self._plasma().read(shm_name, size)
 

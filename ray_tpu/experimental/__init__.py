@@ -1,0 +1,3 @@
+from ray_tpu.experimental import internal_kv
+
+__all__ = ["internal_kv"]

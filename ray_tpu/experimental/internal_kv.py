@@ -1,0 +1,38 @@
+"""Internal KV store (reference: ``python/ray/experimental/internal_kv.py``,
+backed there by the GCS internal KV table). Persistence: when
+``Config.gcs_snapshot_path`` is set, the controller checkpoints the KV table
+to disk and reloads it on the next ``init`` — the GCS-restart/Redis
+fault-tolerance analog (``gcs_table_storage.h:213``, ``gcs_init_data.h``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _call(op: str, payload=None):
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller_call(op, payload)
+
+
+def _internal_kv_put(key: str, value: bytes, namespace: str = "default") -> None:
+    _call("kv_put", (namespace, key, value))
+
+
+def _internal_kv_get(key: str, namespace: str = "default") -> Optional[bytes]:
+    return _call("kv_get", (namespace, key))
+
+
+def _internal_kv_del(key: str, namespace: str = "default") -> bool:
+    return _call("kv_del", (namespace, key))
+
+
+def _internal_kv_list(prefix: str = "", namespace: str = "default") -> list[str]:
+    return _call("kv_keys", (namespace, prefix))
+
+
+# unprefixed aliases
+kv_put = _internal_kv_put
+kv_get = _internal_kv_get
+kv_del = _internal_kv_del
+kv_list = _internal_kv_list
