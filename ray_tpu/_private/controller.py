@@ -231,8 +231,8 @@ class Controller:
         self._spill_lock = threading.Lock()
         # spilled objects' plasma blocks are reclaimed after a grace period
         # (in-flight readers may hold the already-sent shm location)
-        self._spill_trash: deque[tuple[float, ObjectID]] = deque()
-        self._spill_grace_s = 2.0
+        self._spill_trash: deque[tuple[float, ObjectID, int]] = deque()
+        self._spill_grace_s = 1.0
         self.spill_dir = os.path.join(
             config.spill_directory or "/tmp",
             f"ray_tpu_spill_{os.getpid()}",
@@ -279,11 +279,12 @@ class Controller:
         if not self._kv_snapshot_path:
             return
         self._kv_dirty.set()
-        if self._kv_flusher is None:
-            self._kv_flusher = threading.Thread(
-                target=self._kv_flush_loop, daemon=True, name="kv-flusher"
-            )
-            self._kv_flusher.start()
+        with self.lock:
+            if self._kv_flusher is None:
+                self._kv_flusher = threading.Thread(
+                    target=self._kv_flush_loop, daemon=True, name="kv-flusher"
+                )
+                self._kv_flusher.start()
 
     def _kv_flush_loop(self):
         import pickle as _pickle
@@ -434,20 +435,18 @@ class Controller:
         spill the same object (one would delete the arena block while the
         other is still reading it — torn spill files)."""
         os.makedirs(self.spill_dir, exist_ok=True)
-        freed = 0
         with self._spill_lock:
-            # reclaim matured trash first: blocks of previously-spilled
-            # objects whose in-flight-reader grace has passed
-            now = time.time()
-            while self._spill_trash and now - self._spill_trash[0][0] >= self._spill_grace_s:
-                _, old_oid = self._spill_trash.popleft()
-                self.plasma.delete(old_oid)
-                freed += 1  # freed space is reflected by the store itself
+            # 1) reclaim matured trash: blocks of previously-spilled objects
+            # whose in-flight-reader grace has passed
+            freed = self._reclaim_trash_locked()
+            if freed >= need_bytes:
+                return True
+            # 2) spill just enough cold residents to cover the remainder
             with self.lock:
                 candidates = list(self.plasma_resident.items())
             spilled_bytes = 0
             for oid, (name, size) in candidates:
-                if spilled_bytes >= need_bytes:
+                if freed + spilled_bytes >= need_bytes:
                     break
                 with self.lock:
                     if oid not in self.plasma_resident:
@@ -473,10 +472,32 @@ class Controller:
                     self.memory_store.put(oid, ("spilled", (path, size)))
                     # plasma block reclaimed AFTER the reader grace period —
                     # workers may already hold the old plasma location
-                    self._spill_trash.append((time.time(), oid))
+                    self._spill_trash.append((time.time(), oid, size))
                 spilled_bytes += size
                 logger.info("spilled %s (%d bytes) to %s", oid.hex(), size, path)
-        return freed > 0 or spilled_bytes >= need_bytes
+            if freed + spilled_bytes < need_bytes:
+                return freed > 0  # partial progress at best
+            # 3) the just-spilled blocks only free space after the grace;
+            # wait it out HERE (spilling is serialized anyway) so the caller's
+            # retry actually succeeds instead of mass-spilling more residents
+            if self._spill_trash:
+                mature_at = self._spill_trash[0][0] + self._spill_grace_s
+                delay = mature_at - time.time()
+                if delay > 0:
+                    time.sleep(delay)
+                self._reclaim_trash_locked()
+            return True
+
+    def _reclaim_trash_locked(self) -> int:
+        """Delete matured trash blocks; returns bytes freed. Caller holds
+        ``_spill_lock``."""
+        now = time.time()
+        freed = 0
+        while self._spill_trash and now - self._spill_trash[0][0] >= self._spill_grace_s:
+            _, old_oid, size = self._spill_trash.popleft()
+            self.plasma.delete(old_oid)
+            freed += size
+        return freed
 
     def resolve_object(self, entry) -> SerializedObject:
         kind, payload = entry
@@ -526,11 +547,15 @@ class Controller:
                 self._free_object(object_id)
 
     def _free_object(self, object_id: ObjectID):
-        entry = self.memory_store.get([object_id], timeout=0)[0]
-        self.memory_store.delete([object_id])
-        self.plasma.delete(object_id)
+        # atomic vs the spill commit (also under self.lock): the entry read
+        # and the resident removal must observe one consistent state, or a
+        # concurrent spill repoints the entry after we read 'plasma' and its
+        # file is never unlinked
         with self.lock:
+            entry = self.memory_store.get([object_id], timeout=0)[0]
+            self.memory_store.delete([object_id])
             self.plasma_resident.pop(object_id, None)
+        self.plasma.delete(object_id)
         if entry is not None and entry[0] == "spilled":
             try:
                 os.unlink(entry[1][0])
@@ -1002,21 +1027,27 @@ class Controller:
             return self.node_infos()
         if op == "kv_put":
             ns, key, value = payload
-            self.kv[(ns, key)] = value
+            with self.lock:
+                self.kv[(ns, key)] = value
             self._persist_kv()
             return None
         if op == "kv_get":
             ns, key = payload
-            return self.kv.get((ns, key))
+            with self.lock:
+                return self.kv.get((ns, key))
         if op == "kv_del":
             ns, key = payload
-            existed = self.kv.pop((ns, key), None) is not None
+            with self.lock:
+                existed = self.kv.pop((ns, key), None) is not None
             if existed:
                 self._persist_kv()
             return existed
         if op == "kv_keys":
             ns, prefix = payload
-            return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+            with self.lock:
+                return [
+                    k for (n, k) in self.kv if n == ns and k.startswith(prefix)
+                ]
         if op == "actor_state":
             actor = self.actors.get(payload)
             return actor.state if actor else None
@@ -1588,6 +1619,10 @@ class Controller:
             except OSError:
                 pass
         self.plasma.shutdown()
+        # reclaim the session's spill files (objects die with the cluster)
+        import shutil as _shutil
+
+        _shutil.rmtree(self.spill_dir, ignore_errors=True)
         self.plasma_client.close()
         self._reply_pool.shutdown(wait=False)
 

@@ -120,8 +120,9 @@ class PlasmaStore:
         from multiprocessing import shared_memory
 
         with self._lock:
-            if self._used + size > self._capacity:
-                self._evict_locked(self._used + size - self._capacity)
+            # no store-level eviction: the controller's ref counting + disk
+            # spilling own object lifetime; evicting here would unlink
+            # segments the memory_store still points at (silent data loss)
             if self._used + size > self._capacity:
                 raise ObjectStoreFullError(
                     f"object of size {size} does not fit (capacity {self._capacity}, used {self._used})"

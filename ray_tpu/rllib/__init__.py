@@ -6,6 +6,7 @@ LearnerGroup / EnvRunnerGroup, with PPO as the first algorithm
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.core.learner import JaxLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
@@ -15,6 +16,9 @@ from ray_tpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "DQN",
+    "DQNConfig",
+    "ReplayBuffer",
     "EnvRunnerGroup",
     "JaxLearner",
     "LearnerGroup",

@@ -139,6 +139,11 @@ class JaxLearner:
         n = len(batch["obs"])
         if n == 0:
             return {}
+        # env runners attach extra transition keys (rewards/next_obs/...)
+        # for value-based learners; the PPO loss (and its mesh sharding
+        # spec) consumes exactly these five
+        keys = ("obs", "actions", "logp_old", "advantages", "value_targets")
+        batch = {k: batch[k] for k in keys if k in batch}
         minibatch_size = min(minibatch_size or n, n)
         rng = self._rng  # persistent: fresh permutations every iteration
         stats = {}

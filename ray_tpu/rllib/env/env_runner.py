@@ -85,6 +85,7 @@ class SingleAgentEnvRunner:
         episode metrics."""
         T, N = self.rollout_fragment_length, len(self.envs)
         obs_buf = np.zeros((T, N, self._obs[0].shape[0]), np.float32)
+        next_obs_buf = np.zeros((T, N, self._obs[0].shape[0]), np.float32)
         act_buf = np.zeros((T, N), np.int64)
         rew_buf = np.zeros((T, N), np.float32)
         term_buf = np.zeros((T, N), np.float32)  # true termination: boot 0
@@ -108,6 +109,9 @@ class SingleAgentEnvRunner:
             val_buf[t] = values
             for i, env in enumerate(self.envs):
                 o2, r, term, trunc, _ = env.step(int(actions[i]))
+                # pre-reset successor: value-based learners (DQN) need the
+                # true transition even at episode boundaries
+                next_obs_buf[t, i] = np.asarray(o2, np.float32)
                 rew_buf[t, i] = r
                 self._ep_return[i] += r
                 self._ep_len[i] += 1
@@ -175,6 +179,10 @@ class SingleAgentEnvRunner:
                 "logp_old": logp_buf.reshape(-1),
                 "advantages": adv.reshape(-1),
                 "value_targets": value_targets.reshape(-1),
+                # raw transitions for value-based learners (DQN replay)
+                "rewards": rew_buf.reshape(-1),
+                "next_obs": next_obs_buf.reshape(T * N, -1),
+                "terminals": term_buf.reshape(-1),
             },
             "metrics": metrics,
         }

@@ -1,0 +1,93 @@
+"""ActorPool (reference: ``python/ray/util/actor_pool.py``)."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Iterable, Optional
+
+import ray_tpu
+
+
+class ActorPool:
+    """Round-robins work over a fixed set of actors, keeping each busy."""
+
+    def __init__(self, actors: list):
+        self._idle = deque(actors)
+        self._future_to_actor: dict = {}
+        self._pending: deque = deque()  # (fn, value) waiting for an actor
+        self._results: deque = deque()  # completed refs in submit order
+        self._inflight: list = []  # refs in submission order
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        """fn(actor, value) -> ObjectRef."""
+        if self._idle:
+            actor = self._idle.popleft()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = actor
+            self._inflight.append(ref)
+        else:
+            self._pending.append((fn, value))
+
+    def _reclaim(self, ref) -> None:
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is None:
+            return
+        if self._pending:
+            fn, value = self._pending.popleft()
+            new_ref = fn(actor, value)
+            self._future_to_actor[new_ref] = actor
+            self._inflight.append(new_ref)
+        else:
+            self._idle.append(actor)
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in SUBMISSION order. On timeout the ref stays in the
+        pool, so the call is retryable (matches the reference)."""
+        if not self._inflight:
+            raise StopIteration("no pending results")
+        from ray_tpu.exceptions import GetTimeoutError
+
+        ref = self._inflight[0]
+        try:
+            value = ray_tpu.get(ref, timeout=timeout)
+        except GetTimeoutError:
+            raise  # ref retained: the call is retryable
+        except Exception:
+            # task failed: consume the ref and return the actor to the pool
+            self._inflight.pop(0)
+            self._reclaim(ref)
+            raise
+        self._inflight.pop(0)
+        self._reclaim(ref)
+        return value
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next COMPLETED result, any order."""
+        if not self._inflight:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        ref = ready[0]
+        self._inflight.remove(ref)
+        value = ray_tpu.get(ref)
+        self._reclaim(ref)
+        return value
+
+    def has_next(self) -> bool:
+        return bool(self._inflight)
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending
+
+    def map(self, fn: Callable, values: Iterable) -> Iterable:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable) -> Iterable:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

@@ -1,0 +1,119 @@
+"""Distributed Queue (reference: ``python/ray/util/queue.py`` — a bounded
+queue hosted on an actor, shared across tasks/actors)."""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._q: deque = deque()
+
+    def put_nowait(self, item) -> bool:
+        if self.maxsize > 0 and len(self._q) >= self.maxsize:
+            return False
+        self._q.append(item)
+        return True
+
+    def put_nowait_batch(self, items: list) -> int:
+        n = 0
+        for it in items:
+            if not self.put_nowait(it):
+                break
+            n += 1
+        return n
+
+    def get_nowait(self):
+        if not self._q:
+            return (False, None)
+        return (True, self._q.popleft())
+
+    def get_nowait_batch(self, n: int) -> list:
+        out = []
+        while self._q and len(out) < n:
+            out.append(self._q.popleft())
+        return out
+
+    def qsize(self) -> int:
+        return len(self._q)
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0.01)
+        opts.setdefault("max_concurrency", 8)
+        cls = ray_tpu.remote(_QueueActor)
+        self.actor = cls.options(**opts).remote(maxsize)
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self.actor.put_nowait.remote(item)):
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full()
+            time.sleep(0.01)
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty()
+            time.sleep(0.01)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: list) -> None:
+        n = ray_tpu.get(self.actor.put_nowait_batch.remote(list(items)))
+        if n < len(items):
+            raise Full(f"only {n}/{len(items)} items fit")
+
+    def get_nowait_batch(self, n: int) -> list:
+        return ray_tpu.get(self.actor.get_nowait_batch.remote(n))
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def shutdown(self) -> None:
+        try:
+            ray_tpu.kill(self.actor)
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        return (_rebuild_queue, (self.actor,))
+
+
+def _rebuild_queue(actor):
+    q = Queue.__new__(Queue)
+    q.actor = actor
+    return q

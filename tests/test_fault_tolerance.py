@@ -48,6 +48,34 @@ def test_object_spilling_roundtrip(tmp_path):
         ray_tpu.shutdown()
 
 
+def test_object_spilling_python_store_fallback(tmp_path):
+    """Spilling must also work on the Python per-segment store (hosts
+    without the native toolchain) — and never silently evict live data."""
+    ray_tpu.init(
+        num_cpus=2,
+        mode="thread",
+        object_store_memory=20 * 1024 * 1024,
+        config={
+            "spill_directory": str(tmp_path),
+            "use_native_plasma": False,
+        },
+    )
+    try:
+        from ray_tpu._private.object_store import PlasmaStore
+        from ray_tpu._private.worker import global_worker
+
+        c = global_worker().controller
+        assert isinstance(c.plasma, PlasmaStore)
+        refs = [
+            ray_tpu.put(np.full((1024, 1024), i, np.float32)) for i in range(10)
+        ]
+        for i, ref in enumerate(refs):
+            out = ray_tpu.get(ref, timeout=60)
+            assert out[0, 0] == i
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_spill_files_cleaned_on_free(tmp_path):
     ray_tpu.init(
         num_cpus=2,
