@@ -1,0 +1,32 @@
+"""PBT sweep over PPO learning rates on CartPole.
+
+Run: JAX_PLATFORMS=cpu python examples/tune_ppo.py
+"""
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.rllib import PPO, PPOConfig
+from ray_tpu.train import RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+
+if __name__ == "__main__":
+    ray_tpu.init(mode="process")
+    base = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_envs_per_env_runner=8, rollout_fragment_length=128)
+        .training(minibatch_size=256, num_epochs=8, entropy_coeff=0.01,
+                  vf_clip_param=100.0)
+    )
+    results = Tuner(
+        PPO.as_trainable(base),
+        param_space={
+            "lr": tune.grid_search([3e-4, 1e-3, 3e-3]),
+            "stop_iters": 15,
+        },
+        tune_config=TuneConfig(metric="episode_return_mean", mode="max"),
+        run_config=RunConfig(name="ppo-sweep"),
+    ).fit()
+    best = results.get_best_result()
+    print("best lr:", best.config["lr"], "return:", best.metrics["episode_return_mean"])
+    ray_tpu.shutdown()

@@ -15,6 +15,7 @@ Public core API mirrors the reference's L9 surface
 """
 
 from ray_tpu._private.worker import (
+    cluster_address,
     init,
     shutdown,
     is_initialized,
@@ -51,6 +52,7 @@ from ray_tpu._private.state import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "cluster_address",
     "init",
     "shutdown",
     "is_initialized",

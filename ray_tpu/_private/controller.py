@@ -97,6 +97,9 @@ class WorkerHandle:
         # Environment fingerprint this worker was spawned with (TPU
         # visibility, runtime_env vars); only matching tasks may reuse it.
         self.fingerprint = (False, ())
+        self.is_driver = False  # client drivers are never scheduling targets
+        # refs this client driver holds — released if it detaches uncleanly
+        self.held_refs: set = set()
 
     def send(self, msg):
         with self.send_lock:
@@ -186,6 +189,8 @@ class Controller:
         self.workers: dict[WorkerID, WorkerHandle] = {}
         self.idle_workers: dict[NodeID, list[WorkerHandle]] = defaultdict(list)
         self.starting_workers = 0
+        # attached client drivers (ray:// analog) — full API, never scheduled
+        self.driver_conns: dict[WorkerID, WorkerHandle] = {}
 
         # Actors.
         self.actors: dict[ActorID, ActorState] = {}
@@ -267,10 +272,56 @@ class Controller:
             t = threading.Thread(target=self._accept_loop, daemon=True, name="ctrl-accept")
             t.start()
             self._threads.append(t)
+            # session file: lets other processes on this host attach as
+            # client drivers with init(address="auto") (reference: the
+            # /tmp/ray session dir + ray:// connection info)
+            self._write_session_file()
 
         t = threading.Thread(target=self._schedule_loop, daemon=True, name="ctrl-sched")
         t.start()
         self._threads.append(t)
+
+    @staticmethod
+    def _session_file_path() -> str:
+        # per-uid dir: the file holds the cluster authkey, which grants the
+        # full remote-code API — must not be readable by other users
+        return os.path.join(
+            os.environ.get("TMPDIR", "/tmp"),
+            f"ray_tpu-{os.getuid()}",
+            "session_latest.json",
+        )
+
+    def _write_session_file(self):
+        import json
+
+        path = self._session_file_path()
+        session_dir = os.path.dirname(path)
+        try:
+            os.makedirs(session_dir, mode=0o700, exist_ok=True)
+            os.chmod(session_dir, 0o700)
+            info = {
+                "address": self.address,
+                "authkey_hex": self._authkey.hex(),
+                "pid": os.getpid(),
+            }
+            tmp = os.path.join(session_dir, f".session.tmp{os.getpid()}")
+            fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
+                json.dump(info, f)
+            os.replace(tmp, path)
+        except OSError:
+            logger.warning("could not write session file", exc_info=True)
+
+    def _remove_session_file(self):
+        import json
+
+        path = self._session_file_path()
+        try:
+            with open(path) as f:
+                if json.load(f).get("pid") == os.getpid():
+                    os.unlink(path)
+        except (OSError, ValueError):
+            pass
 
     def _persist_kv(self):
         """Mark the KV table dirty; a background flusher writes the snapshot
@@ -882,6 +933,17 @@ class Controller:
         except (EOFError, OSError):
             conn.close()
             return
+        if isinstance(msg, P.RegisterDriver):
+            # client driver (ray:// analog): full API over the channel, but
+            # never a scheduling target
+            handle = WorkerHandle(msg.driver_id, self.head_node_id, conn=conn)
+            handle.is_driver = True
+            handle.registered.set()
+            with self.lock:
+                self.driver_conns[msg.driver_id] = handle
+            logger.info("client driver %s attached", msg.driver_id.hex()[:8])
+            self._worker_reader(handle)
+            return
         if not isinstance(msg, P.RegisterWorker):
             conn.close()
             return
@@ -914,6 +976,8 @@ class Controller:
             elif isinstance(msg, P.PutObject):
                 self._handle_put(handle, msg)
             elif isinstance(msg, P.Request):
+                if handle.is_driver and msg.op == "add_ref":
+                    handle.held_refs.update(msg.payload)
                 if msg.op in ("wait", "pg_ready", "get_entries"):
                     threading.Thread(
                         target=self._handle_request, args=(handle, msg), daemon=True
@@ -922,9 +986,24 @@ class Controller:
                     self._handle_request(handle, msg)
             elif isinstance(msg, P.FreeObjects):
                 for oid in msg.object_ids:
+                    handle.held_refs.discard(oid)
                     self.remove_ref(oid)
             elif isinstance(msg, P.WorkerError):
                 logger.error("worker %s error: %s", handle.worker_id.hex()[:8], msg.message)
+        if handle.is_driver:
+            with self.lock:
+                self.driver_conns.pop(handle.worker_id, None)
+            # release whatever the client still held (a crashed client's
+            # ObjectRef finalizers never ran) — else its objects pin the
+            # store for the cluster's lifetime
+            for oid in list(handle.held_refs):
+                try:
+                    self.remove_ref(oid)
+                except Exception:
+                    pass
+            handle.held_refs.clear()
+            logger.info("client driver %s detached", handle.worker_id.hex()[:8])
+            return
         self._on_worker_death(handle, reason="connection closed")
 
     def _handle_get(self, handle: WorkerHandle, msg: P.GetObjects):
@@ -1592,10 +1671,19 @@ class Controller:
                 return
             self.shutting_down = True
             workers = list(self.workers.values())
+            drivers = list(self.driver_conns.values())
             self.sched_cv.notify_all()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
         self.flush_kv_now()
+        self._remove_session_file()
+        # attached clients must not hang in _await_reply forever
+        for d in drivers:
+            try:
+                d.send(P.Shutdown())
+                d.conn.close()
+            except (OSError, EOFError):
+                pass
         for w in workers:
             try:
                 if w.conn is not None:

@@ -26,6 +26,16 @@ class RegisterWorker:
 
 
 @dataclasses.dataclass
+class RegisterDriver:
+    """A CLIENT driver attaching to a running cluster (``ray://`` analog,
+    reference: ``python/ray/util/client/``). Drivers get the full object/
+    task/actor API over the same channel but are never schedulable."""
+
+    driver_id: WorkerID
+    pid: int
+
+
+@dataclasses.dataclass
 class TaskDone:
     task_id: TaskID
     # list of (object_id, kind, payload): kind in {"inline", "plasma", "error"}

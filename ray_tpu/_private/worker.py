@@ -394,6 +394,7 @@ def is_initialized() -> bool:
 
 def init(
     *,
+    address: Optional[str] = None,
     num_cpus: Optional[int] = None,
     num_tpus: Optional[int] = None,
     resources: Optional[dict[str, float]] = None,
@@ -418,6 +419,22 @@ def init(
             raise RayTpuError("ray_tpu.init() called twice")
         if os.environ.get("RAY_TPU_WORKER") == "1":
             raise RayTpuError("init() must not be called inside a worker")
+
+        if address is not None:
+            if any(
+                v is not None
+                for v in (num_cpus, num_tpus, resources, object_store_memory, config)
+            ):
+                raise RayTpuError(
+                    "resource/config arguments cannot be combined with "
+                    "address=...: the attached cluster's configuration is "
+                    "fixed by its head"
+                )
+            api = _connect_client(address)
+            _global_api = api
+            _install_ref_hooks(api)
+            atexit.register(shutdown)
+            return api
 
         cfg = Config.from_env(_system_config or config)
         if object_store_memory is not None:
@@ -448,6 +465,67 @@ def init(
         return api
 
 
+def _connect_client(address: str) -> "WorkerAPI":
+    """Attach to a running cluster as a CLIENT driver (``ray://`` analog,
+    reference: ``python/ray/util/client/``). ``address="auto"`` reads the
+    session file the head controller writes; otherwise pass
+    ``"<socket-path>?authkey=<hex>"``."""
+    import json
+
+    from multiprocessing.connection import Client as _ConnClient
+
+    from ray_tpu._private.worker_runtime import WorkerRuntime
+
+    if address == "auto":
+        from ray_tpu._private.controller import Controller
+
+        session_file = Controller._session_file_path()
+        try:
+            with open(session_file) as f:
+                info = json.load(f)
+        except OSError as e:
+            raise RayTpuError(
+                "init(address='auto'): no running cluster found (no session "
+                f"file at {session_file})"
+            ) from e
+        sock, authkey = info["address"], bytes.fromhex(info["authkey_hex"])
+    else:
+        sock, _, key_hex = address.partition("?authkey=")
+        if not key_hex:
+            raise RayTpuError(
+                "client address must be 'auto' or '<socket>?authkey=<hex>'"
+            )
+        authkey = bytes.fromhex(key_hex)
+    try:
+        conn = _ConnClient(sock, family="AF_UNIX", authkey=authkey)
+    except (FileNotFoundError, ConnectionRefusedError) as e:
+        raise RayTpuError(
+            f"no running cluster at {sock!r} (stale session file?): {e}"
+        ) from e
+    runtime = WorkerRuntime(WorkerID.from_random(), conn, in_process=False)
+    runtime.client_mode = True
+    # registration must hit the wire BEFORE any API request (the handshake
+    # closes connections whose first message isn't a Register*)
+    runtime.register_driver()
+    pump = threading.Thread(
+        target=runtime.run, daemon=True, name="client-driver-pump"
+    )
+    pump.start()
+    api = WorkerProcAPI(runtime)
+    api.is_client = True
+    return api
+
+
+def cluster_address() -> Optional[str]:
+    """Connect string for ``init(address=...)`` from another process on
+    this host (None in thread mode — no listener)."""
+    api = global_worker()
+    controller = getattr(api, "controller", None)
+    if controller is None or controller.address is None:
+        return None
+    return f"{controller.address}?authkey={controller._authkey.hex()}"
+
+
 def shutdown():
     global _global_api
     with _api_lock:
@@ -456,6 +534,15 @@ def shutdown():
             return
         _global_api = None
         ObjectRef._on_delete = None
+        if getattr(api, "is_client", False):
+            runtime = getattr(api, "runtime", None)
+            if runtime is not None:
+                runtime._shutdown = True
+                try:
+                    runtime.conn.close()
+                except OSError:
+                    pass
+            return
         controller = getattr(api, "controller", None)
         if controller is not None:
             controller.shutdown()

@@ -103,6 +103,9 @@ class WorkerRuntime:
         # The reader loop must never block on task execution (tasks make
         # controller calls — get/submit — whose replies arrive on the reader).
         self._task_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+        # client drivers attach to a foreign cluster: reply pump only, no
+        # task execution, and never os._exit on disconnect
+        self.client_mode = False
 
     # ------------------------------------------------------------- transport
 
@@ -110,8 +113,18 @@ class WorkerRuntime:
         with self._send_lock:
             self.conn.send(msg)
 
+    def register_driver(self):
+        """Synchronous client-driver registration: MUST be on the wire before
+        any API request, or the controller's handshake closes the conn."""
+        self._send(P.RegisterDriver(self.worker_id, os.getpid()))
+
     def run(self):
         # Register with the controller, then serve the task loop.
+        if self.client_mode:
+            # client driver: this loop only pumps replies; no tasks arrive
+            # (registration already sent synchronously by _connect_client)
+            self._client_loop()
+            return
         if self.in_process:
             # Thread mode: the driver's API is already the global one; share
             # its serialization context so ref tracking stays consistent.
@@ -130,14 +143,7 @@ class WorkerRuntime:
             if isinstance(msg, P.ExecuteTask):
                 self._route_task(msg)
             elif isinstance(msg, (P.GetReply, P.PutAck, P.Reply)):
-                with self._get_cv:
-                    if isinstance(msg, P.GetReply):
-                        self._get_replies[msg.req_id] = msg.results
-                    elif isinstance(msg, P.PutAck):
-                        self._get_replies[msg.req_id] = True
-                    else:
-                        self._get_replies[msg.req_id] = msg
-                    self._get_cv.notify_all()
+                self._handle_reply(msg)
             elif isinstance(msg, P.KillActor):
                 break
             elif isinstance(msg, P.Shutdown):
@@ -145,6 +151,31 @@ class WorkerRuntime:
         self._shutdown = True
         if not self.in_process:
             os._exit(0)
+
+    def _handle_reply(self, msg) -> None:
+        with self._get_cv:
+            if isinstance(msg, P.GetReply):
+                self._get_replies[msg.req_id] = msg.results
+            elif isinstance(msg, P.PutAck):
+                self._get_replies[msg.req_id] = True
+            else:
+                self._get_replies[msg.req_id] = msg
+            self._get_cv.notify_all()
+
+    def _client_loop(self):
+        """Reply pump for client-driver mode."""
+        while not self._shutdown:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                break
+            if isinstance(msg, (P.GetReply, P.PutAck, P.Reply)):
+                self._handle_reply(msg)
+            elif isinstance(msg, P.Shutdown):
+                break
+        self._shutdown = True
+        with self._get_cv:
+            self._get_cv.notify_all()
 
     def _route_task(self, msg: P.ExecuteTask):
         spec = msg.spec

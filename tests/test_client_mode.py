@@ -1,0 +1,119 @@
+"""Client-driver attach tests (ray:// analog).
+
+Coverage modeled on the reference's ``python/ray/util/client`` tests: a
+second process attaches to a running cluster and uses the full task/actor/
+object API.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+pytestmark = pytest.mark.timeout(300) if hasattr(pytest.mark, "timeout") else []
+
+
+def test_client_driver_attach(tmp_path):
+    ray_tpu.init(num_cpus=4, mode="process")
+    try:
+        addr = ray_tpu.cluster_address()
+        assert addr and "?authkey=" in addr
+
+        # head-side named actor the client will call
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self, k):
+                self.n += k
+                return self.n
+
+        counter = Counter.options(name="shared-counter").remote()
+        assert ray_tpu.get(counter.bump.remote(1), timeout=60) == 1
+
+        client_code = textwrap.dedent(
+            f"""
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import numpy as np
+            import ray_tpu
+
+            ray_tpu.init(address={addr!r})
+
+            @ray_tpu.remote
+            def square(x):
+                return x * x
+
+            assert ray_tpu.get(square.remote(7), timeout=120) == 49
+
+            # large object through the shared-memory plane
+            big = np.arange(500_000, dtype=np.float64)
+            ref = ray_tpu.put(big)
+
+            @ray_tpu.remote
+            def total(x):
+                return float(x.sum())
+
+            assert ray_tpu.get(total.remote(ref), timeout=120) == float(big.sum())
+
+            # named actor created by the HEAD driver, called from the client
+            c = ray_tpu.get_actor("shared-counter")
+            assert ray_tpu.get(c.bump.remote(10), timeout=60) == 11
+
+            # cluster state visible from the client
+            assert ray_tpu.cluster_resources().get("CPU", 0) == 4
+            ray_tpu.shutdown()
+            print("CLIENT-OK")
+            """
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", client_code],
+            capture_output=True,
+            text=True,
+            timeout=240,
+            env={
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "PYTHONPATH": "/root/repo",
+                "JAX_PLATFORMS": "cpu",
+                "HOME": "/root",
+            },
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "CLIENT-OK" in r.stdout
+
+        # the head still sees the client's state changes
+        assert ray_tpu.get(counter.bump.remote(0), timeout=60) == 11
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_client_auto_address(tmp_path):
+    ray_tpu.init(num_cpus=2, mode="process")
+    try:
+        code = (
+            "import os\nos.environ['JAX_PLATFORMS']='cpu'\n"
+            "import ray_tpu\nray_tpu.init(address='auto')\n"
+            "@ray_tpu.remote\ndef f(): return 5\n"
+            "assert ray_tpu.get(f.remote(), timeout=120) == 5\n"
+            "ray_tpu.shutdown()\nprint('AUTO-OK')\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=240,
+            env={
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "PYTHONPATH": "/root/repo",
+                "JAX_PLATFORMS": "cpu",
+                "HOME": "/root",
+            },
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "AUTO-OK" in r.stdout
+    finally:
+        ray_tpu.shutdown()
