@@ -43,6 +43,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # 'full' | 'ring' | 'ulysses' — ring/ulysses engage when the mesh has sp>1
     attention: str = "full"
+    # route rmsnorm through the fused Pallas kernel (ray_tpu.ops.rmsnorm).
+    # Opt-in: pallas_call has no partitioning rule, so under a sharded pjit
+    # program XLA would replicate around it — use on single-device/replicated
+    # paths (e.g. the serving engine) where it runs in one VMEM pass.
+    fused_rmsnorm: bool = False
     remat: bool = True
     tie_embeddings: bool = False
 
@@ -202,7 +207,12 @@ def init_params(key, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
     return params
 
 
-def _rmsnorm(x, w, eps):
+def _rmsnorm(x, w, eps, fused: bool = False):
+    if fused:
+        from ray_tpu.ops import rmsnorm as _fused_rmsnorm
+
+        # one VMEM pass; output dtype = x.dtype (model weights share cfg.dtype)
+        return _fused_rmsnorm(x, w, eps)
     x32 = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
     return (x32 * scale).astype(x.dtype) * w
@@ -265,7 +275,7 @@ def _layer(layer_params, x, positions, cfg: LlamaConfig, mesh: Optional[Mesh]):
     def c(y, *dims):
         return with_sharding(mesh, y, *dims) if mesh is not None else y
 
-    h = _rmsnorm(x, p["attn_norm"], cfg.rms_eps)
+    h = _rmsnorm(x, p["attn_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
     q = jnp.einsum("bte,ehd->bthd", h, p["wq"])
     k = jnp.einsum("bte,ehd->bthd", h, p["wk"])
     v = jnp.einsum("bte,ehd->bthd", h, p["wv"])
@@ -274,7 +284,7 @@ def _layer(layer_params, x, positions, cfg: LlamaConfig, mesh: Optional[Mesh]):
     attn = _attention(q, k, v, cfg, mesh)
     x = x + c(jnp.einsum("bthd,hde->bte", attn, p["wo"]), "batch", "seq", "embed")
 
-    h = _rmsnorm(x, p["mlp_norm"], cfg.rms_eps)
+    h = _rmsnorm(x, p["mlp_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
     gate = jnp.einsum("bte,ef->btf", h, p["w_gate"])
     up = jnp.einsum("bte,ef->btf", h, p["w_up"])
     ff = c(jax.nn.silu(gate) * up, "batch", "seq", "mlp")
@@ -312,7 +322,7 @@ def forward(
         return layer(p, y), None
 
     x, _ = jax.lax.scan(body, x, stacked)
-    x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
+    x = _rmsnorm(x, params["final_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
     unembed = (
         params["embed"].T if cfg.tie_embeddings else params["unembed"]
     )
@@ -392,7 +402,7 @@ def _decode_forward(
 
     def scan_body(x, inp):
         p, ck, cv = inp
-        h = _rmsnorm(x, p["attn_norm"], cfg.rms_eps)
+        h = _rmsnorm(x, p["attn_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
         q = jnp.einsum("bte,ehd->bthd", h, p["wq"])
         k = jnp.einsum("bte,ehd->bthd", h, p["wk"])
         v = jnp.einsum("bte,ehd->bthd", h, p["wv"])
@@ -411,7 +421,7 @@ def _decode_forward(
         attn = jnp.einsum("bhts,bshd->bthd", w, fv)
         x = x + jnp.einsum("bthd,hde->bte", attn, p["wo"])
 
-        h = _rmsnorm(x, p["mlp_norm"], cfg.rms_eps)
+        h = _rmsnorm(x, p["mlp_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
         ff = jax.nn.silu(jnp.einsum("bte,ef->btf", h, p["w_gate"])) * jnp.einsum(
             "bte,ef->btf", h, p["w_up"]
         )
@@ -421,7 +431,7 @@ def _decode_forward(
     x, (new_k, new_v) = jax.lax.scan(
         scan_body, x, (stacked, cache["k"], cache["v"])
     )
-    x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
+    x = _rmsnorm(x, params["final_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.einsum(
         "bte,ev->btv", x, unembed.astype(x.dtype),

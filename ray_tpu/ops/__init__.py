@@ -1,0 +1,12 @@
+"""ray_tpu.ops — hand-written Pallas TPU kernels for the hot ops.
+
+The compute path is JAX/XLA; these kernels cover what XLA's fusion doesn't
+own outright (single-pass normalization, quantized weight storage). Each op
+falls back to interpreter mode off-TPU so the same code path is exercised by
+the CPU test suite (`/opt/skills/guides/pallas_guide.md` conventions).
+"""
+
+from ray_tpu.ops.rmsnorm import rmsnorm
+from ray_tpu.ops.quant import dequantize_int8, quantize_int8
+
+__all__ = ["dequantize_int8", "quantize_int8", "rmsnorm"]
