@@ -62,6 +62,10 @@ class Config:
     # KV persistence across controller restarts (GCS Redis-FT analog,
     # redis_store_client.h:111); None disables
     gcs_snapshot_path: Optional[str] = None
+    # --- fault injection (reference: rpc_chaos.h:23, RAY_testing_rpc_failure)
+    # format: "op1=prob1,op2=prob2" — controller ops fail with given
+    # probability (tasks/retries exercise the recovery paths); empty = off
+    testing_rpc_failure: str = ""
     object_store_full_delay_ms: int = 100
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_bytes: int = 8 * 1024**2

@@ -209,6 +209,22 @@ class Controller:
         self._kv_snapshot_path = config.gcs_snapshot_path
         self._kv_dirty = threading.Event()
         self._kv_flusher: Optional[threading.Thread] = None
+        # chaos: parse "op=prob,op=prob" once (rpc_chaos analog). Malformed
+        # entries raise: a typo silently disabling fault injection would make
+        # chaos tests pass vacuously.
+        import random
+
+        self._rpc_chaos: dict[str, float] = {}
+        self._chaos_rng = random.Random(0)
+        for part in (config.testing_rpc_failure or "").split(","):
+            if not part.strip():
+                continue
+            op_name, sep, p = part.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"testing_rpc_failure entry {part!r} is not 'op=prob'"
+                )
+            self._rpc_chaos[op_name.strip()] = float(p)
         # serializes snapshot+rename: without it an in-flight background
         # write (stale snapshot) can land AFTER the shutdown flush
         self._kv_write_lock = threading.Lock()
@@ -1043,7 +1059,19 @@ class Controller:
         except (OSError, EOFError):
             pass
 
+    def _maybe_inject_rpc_failure(self, op: str):
+        """Config-driven chaos (reference: ``rpc/rpc_chaos.h:23`` — inject
+        request failures per method via RAY_testing_rpc_failure)."""
+        if not self._rpc_chaos:
+            return
+        prob = self._rpc_chaos.get(op)
+        if prob and self._chaos_rng.random() < prob:
+            raise WorkerCrashedError(
+                f"injected rpc failure for {op!r} (testing_rpc_failure)"
+            )
+
     def _dispatch_request(self, op: str, payload):
+        self._maybe_inject_rpc_failure(op)
         if op == "submit_task":
             spec, name = payload
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:

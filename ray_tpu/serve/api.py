@@ -39,7 +39,7 @@ def _get_controller_handle(create: bool = False):
             )
     cls = ray_tpu.remote(ServeControllerActor)
     _controller_handle = cls.options(
-        name=CONTROLLER_NAME, num_cpus=0.1, max_concurrency=16
+        name=CONTROLLER_NAME, num_cpus=0.1, max_concurrency=64
     ).remote()
     ray_tpu.get(_controller_handle.ping.remote(), timeout=60)
     return _controller_handle

@@ -40,6 +40,12 @@ class _DeploymentState:
         self.last_health_t = 0.0
         self.replica_started_t: dict[str, float] = {}
         self.replica_healthy_once: set[str] = set()
+        # long-poll versioning: RANDOMIZED start (reference long_poll uses
+        # random snapshot ids) so a restarted controller's counter can never
+        # coincide with a listener's stale version and silently block
+        import random as _random
+
+        self.version = _random.getrandbits(62)
         self.metric_window: list[tuple[float, float]] = []  # (ts, ongoing)
         self.status = "UPDATING"
 
@@ -49,6 +55,9 @@ class ServeControllerActor:
         self._deployments: dict[str, _DeploymentState] = {}
         self._apps: dict[str, dict] = {}  # app name -> {ingress, route_prefix}
         self._lock = threading.RLock()
+        # long-poll: handles block here until a replica set changes
+        # (reference: serve/_private/long_poll.py config push)
+        self._change_cv = threading.Condition(self._lock)
         # serializes whole reconcile passes: deploy_application's inline pass
         # must not interleave with the background loop (both would observe the
         # same replica deficit and start duplicates)
@@ -123,6 +132,39 @@ class ServeControllerActor:
             state = self._deployments.get(deployment_name)
             return list(state.replicas.keys()) if state else []
 
+    def get_replicas_versioned(self, deployment_name: str) -> tuple:
+        """(version, names) — pull path that composes with push ordering."""
+        with self._lock:
+            state = self._deployments.get(deployment_name)
+            if state is None:
+                return (-1, [])
+            return (state.version, list(state.replicas.keys()))
+
+    def _bump_version(self, state: "_DeploymentState"):
+        """Callers hold self._lock."""
+        state.version += 1
+        self._change_cv.notify_all()
+
+    def listen_for_replica_change(
+        self, deployment_name: str, known_version: int, timeout_s: float = 10.0
+    ) -> tuple:
+        """Long-poll (reference: ``_private/long_poll.py``): blocks until the
+        deployment's replica set differs from ``known_version`` (or timeout),
+        then returns (version, replica_names). Keep ``timeout_s`` modest —
+        each blocked listen occupies one controller concurrency slot."""
+        deadline = time.time() + timeout_s
+        with self._lock:
+            while True:
+                state = self._deployments.get(deployment_name)
+                if state is None:
+                    return (-1, [])
+                if state.version != known_version:
+                    return (state.version, list(state.replicas.keys()))
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return (state.version, list(state.replicas.keys()))
+                self._change_cv.wait(timeout=remaining)
+
     def get_app_route(self, app_name: str) -> Optional[dict]:
         with self._lock:
             return self._apps.get(app_name)
@@ -185,6 +227,8 @@ class ServeControllerActor:
                             del state.replicas[name]
                             state.replica_started_t.pop(name, None)
                             state.replica_healthy_once.discard(name)
+                        if victims:
+                            self._bump_version(state)
                     grace = state.spec.get("graceful_shutdown_timeout_s", 20.0)
                     for _, h in victims:
                         self._graceful_stop(h, grace)
@@ -236,6 +280,7 @@ class ServeControllerActor:
         with self._lock:
             state.replicas[replica_name] = h
             state.replica_started_t[replica_name] = time.time()
+            self._bump_version(state)
 
     def _health_check(self, state: _DeploymentState):
         now = time.time()
@@ -277,6 +322,7 @@ class ServeControllerActor:
                 state.replicas.pop(name, None)
                 state.replica_started_t.pop(name, None)
                 state.replica_healthy_once.discard(name)
+                self._bump_version(state)
             self._kill_replica(h)
 
     def _autoscale(self):

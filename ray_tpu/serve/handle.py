@@ -62,6 +62,80 @@ class DeploymentResponse:
         return (DeploymentResponse, (self._ref,))
 
 
+# -- shared long-poll listeners ----------------------------------------------
+# ONE listener thread per (process, deployment), shared by every handle
+# (reference: _private/long_poll.py LongPollClient). Each blocked listen
+# occupies a controller concurrency slot, so per-handle listeners would be a
+# scalability cliff; per-deployment listeners bound the count by the number
+# of distinct deployments a process talks to. Handles are tracked by weakref
+# so listeners never pin them; a listener exits when its handles are gone or
+# the controller stays unreachable, and restarts lazily on next use.
+
+_listeners: dict[str, "threading.Thread"] = {}
+_listener_handles: dict[str, list] = {}  # deployment -> [weakref to handles]
+_listeners_lock = threading.Lock()
+
+
+def _ensure_listener(handle: "DeploymentHandle"):
+    import weakref
+
+    name = handle.deployment_name
+    with _listeners_lock:
+        refs = _listener_handles.setdefault(name, [])
+        if not any(r() is handle for r in refs):
+            refs.append(weakref.ref(handle))
+        t = _listeners.get(name)
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(
+            target=_listen_loop, args=(name,), daemon=True,
+            name=f"serve-longpoll-{name}",
+        )
+        _listeners[name] = t
+        t.start()
+
+
+def _live_handles(name: str) -> list:
+    with _listeners_lock:
+        refs = _listener_handles.get(name, [])
+        live = [(r, r()) for r in refs]
+        _listener_handles[name] = [r for r, h in live if h is not None]
+        return [h for _, h in live if h is not None]
+
+
+def _listen_loop(name: str):
+    from ray_tpu.serve.api import _get_controller_handle
+
+    version = -2  # unknown: first listen returns current state immediately
+    failures = 0
+    while True:
+        handles = _live_handles(name)
+        if not handles:
+            return  # every handle for this deployment is gone
+        try:
+            controller = _get_controller_handle()
+            version, names = ray_tpu.get(
+                controller.listen_for_replica_change.remote(name, version, 10.0),
+                timeout=40,
+            )
+            failures = 0
+            if version == -1:
+                time.sleep(1.0)  # deployment gone (maybe redeploying)
+                continue
+            for h in handles:
+                h._apply_names(names, version)
+                with h._lock:
+                    h._last_refresh = time.monotonic()
+            # brief breather between listens: slots must recycle so control
+            # ops (deploy/ping) never starve behind a wall of listens
+            time.sleep(0.05)
+        except Exception:
+            failures += 1
+            if failures >= 30:
+                return  # serve/cluster is down; next handle use restarts us
+            time.sleep(1.0)
+
+
 class _MethodCaller:
     def __init__(self, handle: "DeploymentHandle", method: str):
         self._handle = handle
@@ -80,6 +154,7 @@ class DeploymentHandle:
         self._lock = threading.Lock()
         self._done_queue: "queue.Queue" = queue.Queue()
         self._drainer: Optional[threading.Thread] = None
+        self._applied_version = -(1 << 62)  # any real version exceeds this
 
     # -- replica cache ------------------------------------------------------
 
@@ -90,9 +165,16 @@ class DeploymentHandle:
         from ray_tpu.serve.api import _get_controller_handle
 
         controller = _get_controller_handle()
-        names = ray_tpu.get(
-            controller.get_replica_names.remote(self.deployment_name), timeout=30
+        version, names = ray_tpu.get(
+            controller.get_replicas_versioned.remote(self.deployment_name),
+            timeout=30,
         )
+        self._apply_names(names, version)
+        with self._lock:
+            self._last_refresh = now
+        _ensure_listener(self)
+
+    def _apply_names(self, names: list, version: int):
         replicas = []
         for n in names:
             try:
@@ -100,9 +182,14 @@ class DeploymentHandle:
             except Exception:
                 pass
         with self._lock:
+            # versions are monotonic per controller incarnation: a stale
+            # pull response must not overwrite a newer long-poll push
+            if version != -1 and version < self._applied_version:
+                return
+            if version != -1:
+                self._applied_version = version
             self._replicas = replicas
             self._inflight = {n: self._inflight.get(n, 0) for n, _ in replicas}
-            self._last_refresh = now
 
     # -- routing ------------------------------------------------------------
 

@@ -142,6 +142,30 @@ def test_task_retries_under_worker_kills():
         ray_tpu.shutdown()
 
 
+def test_rpc_chaos_injection():
+    """Config-driven RPC failures surface to callers (rpc_chaos analog)."""
+    ray_tpu.init(
+        num_cpus=2,
+        mode="thread",
+        config={"testing_rpc_failure": "kv_put=1.0"},
+    )
+    try:
+        from ray_tpu.experimental import internal_kv
+
+        with pytest.raises(Exception, match="injected rpc failure"):
+            internal_kv.kv_put("k", b"v")
+        # other ops unaffected
+        assert internal_kv.kv_get("k") is None
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.remote(), timeout=60) == 1
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_kv_persistence_across_restart(tmp_path):
     """KV survives controller restart (GCS Redis fault-tolerance analog)."""
     from ray_tpu.experimental import internal_kv
