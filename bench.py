@@ -34,6 +34,12 @@ def main():
             max_seq_len=2048,
             dtype=jnp.bfloat16,
             remat=True,
+            # tuned on-chip (see PARITY.md perf notes): splash attention
+            # (blockwise-causal Pallas kernel, 2.5x dense XLA fwd+bwd) and
+            # the plain CE path (at V=32k XLA overlaps the logit matmul
+            # better than the chunked scan; fused_ce wins at V>=128k)
+            attention="splash",
+            fused_ce=False,
         )
         batch, seq, steps, warmup = 8, 2048, 10, 3
         peak_flops = 197e12  # v5e bf16

@@ -19,6 +19,14 @@ class NativePlasmaError(RuntimeError):
     pass
 
 
+class NativeObjectExists(NativePlasmaError):
+    """Alloc hit a SEALED entry with the same id — put must be idempotent."""
+
+
+class NativeObjectPinned(NativePlasmaError):
+    """Delete refused: readers still hold pins on the entry."""
+
+
 _lib = None
 _lib_lock = threading.Lock()
 
@@ -70,9 +78,19 @@ def available() -> bool:
     return load_lib() is not None
 
 
-def _id20(object_id_bytes: bytes) -> bytes:
-    b = object_id_bytes[:20]
-    return b + b"\x00" * (20 - len(b))
+_ID_LEN = 32  # must match kIdLen in plasma_store.cc
+
+
+def _id32(object_id_bytes: bytes) -> bytes:
+    """Zero-pad the full 28-byte ObjectID (24-byte task id + 4-byte return
+    index, ids.py) to the native table's fixed width. Never truncate: the
+    return index is in the tail, and dropping it collides all returns of a
+    multi-return task onto one key."""
+    if len(object_id_bytes) > _ID_LEN:
+        raise NativePlasmaError(
+            f"object id too long for native table: {len(object_id_bytes)}"
+        )
+    return object_id_bytes + b"\x00" * (_ID_LEN - len(object_id_bytes))
 
 
 class NativeArena:
@@ -106,33 +124,35 @@ class NativeArena:
 
     def alloc(self, object_id: bytes, size: int) -> int:
         off = ctypes.c_uint64()
-        rc = self._lib.ps_alloc(self._h, _id20(object_id), size, ctypes.byref(off))
+        rc = self._lib.ps_alloc(self._h, _id32(object_id), size, ctypes.byref(off))
         if rc == -2:
-            raise NativePlasmaError("object already exists")
+            raise NativeObjectExists("object already sealed under this id")
         if rc != 0:
             raise NativePlasmaError("out of shared memory (after eviction)")
         return int(off.value)
 
     def seal(self, object_id: bytes) -> None:
-        self._lib.ps_seal(self._h, _id20(object_id))
+        self._lib.ps_seal(self._h, _id32(object_id))
 
     def lookup(self, object_id: bytes) -> Optional[tuple[int, int]]:
         off, size = ctypes.c_uint64(), ctypes.c_uint64()
         rc = self._lib.ps_lookup(
-            self._h, _id20(object_id), ctypes.byref(off), ctypes.byref(size)
+            self._h, _id32(object_id), ctypes.byref(off), ctypes.byref(size)
         )
         if rc != 0:
             return None
         return int(off.value), int(size.value)
 
     def pin(self, object_id: bytes) -> None:
-        self._lib.ps_pin(self._h, _id20(object_id))
+        self._lib.ps_pin(self._h, _id32(object_id))
 
     def unpin(self, object_id: bytes) -> None:
-        self._lib.ps_unpin(self._h, _id20(object_id))
+        self._lib.ps_unpin(self._h, _id32(object_id))
 
     def delete(self, object_id: bytes) -> None:
-        self._lib.ps_delete(self._h, _id20(object_id))
+        rc = self._lib.ps_delete(self._h, _id32(object_id))
+        if rc == -4:
+            raise NativeObjectPinned("delete refused: object still pinned")
 
     def used_bytes(self) -> int:
         return int(self._lib.ps_used(self._h))

@@ -33,9 +33,11 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52545055504C534DULL;  // "RTPUPLSM"
+constexpr uint64_t kMagic = 0x52545055504C5332ULL;  // "RTPUPLS2" (v2: 32-byte ids)
 constexpr uint32_t kSlots = 1 << 16;                // object table capacity
 constexpr uint64_t kAlign = 64;
+constexpr uint32_t kIdLen = 32;  // full 28-byte ObjectID (24-byte task id +
+                                 // 4-byte return index, ids.py) zero-padded
 
 enum EntryState : uint32_t {
   kEmpty = 0,
@@ -45,11 +47,11 @@ enum EntryState : uint32_t {
 };
 
 struct Entry {
-  uint8_t id[20];
+  uint8_t id[kIdLen];
   uint32_t state;
+  uint32_t pins;
   uint64_t offset;  // data offset within the arena (past block header)
   uint64_t size;    // payload size
-  uint32_t pins;
   uint64_t lru;
 };
 
@@ -97,7 +99,7 @@ Block* block_at(Store* s, uint64_t off) {
 
 uint64_t hash_id(const uint8_t* id) {
   uint64_t h = 1469598103934665603ULL;
-  for (int i = 0; i < 20; i++) {
+  for (uint32_t i = 0; i < kIdLen; i++) {
     h ^= id[i];
     h *= 1099511628211ULL;
   }
@@ -118,9 +120,23 @@ Entry* find_entry(Store* s, const uint8_t* id, bool for_insert) {
       if (for_insert && !first_tomb) first_tomb = e;
       continue;
     }
-    if (memcmp(e->id, id, 20) == 0) return e;
+    if (memcmp(e->id, id, kIdLen) == 0) return e;
   }
   return for_insert ? first_tomb : nullptr;
+}
+
+// After a slot turns into a tombstone, decay trailing tombstone runs back to
+// kEmpty when their probe-chain successor is empty — otherwise sustained
+// create/delete churn fills the table with tombstones and every miss becomes
+// a full-table scan under the store mutex.
+void decay_tombstones(Store* s, Entry* e) {
+  Header* h = s->hdr;
+  uint32_t slot = (uint32_t)(e - h->table);
+  if (h->table[(slot + 1) & (kSlots - 1)].state != kEmpty) return;
+  while (h->table[slot].state == kTombstone) {
+    h->table[slot].state = kEmpty;
+    slot = (slot - 1) & (kSlots - 1);
+  }
 }
 
 // -- free list ---------------------------------------------------------------
@@ -219,6 +235,7 @@ void evict_entry(Store* s, Entry* victim) {
   victim->state = kTombstone;
   s->hdr->num_objects--;
   free_block(s, block_off);
+  decay_tombstones(s, victim);
 }
 
 // allocate, evicting LRU sealed+unpinned objects as needed. ONE table scan
@@ -375,13 +392,37 @@ uint64_t ps_num_objects(int handle) {
 }
 
 // allocate an object; out_off receives the PAYLOAD offset from base.
-// returns 0 ok, -1 no space (after eviction), -2 already exists, -3 bad args
+// A stale kCreated entry for the same id (a create whose worker died before
+// sealing, or a task retry re-creating its return) is reclaimed in place,
+// atomically under the store mutex. A SEALED entry is never touched: the
+// caller gets -2 and must treat the put as idempotent (reference plasma
+// Create → ObjectExists semantics), not delete-and-replace.
+// returns 0 ok, -1 no space (after eviction), -2 already sealed, -3 bad args
 int ps_alloc(int handle, const uint8_t* id, uint64_t size, uint64_t* out_off) {
   Store* s = get_store(handle);
   if (!s || size == 0) return -3;
   Guard g(&s->hdr->lock);
   Entry* existing = find_entry(s, id, false);
-  if (existing) return -2;
+  if (existing) {
+    if (existing->state == kSealed) return -2;
+    // kCreated: reclaim the stale allocation, reuse the slot.
+    free_block(s, existing->offset - sizeof(Block));
+    s->hdr->num_objects--;
+    uint64_t block_off = alloc_with_eviction(s, size);
+    if (block_off == 0) {
+      existing->state = kTombstone;
+      decay_tombstones(s, existing);
+      return -1;
+    }
+    existing->state = kCreated;
+    existing->offset = block_off + sizeof(Block);
+    existing->size = size;
+    existing->pins = 0;
+    existing->lru = ++s->hdr->lru_clock;
+    s->hdr->num_objects++;
+    *out_off = existing->offset;
+    return 0;
+  }
   uint64_t block_off = alloc_with_eviction(s, size);
   if (block_off == 0) return -1;
   Entry* e = find_entry(s, id, true);
@@ -389,7 +430,7 @@ int ps_alloc(int handle, const uint8_t* id, uint64_t size, uint64_t* out_off) {
     free_block(s, block_off);
     return -1;
   }
-  memcpy(e->id, id, 20);
+  memcpy(e->id, id, kIdLen);
   e->state = kCreated;
   e->offset = block_off + sizeof(Block);
   e->size = size;
@@ -444,16 +485,19 @@ int ps_unpin(int handle, const uint8_t* id) {
   return 0;
 }
 
+// returns 0 ok, -1 missing, -4 refused (entry still pinned by readers)
 int ps_delete(int handle, const uint8_t* id) {
   Store* s = get_store(handle);
   if (!s) return -3;
   Guard g(&s->hdr->lock);
   Entry* e = find_entry(s, id, false);
   if (!e) return -1;
+  if (e->pins > 0) return -4;
   uint64_t block_off = e->offset - sizeof(Block);
   e->state = kTombstone;
   s->hdr->num_objects--;
   free_block(s, block_off);
+  decay_tombstones(s, e);
   return 0;
 }
 

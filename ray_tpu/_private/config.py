@@ -69,8 +69,16 @@ class Config:
     object_store_full_delay_ms: int = 100
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_bytes: int = 8 * 1024**2
+    # TCP control-plane listener (multi-host attach; the DCN control plane
+    # analog of the reference's gRPC server, src/ray/rpc/grpc_server.h).
+    # None = unix socket only; 0 = ephemeral port; >0 = fixed port.
+    tcp_port: Optional[int] = None
     # --- fault tolerance ---
     task_max_retries: int = 3
+    # Lineage kept for object reconstruction (reference: task_manager.h:177
+    # `max_lineage_bytes`): producer TaskSpecs of retriable tasks, evicted
+    # FIFO past this budget. 0 disables reconstruction.
+    max_lineage_bytes: int = 64 * 1024**2
     actor_max_restarts: int = 0
     health_check_period_ms: int = 1000
     health_check_failure_threshold: int = 5

@@ -26,7 +26,7 @@ import sys
 import threading
 import time
 import traceback
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 from concurrent.futures import ThreadPoolExecutor
 from multiprocessing.connection import Listener
 from typing import Any, Optional
@@ -46,6 +46,7 @@ from ray_tpu._private.serialization import SerializationContext, SerializedObjec
 from ray_tpu._private.task_spec import TaskSpec, TaskType
 from ray_tpu.exceptions import (
     ActorDiedError,
+    ObjectLostError,
     PlacementGroupSchedulingError,
     TaskError,
     WorkerCrashedError,
@@ -179,6 +180,19 @@ class Controller:
         self.head_node_id = NodeID.from_random()
         self.nodes[self.head_node_id] = NodeState(self.head_node_id, head_resources)
 
+        # Per-node object stores (the distributed data plane). Each node has
+        # its own arena; workers attach only their node's arena, and a read
+        # of an object resident on another node goes through the chunked
+        # pull protocol (reference: ObjectManager/PullManager chunked
+        # transfer, object_manager.h:119, pull_manager.h:49). The location
+        # directory is the sealed entry itself — its arena name identifies
+        # the owning node (OwnershipObjectDirectory merged into the
+        # controller the way GCS managers are).
+        self.node_stores: dict[NodeID, object] = {self.head_node_id: self.plasma}
+        self._stores_by_arena: dict[str, object] = {}
+        if hasattr(self.plasma, "arena_name"):
+            self._stores_by_arena[self.plasma.arena_name] = self.plasma
+
         # Scheduling state.
         self.ready_queue: deque[PendingTask] = deque()
         self.waiting_on_deps: dict[ObjectID, list[PendingTask]] = defaultdict(list)
@@ -201,6 +215,18 @@ class Controller:
 
         # Reference counting: driver-held handles + pins from pending tasks.
         self.ref_counts: dict[ObjectID, int] = defaultdict(int)
+
+        # Lineage for object reconstruction (reference:
+        # object_recovery_manager.h:43 + task_manager.h:168): return-id ->
+        # (producer TaskSpec, approx bytes). Deterministic return ids
+        # (ids.py ObjectID.for_return) make a resubmitted producer's results
+        # land under the SAME object ids, so blocked getters just wake up.
+        self.lineage: "OrderedDict[ObjectID, tuple[TaskSpec, int]]" = OrderedDict()
+        self.lineage_bytes = 0
+        self._recovering: set[TaskID] = set()
+        # in-flight chunked pushes from arena-less client drivers:
+        # object_id -> (buffer, {offset: length})
+        self._pending_pushes: dict[ObjectID, tuple[bytearray, dict]] = {}
 
         # Internal KV (GCS KV analog).
         self.kv: dict[tuple[str, bytes], bytes] = {}
@@ -251,8 +277,9 @@ class Controller:
         self.plasma_resident: "_OD[ObjectID, tuple[str, int]]" = _OD()
         self._spill_lock = threading.Lock()
         # spilled objects' plasma blocks are reclaimed after a grace period
-        # (in-flight readers may hold the already-sent shm location)
-        self._spill_trash: deque[tuple[float, ObjectID, int]] = deque()
+        # (in-flight readers may hold the already-sent shm location);
+        # entries: (spill_time, object_id, size, location_name)
+        self._spill_trash: deque[tuple[float, ObjectID, int, str]] = deque()
         self._spill_grace_s = 1.0
         self.spill_dir = os.path.join(
             config.spill_directory or "/tmp",
@@ -281,13 +308,36 @@ class Controller:
         self.listener = None
         self._authkey = os.urandom(16)
         self._threads: list[threading.Thread] = []
+        self.tcp_address = None
+        self._tcp_listener = None
         if mode == "process":
             addr_dir = os.environ.get("TMPDIR", "/tmp")
             self.address = os.path.join(addr_dir, f"ray_tpu_{os.getpid()}_{id(self):x}.sock")
             self.listener = Listener(self.address, family="AF_UNIX", authkey=self._authkey)
-            t = threading.Thread(target=self._accept_loop, daemon=True, name="ctrl-accept")
+            t = threading.Thread(
+                target=self._accept_loop, args=(self.listener,),
+                daemon=True, name="ctrl-accept",
+            )
             t.start()
             self._threads.append(t)
+            if config.tcp_port is not None:
+                # DCN control plane: same wire protocol + authkey over TCP so
+                # drivers/workers on other hosts can attach (reference: the
+                # gRPC server every GCS/raylet/worker runs, grpc_server.h)
+                self._tcp_listener = Listener(
+                    ("0.0.0.0", config.tcp_port),
+                    family="AF_INET",
+                    authkey=self._authkey,
+                )
+                host = P.routable_host()
+                port = self._tcp_listener.address[1]
+                self.tcp_address = f"{host}:{port}"
+                t2 = threading.Thread(
+                    target=self._accept_loop, args=(self._tcp_listener,),
+                    daemon=True, name="ctrl-accept-tcp",
+                )
+                t2.start()
+                self._threads.append(t2)
             # session file: lets other processes on this host attach as
             # client drivers with init(address="auto") (reference: the
             # /tmp/ray session dir + ray:// connection info)
@@ -317,6 +367,7 @@ class Controller:
             os.chmod(session_dir, 0o700)
             info = {
                 "address": self.address,
+                "tcp_address": self.tcp_address,
                 "authkey_hex": self._authkey.hex(),
                 "pid": os.getpid(),
             }
@@ -437,6 +488,37 @@ class Controller:
             self.sched_cv.notify_all()
             return node_id
 
+    def _store_for_node(self, node_id: NodeID):
+        """The node's object store; non-head nodes get their own arena
+        lazily (each node its own data plane — objects cross nodes only via
+        the pull protocol, never via a shared mapping)."""
+        with self.lock:
+            store = self.node_stores.get(node_id)
+            if store is not None:
+                return store
+            from ray_tpu._private.object_store import NativePlasmaStore
+
+            if not hasattr(self.plasma, "arena_name"):
+                # Python per-segment fallback: single shared store
+                self.node_stores[node_id] = self.plasma
+                return self.plasma
+            arena_name = f"/rtpu-{os.getpid()}-n{node_id.hex()[:8]}"
+            store = NativePlasmaStore(self.config.object_store_memory, arena_name)
+            self.node_stores[node_id] = store
+            self._stores_by_arena[arena_name] = store
+            return store
+
+    def _store_for_location(self, shm_name: str):
+        """Route a location string to the store that owns it."""
+        from ray_tpu._private.object_store import parse_arena_location
+
+        loc = parse_arena_location(shm_name)
+        if loc is not None:
+            store = self._stores_by_arena.get(loc[0])
+            if store is not None:
+                return store
+        return self.plasma
+
     def remove_node(self, node_id: NodeID):
         with self.lock:
             node = self.nodes.get(node_id)
@@ -444,25 +526,81 @@ class Controller:
                 return
             node.alive = False
             victims = [w for w in self.workers.values() if w.node_id == node_id]
+            # The node's data plane dies with it: every object resident in
+            # its arena is LOST (reference: node failure → plasma contents
+            # gone; recovery via lineage, object_recovery_manager.h:43).
+            store = self.node_stores.pop(node_id, None)
+            lost: list[ObjectID] = []
+            if store is not None and store is not self.plasma:
+                arena = getattr(store, "arena_name", None)
+                if arena is not None:
+                    self._stores_by_arena.pop(arena, None)
+                    prefix = f"@{arena}#"
+                    lost = [
+                        oid
+                        for oid, (name, _) in list(self.plasma_resident.items())
+                        if name.startswith(prefix)
+                    ]
+                    for oid in lost:
+                        self.plasma_resident.pop(oid, None)
+                        self.memory_store.delete([oid])
+                try:
+                    store.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
         for w in victims:
             self._on_worker_death(w, reason=f"node {node_id.hex()[:8]} removed")
+        if lost:
+            logger.warning(
+                "node %s removed: %d resident object(s) lost",
+                node_id.hex()[:8], len(lost),
+            )
+            # getters may already be BLOCKED on these ids: reconstruct what
+            # lineage covers, and fail the rest with ObjectLostError so no
+            # waiter hangs forever
+            self._maybe_recover(lost)
+            with self.lock:
+                unrecoverable = [
+                    oid
+                    for oid in lost
+                    if not self.memory_store.contains(oid)
+                    and TaskID(oid.binary()[: TaskID.SIZE]) not in self.pending_by_id
+                    and TaskID(oid.binary()[: TaskID.SIZE]) not in self._recovering
+                ]
+            for oid in unrecoverable:
+                err = self.serialization.serialize(
+                    ObjectLostError(
+                        f"object {oid.hex()} was on removed node "
+                        f"{node_id.hex()[:8]} and has no lineage"
+                    )
+                )
+                self.memory_store.put(oid, ("error", err))
+                self._on_object_sealed(oid)
 
     # ------------------------------------------------------------ object plane
 
     def put_serialized(self, object_id: ObjectID, sobj: SerializedObject, is_error=False):
         """Store a driver-side object (inline or plasma by size)."""
+        from ray_tpu._private.object_store import ObjectExistsError
+
         if sobj.total_bytes() <= self.config.max_inline_object_size or is_error:
             self.memory_store.put(object_id, ("error" if is_error else "inline", sobj))
         else:
             data = sobj.to_bytes()
-            seg, name = self._plasma_create_with_spill(object_id, len(data))
+            try:
+                seg, name = self._plasma_create_with_spill(object_id, len(data))
+            except ObjectExistsError:
+                # duplicate put (e.g. a retry whose first attempt sealed):
+                # idempotent — the sealed object stands
+                self._on_object_sealed(object_id)
+                return
             seg.buf[: len(data)] = data
             self._seal_plasma(object_id, name, len(data))
         self._on_object_sealed(object_id)
 
     # ------------------------------------------------------------- spilling
 
-    def _create_with_spill_retry(self, create_fn, object_id: ObjectID, size: int):
+    def _create_with_spill_retry(self, create_fn, object_id: ObjectID, size: int, store=None):
         """Run a plasma create, spilling cold resident objects on
         ObjectStoreFullError (reference: LocalObjectManager::SpillObjects +
         the store-full delay/retry loop, object_store_full_delay_ms).
@@ -478,7 +616,7 @@ class Controller:
             try:
                 return create_fn(object_id, size)
             except ObjectStoreFullError:
-                if self._spill_objects(size):
+                if self._spill_objects(size, store=store or self.plasma):
                     continue
                 if time.time() > deadline:
                     raise
@@ -488,13 +626,13 @@ class Controller:
         return self._create_with_spill_retry(self.plasma.create, object_id, size)
 
     def _seal_plasma(self, object_id: ObjectID, name: str, size: int):
-        self.plasma.seal(object_id, name, size)
+        self._store_for_location(name).seal(object_id, name, size)  # idempotent
         self.memory_store.put(object_id, ("plasma", (name, size)))
         with self.lock:
             self.plasma_resident[object_id] = (name, size)
             self.plasma_resident.move_to_end(object_id)
 
-    def _spill_objects(self, need_bytes: int) -> bool:
+    def _spill_objects(self, need_bytes: int, store=None) -> bool:
         """Move the coldest plasma-resident objects to disk files until
         ``need_bytes`` is freed; their store entries become ('spilled', ...).
 
@@ -508,9 +646,15 @@ class Controller:
             freed = self._reclaim_trash_locked()
             if freed >= need_bytes:
                 return True
-            # 2) spill just enough cold residents to cover the remainder
+            # 2) spill just enough cold residents to cover the remainder —
+            # only residents of the arena that is actually full
+            store = store or self.plasma
             with self.lock:
-                candidates = list(self.plasma_resident.items())
+                candidates = [
+                    (oid, v)
+                    for oid, v in self.plasma_resident.items()
+                    if self._store_for_location(v[0]) is store
+                ]
             spilled_bytes = 0
             for oid, (name, size) in candidates:
                 if freed + spilled_bytes >= need_bytes:
@@ -539,7 +683,9 @@ class Controller:
                     self.memory_store.put(oid, ("spilled", (path, size)))
                     # plasma block reclaimed AFTER the reader grace period —
                     # workers may already hold the old plasma location
-                    self._spill_trash.append((time.time(), oid, size))
+                    # (readers also validate-after-read, so the grace is a
+                    # courtesy, not the correctness mechanism)
+                    self._spill_trash.append((time.time(), oid, size, name))
                 spilled_bytes += size
                 logger.info("spilled %s (%d bytes) to %s", oid.hex(), size, path)
             if freed + spilled_bytes < need_bytes:
@@ -561,12 +707,14 @@ class Controller:
         now = time.time()
         freed = 0
         while self._spill_trash and now - self._spill_trash[0][0] >= self._spill_grace_s:
-            _, old_oid, size = self._spill_trash.popleft()
-            self.plasma.delete(old_oid)
+            _, old_oid, size, name = self._spill_trash.popleft()
+            self._store_for_location(name).delete(old_oid)
             freed += size
         return freed
 
-    def resolve_object(self, entry) -> SerializedObject:
+    def resolve_object(self, entry, object_id: ObjectID = None) -> SerializedObject:
+        from ray_tpu._private.object_store import ObjectRelocatedError
+
         kind, payload = entry
         if kind in ("inline", "error"):
             return payload
@@ -575,13 +723,24 @@ class Controller:
             with open(path, "rb") as f:
                 return SerializedObject.from_buffer(f.read())
         shm_name, size = payload
-        return self.plasma_client.read(shm_name, size)
+        try:
+            return self.plasma_client.read(shm_name, size)
+        except ObjectRelocatedError:
+            # read raced with spilling: re-resolve from the (updated) entry
+            if object_id is None:
+                raise
+            fresh = self.memory_store.get([object_id], timeout=5.0)[0]
+            if fresh is None:
+                raise
+            return self.resolve_object(fresh)
 
     def get_entries(self, object_ids: list[ObjectID], timeout=None):
+        self._maybe_recover(object_ids)
         return self.memory_store.get(object_ids, timeout=timeout)
 
     def _on_object_sealed(self, object_id: ObjectID):
         with self.lock:
+            self._recovering.discard(TaskID(object_id.binary()[: TaskID.SIZE]))
             waiters = self.waiting_on_deps.pop(object_id, [])
             for pt in waiters:
                 pt.unresolved.discard(object_id)
@@ -622,7 +781,10 @@ class Controller:
             entry = self.memory_store.get([object_id], timeout=0)[0]
             self.memory_store.delete([object_id])
             self.plasma_resident.pop(object_id, None)
-        self.plasma.delete(object_id)
+        if entry is not None and entry[0] == "plasma":
+            self._store_for_location(entry[1][0]).delete(object_id)
+        else:
+            self.plasma.delete(object_id)
         if entry is not None and entry[0] == "spilled":
             try:
                 os.unlink(entry[1][0])
@@ -634,6 +796,7 @@ class Controller:
     def submit_task(self, spec: TaskSpec):
         deps = {a[1] for a in spec.args if a[0] == "ref"}
         pt = PendingTask(spec, deps)
+        self._record_lineage(spec)
         with self.lock:
             self.pending_by_id[spec.task_id] = pt
             # Pin deps for the task's lifetime.
@@ -647,9 +810,69 @@ class Controller:
             if unresolved:
                 for d in unresolved:
                     self.waiting_on_deps[d].append(pt)
+                # a dep may be LOST (not merely pending) — kick recovery
+                self._maybe_recover(unresolved)
             else:
                 self._enqueue_ready(pt)
             self.sched_cv.notify_all()
+
+    # -------------------------------------------------- lineage reconstruction
+
+    def _record_lineage(self, spec: TaskSpec):
+        """Remember the producer spec of every retriable task's returns,
+        bounded by ``max_lineage_bytes`` FIFO (reference: task_manager.h:177).
+        """
+        if (
+            self.config.max_lineage_bytes <= 0
+            or spec.max_retries == 0
+            or spec.num_returns < 1
+            or spec.task_type == TaskType.ACTOR_CREATION_TASK
+        ):
+            return
+        cost = len(spec.function_blob or b"") + 256
+        for a in spec.args:
+            if a[0] == "value" and isinstance(a[1], (bytes, bytearray)):
+                cost += len(a[1])
+        per_return = max(cost // max(spec.num_returns, 1), 1)
+        with self.lock:
+            for oid in spec.return_ids():
+                if oid not in self.lineage:
+                    self.lineage_bytes += per_return
+                self.lineage[oid] = (spec, per_return)
+            while self.lineage_bytes > self.config.max_lineage_bytes and self.lineage:
+                _, (_, old_cost) = self.lineage.popitem(last=False)
+                self.lineage_bytes -= old_cost
+
+    def _maybe_recover(self, object_ids):
+        """Resubmit producers of LOST objects (reference:
+        ``object_recovery_manager.h:43``). An object is lost when no entry
+        exists AND no pending task will produce it. Recovery is recursive
+        through ``submit_task``: a resubmitted producer whose own args were
+        lost kicks their producers in turn (lineage chains)."""
+        to_resubmit = []
+        with self.lock:
+            for oid in object_ids:
+                if self.memory_store.contains(oid):
+                    continue
+                producer = TaskID(oid.binary()[: TaskID.SIZE])
+                if producer in self.pending_by_id or producer in self._recovering:
+                    continue  # already in flight
+                entry = self.lineage.get(oid)
+                if entry is None:
+                    continue  # not reconstructable (non-retriable or evicted)
+                spec = entry[0]
+                if spec.is_actor_task():
+                    actor = self.actors.get(spec.actor_id)
+                    if actor is None or actor.state == "DEAD":
+                        continue  # producer actor gone — unrecoverable
+                self._recovering.add(producer)
+                to_resubmit.append(spec)
+        for spec in to_resubmit:
+            logger.warning(
+                "lineage reconstruction: resubmitting task %s for lost object(s)",
+                spec.name,
+            )
+            self.submit_task(spec)
 
     def _enqueue_ready(self, pt: PendingTask):
         self.ready_queue.append(pt)
@@ -885,6 +1108,13 @@ class Controller:
         # for it (reference: accelerators/tpu.py TPU_VISIBLE_CHIPS).
         if not spec_hint.resources.get("TPU"):
             env.setdefault("JAX_PLATFORMS", "cpu")
+        # Data-plane visibility: the worker attaches ONLY its node's arena;
+        # objects on other nodes come through the chunked pull protocol.
+        node_store = self._store_for_node(node_id)
+        if hasattr(node_store, "arena_name"):
+            env["RAY_TPU_ARENA"] = node_store.arena_name
+        else:
+            env.pop("RAY_TPU_ARENA", None)
         env_overrides = spec_hint.runtime_env.get("env_vars", {}) if spec_hint.runtime_env else {}
         env.update({k: str(v) for k, v in env_overrides.items()})
         # runtime_env working_dir (reference: working_dir packaging; local
@@ -935,12 +1165,14 @@ class Controller:
 
     # ------------------------------------------------------- worker transport
 
-    def _accept_loop(self):
+    def _accept_loop(self, listener):
         while not self.shutting_down:
             try:
-                conn = self.listener.accept()
+                conn = listener.accept()
             except (OSError, EOFError):
-                return
+                return  # listener closed (shutdown)
+            except Exception:  # noqa: BLE001 — e.g. failed authkey handshake
+                continue  # keep serving other clients
             threading.Thread(target=self._handshake, args=(conn,), daemon=True).start()
 
     def _handshake(self, conn):
@@ -1023,6 +1255,7 @@ class Controller:
         self._on_worker_death(handle, reason="connection closed")
 
     def _handle_get(self, handle: WorkerHandle, msg: P.GetObjects):
+        self._maybe_recover(msg.object_ids)
         entries = self.memory_store.get(msg.object_ids, timeout=None)
         results = []
         for oid, entry in zip(msg.object_ids, entries):
@@ -1050,7 +1283,7 @@ class Controller:
 
     def _handle_request(self, handle: WorkerHandle, msg: P.Request):
         try:
-            payload = self._dispatch_request(msg.op, msg.payload)
+            payload = self._dispatch_request(msg.op, msg.payload, caller=handle)
             reply = P.Reply(msg.req_id, payload)
         except Exception as e:  # noqa: BLE001
             reply = P.Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
@@ -1070,7 +1303,7 @@ class Controller:
                 f"injected rpc failure for {op!r} (testing_rpc_failure)"
             )
 
-    def _dispatch_request(self, op: str, payload):
+    def _dispatch_request(self, op: str, payload, caller: "WorkerHandle" = None):
         self._maybe_inject_rpc_failure(op)
         if op == "submit_task":
             spec, name = payload
@@ -1095,11 +1328,108 @@ class Controller:
         if op == "shm_create":
             # native-arena allocation for a worker (the plasma-create RPC;
             # reference: plasma client protocol CreateRequest), spilling
-            # cold objects to disk when the arena is full
+            # cold objects to disk when the arena is full. The allocation
+            # lands in the CALLER's node's arena — each node owns its data
+            # plane.
+            from ray_tpu._private.object_store import ObjectExistsError
+
             object_id, size = payload
-            return self._create_with_spill_retry(
-                self.plasma.create_remote, object_id, size
+            store = (
+                self._store_for_node(caller.node_id)
+                if caller is not None and caller.node_id is not None
+                else self.plasma
             )
+            try:
+                return self._create_with_spill_retry(
+                    store.create_remote, object_id, size, store=store
+                )
+            except ObjectExistsError:
+                # duplicate put: tell the worker to skip the write — the
+                # sealed object stands (idempotent put semantics)
+                entry = store.lookup(object_id)
+                if entry is not None:
+                    return ("exists", entry[0], entry[1])
+                raise
+        if op == "push_object_chunk":
+            # inverse of pull: an arena-less client driver streams a put's
+            # bytes to the head, which seals them into its own store
+            # (reference: PushManager, push_manager.h:27). Chunks may be
+            # retried (chaos / transient failures) — writes are idempotent
+            # and completion counts only distinct offsets.
+            object_id, offset, total, data = payload
+            with self.lock:
+                buf, received = self._pending_pushes.setdefault(
+                    object_id, (bytearray(total), {})
+                )
+                buf[offset : offset + len(data)] = data
+                received[offset] = len(data)  # idempotent on chunk retry
+                done = sum(received.values()) >= total
+                if done:
+                    del self._pending_pushes[object_id]
+            if done:
+                self.put_serialized(
+                    object_id, SerializedObject.from_buffer(bytes(buf))
+                )
+            return None
+        if op == "testing_lose_object":
+            # Test hook: destroy an object's sole copy WITHOUT touching ref
+            # counts or lineage — simulates a crashed store/node (reference:
+            # the killer-actor + free() loss pattern in recovery tests).
+            object_id = payload
+            entry = self.memory_store.get([object_id], timeout=0)[0]
+            with self.lock:
+                self.memory_store.delete([object_id])
+                self.plasma_resident.pop(object_id, None)
+            if entry is not None and entry[0] == "plasma":
+                self._store_for_location(entry[1][0]).delete(object_id)
+            elif entry is not None and entry[0] == "spilled":
+                try:
+                    os.unlink(entry[1][0])
+                except OSError:
+                    pass
+            return entry is not None
+        if op == "pull_object_chunk":
+            # chunked node-to-node transfer (reference: ObjectManager::Push
+            # streaming chunks, object_buffer_pool.h): serve [offset,
+            # offset+length) of the object's payload bytes from wherever it
+            # currently lives (arena or spill file). The entry is re-read
+            # per chunk so a spill mid-pull transparently switches backend.
+            object_id, offset, length = payload
+            length = min(length, self.config.object_transfer_chunk_bytes)
+            self._maybe_recover([object_id])
+            entry = self.memory_store.get([object_id], timeout=30)[0]
+            if entry is None:
+                raise ObjectLostError(f"object {object_id.hex()} not found")
+            kind, p = entry
+            if kind == "spilled":
+                path, size = p
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return (size, f.read(length))
+            if kind == "plasma":
+                name, size = p
+                from ray_tpu._private.object_store import (
+                    ObjectRelocatedError,
+                    parse_arena_location,
+                )
+
+                loc = parse_arena_location(name)
+                if loc is None:
+                    # legacy per-segment store: read whole + slice
+                    sobj = self.plasma_client.read(name, size)
+                    return (size, sobj.to_bytes()[offset : offset + length])
+                store = self._store_for_location(name)
+                chunk = bytes(
+                    store.arena.view(loc[1] + offset, min(length, size - offset))
+                )
+                # validate-after-copy (same protocol as PlasmaClient.read)
+                got = store.arena.lookup(object_id.binary())
+                if got is None or got[0] != loc[1]:
+                    raise ObjectRelocatedError(name)
+                return (size, chunk)
+            # inline/error entries are small: serve from their bytes
+            data = p.to_bytes()
+            return (len(data), data[offset : offset + length])
         if op == "kill_actor":
             actor_id, no_restart = payload
             self.kill_actor(actor_id, no_restart)
@@ -1734,7 +2064,16 @@ class Controller:
                 os.unlink(self.address)
             except OSError:
                 pass
-        self.plasma.shutdown()
+        if self._tcp_listener is not None:
+            try:
+                self._tcp_listener.close()
+            except OSError:
+                pass
+        for store in {id(s): s for s in self.node_stores.values()}.values():
+            try:
+                store.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
         # reclaim the session's spill files (objects die with the cluster)
         import shutil as _shutil
 

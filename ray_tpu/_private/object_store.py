@@ -26,6 +26,19 @@ from ray_tpu._private.serialization import SerializedObject
 from ray_tpu.exceptions import ObjectStoreFullError
 
 
+class ObjectExistsError(RuntimeError):
+    """A sealed object with this id is already in the store; the put is a
+    duplicate (task retry after the first attempt sealed) and must be treated
+    as idempotent — never delete-and-replace a sealed object."""
+
+
+class ObjectRelocatedError(RuntimeError):
+    """An arena read raced with spilling/eviction: the entry no longer lives
+    at the offset in the reader's location string. The bytes read are
+    invalid; re-resolve the object through the controller (the entry now
+    points at the spill file or a new location)."""
+
+
 class MemoryStore:
     """Thread-safe in-process object map with blocking get."""
 
@@ -239,21 +252,24 @@ class NativePlasmaStore:
         self.arena_name = arena_name
         self._capacity = capacity_bytes
 
-    def _name_for(self, offset: int) -> str:
-        return f"@{self.arena_name}#{offset}"
+    def _name_for(self, object_id: ObjectID, offset: int) -> str:
+        # The object id rides in the location string so readers can validate
+        # after copying that the entry still lives at this offset (arena
+        # blocks are recycled in place after delete/spill — see
+        # PlasmaClient.read).
+        return f"@{self.arena_name}#{offset}#{object_id.hex()}"
 
     def _alloc(self, object_id: ObjectID, size: int) -> int:
-        from ray_tpu._native.plasma import NativePlasmaError
+        from ray_tpu._native.plasma import NativeObjectExists, NativePlasmaError
 
         try:
             return self.arena.alloc(object_id.binary(), max(size, 1))
+        except NativeObjectExists:
+            # A SEALED object with this id already exists (the native store
+            # reclaims stale unsealed entries itself). Duplicate put: the
+            # caller must reuse the existing entry, never clobber it.
+            raise ObjectExistsError(object_id.hex())
         except NativePlasmaError as e:
-            if "exists" in str(e):
-                # task retry re-creating its return object: the previous
-                # attempt's entry (worker died mid-write) is stale — replace
-                self.arena.unpin(object_id.binary())
-                self.arena.delete(object_id.binary())
-                return self.arena.alloc(object_id.binary(), max(size, 1))
             raise ObjectStoreFullError(
                 f"object of size {size} does not fit in the arena "
                 f"(capacity {self._capacity}, used {self.arena.used_bytes()}): {e}"
@@ -261,14 +277,16 @@ class NativePlasmaStore:
 
     def create(self, object_id: ObjectID, size: int):
         off = self._alloc(object_id, size)
-        return _ArenaWriter(self.arena.view(off, size)), self._name_for(off)
+        return _ArenaWriter(self.arena.view(off, size)), self._name_for(object_id, off)
 
     def create_remote(self, object_id: ObjectID, size: int) -> str:
         """Allocation RPC for workers: returns the location string; the
         worker writes through its own attached mapping."""
-        return self._name_for(self._alloc(object_id, size))
+        return self._name_for(object_id, self._alloc(object_id, size))
 
     def seal(self, object_id: ObjectID, shm_name: str, size: int):
+        if self.arena.lookup(object_id.binary()) is not None:
+            return  # already sealed (duplicate put) — keep the single pin
         self.arena.seal(object_id.binary())
         # liveness pin: the controller's ref counting owns this object's
         # lifetime now — LRU eviction must never reclaim an object that
@@ -280,7 +298,7 @@ class NativePlasmaStore:
         got = self.arena.lookup(object_id.binary())
         if got is None:
             return None
-        return self._name_for(got[0]), got[1]
+        return self._name_for(object_id, got[0]), got[1]
 
     def pin(self, object_id: ObjectID):
         self.arena.pin(object_id.binary())
@@ -289,8 +307,19 @@ class NativePlasmaStore:
         self.arena.unpin(object_id.binary())
 
     def delete(self, object_id: ObjectID):
+        from ray_tpu._native.plasma import NativeObjectPinned
+
         self.arena.unpin(object_id.binary())
-        self.arena.delete(object_id.binary())
+        try:
+            self.arena.delete(object_id.binary())
+        except NativeObjectPinned:
+            # Extra pins beyond the liveness pin (defense in depth): leave
+            # the block alone; LRU eviction reclaims it if pins ever drop.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "delete refused for pinned object %s", object_id.hex()
+            )
 
     def used_bytes(self) -> int:
         return self.arena.used_bytes()
@@ -303,11 +332,14 @@ class NativePlasmaStore:
 
 
 def parse_arena_location(shm_name: str):
-    """'@<arena>#<offset>' -> (arena_name, offset) or None for legacy names."""
+    """'@<arena>#<offset>[#<oid_hex>]' -> (arena, offset, oid_bytes|None),
+    or None for legacy per-segment names."""
     if not shm_name.startswith("@"):
         return None
-    arena, _, off = shm_name[1:].rpartition("#")
-    return arena, int(off)
+    parts = shm_name[1:].split("#")
+    if len(parts) >= 3:
+        return parts[0], int(parts[1]), bytes.fromhex(parts[2])
+    return parts[0], int(parts[1]), None
 
 
 class PlasmaClient:
@@ -337,16 +369,27 @@ class PlasmaClient:
     def read(self, shm_name: str, size: int) -> SerializedObject:
         loc = parse_arena_location(shm_name)
         if loc is not None:
-            arena_name, offset = loc
+            arena_name, offset, oid = loc
+            arena = self._arena(arena_name)
             # COPY out of the arena: deserialized arrays (pickle-5 oob
             # buffers) alias the returned buffer, and arena blocks are
             # REUSED after delete/eviction — aliasing them would corrupt
             # live user arrays. (The per-segment path below stays zero-copy
             # because unlinked segments remain valid while attached; a
             # client release protocol can restore zero-copy here later.)
-            return SerializedObject.from_buffer(
-                bytes(self._arena(arena_name).view(offset, size))
-            )
+            data = bytes(arena.view(offset, size))
+            # Validate AFTER the copy that the entry still lives at this
+            # offset (optimistic concurrency, seqlock-style): spilling or
+            # eviction may have recycled the block while we read. Stale →
+            # the caller re-resolves through the controller, which now
+            # serves the spill file. This makes correctness independent of
+            # the controller's trash grace period and survives readers that
+            # crash mid-read (no pin leases to leak).
+            if oid is not None:
+                got = arena.lookup(oid)
+                if got is None or got[0] != offset:
+                    raise ObjectRelocatedError(shm_name)
+            return SerializedObject.from_buffer(data)
         from multiprocessing import shared_memory
 
         with self._lock:
@@ -357,7 +400,7 @@ class PlasmaClient:
         return SerializedObject.from_buffer(seg.buf[:size])
 
     def write_arena(self, shm_name: str, data: bytes) -> None:
-        arena_name, offset = parse_arena_location(shm_name)
+        arena_name, offset, _ = parse_arena_location(shm_name)
         self._arena(arena_name).write(offset, data)
 
     def detach(self, shm_name: str):

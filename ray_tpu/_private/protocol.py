@@ -17,6 +17,22 @@ from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.task_spec import TaskSpec
 
 
+def routable_host() -> str:
+    """Best-effort externally-routable IP of this host. The UDP-connect
+    trick sends no packets; the kernel just resolves the egress interface."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
 # ---- worker -> controller ----
 
 @dataclasses.dataclass

@@ -189,6 +189,7 @@ class WorkerAPI:
         name: str,
         num_returns: int = 1,
         seq_no: int = 0,
+        max_retries: int = 0,
     ) -> list[ObjectRef]:
         idx = self._next_submit_index()
         task_id = TaskID.for_task(self.job_id, TaskID.for_actor_creation(actor_id), idx)
@@ -204,6 +205,7 @@ class WorkerAPI:
             resources={},
             actor_id=actor_id,
             seq_no=seq_no,
+            max_retries=max_retries,
         )
         return_ids = spec.return_ids()
         self.add_refs(return_ids)
@@ -286,11 +288,11 @@ class DriverAPI(WorkerAPI):
     def _get_serialized(self, object_ids, timeout):
         entries = self.controller.get_entries(object_ids, timeout=timeout)
         out = []
-        for e in entries:
+        for oid, e in zip(object_ids, entries):
             if e is None:
                 out.append(None)
             else:
-                out.append((e[0], self.controller.resolve_object(e)))
+                out.append((e[0], self.controller.resolve_object(e, object_id=oid)))
         return out
 
     def _put_serialized(self, object_id, sobj):
@@ -493,11 +495,19 @@ def _connect_client(address: str) -> "WorkerAPI":
         sock, _, key_hex = address.partition("?authkey=")
         if not key_hex:
             raise RayTpuError(
-                "client address must be 'auto' or '<socket>?authkey=<hex>'"
+                "client address must be 'auto', '<socket>?authkey=<hex>', or "
+                "'tcp://host:port?authkey=<hex>'"
             )
         authkey = bytes.fromhex(key_hex)
+    if isinstance(sock, str) and sock.startswith("tcp://"):
+        # cross-host attach over the controller's TCP listener (the DCN
+        # control plane; reference: ray://<host:port> client mode)
+        host, _, port = sock[len("tcp://"):].rpartition(":")
+        target, family = (host, int(port)), "AF_INET"
+    else:
+        target, family = sock, "AF_UNIX"
     try:
-        conn = _ConnClient(sock, family="AF_UNIX", authkey=authkey)
+        conn = _ConnClient(target, family=family, authkey=authkey)
     except (FileNotFoundError, ConnectionRefusedError) as e:
         raise RayTpuError(
             f"no running cluster at {sock!r} (stale session file?): {e}"
@@ -516,13 +526,18 @@ def _connect_client(address: str) -> "WorkerAPI":
     return api
 
 
-def cluster_address() -> Optional[str]:
-    """Connect string for ``init(address=...)`` from another process on
-    this host (None in thread mode — no listener)."""
+def cluster_address(tcp: bool = False) -> Optional[str]:
+    """Connect string for ``init(address=...)``. Default: same-host unix
+    socket. ``tcp=True``: the cross-host TCP form (requires the head to run
+    with ``config={"tcp_port": 0}`` or a fixed port)."""
     api = global_worker()
     controller = getattr(api, "controller", None)
     if controller is None or controller.address is None:
         return None
+    if tcp:
+        if controller.tcp_address is None:
+            return None
+        return f"tcp://{controller.tcp_address}?authkey={controller._authkey.hex()}"
     return f"{controller.address}?authkey={controller._authkey.hex()}"
 
 

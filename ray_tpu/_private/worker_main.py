@@ -20,6 +20,21 @@ def main():
     worker_id_hex = sys.argv[2]
     authkey = bytes.fromhex(os.environ.pop("RAY_TPU_AUTHKEY"))
 
+    # Honor the controller's accelerator-visibility contract. Site
+    # customization may have pre-imported jax and FORCED a platform list via
+    # jax.config (config beats the JAX_PLATFORMS env var), so a worker that
+    # wasn't granted the TPU must explicitly pin config back to the env
+    # value — otherwise every worker races to claim the chip the moment it
+    # touches jax (reference: TPU_VISIBLE_CHIPS isolation, accelerators/tpu.py).
+    jp = os.environ.get("JAX_PLATFORMS")
+    if jp:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", jp)
+        except Exception:
+            pass
+
     from multiprocessing.connection import Client
 
     from ray_tpu._private.ids import WorkerID

@@ -235,14 +235,82 @@ class WorkerRuntime:
         return reply.payload
 
     def _materialize(self, kind, payload) -> SerializedObject:
-        if kind in ("inline", "error"):
-            return SerializedObject.from_buffer(payload)
-        if kind == "spilled":
-            path, size = payload
-            with open(path, "rb") as f:
-                return SerializedObject.from_buffer(f.read())
-        shm_name, size = payload
-        return self._plasma().read(shm_name, size)
+        from ray_tpu._native.plasma import NativePlasmaError
+        from ray_tpu._private.object_store import (
+            ObjectRelocatedError,
+            parse_arena_location,
+        )
+
+        local_arena = os.environ.get("RAY_TPU_ARENA")
+        for _ in range(5):
+            if kind in ("inline", "error"):
+                return SerializedObject.from_buffer(payload)
+            if kind == "spilled":
+                path, size = payload
+                with open(path, "rb") as f:
+                    return SerializedObject.from_buffer(f.read())
+            shm_name, size = payload
+            loc = parse_arena_location(shm_name)
+            pullable = loc is not None and loc[2] is not None
+            if pullable and local_arena and loc[0] != local_arena:
+                # object lives in ANOTHER node's arena: fetch it through the
+                # chunked pull protocol instead of shared memory (reference:
+                # PullManager, pull_manager.h:49)
+                return SerializedObject.from_buffer(
+                    self._pull_object(ObjectID(loc[2]), size)
+                )
+            try:
+                return self._plasma().read(shm_name, size)
+            except (FileNotFoundError, OSError, NativePlasmaError):
+                # the segment/arena isn't attachable from this process — a
+                # cross-host client driver. Fall back to the pull protocol.
+                if not pullable:
+                    raise
+                return SerializedObject.from_buffer(
+                    self._pull_object(ObjectID(loc[2]), size)
+                )
+            except ObjectRelocatedError:
+                # the arena block was spilled/recycled while we read —
+                # re-resolve through the controller (entry now points at the
+                # spill file or a fresh location)
+                if loc is None or loc[2] is None:
+                    raise
+                req_id = next(self._req_counter)
+                self._send(P.GetObjects(req_id, [ObjectID(loc[2])]))
+                results = self._await_reply(req_id, 30.0)
+                _, kind, payload = results[0]
+        raise ObjectRelocatedError(f"object kept relocating: {payload!r}")
+
+    def _pull_object(
+        self, object_id: ObjectID, size: int, chunk_bytes: int = 4 * 1024**2
+    ) -> bytes:
+        """Chunked pull with per-chunk retry (reference: the chunk retry
+        loop in PullManager/ObjectBufferPool). Each chunk is an independent
+        RPC, so one dropped/failed chunk costs one retransmit, not the
+        whole object."""
+        buf = bytearray()
+        offset = 0
+        while offset < size:
+            last_err = None
+            for _attempt in range(5):
+                try:
+                    total, chunk = self.call_controller(
+                        "pull_object_chunk",
+                        (object_id, offset, min(chunk_bytes, size - offset)),
+                    )
+                    break
+                except (RuntimeError, TimeoutError) as e:
+                    last_err = e
+                    time.sleep(0.05 * (_attempt + 1))
+            else:
+                raise last_err
+            if not chunk:
+                raise RuntimeError(
+                    f"empty chunk at offset {offset}/{size} for {object_id.hex()}"
+                )
+            buf.extend(chunk)
+            offset += len(chunk)
+        return bytes(buf)
 
     def _plasma(self):
         if self._shm_client is None:
@@ -252,6 +320,17 @@ class WorkerRuntime:
         return self._shm_client
 
     def put_serialized(self, object_id: ObjectID, sobj: SerializedObject):
+        if (
+            sobj.total_bytes() > self.max_inline
+            and self.client_mode
+            and not os.environ.get("RAY_TPU_ARENA")
+        ):
+            # client driver (possibly on another host — no attachable
+            # arena): push the bytes through the control channel in chunks
+            # (inverse of the pull protocol; reference: PushManager,
+            # push_manager.h:27). The controller seals into the head store.
+            self._push_object(object_id, sobj.to_bytes())
+            return
         req_id = next(self._req_counter)
         if sobj.total_bytes() <= self.max_inline:
             self._send(P.PutObject(req_id, object_id, "inline", sobj.to_bytes()))
@@ -260,12 +339,37 @@ class WorkerRuntime:
             self._send(P.PutObject(req_id, object_id, "plasma", (name, size)))
         self._await_reply(req_id)
 
+    def _push_object(
+        self, object_id: ObjectID, data: bytes, chunk_bytes: int = 4 * 1024**2
+    ) -> None:
+        """Chunked push with per-chunk retry (mirror of ``_pull_object``)."""
+        total = len(data)
+        offset = 0
+        while offset < total:
+            chunk = data[offset : offset + chunk_bytes]
+            last_err = None
+            for _attempt in range(5):
+                try:
+                    self.call_controller(
+                        "push_object_chunk", (object_id, offset, total, chunk)
+                    )
+                    break
+                except (RuntimeError, TimeoutError) as e:
+                    last_err = e
+                    time.sleep(0.05 * (_attempt + 1))
+            else:
+                raise last_err
+            offset += len(chunk)
+
     def _write_shm(self, object_id: ObjectID, sobj: SerializedObject):
         data = sobj.to_bytes()
         if os.environ.get("RAY_TPU_ARENA"):
             # native arena: allocate via the store authority, write through
             # this process's mapping (plasma create/seal protocol)
             name = self.call_controller("shm_create", (object_id, len(data)))
+            if isinstance(name, tuple) and name[0] == "exists":
+                # duplicate put — the sealed object stands; skip the write
+                return name[1], name[2]
             self._plasma().write_arena(name, data)
             return name, len(data)
         from multiprocessing import shared_memory

@@ -12,17 +12,30 @@ from ray_tpu.remote_function import _resources_from_options, _strategy_from_opti
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(
+        self,
+        handle: "ActorHandle",
+        method_name: str,
+        num_returns: int = 1,
+        max_retries: int = 0,
+    ):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        # retriable actor tasks are also lineage-reconstructable (reference:
+        # max_task_retries on actor methods, task_manager.h)
+        self._max_retries = max_retries
 
-    def options(self, num_returns: int = 1, **_):
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: int = 1, max_retries: int = 0, **_):
+        return ActorMethod(self._handle, self._method_name, num_returns, max_retries)
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(
-            self._method_name, args, kwargs, num_returns=self._num_returns
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            max_retries=self._max_retries,
         )
 
     def bind(self, *args, **kwargs):
@@ -55,7 +68,7 @@ class ActorHandle:
             )
         return ActorMethod(self, item)
 
-    def _submit_method(self, method_name, args, kwargs, num_returns=1):
+    def _submit_method(self, method_name, args, kwargs, num_returns=1, max_retries=0):
         from ray_tpu._private.worker import global_worker
 
         with self._seq_lock:
@@ -69,6 +82,7 @@ class ActorHandle:
             name=f"{self._class_name}.{method_name}",
             num_returns=num_returns,
             seq_no=seq,
+            max_retries=max_retries,
         )
         return refs[0] if num_returns == 1 else refs
 

@@ -25,7 +25,11 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ray_tpu.parallel.mesh import logical_sharding, with_sharding
-from ray_tpu.parallel.ring_attention import ring_attention, full_attention_reference
+from ray_tpu.parallel.ring_attention import (
+    dense_attention,
+    full_attention_reference,
+    ring_attention,
+)
 from ray_tpu.parallel.ulysses import ulysses_attention
 
 
@@ -48,7 +52,14 @@ class LlamaConfig:
     # program XLA would replicate around it — use on single-device/replicated
     # paths (e.g. the serving engine) where it runs in one VMEM pass.
     fused_rmsnorm: bool = False
+    # fused blockwise cross-entropy (ops.cross_entropy): never materializes
+    # the [B, S, V] logit tensor in the train loss
+    fused_ce: bool = True
     remat: bool = True
+    # 'full' = recompute everything in backward; 'dots' = save matmul
+    # outputs, recompute elementwise (jax.checkpoint_policies.dots_saveable)
+    # — trades a little activation memory for ~25% fewer backward FLOPs
+    remat_policy: str = "full"
     tie_embeddings: bool = False
 
     @property
@@ -231,23 +242,69 @@ def _rope(x, positions, theta):
 
 
 def _attention(q, k, v, cfg: LlamaConfig, mesh: Optional[Mesh]):
-    """q: [B, T, H, D]; k/v: [B, T, KV, D]. Returns [B, T, H, D]."""
-    groups = cfg.n_heads // cfg.n_kv_heads
-    if groups > 1:
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
+    """q: [B, T, H, D]; k/v: [B, T, KV, D]. Returns [B, T, H, D].
+
+    The dense path is GQA-native (kv heads contracted directly, never
+    repeated — ``jnp.repeat`` over a tp-sharded heads axis forces SPMD to
+    replicate the tensor). Ring/Ulysses/flash kernels expect equal head
+    counts, so those paths still expand kv heads first."""
     sp = (
         mesh.shape.get("sp", 1)
         if mesh is not None and "sp" in mesh.axis_names
         else 1
     )
+    on_tpu = jax.default_backend() == "tpu"
+    # pallas kernels have no SPMD partitioning rule: only use them when the
+    # program isn't sharded over >1 device (single-chip or per-replica)
+    unsharded = mesh is None or all(s == 1 for s in mesh.shape.values())
+    needs_repeat = (
+        (sp > 1 and cfg.attention == "ulysses" and cfg.n_kv_heads % sp != 0)
+        or (cfg.attention in ("flash", "splash") and on_tpu and unsharded)
+    )
+    groups = cfg.n_heads // cfg.n_kv_heads
+    if needs_repeat and groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
     if sp > 1 and cfg.attention == "ring":
         return ring_attention(q, k, v, mesh, causal=True)
     if sp > 1 and cfg.attention == "ulysses":
         return ulysses_attention(q, k, v, mesh, causal=True)
-    if cfg.attention == "flash" and jax.default_backend() == "tpu":
+    if cfg.attention == "splash" and on_tpu and unsharded:
+        return _splash_attention(q, k, v)
+    if cfg.attention == "flash" and on_tpu and unsharded:
         return _flash_attention(q, k, v)
-    return full_attention_reference(q, k, v, causal=True)
+    return dense_attention(q, k, v, causal=True)
+
+
+def _splash_attention(q, k, v):
+    """Splash attention (Pallas TPU): the production blockwise-causal kernel
+    — never materializes [B, H, T, S] scores in HBM, and its sparse-mask
+    grid skips fully-masked key blocks outright (half the work for causal).
+    Block sizes tuned on v5e for T=2048, D=64: 1024×1024 measured 2.5×
+    faster than dense XLA attention fwd+bwd (12.6ms vs 31.8ms at
+    B8 H16 T2048 D64)."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk,
+        splash_attention_mask as _sm,
+    )
+
+    B, T, H, D = q.shape
+    scale = D**-0.5
+    qt = jnp.swapaxes(q, 1, 2) * scale  # [B, H, T, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    blk = min(1024, T)
+    bs = _sk.BlockSizes(
+        block_q=blk, block_kv=blk, block_kv_compute=blk,
+        block_q_dkv=blk, block_kv_dkv=blk, block_kv_dkv_compute=blk,
+        block_q_dq=blk, block_kv_dq=blk,
+    )
+    mask = _sm.MultiHeadMask([_sm.CausalMask((T, T)) for _ in range(H)])
+    kernel = _sk.make_splash_mha(
+        mask=mask, head_shards=1, q_seq_shards=1, block_sizes=bs
+    )
+    out = jax.vmap(kernel)(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
 def _flash_attention(q, k, v):
@@ -297,6 +354,53 @@ _LAYER_KEYS = (
 )
 
 
+def _embed_lookup(table, tokens, cfg: LlamaConfig, mesh: Optional[Mesh]):
+    """Token embedding. On a sharded mesh the row-gather is replaced by a
+    one-hot matmul: SPMD cannot partition a gather from a table sharded on
+    vocab (tp) and embed (fsdp) — it replicates the output ("involuntary
+    full rematerialization") — while a matmul contracts the sharded vocab
+    dim with a psum and lands directly in activation sharding. The backward
+    pass likewise becomes a matmul instead of a scatter-add."""
+    sharded = mesh is not None and any(s > 1 for s in mesh.shape.values())
+    if not sharded:
+        return table[tokens].astype(cfg.dtype)
+    onehot = jax.nn.one_hot(tokens, table.shape[0], dtype=cfg.dtype)
+    return jnp.einsum("btv,ve->bte", onehot, table.astype(cfg.dtype))
+
+
+def forward_hidden(
+    params,
+    tokens,
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    positions=None,
+):
+    """tokens: [B, T] int32 -> final hidden states [B, T, d_model]."""
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape
+        )
+    x = _embed_lookup(params["embed"], tokens, cfg, mesh)
+    if mesh is not None:
+        x = with_sharding(mesh, x, "batch", "seq", "embed")
+
+    layer = lambda p, y: _layer(p, y, positions, cfg, mesh)
+    if cfg.remat:
+        policy = (
+            jax.checkpoint_policies.dots_saveable
+            if cfg.remat_policy == "dots"
+            else None
+        )
+        layer = jax.checkpoint(layer, policy=policy)
+    stacked = {k: params[k] for k in _LAYER_KEYS}
+
+    def body(y, p):
+        return layer(p, y), None
+
+    x, _ = jax.lax.scan(body, x, stacked)
+    return _rmsnorm(x, params["final_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
+
+
 def forward(
     params,
     tokens,
@@ -305,24 +409,7 @@ def forward(
     positions=None,
 ):
     """tokens: [B, T] int32 -> logits [B, T, vocab] (fp32)."""
-    if positions is None:
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape
-        )
-    x = params["embed"][tokens].astype(cfg.dtype)
-    if mesh is not None:
-        x = with_sharding(mesh, x, "batch", "seq", "embed")
-
-    layer = lambda p, y: _layer(p, y, positions, cfg, mesh)
-    if cfg.remat:
-        layer = jax.checkpoint(layer)
-    stacked = {k: params[k] for k in _LAYER_KEYS}
-
-    def body(y, p):
-        return layer(p, y), None
-
-    x, _ = jax.lax.scan(body, x, stacked)
-    x = _rmsnorm(x, params["final_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
+    x = forward_hidden(params, tokens, cfg, mesh, positions)
     unembed = (
         params["embed"].T if cfg.tie_embeddings else params["unembed"]
     )
@@ -351,6 +438,12 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
         mask = batch.get("mask")
         if mask is not None:
             mask = mask[:, 1:]
+    if cfg.fused_ce:
+        from ray_tpu.ops.cross_entropy import fused_cross_entropy
+
+        x = forward_hidden(params, tokens, cfg, mesh)
+        unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+        return fused_cross_entropy(x, unembed, labels, mask=mask)
     logits = forward(params, tokens, cfg, mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]

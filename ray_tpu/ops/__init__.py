@@ -6,7 +6,8 @@ falls back to interpreter mode off-TPU so the same code path is exercised by
 the CPU test suite (`/opt/skills/guides/pallas_guide.md` conventions).
 """
 
+from ray_tpu.ops.cross_entropy import fused_cross_entropy
 from ray_tpu.ops.rmsnorm import rmsnorm
 from ray_tpu.ops.quant import dequantize_int8, quantize_int8
 
-__all__ = ["dequantize_int8", "quantize_int8", "rmsnorm"]
+__all__ = ["dequantize_int8", "fused_cross_entropy", "quantize_int8", "rmsnorm"]

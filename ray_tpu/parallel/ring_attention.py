@@ -55,6 +55,9 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     my_idx = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    groups = H // k.shape[2]  # GQA: kv heads expanded locally (heads are
+    # unsharded inside shard_map, so this is a plain local broadcast — and
+    # the ring rotates the small KV tensors, not the expanded ones)
 
     m0 = jnp.full((B, H, Tq), _NEG_INF, dtype=jnp.float32)
     l0 = jnp.zeros((B, H, Tq), dtype=jnp.float32)
@@ -73,7 +76,11 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = None
-        m, l, o = _block_attn(q32, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32), m, l, o, mask)
+        k_full, v_full = k_cur, v_cur
+        if groups > 1:
+            k_full = jnp.repeat(k_cur, groups, axis=2)
+            v_full = jnp.repeat(v_cur, groups, axis=2)
+        m, l, o = _block_attn(q32, k_full.astype(jnp.float32), v_full.astype(jnp.float32), m, l, o, mask)
         # Rotate K/V to the next ring neighbor; track whose block we hold.
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -133,3 +140,44 @@ def full_attention_reference(q, k, v, causal: bool = True):
         s = jnp.where(mask[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def dense_attention(q, k, v, causal: bool = True):
+    """Dense attention for the MXU: bf16 operands with fp32 accumulation
+    (``preferred_element_type``) and an fp32 softmax. Numerically this is the
+    MXU's native mode — casting operands to fp32 (as the reference harness
+    above does for exactness) quarters matmul throughput and doubles the
+    HBM traffic of the [B, H, T, S] score tensor.
+
+    GQA-native: q may have more heads than k/v (grouped-query attention).
+    The kv heads are NOT repeated — repeating is a gather across the
+    (tp-sharded) heads axis, which SPMD can only handle by replicating the
+    tensor ("involuntary full rematerialization"), and it multiplies KV HBM
+    traffic by the group count. Instead q is reshaped to [B, T, KV, G, D]
+    and the einsums contract against the shared kv head directly."""
+    B, T, H, D = q.shape
+    KV = k.shape[2]
+    scale = D**-0.5
+    if causal:
+        S = k.shape[1]
+        mask = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
+    if H == KV:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        s = s * scale
+        if causal:
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+        ).astype(q.dtype)
+    G = H // KV
+    qg = q.reshape(B, T, KV, G, D)
+    s = jnp.einsum("btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    o = jnp.einsum(
+        "bkgts,bskd->btkgd", p, v, preferred_element_type=jnp.float32
+    )
+    return o.reshape(B, T, H, D).astype(q.dtype)

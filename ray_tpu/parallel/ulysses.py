@@ -18,11 +18,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ray_tpu.parallel.ring_attention import full_attention_reference
+from ray_tpu.parallel.ring_attention import dense_attention
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
     # q: [B, T_local, H, D] -> all_to_all -> [B, T, H_local, D]
+    # GQA-native: k/v keep their (smaller) kv head count through the
+    # all-to-all; the local dense attention contracts groups directly.
     def seq_to_heads(x):
         # split_axis=2 (heads), concat_axis=1 (seq)
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
@@ -31,7 +33,7 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = full_attention_reference(qh, kh, vh, causal=causal)
+    out = dense_attention(qh, kh, vh, causal=causal)
     return heads_to_seq(out)
 
 
@@ -53,6 +55,11 @@ def ulysses_attention(
     if q.shape[2] % sp:
         raise ValueError(
             f"ulysses needs heads ({q.shape[2]}) divisible by {axis_name}={sp}"
+        )
+    if k.shape[2] % sp:
+        raise ValueError(
+            f"ulysses needs kv heads ({k.shape[2]}) divisible by {axis_name}={sp}"
+            " (repeat kv heads to a multiple first)"
         )
     if qkv_spec is None:
         batch_axis = "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None

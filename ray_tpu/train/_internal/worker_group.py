@@ -37,6 +37,19 @@ class TrainWorker:
         self._error: Optional[str] = None
         self._done = False
 
+    def reserve_coordinator(self) -> str:
+        """Pick this host's routable IP + a free port for the JAX
+        distributed coordinator (called on rank 0 before setup)."""
+        import socket
+
+        from ray_tpu._private.protocol import routable_host
+
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return f"{routable_host()}:{port}"
+
     def setup(
         self,
         context_kwargs: dict,
@@ -49,6 +62,19 @@ class TrainWorker:
 
         for k, v in (jax_env or {}).items():
             os.environ[k] = v
+        coordinator = (jax_env or {}).get("RAY_TPU_JAX_COORDINATOR")
+        if coordinator:
+            # The actual multi-host rendezvous (reference contract:
+            # _setup_torch_process_group, train/torch/config.py:66). Must
+            # run before this process's first JAX backend use; after it,
+            # jax.devices() is the GLOBAL device set across the gang.
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=int(jax_env["RAY_TPU_WORLD_SIZE"]),
+                process_id=int(jax_env["RAY_TPU_RANK"]),
+            )
         ctx = TrainContext(**context_kwargs)
         chk = Checkpoint(latest_checkpoint_path) if latest_checkpoint_path else None
         os.makedirs(storage_dir, exist_ok=True)
@@ -158,6 +184,14 @@ class WorkerGroup:
         """Init sessions on all ranks (rank/world wiring + JAX env)."""
         n = self.num_workers
         chk_path = latest_checkpoint.path if latest_checkpoint else None
+        coordinator = None
+        if n > 1 and getattr(self.scaling, "use_jax_distributed", False):
+            # rank 0's worker picks the coordinator endpoint; the address is
+            # brokered to the gang through this (control-plane) call — the
+            # TCPStore-rendezvous analog of train/torch/config.py:66
+            coordinator = ray_tpu.get(
+                self.workers[0].reserve_coordinator.remote(), timeout=60
+            )
         refs = []
         for rank, w in enumerate(self.workers):
             ctx = dict(
@@ -169,13 +203,12 @@ class WorkerGroup:
                 experiment_name=self.experiment_name,
                 trial_id=self.trial_id,
             )
-            # multi-host JAX rendezvous env: worker 0's host is coordinator.
-            # In-process/test runtimes run single-host; real TPU pods get
-            # JAX_COORDINATOR_ADDRESS + process ids (jax.distributed args).
             jax_env = {
                 "RAY_TPU_WORLD_SIZE": str(n),
                 "RAY_TPU_RANK": str(rank),
             }
+            if coordinator:
+                jax_env["RAY_TPU_JAX_COORDINATOR"] = coordinator
             refs.append(w.setup.remote(ctx, storage_dir, chk_path, jax_env))
         ray_tpu.get(refs)
 

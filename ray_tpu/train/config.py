@@ -33,6 +33,12 @@ class ScalingConfig:
     topology: Optional[str] = None  # e.g. "v5e-16": gang-schedule a slice
     resources_per_worker: Optional[dict[str, float]] = None
     placement_strategy: str = "STRICT_PACK"  # gang on one ICI domain
+    # Multi-host SPMD: run jax.distributed.initialize across the worker
+    # gang (rank 0 hosts the coordinator; address brokered through the
+    # control plane — the analog of the reference's TCPStore rendezvous in
+    # train/torch/config.py:66). Each worker process then sees the global
+    # device set and psum/all_gather span hosts over DCN/ICI.
+    use_jax_distributed: bool = False
     # elastic range; None disables elasticity (fixed size = num_workers)
     min_workers: Optional[int] = None
     max_workers: Optional[int] = None

@@ -14,7 +14,13 @@ import sys
 import numpy as np
 import pytest
 
-from ray_tpu._native.plasma import NativeArena, NativePlasmaError, available
+from ray_tpu._native.plasma import (
+    NativeArena,
+    NativeObjectExists,
+    NativeObjectPinned,
+    NativePlasmaError,
+    available,
+)
 
 pytestmark = pytest.mark.timeout(300) if hasattr(pytest.mark, "timeout") else []
 
@@ -46,10 +52,76 @@ def test_roundtrip_and_states(arena):
 
 
 @needs_native
-def test_duplicate_alloc_rejected(arena):
+def test_duplicate_alloc_semantics(arena):
+    # unsealed duplicate = stale create (worker died mid-write / task retry):
+    # reclaimed in place, new offset handed out
     arena.alloc(b"d" * 20, 10)
-    with pytest.raises(NativePlasmaError, match="exists"):
+    off2 = arena.alloc(b"d" * 20, 10)
+    arena.write(off2, b"x" * 10)
+    arena.seal(b"d" * 20)
+    # sealed duplicate = idempotent-put signal; the entry must survive intact
+    with pytest.raises(NativeObjectExists):
         arena.alloc(b"d" * 20, 10)
+    got = arena.lookup(b"d" * 20)
+    assert got is not None and bytes(arena.view(got[0], 10)) == b"x" * 10
+
+
+@needs_native
+def test_full_28_byte_ids_do_not_collide(arena):
+    """Return ids of one multi-return task differ only in the trailing
+    4-byte return index (ids.py) — the native table must key on all 28
+    bytes, not a 20-byte prefix."""
+    task_id = os.urandom(24)
+    ids = [task_id + i.to_bytes(4, "little") for i in range(4)]
+    for i, oid in enumerate(ids):
+        off = arena.alloc(oid, 64)
+        arena.write(off, bytes([i]) * 64)
+        arena.seal(oid)
+    assert arena.num_objects() == 4
+    for i, oid in enumerate(ids):
+        got = arena.lookup(oid)
+        assert got is not None
+        assert bytes(arena.view(got[0], 64)) == bytes([i]) * 64
+
+
+@needs_native
+def test_delete_refused_while_pinned(arena):
+    oid = b"p" * 20
+    arena.alloc(oid, 64)
+    arena.seal(oid)
+    arena.pin(oid)
+    with pytest.raises(NativeObjectPinned):
+        arena.delete(oid)
+    assert arena.lookup(oid) is not None
+    arena.unpin(oid)
+    arena.delete(oid)
+    assert arena.lookup(oid) is None
+
+
+@needs_native
+def test_read_validation_detects_relocation(arena):
+    """PlasmaClient.read validates after copying that the entry still lives
+    at the location's offset — a spilled/recycled block raises
+    ObjectRelocatedError instead of returning reused memory."""
+    from ray_tpu._private.object_store import (
+        ObjectRelocatedError,
+        PlasmaClient,
+    )
+
+    from ray_tpu._private.serialization import SerializationContext
+
+    payload = SerializationContext().serialize({"k": 42}).to_bytes()
+    oid = b"v" * 28
+    off = arena.alloc(oid, len(payload))
+    arena.write(off, payload)
+    arena.seal(oid)
+    loc = f"@{arena.name}#{off}#{oid.hex()}"
+    client = PlasmaClient()
+    assert client.read(loc, len(payload)).to_bytes() == payload
+    arena.delete(oid)
+    with pytest.raises(ObjectRelocatedError):
+        client.read(loc, len(payload))
+    client.close()
 
 
 @needs_native

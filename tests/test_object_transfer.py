@@ -1,0 +1,111 @@
+"""Node-to-node object transfer: per-node arenas + chunked pull protocol.
+
+Reference: ``src/ray/object_manager/object_manager.h:119`` (node↔node
+transfer), ``pull_manager.h:49`` (pull admission/retry),
+``object_buffer_pool.h`` (chunking). Here each (fake) node owns a separate
+shm arena; a consumer on another node can only get the bytes through the
+chunked pull RPCs — the test asserts the arenas really are distinct, so a
+passing read proves the transfer path ran.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.object_store import parse_arena_location
+from ray_tpu._native.plasma import available as native_available
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native arena store unavailable"
+)
+
+
+@pytest.fixture
+def transfer_cluster(request):
+    extra_cfg = getattr(request, "param", {})
+    ray_tpu.init(
+        num_cpus=1,
+        resources={"nodeA": 1.0},
+        mode="process",
+        config={"object_transfer_chunk_bytes": 256 * 1024, **extra_cfg},
+    )
+    from ray_tpu._private.worker import global_worker
+
+    controller = global_worker().controller
+    node_b = controller.add_node({"CPU": 1.0, "nodeB": 1.0})
+    yield controller, node_b
+    ray_tpu.shutdown()
+
+
+@needs_native
+def test_cross_node_get_via_pull(transfer_cluster):
+    controller, node_b = transfer_cluster
+
+    @ray_tpu.remote(resources={"nodeA": 1})
+    def produce():
+        return np.arange(500_000, dtype=np.float64)  # 4 MB -> plasma
+
+    @ray_tpu.remote(resources={"nodeB": 1})
+    def consume(x):
+        return float(x.sum()), x.shape
+
+    ref = produce.remote()
+    ray_tpu.get(ref, timeout=120)  # ensure sealed before inspecting location
+
+    # the object must live in node A's arena, and node B must have its own
+    entry = controller.memory_store.get([ref.id()], timeout=10)[0]
+    assert entry is not None and entry[0] == "plasma", entry
+    loc = parse_arena_location(entry[1][0])
+    assert loc is not None
+    store_a = controller._store_for_location(entry[1][0])
+    store_b = controller._store_for_node(node_b)
+    assert store_a is not store_b, "nodes must not share an arena"
+
+    total, shape = ray_tpu.get(consume.remote(ref), timeout=120)
+    expected = np.arange(500_000, dtype=np.float64)
+    assert total == float(expected.sum())
+    assert tuple(shape) == expected.shape
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "transfer_cluster",
+    [{"testing_rpc_failure": "pull_object_chunk=0.3"}],
+    indirect=True,
+)
+def test_pull_retries_chunk_failures(transfer_cluster):
+    """With 30% injected failure per chunk RPC (rpc_chaos analog), the
+    per-chunk retry loop still completes the transfer intact."""
+    controller, node_b = transfer_cluster
+
+    @ray_tpu.remote(resources={"nodeA": 1})
+    def produce():
+        rng = np.random.default_rng(7)
+        return rng.normal(size=250_000)  # 2 MB -> ~8 chunks at 256 KiB
+
+    @ray_tpu.remote(resources={"nodeB": 1})
+    def digest(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    got = ray_tpu.get(digest.remote(ref), timeout=120)
+    expected = float(np.random.default_rng(7).normal(size=250_000).sum())
+    assert abs(got - expected) < 1e-6
+
+
+@needs_native
+def test_cross_node_roundtrip_both_directions(transfer_cluster):
+    controller, node_b = transfer_cluster
+
+    @ray_tpu.remote(resources={"nodeB": 1})
+    def produce_b():
+        return np.ones((300, 1000), dtype=np.float32)
+
+    @ray_tpu.remote(resources={"nodeA": 1})
+    def consume_a(x):
+        return float(x.sum())
+
+    # B -> A (the reverse of the other test: head pulls from a fake node)
+    assert ray_tpu.get(
+        consume_a.remote(produce_b.remote()), timeout=120
+    ) == 300_000.0
