@@ -29,7 +29,7 @@ from ray_tpu._private.worker import (
 )
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor
 from ray_tpu.remote_function import RemoteFunction
-from ray_tpu.object_ref import ObjectRef
+from ray_tpu.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.exceptions import (
     RayTpuError,
     TaskError,
@@ -68,6 +68,7 @@ __all__ = [
     "get_actor",
     "RemoteFunction",
     "ObjectRef",
+    "ObjectRefGenerator",
     "RayTpuError",
     "TaskError",
     "ActorError",

@@ -70,6 +70,28 @@ def load_lib():
         lib.ps_close.restype = None
         lib.ps_unlink.argtypes = [ctypes.c_char_p]
         lib.ps_unlink.restype = ctypes.c_int
+        # mutable ring-buffer channels (compiled-graph data plane)
+        lib.ch_create.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+        ]
+        lib.ch_create.restype = ctypes.c_int
+        lib.ch_write_begin.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ]
+        lib.ch_write_begin.restype = ctypes.c_int
+        lib.ch_write_commit.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.ch_write_commit.restype = ctypes.c_int
+        lib.ch_read_begin.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ]
+        lib.ch_read_begin.restype = ctypes.c_int
+        for fn in ("ch_read_done", "ch_close", "ch_destroy"):
+            getattr(lib, fn).argtypes = [ctypes.c_int, ctypes.c_char_p]
+            getattr(lib, fn).restype = ctypes.c_int
         _lib = lib
         return lib
 
@@ -159,6 +181,69 @@ class NativeArena:
 
     def num_objects(self) -> int:
         return int(self._lib.ps_num_objects(self._h))
+
+    # -- mutable channels (compiled-graph data plane) ------------------------
+
+    class ChannelClosed(Exception):
+        pass
+
+    class ChannelTimeout(Exception):
+        pass
+
+    def _ch_check(self, rc: int, op: str):
+        if rc == 0:
+            return
+        if rc == -5:
+            raise NativeArena.ChannelClosed(op)
+        if rc == -6:
+            raise NativeArena.ChannelTimeout(op)
+        if rc == -7:
+            raise NativePlasmaError(f"{op}: payload exceeds channel slot size")
+        raise NativePlasmaError(f"{op} failed (rc={rc})")
+
+    def ch_create(self, chan_id: bytes, slot_size: int, num_slots: int = 2):
+        self._ch_check(
+            self._lib.ch_create(self._h, _id32(chan_id), slot_size, num_slots),
+            "ch_create",
+        )
+
+    def ch_write(self, chan_id: bytes, data, timeout_ms: int = -1):
+        """Blocking SPSC write: acquire slot → copy → commit."""
+        mv = memoryview(data).cast("B")
+        off = ctypes.c_uint64()
+        self._ch_check(
+            self._lib.ch_write_begin(
+                self._h, _id32(chan_id), len(mv), ctypes.byref(off), timeout_ms
+            ),
+            "ch_write_begin",
+        )
+        self._view[off.value : off.value + len(mv)] = mv
+        self._ch_check(
+            self._lib.ch_write_commit(self._h, _id32(chan_id), len(mv)),
+            "ch_write_commit",
+        )
+
+    def ch_read(self, chan_id: bytes, timeout_ms: int = -1) -> bytes:
+        """Blocking SPSC read: acquire → copy out → release the slot."""
+        off, size = ctypes.c_uint64(), ctypes.c_uint64()
+        self._ch_check(
+            self._lib.ch_read_begin(
+                self._h, _id32(chan_id), ctypes.byref(off),
+                ctypes.byref(size), timeout_ms,
+            ),
+            "ch_read_begin",
+        )
+        data = bytes(self._view[off.value : off.value + size.value])
+        self._ch_check(
+            self._lib.ch_read_done(self._h, _id32(chan_id)), "ch_read_done"
+        )
+        return data
+
+    def ch_close(self, chan_id: bytes):
+        self._lib.ch_close(self._h, _id32(chan_id))
+
+    def ch_destroy(self, chan_id: bytes):
+        self._lib.ch_destroy(self._h, _id32(chan_id))
 
     # -- data plane ----------------------------------------------------------
 

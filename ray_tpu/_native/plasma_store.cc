@@ -17,10 +17,12 @@
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in the image).
 
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
+#include <ctime>
 #include <fcntl.h>
 #include <algorithm>
 #include <mutex>
@@ -516,5 +518,190 @@ void ps_close(int handle) {
 }
 
 int ps_unlink(const char* name) { return shm_unlink(name); }
+
+// ---------------------------------------------------------------------------
+// Mutable ring-buffer channels (compiled-graph data plane).
+//
+// Reference: src/ray/core_worker/experimental_mutable_object_manager.h —
+// mutable plasma objects with WriteAcquire/WriteRelease + ReadAcquire/
+// ReadRelease versioning, used by compiled graphs' shared-memory channels
+// (python/ray/experimental/channel/shared_memory_channel.py:91). Here a
+// channel is one arena block holding a lock-free SPSC ring: one writer
+// process, one reader process, seq counters with acquire/release ordering.
+// Blocking is a bounded nanosleep poll (robust against peer death, unlike a
+// condvar held by a crashed process; ~5-50us wake latency).
+//
+// The channel's table entry is created pinned (pins=1) so LRU eviction can
+// never reclaim a live channel; ch_destroy unpins and frees it.
+
+namespace {
+
+struct Chan {
+  std::atomic<uint64_t> write_seq;  // items committed by the writer
+  std::atomic<uint64_t> read_seq;   // items released by the reader
+  std::atomic<uint32_t> closed;
+  uint32_t num_slots;
+  uint64_t slot_size;  // payload bytes per slot (8-byte size header extra)
+  // followed by num_slots * (uint64_t size + uint8_t payload[slot_size])
+};
+
+constexpr uint64_t kChanSlotHdr = sizeof(uint64_t);
+
+Chan* chan_at(Store* s, Entry* e) {
+  return reinterpret_cast<Chan*>(s->base + e->offset);
+}
+
+uint64_t chan_slot_off(Entry* e, Chan* c, uint64_t seq) {
+  uint64_t slot = seq % c->num_slots;
+  return e->offset + sizeof(Chan) + slot * (kChanSlotHdr + c->slot_size);
+}
+
+Entry* chan_entry(Store* s, const uint8_t* id) {
+  Guard g(&s->hdr->lock);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state != kSealed) return nullptr;
+  return e;
+}
+
+void chan_pause() {
+  timespec ts{0, 5000};  // 5us request (timer slack can stretch this)
+  nanosleep(&ts, nullptr);
+}
+
+int64_t mono_us() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+}
+
+}  // namespace
+
+// returns 0 ok, -1 no space, -2 id already exists, -3 bad args
+int ch_create(int handle, const uint8_t* id, uint64_t slot_size,
+              uint32_t num_slots) {
+  Store* s = get_store(handle);
+  if (!s || slot_size == 0 || num_slots == 0) return -3;
+  uint64_t need = sizeof(Chan) + (uint64_t)num_slots * (kChanSlotHdr + slot_size);
+  Guard g(&s->hdr->lock);
+  if (find_entry(s, id, false)) return -2;
+  uint64_t block_off = alloc_with_eviction(s, need);
+  if (block_off == 0) return -1;
+  Entry* e = find_entry(s, id, true);
+  if (!e) {
+    free_block(s, block_off);
+    return -1;
+  }
+  memcpy(e->id, id, kIdLen);
+  e->state = kSealed;
+  e->offset = block_off + sizeof(Block);
+  e->size = need;
+  e->pins = 1;  // immune to LRU eviction for the channel's lifetime
+  e->lru = ++s->hdr->lru_clock;
+  s->hdr->num_objects++;
+  Chan* c = chan_at(s, e);
+  c->write_seq.store(0, std::memory_order_relaxed);
+  c->read_seq.store(0, std::memory_order_relaxed);
+  c->closed.store(0, std::memory_order_relaxed);
+  c->num_slots = num_slots;
+  c->slot_size = slot_size;
+  return 0;
+}
+
+// acquire the next write slot: waits until the ring has room.
+// returns 0 ok (out_off = payload offset), -1 missing, -5 closed,
+// -6 timeout, -7 payload too large
+int ch_write_begin(int handle, const uint8_t* id, uint64_t size,
+                   uint64_t* out_off, int timeout_ms) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  Entry* e = chan_entry(s, id);
+  if (!e) return -1;
+  Chan* c = chan_at(s, e);
+  if (size > c->slot_size) return -7;
+  // wall-clock deadline: nanosleep(5us) really costs ~50us+ with default
+  // timer slack, so counting iterations would overshoot timeouts ~10x
+  int64_t deadline = timeout_ms >= 0 ? mono_us() + (int64_t)timeout_ms * 1000 : 0;
+  for (;;) {
+    if (c->closed.load(std::memory_order_acquire)) return -5;
+    uint64_t w = c->write_seq.load(std::memory_order_relaxed);
+    uint64_t r = c->read_seq.load(std::memory_order_acquire);
+    if (w - r < c->num_slots) {
+      *out_off = chan_slot_off(e, c, w) + kChanSlotHdr;
+      return 0;
+    }
+    if (timeout_ms >= 0 && mono_us() >= deadline) return -6;
+    chan_pause();
+  }
+}
+
+int ch_write_commit(int handle, const uint8_t* id, uint64_t size) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  Entry* e = chan_entry(s, id);
+  if (!e) return -1;
+  Chan* c = chan_at(s, e);
+  uint64_t w = c->write_seq.load(std::memory_order_relaxed);
+  uint64_t slot_off = chan_slot_off(e, c, w);
+  *reinterpret_cast<uint64_t*>(s->base + slot_off) = size;
+  c->write_seq.store(w + 1, std::memory_order_release);
+  return 0;
+}
+
+// acquire the next readable item. returns 0 ok, -1 missing, -5 closed AND
+// drained, -6 timeout
+int ch_read_begin(int handle, const uint8_t* id, uint64_t* out_off,
+                  uint64_t* out_size, int timeout_ms) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  Entry* e = chan_entry(s, id);
+  if (!e) return -1;
+  Chan* c = chan_at(s, e);
+  int64_t deadline = timeout_ms >= 0 ? mono_us() + (int64_t)timeout_ms * 1000 : 0;
+  for (;;) {
+    uint64_t r = c->read_seq.load(std::memory_order_relaxed);
+    uint64_t w = c->write_seq.load(std::memory_order_acquire);
+    if (w > r) {
+      uint64_t slot_off = chan_slot_off(e, c, r);
+      *out_size = *reinterpret_cast<uint64_t*>(s->base + slot_off);
+      *out_off = slot_off + kChanSlotHdr;
+      return 0;
+    }
+    if (c->closed.load(std::memory_order_acquire)) return -5;
+    if (timeout_ms >= 0 && mono_us() >= deadline) return -6;
+    chan_pause();
+  }
+}
+
+int ch_read_done(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  Entry* e = chan_entry(s, id);
+  if (!e) return -1;
+  Chan* c = chan_at(s, e);
+  c->read_seq.fetch_add(1, std::memory_order_release);
+  return 0;
+}
+
+int ch_close(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  Entry* e = chan_entry(s, id);
+  if (!e) return -1;
+  chan_at(s, e)->closed.store(1, std::memory_order_release);
+  return 0;
+}
+
+int ch_destroy(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return -3;
+  {
+    Entry* e = chan_entry(s, id);
+    if (!e) return -1;
+    chan_at(s, e)->closed.store(1, std::memory_order_release);
+    Guard g(&s->hdr->lock);
+    e->pins = 0;
+  }
+  return ps_delete(handle, id);
+}
 
 }  // extern "C"

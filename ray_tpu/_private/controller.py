@@ -228,6 +228,16 @@ class Controller:
         # object_id -> (buffer, {offset: length})
         self._pending_pushes: dict[ObjectID, tuple[bytearray, dict]] = {}
 
+        # Streaming-generator consumer progress (backpressure): task_id ->
+        # highest item index the consumer has taken. Bounded FIFO.
+        self._stream_consumed: dict[TaskID, int] = {}
+        # Producer-side pins of streamed items: sealed stream items have no
+        # consumer handle yet, so the producer pins them (else the eager
+        # refcount-0 free in _on_object_sealed reclaims them instantly).
+        # The pin transfers to the consumer at stream_consumed_report; any
+        # leftovers release when the completion record is freed.
+        self._stream_pins: dict[TaskID, set[int]] = {}
+
         # Internal KV (GCS KV analog).
         self.kv: dict[tuple[str, bytes], bytes] = {}
         # GCS fault-tolerance analog (reference: RedisStoreClient +
@@ -759,6 +769,24 @@ class Controller:
             if object_id not in self.ref_counts:
                 self._free_object(object_id)
 
+    def _maybe_pin_stream_item(self, object_id: ObjectID):
+        """Pin a freshly-sealed stream item on behalf of its producer (the
+        consumer has no handle yet; without this the refcount-0 eager free
+        reclaims it before the consumer's wait() can see it)."""
+        idx = object_id.return_index()
+        if idx == 0 or object_id.is_put_object():
+            return
+        task_id = object_id.task_id()
+        with self.lock:
+            pt = self.pending_by_id.get(task_id)
+            if pt is None or pt.spec.num_returns != "streaming":
+                return
+            pins = self._stream_pins.setdefault(task_id, set())
+            if idx in pins:
+                return  # retried producer re-putting an item: already pinned
+            self.ref_counts[object_id] += 1
+            pins.add(idx)
+
     # Reference counting -----------------------------------------------------
 
     def add_ref(self, object_id: ObjectID):
@@ -778,6 +806,22 @@ class Controller:
         # concurrent spill repoints the entry after we read 'plasma' and its
         # file is never unlinked
         with self.lock:
+            if not object_id.is_put_object() and object_id.return_index() == 0:
+                # a freed streaming completion record orphans the producer's
+                # pins on never-consumed items — release them too
+                task_id = object_id.task_id()
+                pins = self._stream_pins.pop(task_id, None)
+                if pins:
+                    for idx in pins:
+                        self.remove_ref(ObjectID.for_return(task_id, idx))
+                pt = self.pending_by_id.get(task_id)
+                if pt is not None and pt.spec.num_returns == "streaming":
+                    # consumer abandoned a LIVE stream: -1 tells a
+                    # backpressured producer to stop instead of polling a
+                    # zero count forever
+                    self._stream_consumed[task_id] = -1
+                else:
+                    self._stream_consumed.pop(task_id, None)
             entry = self.memory_store.get([object_id], timeout=0)[0]
             self.memory_store.delete([object_id])
             self.plasma_resident.pop(object_id, None)
@@ -822,10 +866,11 @@ class Controller:
         """Remember the producer spec of every retriable task's returns,
         bounded by ``max_lineage_bytes`` FIFO (reference: task_manager.h:177).
         """
+        n_returns = len(spec.return_ids())  # 1 for "streaming"
         if (
             self.config.max_lineage_bytes <= 0
             or spec.max_retries == 0
-            or spec.num_returns < 1
+            or n_returns < 1
             or spec.task_type == TaskType.ACTOR_CREATION_TASK
         ):
             return
@@ -833,7 +878,7 @@ class Controller:
         for a in spec.args:
             if a[0] == "value" and isinstance(a[1], (bytes, bytearray)):
                 cost += len(a[1])
-        per_return = max(cost // max(spec.num_returns, 1), 1)
+        per_return = max(cost // n_returns, 1)
         with self.lock:
             for oid in spec.return_ids():
                 if oid not in self.lineage:
@@ -1270,8 +1315,9 @@ class Controller:
             pass
 
     def _handle_put(self, handle: WorkerHandle, msg: P.PutObject):
-        if msg.kind == "inline":
-            self.memory_store.put(msg.object_id, ("inline", SerializedObject.from_buffer(msg.payload)))
+        self._maybe_pin_stream_item(msg.object_id)
+        if msg.kind in ("inline", "error"):
+            self.memory_store.put(msg.object_id, (msg.kind, SerializedObject.from_buffer(msg.payload)))
         else:
             shm_name, size = msg.payload
             self._seal_plasma(msg.object_id, shm_name, size)
@@ -1319,6 +1365,39 @@ class Controller:
         if op == "wait":
             object_ids, num_returns, timeout = payload
             return self.memory_store.wait(object_ids, num_returns, timeout)
+        if op == "stream_consumed_report":
+            # consumer progress: feeds backpressure and transfers the
+            # producer's pin of the taken item to the consumer (who has
+            # already add_ref'd it — FIFO on the channel guarantees order)
+            task_id, count = payload
+            with self.lock:
+                if count > self._stream_consumed.get(task_id, 0):
+                    self._stream_consumed[task_id] = count
+                if len(self._stream_consumed) > 4096:
+                    # evict only finished streams: dropping a live counter
+                    # would deadlock its backpressured producer against its
+                    # consumer
+                    for tid in list(self._stream_consumed):
+                        if tid not in self.pending_by_id:
+                            del self._stream_consumed[tid]
+                            if len(self._stream_consumed) <= 4096:
+                                break
+                pins = self._stream_pins.get(task_id)
+                if pins is not None:
+                    for idx in [i for i in pins if i <= count]:
+                        pins.discard(idx)
+                        self.remove_ref(ObjectID.for_return(task_id, idx))
+                    if not pins:
+                        self._stream_pins.pop(task_id, None)
+            return None
+        if op == "stream_consumed_get":
+            with self.lock:
+                return self._stream_consumed.get(payload, 0)
+        if op == "head_arena":
+            # client drivers probe-attach this arena: same-host clients get
+            # the shared-memory data plane, cross-host ones fall back to
+            # chunked push/pull
+            return getattr(self.plasma, "arena_name", None)
         if op == "get_named_actor":
             actor_id = self.get_named_actor(payload)
             if actor_id is None:
@@ -1357,6 +1436,11 @@ class Controller:
             # retried (chaos / transient failures) — writes are idempotent
             # and completion counts only distinct offsets.
             object_id, offset, total, data = payload
+            if self.memory_store.contains(object_id):
+                # retried chunk arriving after the push completed and sealed:
+                # ack without re-opening a pending buffer (it would never
+                # complete and leak `total` bytes)
+                return None
             with self.lock:
                 buf, received = self._pending_pushes.setdefault(
                     object_id, (bytearray(total), {})
@@ -1688,6 +1772,7 @@ class Controller:
                 # actor death); everything else releases at task completion.
                 self._release_task_resources(pt)
             self.pending_by_id.pop(spec.task_id, None)
+            self._stream_consumed.pop(spec.task_id, None)
             self._unpin_task_deps(pt)
             if spec.is_actor_creation():
                 actor = self.actors.get(spec.actor_id)

@@ -44,7 +44,10 @@ class TaskSpec:
     # Args: list of either ("value", SerializedObject-bytes) or ("ref", ObjectID).
     args: list
     kwargs_included: bool  # args holds a single (args_tuple, kwargs_dict) payload
-    num_returns: int
+    # int, or "streaming" for generator tasks (reference: num_returns
+    # "streaming"/"dynamic", python/ray/remote_function.py): yielded item i is
+    # sealed eagerly at return index i+1; index 0 is the completion record.
+    num_returns: Any
     resources: dict[str, float]
     max_retries: int = 0
     retry_exceptions: bool = False
@@ -60,8 +63,14 @@ class TaskSpec:
     seq_no: int = 0
     # Runtime env (env vars for now; full runtime-env plugins later).
     runtime_env: Optional[dict] = None
+    # Streaming generators: max yielded-but-unconsumed items before the
+    # producer blocks; 0 = unbounded (reference:
+    # _generator_backpressure_num_objects, python/ray/remote_function.py).
+    generator_backpressure: int = 0
 
     def return_ids(self) -> list[ObjectID]:
+        if self.num_returns == "streaming":
+            return [ObjectID.for_return(self.task_id, 0)]
         return [ObjectID.for_return(self.task_id, i) for i in range(self.num_returns)]
 
     def is_actor_task(self) -> bool:

@@ -111,12 +111,13 @@ class WorkerAPI:
         kwargs: dict,
         *,
         name: str,
-        num_returns: int = 1,
+        num_returns=1,
         resources: dict[str, float] | None = None,
         max_retries: int = 0,
         strategy: SchedulingStrategy | None = None,
         runtime_env: dict | None = None,
         function_blob: bytes | None = None,
+        generator_backpressure: int = 0,
     ) -> list[ObjectRef]:
         idx = self._next_submit_index()
         task_id = TaskID.for_task(self.job_id, None, idx)
@@ -134,6 +135,7 @@ class WorkerAPI:
             max_retries=max_retries,
             strategy=strategy or SchedulingStrategy(),
             runtime_env=runtime_env,
+            generator_backpressure=generator_backpressure,
         )
         return_ids = spec.return_ids()
         self.add_refs(return_ids)
@@ -187,9 +189,10 @@ class WorkerAPI:
         kwargs: dict,
         *,
         name: str,
-        num_returns: int = 1,
+        num_returns=1,
         seq_no: int = 0,
         max_retries: int = 0,
+        generator_backpressure: int = 0,
     ) -> list[ObjectRef]:
         idx = self._next_submit_index()
         task_id = TaskID.for_task(self.job_id, TaskID.for_actor_creation(actor_id), idx)
@@ -206,6 +209,7 @@ class WorkerAPI:
             actor_id=actor_id,
             seq_no=seq_no,
             max_retries=max_retries,
+            generator_backpressure=generator_backpressure,
         )
         return_ids = spec.return_ids()
         self.add_refs(return_ids)
@@ -244,6 +248,17 @@ class WorkerAPI:
         return ref
 
     def get(self, refs, timeout: Optional[float] = None):
+        from ray_tpu.dag.compiled_dag import _CompiledResult
+        from ray_tpu.object_ref import ObjectRefGenerator
+
+        if isinstance(refs, _CompiledResult):
+            # compiled-graph result (reference: ray.get on CompiledDAGRef)
+            return refs.get(timeout)
+        if isinstance(refs, ObjectRefGenerator):
+            raise TypeError(
+                "ray_tpu.get on an ObjectRefGenerator is not allowed; "
+                "iterate it and get() each yielded ObjectRef"
+            )
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
         for r in ref_list:
@@ -521,6 +536,22 @@ def _connect_client(address: str) -> "WorkerAPI":
         target=runtime.run, daemon=True, name="client-driver-pump"
     )
     pump.start()
+    if not os.environ.get("RAY_TPU_ARENA") and not os.environ.get(
+        "RAY_TPU_NO_ARENA_ATTACH"
+    ):
+        # same-host clients ride shared memory for large puts/gets; the
+        # attach probe fails cleanly on another host and the chunked
+        # push/pull protocol takes over (RAY_TPU_NO_ARENA_ATTACH forces the
+        # cross-host path — used by tests simulating a remote client)
+        try:
+            arena = runtime.call_controller("head_arena", None)
+            if arena:
+                from ray_tpu._native.plasma import NativeArena
+
+                NativeArena(arena).close()
+                os.environ["RAY_TPU_ARENA"] = arena
+        except Exception:
+            pass
     api = WorkerProcAPI(runtime)
     api.is_client = True
     return api

@@ -35,6 +35,11 @@ from ray_tpu.exceptions import TaskError
 
 _INLINE_LIMIT_ENV = "RAY_TPU_MAX_INLINE_OBJECT_SIZE"
 
+
+class StreamConsumerGone(Exception):
+    """The consumer of a streaming generator freed its ObjectRefGenerator
+    while the (backpressured) producer was still running."""
+
 # Per-thread execution context: which actor's task is running on this thread.
 # Tasks execute wholly on one thread (worker loop thread, actor pool thread,
 # or thread-mode worker thread), so a threading.local is exact — unlike
@@ -202,7 +207,10 @@ class WorkerRuntime:
         req_id = next(self._req_counter)
         self._send(P.GetObjects(req_id, object_ids))
         results = self._await_reply(req_id, timeout)
-        return [(self._materialize(kind, payload), kind) for _, kind, payload in results]
+        return [
+            (self._materialize(kind, payload, object_id=oid), kind)
+            for oid, kind, payload in results
+        ]
 
     def _await_reply(self, req_id: int, timeout=None):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -234,7 +242,7 @@ class WorkerRuntime:
             raise RuntimeError(f"controller call {op} failed: {reply.error}")
         return reply.payload
 
-    def _materialize(self, kind, payload) -> SerializedObject:
+    def _materialize(self, kind, payload, object_id=None) -> SerializedObject:
         from ray_tpu._native.plasma import NativePlasmaError
         from ray_tpu._private.object_store import (
             ObjectRelocatedError,
@@ -247,8 +255,17 @@ class WorkerRuntime:
                 return SerializedObject.from_buffer(payload)
             if kind == "spilled":
                 path, size = payload
-                with open(path, "rb") as f:
-                    return SerializedObject.from_buffer(f.read())
+                try:
+                    with open(path, "rb") as f:
+                        return SerializedObject.from_buffer(f.read())
+                except OSError:
+                    # spill file lives on the head's filesystem — a cross-host
+                    # client pulls it through the chunk protocol instead
+                    if object_id is None:
+                        raise
+                    return SerializedObject.from_buffer(
+                        self._pull_object(object_id, size)
+                    )
             shm_name, size = payload
             loc = parse_arena_location(shm_name)
             pullable = loc is not None and loc[2] is not None
@@ -438,11 +455,17 @@ class WorkerRuntime:
         try:
             args, kwargs = self._deserialize_args(spec, msg.resolved_args)
             instance = self.actors[spec.actor_id.binary()]
-            method = getattr(instance, spec.method_name)
-            value = method(*args, **kwargs)
+            if spec.method_name == "__rtpu_call__":
+                value = args[0](instance, *args[1:], **kwargs)
+            else:
+                method = getattr(instance, spec.method_name)
+                value = method(*args, **kwargs)
             if asyncio.iscoroutine(value):
                 value = await value
-            results = self._store_returns(spec, value)
+            if spec.num_returns == "streaming" and hasattr(value, "__anext__"):
+                results = await self._stream_returns_async(spec, value)
+            else:
+                results = self._store_returns(spec, value)
         except BaseException as e:  # noqa: BLE001
             results = self._store_error(spec, e)
         exec_ms = (time.monotonic() - start) * 1e3
@@ -474,11 +497,18 @@ class WorkerRuntime:
             return None
         # ACTOR_TASK
         instance = self.actors[spec.actor_id.binary()]
+        if spec.method_name == "__rtpu_call__":
+            # run an arbitrary function against the actor instance
+            # (reference: ``__ray_call__``, used by compiled-graph loops)
+            fn = args[0]
+            return fn(instance, *args[1:], **kwargs)
         method = getattr(instance, spec.method_name)
         return method(*args, **kwargs)
 
     def _store_returns(self, spec: TaskSpec, value) -> list:
         return_ids = spec.return_ids()
+        if spec.num_returns == "streaming":
+            return self._stream_returns(spec, value)
         if spec.num_returns == 1:
             values = [value]
         else:
@@ -497,6 +527,89 @@ class WorkerRuntime:
                 name, size = self._write_shm(oid, sobj)
                 results.append((oid, "plasma", (name, size)))
         return results
+
+    def _stream_returns(self, spec: TaskSpec, value) -> list:
+        """Execute a streaming-generator task: seal each yielded item into the
+        store as it is produced (item i → return index i+1), then report the
+        completion record (total count) at index 0 via the final TaskDone.
+
+        Reference: the streaming-generator execution path in
+        ``_raylet.pyx`` (``execute_streaming_generator_sync``) — items are
+        reported to the owner eagerly, not batched at task end.
+        """
+        if not hasattr(value, "__next__"):
+            raise TypeError(
+                f"streaming task {spec.name} must return a generator, "
+                f"got {type(value).__name__}"
+            )
+        count = 0
+        try:
+            for item in value:
+                count += 1
+                oid = ObjectID.for_return(spec.task_id, count)
+                self.put_serialized(oid, self.serialization.serialize(item))
+                self._stream_backpressure(spec, count)
+        except BaseException as e:  # noqa: BLE001 — surface at the fail point
+            count = self._seal_stream_error(spec, count, e)
+        return self._stream_completion(spec, count)
+
+    def _seal_stream_error(self, spec: TaskSpec, count: int, exc) -> int:
+        """Seal a mid-stream error as the FINAL stream item: consumers drain
+        every good item, raise on this one, then see StopIteration. The
+        completion record still resolves to the count — only external
+        failures (worker crash, cancel) surface through it."""
+        count += 1
+        payload = self._store_error(spec, exc)[0][2]
+        oid = ObjectID.for_return(spec.task_id, count)
+        req_id = next(self._req_counter)
+        self._send(P.PutObject(req_id, oid, "error", payload))
+        self._await_reply(req_id)
+        return count
+
+    def _stream_completion(self, spec: TaskSpec, count: int) -> list:
+        gen_id = ObjectID.for_return(spec.task_id, 0)
+        sobj = self.serialization.serialize(count)
+        return [(gen_id, "inline", sobj.to_bytes())]
+
+    def _stream_backpressure(self, spec: TaskSpec, produced: int):
+        """Block while produced - consumed >= the backpressure threshold."""
+        if not spec.generator_backpressure:
+            return
+        delay = 0.002
+        while True:
+            consumed = self.call_controller("stream_consumed_get", spec.task_id)
+            if consumed < 0:
+                # the consumer freed the generator: stop producing rather
+                # than poll a dead stream forever
+                raise StreamConsumerGone(
+                    f"stream consumer for {spec.name} is gone"
+                )
+            if produced - consumed < spec.generator_backpressure:
+                return
+            # backoff: a long-stalled consumer must not saturate the shared
+            # control channel with poll RPCs
+            time.sleep(delay)
+            delay = min(delay * 1.6, 0.1)
+
+    async def _stream_returns_async(self, spec: TaskSpec, agen) -> list:
+        """Async-actor variant of ``_stream_returns`` for async generators."""
+        count = 0
+        loop = asyncio.get_running_loop()
+        try:
+            async for item in agen:
+                count += 1
+                oid = ObjectID.for_return(spec.task_id, count)
+                sobj = self.serialization.serialize(item)
+                await loop.run_in_executor(None, self.put_serialized, oid, sobj)
+                if spec.generator_backpressure:
+                    await loop.run_in_executor(
+                        None, self._stream_backpressure, spec, count
+                    )
+        except BaseException as e:  # noqa: BLE001
+            count = await loop.run_in_executor(
+                None, self._seal_stream_error, spec, count, e
+            )
+        return self._stream_completion(spec, count)
 
     def _store_error(self, spec: TaskSpec, exc: BaseException) -> list:
         if isinstance(exc, TaskError):

@@ -16,8 +16,9 @@ class ActorMethod:
         self,
         handle: "ActorHandle",
         method_name: str,
-        num_returns: int = 1,
+        num_returns=1,
         max_retries: int = 0,
+        generator_backpressure: int = 0,
     ):
         self._handle = handle
         self._method_name = method_name
@@ -25,9 +26,22 @@ class ActorMethod:
         # retriable actor tasks are also lineage-reconstructable (reference:
         # max_task_retries on actor methods, task_manager.h)
         self._max_retries = max_retries
+        self._generator_backpressure = generator_backpressure
 
-    def options(self, num_returns: int = 1, max_retries: int = 0, **_):
-        return ActorMethod(self._handle, self._method_name, num_returns, max_retries)
+    def options(
+        self,
+        num_returns=1,
+        max_retries: int = 0,
+        _generator_backpressure_num_objects: int = 0,
+        **_,
+    ):
+        return ActorMethod(
+            self._handle,
+            self._method_name,
+            num_returns,
+            max_retries,
+            _generator_backpressure_num_objects,
+        )
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(
@@ -36,6 +50,7 @@ class ActorMethod:
             kwargs,
             num_returns=self._num_returns,
             max_retries=self._max_retries,
+            generator_backpressure=self._generator_backpressure,
         )
 
     def bind(self, *args, **kwargs):
@@ -68,7 +83,15 @@ class ActorHandle:
             )
         return ActorMethod(self, item)
 
-    def _submit_method(self, method_name, args, kwargs, num_returns=1, max_retries=0):
+    def _submit_method(
+        self,
+        method_name,
+        args,
+        kwargs,
+        num_returns=1,
+        max_retries=0,
+        generator_backpressure=0,
+    ):
         from ray_tpu._private.worker import global_worker
 
         with self._seq_lock:
@@ -83,8 +106,18 @@ class ActorHandle:
             num_returns=num_returns,
             seq_no=seq,
             max_retries=max_retries,
+            generator_backpressure=generator_backpressure,
         )
+        if num_returns == "streaming":
+            from ray_tpu.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0])
         return refs[0] if num_returns == 1 else refs
+
+    def _call_fn(self, fn, *args, **kwargs):
+        """Run ``fn(instance, *args, **kwargs)`` on the actor (reference:
+        ``actor.__ray_call__`` — used by compiled-graph executor loops)."""
+        return self._submit_method("__rtpu_call__", (fn,) + args, kwargs)
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"

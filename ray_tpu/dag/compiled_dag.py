@@ -1,13 +1,17 @@
-"""Compiled DAG: pre-planned execution schedule.
+"""Compiled DAG: pre-allocated channels + persistent actor executor loops.
 
 Reference: ``python/ray/dag/compiled_dag_node.py:809`` (CompiledDAG) +
-``dag_node_operation.py`` (execution-schedule builder). The reference
-pre-allocates shared-memory/NCCL channels between actors; here compilation
-precomputes the topological schedule + arg-resolution plan once, so each
-``execute`` is a straight loop of actor submissions with zero graph walking
-— payloads ride the shared-memory object plane. (The accelerator-channel
-analog on TPU is in-program ICI: a multi-stage pjit program; see
-``ray_tpu.parallel.pipeline``.)
+``dag_node_operation.py`` (execution-schedule builder). Like the reference,
+compilation pre-allocates shared-memory channels between the participating
+actors and starts a long-running executor loop on each (via the
+``__ray_call__`` analog ``ActorHandle._call_fn``); each ``execute()`` then
+writes the input into the entry channels and reads the result from the exit
+channel — zero task submissions, zero controller RPCs on the hot path.
+(The accelerator-channel analog on TPU is in-program ICI: a multi-stage pjit
+program; see ``ray_tpu.parallel.pipeline``.)
+
+Falls back to the pre-planned per-execute task-submission schedule when the
+graph contains plain function nodes or the native arena is unavailable.
 """
 
 from __future__ import annotations
@@ -15,6 +19,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
     DAGNode,
     InputAttributeNode,
     InputNode,
@@ -22,14 +27,120 @@ from ray_tpu.dag.dag_node import (
 )
 
 
+class _DagError:
+    """An upstream node's failure, propagated through channels."""
+
+    def __init__(self, err: BaseException, node_name: str):
+        self.err = err
+        self.node_name = node_name
+
+
+class _ChannelsUnavailable(Exception):
+    pass
+
+
+def _dag_spawn_loop(instance, node_specs, close_channels, exit_ch):
+    """Start the executor loop on a BACKGROUND thread in the actor process
+    (reference: compiled-graph loops run on a dedicated concurrency group so
+    the actor keeps serving normal calls). The thread exits when an input
+    channel closes and acks through ``exit_ch`` so teardown can safely
+    destroy the rings."""
+    import threading
+
+    def run():
+        try:
+            _dag_actor_loop(instance, node_specs, close_channels)
+        finally:
+            try:
+                exit_ch.write(True, timeout_s=5)
+            except Exception:
+                pass
+
+    threading.Thread(target=run, daemon=True, name="dag-loop").start()
+    return True
+
+
+def _dag_actor_loop(instance, node_specs, close_channels):
+    """Persistent executor loop running ON the actor (reference: the
+    compiled-graph executor loop submitted via ``actor.__ray_call__``).
+
+    ``node_specs``: this actor's DAG nodes in topological order, each
+    ``(method_name, arg_plan, kwarg_plan, out_channels)`` where plan entries
+    are ``("chan", Channel)`` / ``("const", value)`` / ``("local", i)`` (the
+    i-th node's output from the SAME tick — same-actor edges skip channels).
+    One tick = one ``execute()``: read every input channel once, run the
+    methods, write every output channel once. Exits when an input channel
+    closes, then closes its own outputs (teardown cascades downstream).
+    """
+    from ray_tpu.experimental.channel import ChannelClosedError
+
+    def resolve(plan, locals_):
+        vals = []
+        for kind, v in plan:
+            if kind == "chan":
+                vals.append(v.read())
+            elif kind == "local":
+                vals.append(locals_[v])
+            else:
+                vals.append(v)
+        return vals
+
+    try:
+        while True:
+            locals_: list[Any] = []
+            try:
+                for method_name, arg_plan, kwarg_plan, out_channels in node_specs:
+                    args = resolve(arg_plan, locals_)
+                    kwargs = dict(
+                        zip(kwarg_plan.keys(),
+                            resolve(list(kwarg_plan.values()), locals_))
+                    )
+                    upstream_err = next(
+                        (a for a in list(args) + list(kwargs.values())
+                         if isinstance(a, _DagError)),
+                        None,
+                    )
+                    if upstream_err is not None:
+                        out = upstream_err
+                    else:
+                        try:
+                            out = getattr(instance, method_name)(*args, **kwargs)
+                        except BaseException as e:  # noqa: BLE001 — propagate
+                            out = _DagError(e, method_name)
+                    locals_.append(out)
+                    for ch in out_channels:
+                        ch.write(out)
+            except ChannelClosedError:
+                return  # teardown signal
+    finally:
+        for ch in close_channels:
+            ch.close()
+
+
+class _CompiledResult:
+    """Handle for one execute()'s output (reference: CompiledDAGRef)."""
+
+    def __init__(self, dag: "CompiledDAG"):
+        self._dag = dag
+        self._value: Any = None
+        self._done = False
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        # results complete strictly in submission order (SPSC channels), so
+        # draining earlier pending results first preserves correctness
+        while not self._done:
+            self._dag._drain_next(timeout)
+        if isinstance(self._value, _DagError):
+            raise self._value.err
+        return self._value
+
+
 class CompiledDAG:
-    def __init__(self, root: DAGNode):
+    def __init__(self, root: DAGNode, *, buffer_size_bytes: int = 4 << 20):
         self._root = root
         self._schedule = root.topological()
-        # plan: per node, the positional indices of its DAGNode args resolved
-        # to schedule positions (arg resolution with no isinstance checks at
-        # execute time)
         self._index = {id(n): i for i, n in enumerate(self._schedule)}
+        # legacy plan (always built — the fallback execution path)
         self._plans = []
         for node in self._schedule:
             arg_plan = []
@@ -46,7 +157,205 @@ class CompiledDAG:
                     kwarg_plan[k] = ("const", v)
             self._plans.append((node, arg_plan, kwarg_plan))
 
+        self._channel_mode = False
+        self._torn_down = False
+        self._pending: list[_CompiledResult] = []
+        self._partial_outs: list[Any] = []
+        self._all_channels: list = []
+        try:
+            self._compile_channels(buffer_size_bytes)
+            self._channel_mode = True
+        except BaseException as e:
+            # channels are created pinned (LRU-immune): a partial compile
+            # must free them or repeated failed compiles exhaust the arena.
+            # Loops are spawned only after full validation, so none exist yet.
+            for ch in self._all_channels:
+                ch.destroy()
+            self._all_channels = []
+            if not isinstance(e, _ChannelsUnavailable):
+                raise
+
+    # -- channel compilation -------------------------------------------------
+
+    def _compile_channels(self, buffer_size_bytes: int):
+        import os
+
+        import ray_tpu
+
+        if not os.environ.get("RAY_TPU_ARENA"):
+            raise _ChannelsUnavailable("native arena store not active")
+        actor_nodes: list[ClassMethodNode] = []
+        for n in self._schedule:
+            if isinstance(n, (InputNode, InputAttributeNode, MultiOutputNode)):
+                continue
+            if isinstance(n, ClassMethodNode):
+                actor_nodes.append(n)
+            else:
+                raise _ChannelsUnavailable(
+                    "channel mode needs an all-actor graph"
+                )
+        if not actor_nodes:
+            raise _ChannelsUnavailable("no actor nodes")
+
+        from ray_tpu.experimental.channel import Channel
+
+        def new_chan():
+            ch = Channel.create(slot_size=buffer_size_bytes, num_slots=2)
+            self._all_channels.append(ch)
+            return ch
+
+        # per consumed edge (consumer node, producer node) -> Channel;
+        # driver-written channels keyed by the producing input node
+        self._driver_out: list[tuple[DAGNode, Any]] = []  # (input node, chan)
+
+        def actor_of(n: ClassMethodNode):
+            return n._actor_method._handle
+
+        # plan entries for a consumer's single argument
+        def edge_plan(consumer: ClassMethodNode, arg):
+            if not isinstance(arg, DAGNode):
+                return ("const", arg)
+            if isinstance(arg, (InputNode, InputAttributeNode)):
+                ch = new_chan()
+                self._driver_out.append((arg, ch))
+                return ("chan", ch)
+            if isinstance(arg, ClassMethodNode):
+                if actor_of(arg)._actor_id == actor_of(consumer)._actor_id:
+                    # same-actor edge: pass locally inside the loop
+                    return ("local", per_actor_index[id(arg)])
+                ch = new_chan()
+                producer_outs[id(arg)].append(ch)
+                return ("chan", ch)
+            raise _ChannelsUnavailable(f"unsupported arg node {type(arg)}")
+
+        producer_outs: dict[int, list] = {id(n): [] for n in actor_nodes}
+        per_actor_index: dict[int, int] = {}
+        by_actor: dict[bytes, list[ClassMethodNode]] = {}
+        for n in actor_nodes:
+            key = actor_of(n)._actor_id.binary()
+            per_actor_index[id(n)] = len(by_actor.setdefault(key, []))
+            by_actor[key].append(n)
+
+        node_plans: dict[int, tuple] = {}
+        for n in actor_nodes:
+            arg_plan = [edge_plan(n, a) for a in n._bound_args]
+            kwarg_plan = {
+                k: edge_plan(n, v) for k, v in n._bound_kwargs.items()
+            }
+            node_plans[id(n)] = (arg_plan, kwarg_plan)
+
+        # exit channels: root's producers stream to the driver
+        root = self._root
+        if isinstance(root, MultiOutputNode):
+            outputs = [a for a in root._bound_args]
+        else:
+            outputs = [root]
+        self._exit_channels = []
+        for out in outputs:
+            if not isinstance(out, ClassMethodNode):
+                raise _ChannelsUnavailable("DAG output must be an actor node")
+            ch = new_chan()
+            producer_outs[id(out)].append(ch)
+            self._exit_channels.append(ch)
+
+        # build + VALIDATE every actor's loop plan before spawning any loop:
+        # a validation failure after a partial spawn would strand executor
+        # threads the fallback path can never reach
+        to_spawn = []
+        self._exit_acks: list = []
+        self._loop_input_channels: list = []
+        for key, nodes in by_actor.items():
+            specs = []
+            in_chans = []
+            for n in nodes:
+                arg_plan, kwarg_plan = node_plans[id(n)]
+                for kind, v in list(arg_plan) + list(kwarg_plan.values()):
+                    if kind == "chan":
+                        in_chans.append(v)
+                specs.append(
+                    (
+                        n._actor_method._method_name,
+                        arg_plan,
+                        kwarg_plan,
+                        producer_outs[id(n)],
+                    )
+                )
+            if not in_chans:
+                raise _ChannelsUnavailable(
+                    "an actor node without channel inputs would free-run"
+                )
+            close_channels = [ch for n in nodes for ch in producer_outs[id(n)]]
+            to_spawn.append((actor_of(nodes[0]), specs, close_channels, in_chans))
+        spawn_refs = []
+        for handle, specs, close_channels, in_chans in to_spawn:
+            exit_ch = Channel.create(slot_size=64, num_slots=1)
+            self._all_channels.append(exit_ch)
+            self._exit_acks.append(exit_ch)
+            spawn_refs.append(
+                handle._call_fn(
+                    _dag_spawn_loop, specs, close_channels, exit_ch
+                )
+            )
+            self._loop_input_channels.extend(in_chans)
+        # surface spawn failures at compile time, not first execute
+        ray_tpu.get(spawn_refs, timeout=60)
+        self._multi_output = isinstance(root, MultiOutputNode)
+        self._ray = ray_tpu
+
+    # -- execution -----------------------------------------------------------
+
     def execute(self, *input_args, **input_kwargs):
+        if self._torn_down:
+            raise RuntimeError("CompiledDAG was torn down")
+        if not self._channel_mode:
+            return self._execute_legacy(input_args, input_kwargs)
+        if input_args and input_kwargs:
+            raise ValueError(
+                "execute() takes positional OR keyword inputs, not both"
+            )
+        if len(input_args) == 1:
+            base = input_args[0]
+        elif input_kwargs:
+            base = dict(input_kwargs)
+        else:
+            base = input_args
+        for src, ch in self._driver_out:
+            if isinstance(src, InputAttributeNode):
+                key = src._key
+                value = (
+                    base[key]
+                    if isinstance(base, dict) or isinstance(key, int)
+                    else getattr(base, key)
+                )
+            else:
+                value = base
+            ch.write(value, timeout_s=60.0)
+        res = _CompiledResult(self)
+        self._pending.append(res)
+        return res
+
+    def _drain_next(self, timeout: Optional[float]):
+        """Complete the OLDEST pending execute by reading the exit
+        channel(s) — results arrive strictly in submission order. Partial
+        reads persist in ``_partial_outs`` so a timeout mid-tick neither
+        drops the pending result nor desyncs the exit channels: a retried
+        get() resumes exactly where the last attempt stopped."""
+        if not self._pending:
+            raise RuntimeError("no pending compiled-DAG executions")
+        res = self._pending[0]
+        while len(self._partial_outs) < len(self._exit_channels):
+            ch = self._exit_channels[len(self._partial_outs)]
+            self._partial_outs.append(ch.read(timeout_s=timeout))
+        outs, self._partial_outs = self._partial_outs, []
+        self._pending.pop(0)
+        err = next((o for o in outs if isinstance(o, _DagError)), None)
+        if err is not None:
+            res._value = err
+        else:
+            res._value = outs if self._multi_output else outs[0]
+        res._done = True
+
+    def _execute_legacy(self, input_args, input_kwargs):
         slots: list[Any] = [None] * len(self._schedule)
         for i, (node, arg_plan, kwarg_plan) in enumerate(self._plans):
             if isinstance(node, InputNode):
@@ -77,8 +386,27 @@ class CompiledDAG:
         return slots[-1]
 
     def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        if self._channel_mode:
+            # closing every data channel unblocks all loops wherever they
+            # block (reads AND writes); each loop acks its exit before the
+            # rings are destroyed
+            acks = set(id(c) for c in self._exit_acks)
+            for ch in self._all_channels:
+                if id(ch) not in acks:
+                    ch.close()
+            for ack in self._exit_acks:
+                try:
+                    ack.read(timeout_s=10)
+                except Exception:
+                    pass
+            for ch in self._all_channels:
+                ch.destroy()
         self._plans = []
         self._schedule = []
 
     def __repr__(self):
-        return f"CompiledDAG(num_nodes={len(self._schedule)})"
+        mode = "channels" if self._channel_mode else "tasks"
+        return f"CompiledDAG(num_nodes={len(self._schedule)}, mode={mode})"

@@ -19,12 +19,28 @@ from ray_tpu.data.block import Block, BlockAccessor
 class ReadTask:
     """A serializable zero-arg callable producing one block."""
 
+    streaming = False
+
     def __init__(self, fn: Callable[[], Block], metadata: Optional[dict] = None):
         self._fn = fn
         self.metadata = metadata or {}
 
     def __call__(self) -> Block:
         return BlockAccessor.normalize(self._fn())
+
+
+class StreamingReadTask(ReadTask):
+    """A read task producing MULTIPLE blocks lazily. The executor runs it as
+    a streaming-generator task: each block seals into the store as the reader
+    produces it, so one giant file never materializes as one giant block
+    (reference: ReadTasks returning Iterable[Block], executed via streaming
+    generators — ``python/ray/data/_internal/planner/plan_read_op.py``)."""
+
+    streaming = True
+
+    def iter_blocks(self):
+        for b in self._fn():
+            yield BlockAccessor.normalize(b)
 
 
 class Datasource:
@@ -135,10 +151,34 @@ class FileBasedDatasource(Datasource):
 
 
 class CSVDatasource(FileBasedDatasource):
+    """``chunk_rows=N`` streams each file as ceil(rows/N) blocks via a
+    streaming read task instead of one block per file."""
+
+    def __init__(self, paths, chunk_rows: Optional[int] = None, **reader_kwargs):
+        super().__init__(paths, **reader_kwargs)
+        self.chunk_rows = chunk_rows
+
     def _read_file(self, path: str) -> Block:
         import pandas as pd
 
         return BlockAccessor.normalize(pd.read_csv(path, **self.reader_kwargs))
+
+    def _read_file_chunks(self, path: str):
+        import pandas as pd
+
+        with pd.read_csv(
+            path, chunksize=self.chunk_rows, **self.reader_kwargs
+        ) as reader:
+            for df in reader:
+                yield BlockAccessor.normalize(df)
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        if self.chunk_rows is None:
+            return super().get_read_tasks(parallelism)
+        return [
+            StreamingReadTask(lambda p=p: self._read_file_chunks(p), {"path": p})
+            for p in self.paths
+        ]
 
 
 class JSONDatasource(FileBasedDatasource):

@@ -64,6 +64,14 @@ def _read_block(read_task, transforms):
     return _apply_transforms(read_task(), transforms)
 
 
+@ray_tpu.remote(num_returns="streaming")
+def _read_blocks_streaming(read_task, transforms):
+    """Multi-block read task: each produced block seals as the reader yields
+    it (streaming-generator return path)."""
+    for b in read_task.iter_blocks():
+        yield _apply_transforms(b, transforms)
+
+
 @ray_tpu.remote
 def _transform_block(block, transforms):
     return _apply_transforms(block, transforms)
@@ -142,11 +150,19 @@ def _sample_block(block, key, k):
 # -- streaming driver --------------------------------------------------------
 
 
-def _read_submits(tasks, transforms):
+def _read_submits(tasks, transforms, backpressure=8):
     """Submit thunks with `transforms` bound NOW — the executor's loop
     variable gets rebound per stage, and these generators run lazily."""
     for t in tasks:
-        yield lambda t=t: _read_block.remote(t, transforms)
+        if getattr(t, "streaming", False):
+            # bound the producer's lead so a big file doesn't seal every
+            # chunk into the store ahead of a slow consumer
+            yield lambda t=t: _read_blocks_streaming.options(
+                num_returns="streaming",
+                _generator_backpressure_num_objects=backpressure,
+            ).remote(t, transforms)
+        else:
+            yield lambda t=t: _read_block.remote(t, transforms)
 
 
 def _transform_submits(refs, transforms):
@@ -168,6 +184,8 @@ class StreamingExecutor:
         pending: deque = deque()
         exhausted = False
         it = iter(submit_iter)
+        from ray_tpu.object_ref import ObjectRefGenerator
+
         while pending or not exhausted:
             while not exhausted and len(pending) < cap:
                 try:
@@ -175,7 +193,13 @@ class StreamingExecutor:
                 except StopIteration:
                     exhausted = True
             if pending:
-                yield pending.popleft()
+                head = pending.popleft()
+                if isinstance(head, ObjectRefGenerator):
+                    # streaming read task: its block refs flatten into the
+                    # stage output in production order
+                    yield from head
+                else:
+                    yield head
 
     def execute(self, plan: L.LogicalPlan) -> Iterator[Any]:
         """Returns an iterator of block refs."""
@@ -194,7 +218,13 @@ class StreamingExecutor:
                             int(ray_tpu.cluster_resources().get("CPU", 4)) * 2, 8
                         )
                     tasks = op.datasource.get_read_tasks(parallelism)
-                    stream = self._stream_stage(_read_submits(tasks, transforms))
+                    stream = self._stream_stage(
+                        _read_submits(
+                            tasks,
+                            transforms,
+                            backpressure=self.ctx.max_tasks_in_flight,
+                        )
+                    )
                 else:
                     refs = op.refs
                     if transforms:

@@ -1,0 +1,132 @@
+"""Mutable shared-memory channels — the compiled-graph data plane.
+
+Reference: ``python/ray/experimental/channel/shared_memory_channel.py:91``
+(Channel over mutable plasma objects) +
+``src/ray/core_worker/experimental_mutable_object_manager.h``
+(WriteAcquire/WriteRelease + ReadAcquire/ReadRelease versioning). Here a
+channel is a lock-free SPSC ring allocated inside the node's native arena
+(``_native/plasma_store.cc`` ``ch_*`` ABI): the writer process serializes
+into the ring slot, the reader deserializes out of it — no controller RPC,
+no per-message allocation, no task submission on the hot path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu._native.plasma import NativeArena
+
+
+class ChannelClosedError(Exception):
+    """The peer closed the channel (normal teardown signal)."""
+
+
+# One NativeArena handle per (process, arena): the native handle table is
+# bounded (kMaxStores), so per-Channel attaches would exhaust it. Entries for
+# arenas whose shm segment has been unlinked (dead clusters) are purged so
+# long-lived processes don't pin dead arena memory.
+_arena_cache: dict[str, NativeArena] = {}
+_arena_lock = threading.Lock()
+
+
+def _shared_arena(name: str) -> NativeArena:
+    with _arena_lock:
+        for n in list(_arena_cache):
+            if n != name and not os.path.exists("/dev/shm/" + n.lstrip("/")):
+                _arena_cache.pop(n).close()
+        arena = _arena_cache.get(name)
+        if arena is None:
+            arena = NativeArena(name)
+            _arena_cache[name] = arena
+        return arena
+
+
+def _ms(timeout_s: Optional[float]) -> int:
+    return -1 if timeout_s is None else max(int(timeout_s * 1000), 0)
+
+
+class Channel:
+    """One single-writer single-reader mutable channel.
+
+    Pickles to its (id, arena, geometry) descriptor: any process on the node
+    that can attach the arena can be the writer or the reader.
+    """
+
+    def __init__(
+        self, chan_id: bytes, arena_name: str, slot_size: int, num_slots: int
+    ):
+        self._chan_id = chan_id
+        self._arena_name = arena_name
+        self._slot_size = slot_size
+        self._num_slots = num_slots
+        self._arena: Optional[NativeArena] = None
+
+    @classmethod
+    def create(cls, slot_size: int = 4 << 20, num_slots: int = 2) -> "Channel":
+        arena_name = os.environ.get("RAY_TPU_ARENA")
+        if not arena_name:
+            raise RuntimeError(
+                "mutable channels require the native arena store "
+                "(config use_native_plasma=True)"
+            )
+        chan_id = os.urandom(28)
+        ch = cls(chan_id, arena_name, slot_size, num_slots)
+        ch._attach().ch_create(chan_id, slot_size, num_slots)
+        return ch
+
+    def _attach(self) -> NativeArena:
+        if self._arena is None:
+            self._arena = _shared_arena(self._arena_name)
+        return self._arena
+
+    def write(self, value: Any, timeout_s: Optional[float] = None) -> None:
+        data = cloudpickle.dumps(value)
+        if len(data) > self._slot_size:
+            raise ValueError(
+                f"serialized value ({len(data)} B) exceeds the channel slot "
+                f"size ({self._slot_size} B); recompile with a larger "
+                f"buffer_size_bytes"
+            )
+        try:
+            self._attach().ch_write(self._chan_id, data, _ms(timeout_s))
+        except NativeArena.ChannelClosed:
+            raise ChannelClosedError("channel closed") from None
+        except NativeArena.ChannelTimeout:
+            raise TimeoutError(f"channel write timed out after {timeout_s}s") from None
+
+    def read(self, timeout_s: Optional[float] = None) -> Any:
+        try:
+            data = self._attach().ch_read(self._chan_id, _ms(timeout_s))
+        except NativeArena.ChannelClosed:
+            raise ChannelClosedError("channel closed") from None
+        except NativeArena.ChannelTimeout:
+            raise TimeoutError(f"channel read timed out after {timeout_s}s") from None
+        return cloudpickle.loads(data)
+
+    def close(self) -> None:
+        """Signal EOF: blocked/future reads raise ChannelClosedError once
+        drained; writes fail immediately."""
+        try:
+            self._attach().ch_close(self._chan_id)
+        except Exception:
+            pass
+
+    def destroy(self) -> None:
+        """Close and free the ring's arena block."""
+        try:
+            self._attach().ch_destroy(self._chan_id)
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        return (
+            Channel,
+            (self._chan_id, self._arena_name, self._slot_size, self._num_slots),
+        )
+
+    def __repr__(self):
+        return f"Channel({self._chan_id.hex()[:12]}, slots={self._num_slots})"

@@ -212,6 +212,11 @@ class JaxEngine:
         req = self.submit(
             prompt, prompt_token_ids=prompt_token_ids, sampling_params=sampling_params
         )
+        yield from self.drain(req)
+
+    def drain(self, req: "_Request") -> Iterator[dict]:
+        """Token increments of a submitted request until its end sentinel;
+        raises the request's error, if any, after the stream ends."""
         while True:
             item = req.stream_queue.get()
             if item is None:

@@ -47,7 +47,35 @@ class OpenAIRouter:
                     }
                 }
         if path.endswith("/chat/completions"):
+            if body.get("stream"):
+                return self._sse(handle.options(stream=True).chat_stream.remote(body))
             return handle.chat.remote(body).result(timeout_s=600)
         if path.endswith("/completions"):
+            if body.get("stream"):
+                return self._sse(
+                    handle.options(stream=True).completions_stream.remote(body)
+                )
             return handle.completions.remote(body).result(timeout_s=600)
         return {"error": {"message": f"unknown route {path}", "code": 404}}
+
+    @staticmethod
+    def _sse(chunks):
+        """Wrap model-deployment chunks as an SSE stream (``stream: true``;
+        reference: the OpenAI router's StreamingResponse path). The router's
+        own generator re-streams through ITS replica, so tokens flow
+        model-replica → router-replica → proxy → socket chunk by chunk."""
+        from ray_tpu.serve.streaming import StreamStart
+
+        def gen():
+            yield StreamStart("text/event-stream")
+            while True:
+                try:
+                    # same 600s bound as the unary .result(timeout_s=600):
+                    # a hung engine must not pin this router thread forever
+                    chunk = chunks.next(timeout_s=600)
+                except StopIteration:
+                    break
+                yield f"data: {json.dumps(chunk)}\n\n"
+            yield "data: [DONE]\n\n"
+
+        return gen()

@@ -87,6 +87,75 @@ class LLMServer:
             },
         }
 
+    def completions_stream(self, body: dict):
+        """Generator of OpenAI ``text_completion`` chunk dicts — one per
+        generated token as the engine emits it (reference: the vLLM-engine
+        streaming path in ``llm/_internal/serve/deployments/llm/llm_server.py``)."""
+        prompt = body.get("prompt", "")
+        params = _sampling_from_dict(
+            {
+                "max_tokens": body.get("max_tokens", 64),
+                "temperature": body.get("temperature", 0.0),
+                "top_k": body.get("top_k", 50),
+            }
+        )
+        req = self.engine.submit(prompt, sampling_params=params)
+        created = int(time.time())
+        for inc in self.engine.drain(req):
+            yield {
+                "id": f"cmpl-{req.request_id}",
+                "object": "text_completion",
+                "created": created,
+                "model": self.llm_config.served_name,
+                "choices": [
+                    {"index": 0, "text": inc["text"], "finish_reason": None}
+                ],
+            }
+        yield {
+            "id": f"cmpl-{req.request_id}",
+            "object": "text_completion",
+            "created": created,
+            "model": self.llm_config.served_name,
+            "choices": [
+                {"index": 0, "text": "", "finish_reason": req.finish_reason}
+            ],
+        }
+
+    def chat_stream(self, body: dict):
+        """Generator of OpenAI ``chat.completion.chunk`` dicts."""
+        prompt = self._render_chat(body.get("messages", []))
+        params = _sampling_from_dict(
+            {
+                "max_tokens": body.get("max_tokens", 64),
+                "temperature": body.get("temperature", 0.0),
+                "top_k": body.get("top_k", 50),
+            }
+        )
+        req = self.engine.submit(prompt, sampling_params=params)
+        created = int(time.time())
+        first = True
+        for inc in self.engine.drain(req):
+            delta = {"content": inc["text"]}
+            if first:
+                delta["role"] = "assistant"
+                first = False
+            yield {
+                "id": f"chatcmpl-{req.request_id}",
+                "object": "chat.completion.chunk",
+                "created": created,
+                "model": self.llm_config.served_name,
+                "choices": [{"index": 0, "delta": delta, "finish_reason": None}],
+            }
+        yield {
+            "id": f"chatcmpl-{req.request_id}",
+            "object": "chat.completion.chunk",
+            "created": created,
+            "model": self.llm_config.served_name,
+            "choices": [
+                {"index": 0, "delta": {}, "finish_reason": req.finish_reason}
+            ],
+        }
+
     @staticmethod
     def _render_chat(messages: list[dict]) -> str:
         parts = []

@@ -88,3 +88,104 @@ class ObjectRef:
 
         threading.Thread(target=resolve, daemon=True).start()
         return fut
+
+
+class ObjectRefGenerator:
+    """Iterator over the streamed returns of a ``num_returns="streaming"``
+    task (reference: ``ObjectRefGenerator``, ``python/ray/_raylet.pyx:1388``).
+
+    The producer task must be a generator (or async generator in an async
+    actor); each yielded value is sealed into the object store *as it is
+    produced*, and ``__next__`` here returns its ``ObjectRef`` — blocking only
+    until that single item is ready, not until the whole task finishes. Item
+    ``i`` lives at the deterministic id ``ObjectID.for_return(task_id, i+1)``;
+    return index 0 holds the completion record (total item count, or the
+    producer's error), sealed when the task exits.
+    """
+
+    def __init__(self, completion_ref: ObjectRef):
+        self._completion_ref = completion_ref
+        self._task_id = completion_ref.id().task_id()
+        self._index = 0  # items consumed so far
+        self._total: Optional[int] = None
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        ref = self._next_ref(timeout=None)
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def __aiter__(self) -> "ObjectRefGenerator":
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        ref = await loop.run_in_executor(None, self._next_ref, None)
+        if ref is None:
+            raise StopAsyncIteration
+        return ref
+
+    def _next_ref(self, timeout: Optional[float]) -> Optional[ObjectRef]:
+        """The next item's ref, or None when the stream is exhausted.
+
+        Blocks on either the next item id or the completion record, whichever
+        seals first. An already-yielded item always wins over a completion
+        error, so consumers drain buffered items before seeing the failure —
+        the reference's semantics for mid-stream producer errors.
+        """
+        import time as _time
+
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.worker import global_worker
+
+        api = global_worker()
+        i = self._index + 1
+        item_id = ObjectID.for_return(self._task_id, i)
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            if self._total is not None and i > self._total:
+                return None
+            wait_ids = [item_id]
+            if self._total is None:
+                wait_ids.append(self._completion_ref.id())
+            t = 10.0
+            if deadline is not None:
+                t = min(t, max(0.0, deadline - _time.monotonic()))
+            ready, _ = api.controller_call("wait", (wait_ids, 1, t))
+            if item_id in ready:
+                self._index = i
+                # take ownership BEFORE the report releases the producer's
+                # pin (both ride the same FIFO channel, so order is kept)
+                api.add_refs([item_id])
+                api.controller_call(
+                    "stream_consumed_report", (self._task_id, i)
+                )
+                return ObjectRef(item_id)
+            if self._completion_ref.id() in ready:
+                # completion sealed and the item is not: the stream ended.
+                # get() raises the producer's error if it failed mid-stream.
+                self._total = api.get(self._completion_ref)
+                continue
+            if deadline is not None and _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"no stream item ready within {timeout}s (consumed {self._index})"
+                )
+
+    def completed(self) -> ObjectRef:
+        """Ref of the completion record; get() blocks until the producer task
+        exits and resolves to the total item count (a mid-stream producer
+        error counts as the final item). It raises only when an external
+        failure — worker crash, cancellation — ended the task before it could
+        seal its completion."""
+        return self._completion_ref
+
+    def __repr__(self):
+        return (
+            f"ObjectRefGenerator(task={self._task_id.hex()[:16]}, "
+            f"consumed={self._index})"
+        )

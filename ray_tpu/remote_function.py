@@ -70,7 +70,14 @@ class RemoteFunction:
             strategy=_strategy_from_options(opts),
             runtime_env=opts.get("runtime_env"),
             function_blob=self._function_blob,
+            generator_backpressure=opts.get(
+                "_generator_backpressure_num_objects", 0
+            ),
         )
+        if num_returns == "streaming":
+            from ray_tpu.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0])
         return refs[0] if num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):

@@ -109,10 +109,71 @@ def main(mode: str = "thread", num_cpus: int = 8) -> list[dict]:
 
     results.append(timeit("placement group create/remove", pg_cycle))
 
+    # get() latency on an already-sealed small object (reference ray_perf
+    # "single client get calls")
+    sealed = ray_tpu.put(small)
+    results.append(timeit("single client get (sealed small)", lambda: ray_tpu.get(sealed)))
+
+    # queued-task ceiling: tasks buffered on one node far beyond worker
+    # capacity (reference envelope row "tasks queued on one node"); measures
+    # submit throughput into a deep queue, then drains for correctness
+    def queue_depth(n=5000):
+        @ray_tpu.remote
+        def tick(i):
+            return i
+
+        t0 = time.perf_counter()
+        refs = [tick.remote(i) for i in range(n)]
+        submit_rate = n / (time.perf_counter() - t0)
+        out = ray_tpu.get(refs, timeout=600)
+        assert out[-1] == n - 1
+        return submit_rate
+
+    rate = queue_depth()
+    print(f"{'task submit into 5k-deep queue':<42s} {rate:>12.1f} /s")
+    results.append({"name": "task submit into 5k-deep queue", "rate_per_s": rate})
+
+    # compiled-graph channel round trip vs the actor-task path (aDAG analog)
+    chan_actor = Actor.remote()
+    ray_tpu.get(chan_actor.method.remote(1), timeout=60)
+    from ray_tpu.dag.dag_node import InputNode
+
+    with InputNode() as inp:
+        dag = chan_actor.method.bind(inp)
+    compiled = dag.experimental_compile()
+    if "channels" in repr(compiled):
+        ray_tpu.get(compiled.execute(0))
+        results.append(
+            timeit(
+                "compiled DAG round trip (channels)",
+                lambda: ray_tpu.get(compiled.execute(1)),
+            )
+        )
+    compiled.teardown()
+
     ray_tpu.shutdown()
     print(json.dumps({"microbenchmark": results}))
     return results
 
 
+def record(path: str = "MICROBENCH.json") -> None:
+    """Run both modes and check the numbers into the repo (VERDICT r1 #8:
+    framework-overhead numbers live in-repo, regression-asserted in tests)."""
+    import os
+    import platform
+
+    out = {"host_cpus": os.cpu_count(), "platform": platform.platform()}
+    for mode in ("thread", "process"):
+        out[mode] = main(mode=mode)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}")
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--record" in sys.argv:
+        record()
+    else:
+        main()

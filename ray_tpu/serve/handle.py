@@ -141,7 +141,9 @@ class _MethodCaller:
         self._handle = handle
         self._method = method
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
+        if getattr(self._handle, "_stream", False):
+            return self._handle._call_streaming(self._method, args, kwargs)
         return self._handle._call(self._method, args, kwargs)
 
 
@@ -189,7 +191,14 @@ class DeploymentHandle:
             if version != -1:
                 self._applied_version = version
             self._replicas = replicas
-            self._inflight = {n: self._inflight.get(n, 0) for n, _ in replicas}
+            # mutate in place: the dict is shared with the stream/unary
+            # variant handle (options(stream=...)) for combined P2C counts
+            keep = {n for n, _ in replicas}
+            for n in list(self._inflight):
+                if n not in keep:
+                    del self._inflight[n]
+            for n in keep:
+                self._inflight.setdefault(n, 0)
 
     # -- routing ------------------------------------------------------------
 
@@ -274,7 +283,47 @@ class DeploymentHandle:
                 with self._lock:
                     self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def _call_streaming(self, method: str, args: tuple, kwargs: dict):
+        """Streaming call (reference: ``handle.options(stream=True)``): the
+        replica method runs as a streaming-generator actor task; chunks are
+        consumable as they are produced."""
+        from ray_tpu.serve.streaming import DeploymentResponseGenerator
+
+        name, actor = self._pick_replica()
+        with self._lock:
+            self._inflight[name] = self._inflight.get(name, 0) + 1
+
+        args = tuple(
+            a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+            for a in args
+        )
+        kwargs = {
+            k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v)
+            for k, v in kwargs.items()
+        }
+        try:
+            ref_gen = actor.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(method, *args, **kwargs)
+        except Exception:
+            with self._lock:
+                self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
+            raise
+        # in-flight accounting keys off the completion record: it seals when
+        # the replica's generator exits (same drainer as unary calls)
+        self._done_queue.put((name, ref_gen.completed()))
+        with self._lock:
+            if self._drainer is None or not self._drainer.is_alive():
+                self._drainer = threading.Thread(
+                    target=self._drain_loop, daemon=True,
+                    name=f"handle-drain-{self.deployment_name}",
+                )
+                self._drainer.start()
+        return DeploymentResponseGenerator(ref_gen)
+
+    def remote(self, *args, **kwargs):
+        if getattr(self, "_stream", False):
+            return self._call_streaming("__call__", args, kwargs)
         return self._call("__call__", args, kwargs)
 
     def __getattr__(self, item: str) -> _MethodCaller:
@@ -282,8 +331,26 @@ class DeploymentHandle:
             raise AttributeError(item)
         return _MethodCaller(self, item)
 
-    def options(self, **_kwargs) -> "DeploymentHandle":
-        return self  # API parity (stream=False etc.)
+    def options(self, *, stream: bool = False, **_kwargs) -> "DeploymentHandle":
+        if stream == getattr(self, "_stream", False):
+            return self
+        # cache the variant under the lock: options() runs per request in the
+        # proxy/router, and an unsynchronized fresh handle per call would
+        # leak a drainer thread + replica cache each time. The variant SHARES
+        # this handle's lock, in-flight counts, and done-queue so P2C sees
+        # combined stream+unary load on each replica.
+        with self._lock:
+            cached = getattr(self, "_variant", None)
+            if cached is None:
+                h = DeploymentHandle(self.deployment_name)
+                h._stream = stream
+                h._lock = self._lock
+                h._inflight = self._inflight
+                h._done_queue = self._done_queue
+                h._variant = self
+                self._variant = h
+                cached = h
+        return cached
 
     def __reduce__(self):
         return (DeploymentHandle, (self.deployment_name,))

@@ -80,11 +80,24 @@ class ProxyActor:
                         dict(self.headers.items()),
                         body,
                     )
-                    result = handle.remote(req).result(timeout_s=120)
-                    if isinstance(result, bytes):
-                        return self._respond(200, result, "application/octet-stream")
+                    # All proxy requests ride the streaming path; unary
+                    # handlers arrive as a single non-StreamStart chunk and
+                    # fall back to a buffered JSON response (reference:
+                    # proxy.py streaming responses — ASGI there, chunked
+                    # transfer-encoding here).
+                    chunks = handle.options(stream=True).remote(req)
+                    try:
+                        first = chunks.next(timeout_s=120)
+                    except StopIteration:
+                        first = None
+                    if chunks.stream_start is not None:
+                        return self._stream_body(
+                            chunks.stream_start.content_type, first, chunks
+                        )
+                    if isinstance(first, bytes):
+                        return self._respond(200, first, "application/octet-stream")
                     return self._respond(
-                        200, json.dumps(result).encode(), "application/json"
+                        200, json.dumps(first).encode(), "application/json"
                     )
                 except Exception:
                     return self._respond(
@@ -97,6 +110,43 @@ class ProxyActor:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _stream_body(self, ctype: str, first, chunks):
+                """Chunked transfer-encoding: each deployment chunk hits the
+                socket as it seals — SSE works end to end. A mid-stream
+                handler error TRUNCATES the chunked body (no terminator) and
+                drops the connection: headers are already on the wire, so a
+                trailing 500 would corrupt keep-alive framing, while a
+                missing terminator is an unambiguous client-side error."""
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                try:
+                    item = first
+                    while True:
+                        if item is not None:
+                            if isinstance(item, str):
+                                data = item.encode()
+                            elif isinstance(item, bytes):
+                                data = item
+                            else:
+                                data = json.dumps(item).encode() + b"\n"
+                            if data:
+                                self.wfile.write(f"{len(data):x}\r\n".encode())
+                                self.wfile.write(data + b"\r\n")
+                                self.wfile.flush()
+                        try:
+                            # per-chunk deadline: a stalled replica must not
+                            # pin this handler thread forever
+                            item = chunks.next(timeout_s=120)
+                        except StopIteration:
+                            break
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except Exception:
+                    self.close_connection = True
 
             do_GET = do_POST = do_PUT = do_DELETE = _handle
 
@@ -125,8 +175,17 @@ class ProxyActor:
                 controller = _get_controller_handle()
                 routes = ray_tpu.get(controller.list_routes.remote(), timeout=10)
                 with self._routes_lock:
+                    # reuse unchanged handles: a fresh handle per refresh
+                    # tick would discard replica caches and strand drainer
+                    # threads
                     self._routes = {
-                        prefix: DeploymentHandle(info["ingress"])
+                        prefix: (
+                            self._routes[prefix]
+                            if prefix in self._routes
+                            and self._routes[prefix].deployment_name
+                            == info["ingress"]
+                            else DeploymentHandle(info["ingress"])
+                        )
                         for prefix, info in routes.items()
                     }
             except Exception:

@@ -16,6 +16,22 @@ import time
 from typing import Any, Optional
 
 
+def _drive_async_gen(agen):
+    """Adapt an async-generator handler to a sync generator on a private
+    event loop (streamed chunks still seal one by one)."""
+    import asyncio
+
+    loop = asyncio.new_event_loop()
+    try:
+        while True:
+            try:
+                yield loop.run_until_complete(agen.__anext__())
+            except StopAsyncIteration:
+                return
+    finally:
+        loop.close()
+
+
 class ReplicaActor:
     def __init__(
         self,
@@ -58,7 +74,60 @@ class ReplicaActor:
                 fn = self._callable  # function deployment: one entry point
             else:
                 fn = getattr(self._callable, method)
-            return fn(*args, **kwargs)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                # async handlers run on a per-request loop (requests already
+                # parallelize across the replica's concurrency threads)
+                import asyncio
+
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def handle_request_streaming(self, method: str, *args, **kwargs):
+        """Generator actor method (called with ``num_returns="streaming"``):
+        yields the handler's chunks as they are produced. A handler that
+        returns a generator streams; anything else yields once (the proxy
+        falls back to a buffered JSON response for single-item streams that
+        don't start with a StreamStart)."""
+        from ray_tpu.serve.streaming import StreamStart
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if inspect.isfunction(self._callable) or inspect.isbuiltin(
+                self._callable
+            ):
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)
+            if hasattr(result, "__anext__"):
+                result = _drive_async_gen(result)
+            if inspect.isgenerator(result):
+                first = True
+                for item in result:
+                    if first and not isinstance(item, StreamStart):
+                        if isinstance(item, str):
+                            ct = "text/event-stream"
+                        elif isinstance(item, bytes):
+                            ct = "application/octet-stream"
+                        else:
+                            ct = "application/x-ndjson"
+                        yield StreamStart(ct)
+                    first = False
+                    yield item
+                if first:
+                    yield StreamStart()
+            else:
+                yield result
         finally:
             with self._lock:
                 self._ongoing -= 1

@@ -1,0 +1,64 @@
+"""Serve response streaming over streaming generators.
+
+Reference: the serve streaming path (``python/ray/serve/_private/proxy.py``
+streaming responses + ``handle.options(stream=True)`` →
+``DeploymentResponseGenerator``, ``python/ray/serve/handle.py``). Here the
+transport is the core ``num_returns="streaming"`` machinery: the replica's
+``handle_request_streaming`` is a generator actor method, each yielded chunk
+seals into the object store as produced, and the proxy writes chunks to the
+socket as they arrive (chunked transfer-encoding / SSE).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator, Optional
+
+
+@dataclasses.dataclass
+class StreamStart:
+    """First item of a streamed deployment response: tells the proxy to
+    switch to chunked/SSE output with this content type instead of buffering
+    a single JSON body. User handlers may yield one explicitly as the first
+    item to control the content type; otherwise the replica infers one."""
+
+    content_type: str = "text/event-stream"
+
+
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment call's chunk VALUES
+    (reference: ``DeploymentResponseGenerator``, ``python/ray/serve/handle.py``
+    — which yields refs; here each step resolves the value for you)."""
+
+    def __init__(self, ref_gen, on_done=None):
+        self._ref_gen = ref_gen
+        self._on_done = on_done
+        # the replica's protocol-level StreamStart is absorbed here rather
+        # than yielded: handle-level consumers see only user chunks; the
+        # proxy reads .stream_start to pick content type
+        self.stream_start: Optional[StreamStart] = None
+
+    def __iter__(self) -> "DeploymentResponseGenerator":
+        return self
+
+    def __next__(self) -> Any:
+        return self.next(timeout_s=None)
+
+    def next(self, timeout_s: Optional[float] = None) -> Any:
+        import ray_tpu
+
+        while True:
+            ref = self._ref_gen._next_ref(timeout_s)
+            if ref is None:
+                if self._on_done is not None:
+                    self._on_done()
+                    self._on_done = None
+                raise StopIteration
+            value = ray_tpu.get(ref)
+            if isinstance(value, StreamStart):
+                self.stream_start = value
+                continue
+            return value
+
+    def completed(self):
+        return self._ref_gen.completed()

@@ -117,3 +117,44 @@ def test_client_auto_address(tmp_path):
         assert "AUTO-OK" in r.stdout
     finally:
         ray_tpu.shutdown()
+
+
+def test_client_same_host_arena_probe(tmp_path):
+    """A same-host client (launched WITHOUT the inherited arena env) probes
+    and attaches the head's native arena, so its large puts ride shared
+    memory instead of the chunked push protocol."""
+    ray_tpu.init(num_cpus=2, mode="process")
+    try:
+        code = (
+            "import os\nos.environ['JAX_PLATFORMS']='cpu'\n"
+            "import numpy as np\nimport ray_tpu\n"
+            "ray_tpu.init(address='auto')\n"
+            "print('ARENA:', os.environ.get('RAY_TPU_ARENA', ''))\n"
+            "big = np.arange(400_000, dtype=np.float64)\n"
+            "ref = ray_tpu.put(big)\n"
+            "@ray_tpu.remote\ndef total(x): return float(x.sum())\n"
+            "assert ray_tpu.get(total.remote(ref), timeout=120) == float(big.sum())\n"
+            "print('PROBE-OK')\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=240,
+            env={
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "PYTHONPATH": "/root/repo",
+                "JAX_PLATFORMS": "cpu",
+                "HOME": "/root",
+            },
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "PROBE-OK" in r.stdout
+        # the head runs the native arena in this environment, so the probe
+        # must have attached it
+        import ray_tpu._private.worker as w
+
+        if hasattr(w.global_worker().controller.plasma, "arena_name"):
+            assert "ARENA: /rtpu-" in r.stdout
+    finally:
+        ray_tpu.shutdown()

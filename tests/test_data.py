@@ -185,6 +185,18 @@ def test_read_write_csv_json_parquet(ray_start_thread, tmp_path):
         assert back.sum("a") == ds.sum("a")
 
 
+def test_csv_chunked_streaming_read(ray_start_thread, tmp_path):
+    """chunk_rows streams one file as many blocks via a streaming read task."""
+    p = tmp_path / "one.csv"
+    p.write_text("a\n" + "\n".join(str(i) for i in range(100)) + "\n")
+    back = rd.read_csv(str(p), chunk_rows=10)
+    mat = back.materialize()
+    # ONE file split into 10 blocks proves the chunked streaming path ran
+    assert mat.num_blocks() == 10
+    assert mat.count() == 100
+    assert mat.sum("a") == sum(range(100))
+
+
 def test_read_numpy_roundtrip(ray_start_thread, tmp_path):
     arr = np.arange(24, dtype=np.float32).reshape(6, 4)
     d = tmp_path / "np"

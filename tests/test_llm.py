@@ -213,3 +213,59 @@ def test_openai_router_routing():
     assert router(req)["ok"] is True
     req = Request("POST", "/v1/chat/completions", {}, {}, b'{"model": "nope"}')
     assert router(req)["error"]["code"] == 404
+
+
+def test_openai_sse_end_to_end(ray_start_thread):
+    """``stream: true`` through app → router → LLMServer → proxy as SSE
+    (reference: the OpenAI router's StreamingResponse path)."""
+    import json
+    import time
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.llm import build_openai_app
+
+    cfg = LLMConfig(
+        model=ModelConfig(model_id="tiny", tokenizer="byte", seed=0),
+        engine=EngineConfig(
+            max_num_seqs=2, max_seq_len=64, prefill_buckets=(16, 32, 64)
+        ),
+    )
+    serve.run(build_openai_app(cfg), name="llm-app", route_prefix="/")
+    _, port = serve.start_proxy(port=0)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/-/routes", timeout=5
+            ) as r:
+                if "/" in json.loads(r.read()):
+                    break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    body = json.dumps(
+        {
+            "model": cfg.served_name,
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4,
+            "stream": True,
+        }
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        assert r.headers.get("Content-Type") == "text/event-stream"
+        raw = r.read().decode()
+    events = [e for e in raw.split("\n\n") if e.startswith("data: ")]
+    assert events[-1] == "data: [DONE]"
+    chunks = [json.loads(e[len("data: ") :]) for e in events[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    # token deltas (all but the final finish chunk) are non-empty text
+    assert sum(len(c["choices"][0]["delta"].get("content", "")) for c in chunks) > 0
+    serve.shutdown()

@@ -72,9 +72,9 @@ def test_tcp_client_driver_end_to_end(ray_start_tcp):
         """
     )
     # a REAL remote host could not attach the head's shm arena: drop the
-    # inherited arena env so the client exercises the chunked push (put)
-    # and pull (get) protocols end to end
-    env = {**os.environ, "PYTHONPATH": REPO}
+    # inherited arena env AND disable the same-host attach probe so the
+    # client exercises the chunked push (put) and pull (get) protocols
+    env = {**os.environ, "PYTHONPATH": REPO, "RAY_TPU_NO_ARENA_ATTACH": "1"}
     env.pop("RAY_TPU_ARENA", None)
     r = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,

@@ -234,6 +234,151 @@ def test_http_proxy_end_to_end(serve_instance):
     assert out == {"path": "/predict", "echo": {"x": 1}}
 
 
+def test_handle_streaming_response(serve_instance):
+    """handle.options(stream=True): chunk values consumable mid-request."""
+
+    @serve.deployment
+    class Tokens:
+        def __call__(self, n):
+            for i in range(n):
+                yield {"tok": i}
+                if i == 0:
+                    time.sleep(3.0)  # long gap AFTER the first chunk
+
+    handle = serve.run(Tokens.bind(), name="tok")
+    gen = handle.options(stream=True).remote(4)
+    from ray_tpu.serve.streaming import StreamStart
+
+    t0 = time.monotonic()
+    first = next(gen)
+    assert first == {"tok": 0}
+    # the protocol-level StreamStart is absorbed, not yielded
+    assert isinstance(gen.stream_start, StreamStart)
+    assert time.monotonic() - t0 < 2.5, "first chunk was not streamed"
+    assert [c["tok"] for c in gen] == [1, 2, 3]
+
+
+def test_http_streaming_sse(serve_instance):
+    """Chunked HTTP: bytes hit the socket while the handler still runs."""
+
+    @serve.deployment
+    class SSE:
+        def __call__(self, request):
+            for i in range(3):
+                yield f"data: chunk{i}\n\n"
+                time.sleep(0.8)
+
+    serve.run(SSE.bind(), name="sse", route_prefix="/sse")
+    _, port = serve.start_proxy(port=0)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/-/routes", timeout=5
+            ) as r:
+                if "/sse" in json.loads(r.read()):
+                    break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/sse/", timeout=60
+    ) as r:
+        assert r.headers.get("Content-Type") == "text/event-stream"
+        t0 = time.monotonic()
+        first = r.read(len(b"data: chunk0\n\n"))
+        first_latency = time.monotonic() - t0
+        rest = r.read()
+    assert first == b"data: chunk0\n\n"
+    # the handler sleeps 0.8s after each chunk: a buffered (non-streaming)
+    # proxy could not deliver chunk0 before ~2.4s
+    assert first_latency < 2.0, f"first SSE chunk took {first_latency:.1f}s"
+    assert rest == b"data: chunk1\n\ndata: chunk2\n\n"
+
+
+def test_async_deployment_handlers(serve_instance):
+    """async def handlers work for unary and streaming paths."""
+
+    @serve.deployment
+    class Async:
+        async def __call__(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return {"doubled": x * 2}
+
+        async def ticks(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i
+
+    handle = serve.run(Async.bind(), name="async")
+    assert handle.remote(21).result(timeout_s=60) == {"doubled": 42}
+    gen = handle.options(stream=True).ticks.remote(3)
+    assert list(gen) == [0, 1, 2]
+
+
+def test_proxy_none_result_is_null_json(serve_instance):
+    @serve.deployment
+    def fire_and_forget(request):
+        return None
+
+    serve.run(fire_and_forget.bind(), name="null", route_prefix="/null")
+    _, port = serve.start_proxy(port=0)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/-/routes", timeout=5
+            ) as r:
+                if "/null" in json.loads(r.read()):
+                    break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/null/", timeout=30) as r:
+        assert r.status == 200
+        assert r.read() == b"null"
+
+
+def test_http_stream_error_truncates(serve_instance):
+    """A mid-stream handler error truncates the chunked body instead of
+    appending a second response to the socket."""
+    import http.client
+
+    @serve.deployment
+    class Bad:
+        def __call__(self, request):
+            yield "data: ok\n\n"
+            raise RuntimeError("mid-stream boom")
+
+    serve.run(Bad.bind(), name="bad", route_prefix="/bad")
+    _, port = serve.start_proxy(port=0)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/-/routes", timeout=5
+            ) as r:
+                if "/bad" in json.loads(r.read()):
+                    break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", "/bad/")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    with pytest.raises(http.client.IncompleteRead):
+        data = resp.read()
+        # server truncated the chunked body: http.client must raise, never
+        # silently return a "complete" response
+        raise AssertionError(f"read returned {data!r} without error")
+    conn.close()
+
+
 def test_autoscaling_config_math():
     ac = serve.AutoscalingConfig(
         min_replicas=1, max_replicas=8, target_ongoing_requests=2

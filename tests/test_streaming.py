@@ -1,0 +1,211 @@
+"""Streaming generators: ``num_returns="streaming"`` → ``ObjectRefGenerator``.
+
+Reference coverage model: ``python/ray/tests/test_streaming_generator.py``
+(eager per-item sealing, mid-stream errors surface at the fail point,
+backpressure bounds producer lead, async-actor generators).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.object_ref import ObjectRefGenerator
+
+
+def test_basic_streaming(ray_start_thread):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    g = gen.remote(5)
+    assert isinstance(g, ObjectRefGenerator)
+    values = [ray_tpu.get(ref) for ref in g]
+    assert values == [0, 1, 4, 9, 16]
+    # completion record resolves to the item count
+    assert ray_tpu.get(g.completed()) == 5
+
+
+def test_streaming_items_arrive_before_task_finishes(ray_start_thread):
+    """The defining property: items are consumable while the producer runs."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        yield "first"
+        time.sleep(5.0)
+        yield "second"
+
+    g = slow_gen.remote()
+    t0 = time.monotonic()
+    first = ray_tpu.get(next(g))
+    elapsed = time.monotonic() - t0
+    assert first == "first"
+    assert elapsed < 3.0, f"first item took {elapsed:.1f}s — not streamed"
+    assert ray_tpu.get(next(g)) == "second"
+    with pytest.raises(StopIteration):
+        next(g)
+
+
+def test_streaming_mid_stream_error(ray_start_thread):
+    @ray_tpu.remote(num_returns="streaming")
+    def bad_gen():
+        yield 1
+        yield 2
+        raise ValueError("boom at item 3")
+
+    g = bad_gen.remote()
+    assert ray_tpu.get(next(g)) == 1
+    assert ray_tpu.get(next(g)) == 2
+    with pytest.raises(ValueError, match="boom at item 3"):
+        ray_tpu.get(next(g))
+    # after the error item the stream ends
+    with pytest.raises(StopIteration):
+        next(g)
+    # completion record counts the error item; it raises only for external
+    # failures (worker crash / cancel) that prevented a mid-stream seal
+    assert ray_tpu.get(g.completed()) == 3
+
+
+def test_streaming_non_generator_errors(ray_start_thread):
+    @ray_tpu.remote(num_returns="streaming")
+    def not_a_gen():
+        return [1, 2, 3]
+
+    g = not_a_gen.remote()
+    with pytest.raises(TypeError, match="must return a generator"):
+        ray_tpu.get(next(g))
+
+
+def test_get_on_generator_rejected(ray_start_thread):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 1
+
+    g = gen.remote()
+    with pytest.raises(TypeError, match="ObjectRefGenerator"):
+        ray_tpu.get(g)
+    assert ray_tpu.get(next(g)) == 1
+
+
+def test_streaming_large_items_process_mode(ray_start_process):
+    """Large yielded arrays travel via the shared-memory data plane."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen_arrays(n):
+        for i in range(n):
+            yield np.full(200_000, i, dtype=np.float32)
+
+    g = gen_arrays.remote(3)
+    for i, ref in enumerate(g):
+        arr = ray_tpu.get(ref)
+        assert arr.shape == (200_000,)
+        assert float(arr[0]) == float(i)
+
+
+def test_streaming_backpressure(ray_start_process):
+    """Producer lead over the consumer is bounded by the threshold."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        import time as _t
+
+        for i in range(n):
+            yield (i, _t.monotonic())
+
+    g = gen.options(
+        num_returns="streaming", _generator_backpressure_num_objects=2
+    ).remote(6)
+    produced_times = []
+    for ref in g:
+        i, t = ray_tpu.get(ref)
+        produced_times.append(t)
+        time.sleep(0.3)  # slow consumer
+    assert len(produced_times) == 6
+    # with a lead of 2, item 5 cannot have been produced before the consumer
+    # took item ~3 — i.e. production must span most of the consumption window
+    span = produced_times[-1] - produced_times[0]
+    assert span > 0.5, f"producer never blocked (span {span:.2f}s)"
+
+
+def test_abandoned_backpressured_stream_frees_producer(ray_start_thread):
+    """Dropping the generator must unblock (and end) a backpressured
+    producer instead of leaving it polling a dead stream forever."""
+    import gc
+
+    import ray_tpu._private.worker as w
+
+    @ray_tpu.remote(num_returns="streaming")
+    def endless():
+        for i in range(10_000):
+            yield i
+
+    g = endless.options(
+        num_returns="streaming", _generator_backpressure_num_objects=2
+    ).remote()
+    ray_tpu.get(next(g))  # stream is live
+    del g
+    gc.collect()
+    controller = w.global_worker().controller
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        done = [
+            e
+            for e in list(controller.task_events)
+            if e["name"] == "endless" and e["event"] in ("FINISHED", "FAILED")
+        ]
+        if done:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("abandoned producer still running after 30s")
+
+
+def test_actor_streaming_method(ray_start_thread):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.base = 100
+
+        def stream(self, n):
+            for i in range(n):
+                yield self.base + i
+
+    c = Counter.remote()
+    g = c.stream.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in g] == [100, 101, 102, 103]
+
+
+def test_async_actor_streaming(ray_start_process):
+    @ray_tpu.remote
+    class AsyncGen:
+        async def ticks(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * 10
+
+        async def noop(self):
+            return None
+
+    a = AsyncGen.remote()
+    g = a.ticks.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r) for r in g] == [0, 10, 20]
+
+
+def test_streaming_into_downstream_task(ray_start_thread):
+    """Yielded refs are first-class: pass them to other tasks."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i + 1
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    refs = [double.remote(r) for r in gen.remote(4)]
+    assert ray_tpu.get(refs) == [2, 4, 6, 8]
