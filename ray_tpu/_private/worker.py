@@ -248,17 +248,19 @@ class WorkerAPI:
         return ref
 
     def get(self, refs, timeout: Optional[float] = None):
-        from ray_tpu.dag.compiled_dag import _CompiledResult
-        from ray_tpu.object_ref import ObjectRefGenerator
+        # hot path: plain refs/lists skip the special-type imports entirely
+        if not isinstance(refs, (ObjectRef, list, tuple)):
+            from ray_tpu.dag.compiled_dag import _CompiledResult
+            from ray_tpu.object_ref import ObjectRefGenerator
 
-        if isinstance(refs, _CompiledResult):
-            # compiled-graph result (reference: ray.get on CompiledDAGRef)
-            return refs.get(timeout)
-        if isinstance(refs, ObjectRefGenerator):
-            raise TypeError(
-                "ray_tpu.get on an ObjectRefGenerator is not allowed; "
-                "iterate it and get() each yielded ObjectRef"
-            )
+            if isinstance(refs, _CompiledResult):
+                # compiled-graph result (reference: ray.get on CompiledDAGRef)
+                return refs.get(timeout)
+            if isinstance(refs, ObjectRefGenerator):
+                raise TypeError(
+                    "ray_tpu.get on an ObjectRefGenerator is not allowed; "
+                    "iterate it and get() each yielded ObjectRef"
+                )
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
         for r in ref_list:

@@ -7,6 +7,8 @@ LearnerGroup / EnvRunnerGroup, with PPO as the first algorithm
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, ReplayBuffer
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.core.learner import JaxLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
@@ -20,6 +22,10 @@ __all__ = [
     "DQNConfig",
     "ReplayBuffer",
     "EnvRunnerGroup",
+    "IMPALA",
+    "IMPALAConfig",
+    "SAC",
+    "SACConfig",
     "JaxLearner",
     "LearnerGroup",
     "PPO",

@@ -126,6 +126,7 @@ class Algorithm:
             gamma=config.gamma,
             lambda_=getattr(config, "lambda_", 0.95),
             seed=config.seed,
+            emit_sequences=getattr(config, "_emit_sequences", False),
         )
         self.iteration = 0
         self._total_env_steps = 0

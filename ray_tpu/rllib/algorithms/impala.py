@@ -1,0 +1,248 @@
+"""IMPALA: asynchronous sampling with a V-trace-corrected learner.
+
+Reference: ``rllib/algorithms/impala/impala.py:599`` (the async
+sample→learner pipeline: env runners keep sampling under a stale policy
+while the learner consumes queued batches) and the V-trace importance
+weighting of Espeholt et al. (``rllib/algorithms/impala/vtrace``).
+
+Here the async pipeline is one outstanding ``sample.remote()`` per runner:
+``training_step`` waits for whichever runner finishes first, IMMEDIATELY
+resubmits it (with refreshed weights every ``broadcast_interval`` batches),
+and only then runs the jitted V-trace update — so every update overlaps
+with all runners' ongoing sampling. The behavior-policy lag this creates is
+exactly what V-trace's clipped importance ratios correct for.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import RLModule
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=IMPALA)
+        self.num_env_runners = 2
+        self.rollout_fragment_length = 50
+        self.lr = 5e-4
+        self.grad_clip = 40.0
+        self.vtrace_clip_rho_threshold = 1.0
+        self.vtrace_clip_pg_rho_threshold = 1.0
+        self.entropy_coeff = 0.01
+        self.vf_loss_coeff = 0.5
+        # batches consumed per training_step and weight-push cadence
+        self.num_batches_per_iteration = 8
+        self.broadcast_interval = 1
+        self._emit_sequences = True
+
+
+class IMPALA(Algorithm):
+    def __init__(self, config: IMPALAConfig):
+        super().__init__(config)
+        import jax.numpy as jnp
+        import optax
+
+        weights = self.learner_group.get_weights()
+        self._params = {k: jnp.asarray(v) for k, v in weights.items()}
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr),
+        )
+        self._opt_state = self.optimizer.init(self._params)
+        self._update_fn = self._build_update()
+        self._batches_consumed = 0
+        # ref -> runner index: the in-flight async sample per runner
+        self._inflight: dict = {}
+
+    # -- v-trace update ------------------------------------------------------
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        n_hidden = len(self.module_spec.hidden)
+        gamma = self.config.gamma
+        rho_clip = self.config.vtrace_clip_rho_threshold
+        pg_rho_clip = self.config.vtrace_clip_pg_rho_threshold
+        ent_c = self.config.entropy_coeff
+        vf_c = self.config.vf_loss_coeff
+        optimizer = self.optimizer
+
+        def loss_fn(params, seq):
+            T, N, D = seq["obs"].shape
+            logits, values = RLModule.forward(
+                params, seq["obs"].reshape(T * N, D), n_hidden
+            )
+            logits = logits.reshape(T, N, -1)
+            values = values.reshape(T, N)
+            _, next_values = RLModule.forward(
+                params, seq["next_obs"].reshape(T * N, D), n_hidden
+            )
+            # V(s') is 0 past a true termination; for truncation next_obs is
+            # the pre-reset state so its value is the correct bootstrap
+            next_values = next_values.reshape(T, N) * (1.0 - seq["terminals"])
+
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, seq["actions"][:, :, None].astype(jnp.int32), axis=2
+            )[:, :, 0]
+            rho = jnp.exp(logp - seq["logp_behavior"])
+            rho_bar = jnp.minimum(rho, rho_clip)
+            c_bar = jnp.minimum(rho, 1.0)
+            not_end = 1.0 - seq["ends"]
+
+            delta = rho_bar * (
+                seq["rewards"] + gamma * next_values - values
+            )
+
+            # reverse scan: acc_t = delta_t + gamma c_t not_end_t acc_{t+1},
+            # vs_t = V_t + acc_t (Espeholt et al. eq. 1, telescoped)
+            def scan_fn(acc, xs):
+                d, c, ne = xs
+                acc = d + gamma * c * ne * acc
+                return acc, acc
+
+            _, acc_rev = jax.lax.scan(
+                scan_fn,
+                jnp.zeros((N,), jnp.float32),
+                (delta[::-1], c_bar[::-1], not_end[::-1]),
+            )
+            acc = acc_rev[::-1]
+            vs = values + acc
+            # vs_{t+1}: next step's vs inside the fragment; at fragment end
+            # or episode end, the (boundary-aware) next_values bootstrap
+            vs_tp1 = jnp.concatenate([vs[1:], next_values[-1:]], axis=0)
+            vs_tp1 = jnp.where(seq["ends"] > 0, next_values, vs_tp1)
+            pg_adv = jnp.minimum(rho, pg_rho_clip) * (
+                seq["rewards"] + gamma * vs_tp1 - values
+            )
+            pg_loss = -jnp.mean(jax.lax.stop_gradient(pg_adv) * logp)
+            vf_loss = 0.5 * jnp.mean(
+                (jax.lax.stop_gradient(vs) - values) ** 2
+            )
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+            )
+            total = pg_loss + vf_c * vf_loss - ent_c * entropy
+            return total, (pg_loss, vf_loss, entropy, jnp.mean(rho))
+
+        def update(params, opt_state, seq):
+            import optax
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, seq
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        return jax.jit(update, donate_argnums=(0, 1))
+
+    # -- async pipeline ------------------------------------------------------
+
+    def _runners(self):
+        return self.env_runner_group.runners
+
+    def _submit(self, i: int, push_weights: bool):
+        runner = self._runners()[i]
+        if push_weights:
+            runner.set_weights.remote(
+                {k: np.asarray(v) for k, v in self._params.items()}
+            )
+        ref = runner.sample.remote()
+        self._inflight[ref] = i
+
+    def _next_batch(self, timeout: float = 300.0) -> Optional[dict]:
+        """Async consume: wait for ANY runner, resubmit it immediately (so
+        sampling continues during the coming update), return its output."""
+        for attempt in range(3):
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1, timeout=timeout
+            )
+            if not ready:
+                raise TimeoutError("no env-runner batch within timeout")
+            ref = ready[0]
+            i = self._inflight.pop(ref)
+            push = self._batches_consumed % self.config.broadcast_interval == 0
+            try:
+                out = ray_tpu.get(ref)
+            except Exception:
+                # fault tolerance: replace the runner, keep the pipeline full
+                self.env_runner_group.replace_runner(i)
+                self._submit(i, push_weights=True)
+                continue
+            self._submit(i, push_weights=push)
+            return out
+        return None
+
+    def training_step(self) -> dict:
+        if not self._runners():
+            return self._training_step_sync()
+        if not self._inflight:
+            for i in range(len(self._runners())):
+                self._submit(i, push_weights=True)
+        losses, metrics_list = [], []
+        for _ in range(self.config.num_batches_per_iteration):
+            out = self._next_batch()
+            if out is None:
+                continue
+            self._batches_consumed += 1
+            seq = self._to_device(out["seq"])
+            self._params, self._opt_state, loss, aux = self._update_fn(
+                self._params, self._opt_state, seq
+            )
+            losses.append(float(loss))
+            metrics_list.append(out["metrics"])
+        self.learner_group.set_weights(
+            {k: np.asarray(v) for k, v in self._params.items()}
+        )
+        return self._result(losses, metrics_list)
+
+    def _training_step_sync(self) -> dict:
+        """num_env_runners=0 degenerate mode: local sampling, still V-trace."""
+        weights = {k: np.asarray(v) for k, v in self._params.items()}
+        self.env_runner_group.local_runner.set_weights(weights)
+        out = self.env_runner_group.local_runner.sample()
+        seq = self._to_device(out["seq"])
+        self._params, self._opt_state, loss, _ = self._update_fn(
+            self._params, self._opt_state, seq
+        )
+        self._batches_consumed += 1
+        self.learner_group.set_weights(
+            {k: np.asarray(v) for k, v in self._params.items()}
+        )
+        return self._result([float(loss)], [out["metrics"]])
+
+    @staticmethod
+    def _to_device(seq: dict):
+        import jax.numpy as jnp
+
+        return {k: jnp.asarray(v) for k, v in seq.items()}
+
+    def _result(self, losses, metrics_list) -> dict:
+        returns = [
+            m["episode_return_mean"]
+            for m in metrics_list
+            if not np.isnan(m["episode_return_mean"])
+        ]
+        steps = sum(m["num_env_steps"] for m in metrics_list)
+        return {
+            "learner": {
+                "total_loss": float(np.mean(losses)) if losses else float("nan"),
+                "num_batches_consumed_lifetime": self._batches_consumed,
+            },
+            "episode_return_mean": (
+                float(np.mean(returns)) if returns else float("nan")
+            ),
+            "num_env_steps_sampled": steps,
+            "num_in_flight_samples": len(self._inflight),
+        }
+
+    def stop(self):
+        self._inflight.clear()
+        super().stop()

@@ -1,0 +1,483 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference: ``rllib/algorithms/sac/sac.py`` (tanh-squashed Gaussian actor,
+twin Q critics with target networks, automatic entropy-temperature tuning
+against ``target_entropy = -|A|``). All nets are plain JAX pytrees; the
+whole update (actor + twin critics + alpha + polyak) is ONE jitted function
+with donated buffers, so the TPU hot path is a single compiled program per
+minibatch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env.continuous import make_continuous_env
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def _mlp_init(key, sizes):
+    import jax
+    import jax.numpy as jnp
+
+    params = {}
+    keys = jax.random.split(key, len(sizes))
+    for i in range(len(sizes) - 1):
+        params[f"w{i}"] = (
+            jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
+            / np.sqrt(sizes[i])
+        ).astype(jnp.float32)
+        params[f"b{i}"] = jnp.zeros((sizes[i + 1],), jnp.float32)
+    return params
+
+
+def _mlp(params, x, n_layers):
+    import jax.numpy as jnp
+
+    for i in range(n_layers - 1):
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+    return x @ params[f"w{n_layers - 1}"] + params[f"b{n_layers - 1}"]
+
+
+def actor_dist(params, obs, n_layers):
+    """(mu, log_std) of the pre-squash Gaussian."""
+    import jax.numpy as jnp
+
+    out = _mlp(params, obs, n_layers)
+    mu, log_std = jnp.split(out, 2, axis=-1)
+    return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+
+def sample_action(params, obs, key, n_layers):
+    """tanh-squashed sample + its log-prob (change-of-variables corrected)."""
+    import jax
+    import jax.numpy as jnp
+
+    mu, log_std = actor_dist(params, obs, n_layers)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mu.shape)
+    pre = mu + std * eps
+    act = jnp.tanh(pre)
+    logp = jnp.sum(
+        -0.5 * (eps**2 + 2 * log_std + np.log(2 * np.pi)), axis=-1
+    )
+    # tanh correction: log det of d tanh(u)/du (stable form)
+    logp -= jnp.sum(
+        2.0 * (np.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)), axis=-1
+    )
+    return act, logp
+
+
+class _Replay:
+    def __init__(self, capacity, obs_dim, act_dim):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity, act_dim), np.float32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.terminals = np.zeros(capacity, np.float32)
+        self.size = 0
+        self._next = 0
+
+    def add(self, obs, action, reward, next_obs, terminal):
+        j = self._next
+        self.obs[j], self.actions[j] = obs, action
+        self.rewards[j], self.next_obs[j] = reward, next_obs
+        self.terminals[j] = terminal
+        self._next = (self._next + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, n, rng):
+        idx = rng.integers(0, self.size, n)
+        return {
+            "obs": self.obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "next_obs": self.next_obs[idx],
+            "terminals": self.terminals[idx],
+        }
+
+
+class ContinuousEnvRunner:
+    """Steps a continuous env with the current actor params; remote-able
+    (same role as SingleAgentEnvRunner for the discrete stack)."""
+
+    def __init__(self, env_id: str, hidden, seed: int = 0):
+        import jax
+
+        self.env = make_continuous_env(env_id)
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.act_dim = int(np.prod(self.env.action_space.shape))
+        self.scale = np.asarray(self.env.action_space.high, np.float32)
+        self.n_layers = len(hidden) + 1
+        self._params = _mlp_init(
+            jax.random.PRNGKey(seed),
+            [self.obs_dim, *hidden, 2 * self.act_dim],
+        )
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._jit_sample = jax.jit(
+            lambda p, o, k: sample_action(p, o, k, self.n_layers)
+        )
+        self._rng = np.random.default_rng(seed)
+        self._obs, _ = self.env.reset(seed=seed)
+        from collections import deque
+
+        self._ep_ret = 0.0
+        self.completed: "deque[float]" = deque(maxlen=200)
+
+    def set_weights(self, params) -> bool:
+        self._params = params
+        return True
+
+    def collect(self, n_steps: int, random_actions: bool = False) -> dict:
+        import jax
+
+        T = n_steps
+        obs_b = np.zeros((T, self.obs_dim), np.float32)
+        act_b = np.zeros((T, self.act_dim), np.float32)
+        rew_b = np.zeros(T, np.float32)
+        next_b = np.zeros((T, self.obs_dim), np.float32)
+        term_b = np.zeros(T, np.float32)
+        for t in range(T):
+            o = np.asarray(self._obs, np.float32).reshape(-1)
+            if random_actions:
+                a = self._rng.uniform(-1.0, 1.0, self.act_dim).astype(np.float32)
+            else:
+                self._key, sub = jax.random.split(self._key)
+                a, _ = self._jit_sample(self._params, o[None], sub)
+                a = np.asarray(a[0])
+            o2, r, term, trunc, _ = self.env.step(a * self.scale)
+            obs_b[t], act_b[t], rew_b[t] = o, a, r
+            next_b[t] = np.asarray(o2, np.float32).reshape(-1)
+            term_b[t] = float(term)
+            self._ep_ret += r
+            if term or trunc:
+                self.completed.append(float(self._ep_ret))
+                self._ep_ret = 0.0
+                o2, _ = self.env.reset()
+            self._obs = o2
+        recent = list(self.completed)[-50:]
+        return {
+            "batch": {
+                "obs": obs_b,
+                "actions": act_b,
+                "rewards": rew_b,
+                "next_obs": next_b,
+                "terminals": term_b,
+            },
+            "metrics": {
+                "episode_return_mean": (
+                    float(np.mean(recent)) if recent else float("nan")
+                ),
+                "num_env_steps": T,
+            },
+        }
+
+    def ping(self) -> bool:
+        return True
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.tau = 0.005
+        self.initial_alpha = 1.0
+        self.target_entropy: Optional[float] = None  # default -|A|
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 500
+        self.rollout_fragment_length = 200
+        self.train_batch_size = 128
+        self.num_updates_per_iteration = 100
+        self.model = {"hidden": (64, 64)}
+
+
+class SAC(Algorithm):
+    """Continuous control only: builds its own runner (the shared discrete
+    env-runner stack assumes categorical actions)."""
+
+    def __init__(self, config: SACConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        if config.env is None:
+            raise ValueError("config.environment(env=...) is required")
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        env = make_continuous_env(config.env)
+        self.obs_dim = int(np.prod(env.observation_space.shape))
+        self.act_dim = int(np.prod(env.action_space.shape))
+        hidden = tuple(config.model.get("hidden", (64, 64)))
+        self.n_layers = len(hidden) + 1
+        self._rng = np.random.default_rng(config.seed)
+
+        if config.num_env_runners > 0:
+            cls = ray_tpu.remote(ContinuousEnvRunner)
+            self._runners = [
+                cls.options(num_cpus=1).remote(
+                    config.env, hidden, seed=config.seed + i
+                )
+                for i in range(config.num_env_runners)
+            ]
+            self._local = None
+        else:
+            self._runners = []
+            self._local = ContinuousEnvRunner(config.env, hidden, config.seed)
+
+        key = jax.random.PRNGKey(config.seed)
+        k_actor, k_q1, k_q2 = jax.random.split(key, 3)
+        q_sizes = [self.obs_dim + self.act_dim, *hidden, 1]
+        self._state = {
+            "actor": _mlp_init(k_actor, [self.obs_dim, *hidden, 2 * self.act_dim]),
+            "q1": _mlp_init(k_q1, q_sizes),
+            "q2": _mlp_init(k_q2, q_sizes),
+            "q1_target": None,
+            "q2_target": None,
+            "log_alpha": jnp.asarray(np.log(config.initial_alpha), jnp.float32),
+        }
+        self._state["q1_target"] = jax.tree.map(jnp.copy, self._state["q1"])
+        self._state["q2_target"] = jax.tree.map(jnp.copy, self._state["q2"])
+        self.target_entropy = (
+            config.target_entropy
+            if config.target_entropy is not None
+            else -float(self.act_dim)
+        )
+        self._opt = {
+            "actor": optax.adam(config.actor_lr),
+            "critic": optax.adam(config.critic_lr),
+            "alpha": optax.adam(config.alpha_lr),
+        }
+        self._opt_state = {
+            "actor": self._opt["actor"].init(self._state["actor"]),
+            "critic": self._opt["critic"].init(
+                (self._state["q1"], self._state["q2"])
+            ),
+            "alpha": self._opt["alpha"].init(self._state["log_alpha"]),
+        }
+        self.replay = _Replay(
+            config.replay_buffer_capacity, self.obs_dim, self.act_dim
+        )
+        self._update_fn = self._build_update()
+        self._jax_key = jax.random.PRNGKey(config.seed + 7)
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        n_layers = self.n_layers
+        gamma = self.config.gamma
+        tau = self.config.tau
+        target_entropy = self.target_entropy
+        opt = self._opt
+
+        def q_val(q_params, obs, act):
+            return _mlp(q_params, jnp.concatenate([obs, act], -1), n_layers)[:, 0]
+
+        def critic_loss(q_pair, state, batch, key):
+            q1, q2 = q_pair
+            next_a, next_logp = sample_action(
+                state["actor"], batch["next_obs"], key, n_layers
+            )
+            alpha = jnp.exp(state["log_alpha"])
+            tq = jnp.minimum(
+                q_val(state["q1_target"], batch["next_obs"], next_a),
+                q_val(state["q2_target"], batch["next_obs"], next_a),
+            )
+            target = batch["rewards"] + gamma * (1 - batch["terminals"]) * (
+                tq - alpha * next_logp
+            )
+            target = jax.lax.stop_gradient(target)
+            l1 = jnp.mean((q_val(q1, batch["obs"], batch["actions"]) - target) ** 2)
+            l2 = jnp.mean((q_val(q2, batch["obs"], batch["actions"]) - target) ** 2)
+            return l1 + l2
+
+        def actor_loss(actor, state, batch, key):
+            a, logp = sample_action(actor, batch["obs"], key, n_layers)
+            alpha = jax.lax.stop_gradient(jnp.exp(state["log_alpha"]))
+            q = jnp.minimum(
+                q_val(state["q1"], batch["obs"], a),
+                q_val(state["q2"], batch["obs"], a),
+            )
+            return jnp.mean(alpha * logp - q), logp
+
+        def alpha_loss(log_alpha, logp):
+            return -jnp.mean(
+                log_alpha * jax.lax.stop_gradient(logp + target_entropy)
+            )
+
+        def update(state, opt_state, batch, key):
+            k1, k2 = jax.random.split(key)
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                (state["q1"], state["q2"]), state, batch, k1
+            )
+            cupd, new_c_opt = opt["critic"].update(
+                cgrads, opt_state["critic"], (state["q1"], state["q2"])
+            )
+            q1, q2 = optax.apply_updates((state["q1"], state["q2"]), cupd)
+            state = {**state, "q1": q1, "q2": q2}
+
+            (aloss, logp), agrads = jax.value_and_grad(actor_loss, has_aux=True)(
+                state["actor"], state, batch, k2
+            )
+            aupd, new_a_opt = opt["actor"].update(
+                agrads, opt_state["actor"], state["actor"]
+            )
+            state = {**state, "actor": optax.apply_updates(state["actor"], aupd)}
+
+            lloss, lgrads = jax.value_and_grad(alpha_loss)(
+                state["log_alpha"], logp
+            )
+            lupd, new_l_opt = opt["alpha"].update(
+                lgrads, opt_state["alpha"], state["log_alpha"]
+            )
+            state = {
+                **state,
+                "log_alpha": optax.apply_updates(state["log_alpha"], lupd),
+            }
+
+            polyak = lambda t, s: jax.tree.map(  # noqa: E731
+                lambda a, b: (1 - tau) * a + tau * b, t, s
+            )
+            state = {
+                **state,
+                "q1_target": polyak(state["q1_target"], state["q1"]),
+                "q2_target": polyak(state["q2_target"], state["q2"]),
+            }
+            opt_state = {
+                "critic": new_c_opt,
+                "actor": new_a_opt,
+                "alpha": new_l_opt,
+            }
+            return state, opt_state, closs, aloss, jnp.exp(state["log_alpha"])
+
+        return jax.jit(update, donate_argnums=(0, 1))
+
+    # -- sampling ------------------------------------------------------------
+
+    def _collect(self, random_actions: bool):
+        n = self.config.rollout_fragment_length
+        if self._local is not None:
+            self._local.set_weights(
+                {k: np.asarray(v) for k, v in self._state["actor"].items()}
+            )
+            return [self._local.collect(n, random_actions)]
+        weights = {k: np.asarray(v) for k, v in self._state["actor"].items()}
+        ray_tpu.get([r.set_weights.remote(weights) for r in self._runners])
+        return ray_tpu.get(
+            [r.collect.remote(n, random_actions) for r in self._runners],
+            timeout=300,
+        )
+
+    def training_step(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        warmup = (
+            self.replay.size
+            < self.config.num_steps_sampled_before_learning_starts
+        )
+        outs = self._collect(random_actions=warmup)
+        steps = 0
+        returns = []
+        for out in outs:
+            b = out["batch"]
+            for t in range(len(b["rewards"])):
+                self.replay.add(
+                    b["obs"][t], b["actions"][t], b["rewards"][t],
+                    b["next_obs"][t], b["terminals"][t],
+                )
+            steps += out["metrics"]["num_env_steps"]
+            if not np.isnan(out["metrics"]["episode_return_mean"]):
+                returns.append(out["metrics"]["episode_return_mean"])
+
+        stats = {}
+        if not warmup:
+            closs = aloss = alpha = 0.0
+            for _ in range(self.config.num_updates_per_iteration):
+                mb = self.replay.sample(self.config.train_batch_size, self._rng)
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                self._jax_key, sub = jax.random.split(self._jax_key)
+                self._state, self._opt_state, closs, aloss, alpha = (
+                    self._update_fn(self._state, self._opt_state, mb, sub)
+                )
+            stats = {
+                "critic_loss": float(closs),
+                "actor_loss": float(aloss),
+                "alpha": float(alpha),
+            }
+        return {
+            "learner": stats,
+            "episode_return_mean": (
+                float(np.mean(returns)) if returns else float("nan")
+            ),
+            "num_env_steps_sampled": steps,
+            "replay_size": self.replay.size,
+        }
+
+    def evaluate(self, n_episodes: int = 10) -> float:
+        """Mean return of the DETERMINISTIC policy (tanh of the Gaussian
+        mean) — the reference's evaluation-worker role, without the lag of
+        the rolling training-episode window."""
+        import jax
+        import jax.numpy as jnp
+
+        env = make_continuous_env(self.config.env)
+        scale = np.asarray(env.action_space.high, np.float32)
+        fwd = jax.jit(
+            lambda p, o: jnp.tanh(actor_dist(p, o, self.n_layers)[0])
+        )
+        returns = []
+        for ep in range(n_episodes):
+            obs, _ = env.reset(seed=10_000 + ep)
+            total, done = 0.0, False
+            while not done:
+                a = np.asarray(
+                    fwd(self._state["actor"], np.asarray(obs, np.float32)[None])
+                )[0]
+                obs, r, term, trunc, _ = env.step(a * scale)
+                total += r
+                done = term or trunc
+            returns.append(total)
+        return float(np.mean(returns))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def stop(self):
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def get_state(self) -> dict:
+        import jax
+
+        return {
+            "sac": jax.device_get(self._state),
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state: dict):
+        import jax.numpy as jnp
+
+        self._state = {
+            k: (
+                jnp.asarray(v)
+                if k == "log_alpha"
+                else {kk: jnp.asarray(vv) for kk, vv in v.items()}
+            )
+            for k, v in state["sac"].items()
+        }
+        self.iteration = state.get("iteration", 0)
+        self._total_env_steps = state.get("total_env_steps", 0)

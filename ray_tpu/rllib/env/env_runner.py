@@ -53,6 +53,7 @@ class SingleAgentEnvRunner:
         gamma: float = 0.99,
         lambda_: float = 0.95,
         seed: int = 0,
+        emit_sequences: bool = False,
     ):
         import cloudpickle
 
@@ -62,6 +63,9 @@ class SingleAgentEnvRunner:
         self.rollout_fragment_length = rollout_fragment_length
         self.gamma = gamma
         self.lambda_ = lambda_
+        # time-major [T, N] sequences for off-policy-corrected learners
+        # (IMPALA's V-trace needs per-step behavior logp in trajectory order)
+        self.emit_sequences = emit_sequences
         self._rng = np.random.default_rng(seed)
         self._obs = []
         for i, e in enumerate(self.envs):
@@ -172,7 +176,7 @@ class SingleAgentEnvRunner:
             "num_env_steps": T * N,
             "num_episodes": episodes_this_sample,  # per-fragment, not lifetime
         }
-        return {
+        out = {
             "batch": {
                 "obs": obs_buf.reshape(T * N, -1),
                 "actions": act_buf.reshape(-1),
@@ -186,6 +190,17 @@ class SingleAgentEnvRunner:
             },
             "metrics": metrics,
         }
+        if self.emit_sequences:
+            out["seq"] = {
+                "obs": obs_buf,  # [T, N, D]
+                "next_obs": next_obs_buf,
+                "actions": act_buf,  # [T, N]
+                "rewards": rew_buf,
+                "terminals": term_buf,  # true termination: V(s') = 0
+                "ends": end_buf,  # term OR trunc: cuts the v-trace scan
+                "logp_behavior": logp_buf,
+            }
+        return out
 
     def ping(self) -> bool:
         return True
@@ -211,6 +226,7 @@ class EnvRunnerGroup:
         gamma: float = 0.99,
         lambda_: float = 0.95,
         seed: int = 0,
+        emit_sequences: bool = False,
     ):
         import cloudpickle
 
@@ -221,6 +237,7 @@ class EnvRunnerGroup:
             rollout_fragment_length=rollout_fragment_length,
             gamma=gamma,
             lambda_=lambda_,
+            emit_sequences=emit_sequences,
         )
         self._seed = seed
         self.num_env_runners = num_env_runners
@@ -240,6 +257,21 @@ class EnvRunnerGroup:
         return cls.options(num_cpus=1).remote(
             self._env_id, self._payload, seed=self._seed + index, **self._kwargs
         )
+
+    @property
+    def runners(self) -> list:
+        """Remote runner handles (empty in local mode)."""
+        return self._remote
+
+    @property
+    def local_runner(self):
+        return self._local
+
+    def replace_runner(self, index: int):
+        """Respawn a dead runner in place; returns the new handle (used by
+        async consumers like IMPALA that manage their own in-flight refs)."""
+        self._remote[index] = self._spawn(index)
+        return self._remote[index]
 
     def sample(self, weights: Optional[dict] = None) -> tuple[dict, dict]:
         """Returns (concatenated batch, aggregated metrics)."""

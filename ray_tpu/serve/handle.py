@@ -302,8 +302,13 @@ class DeploymentHandle:
             for k, v in kwargs.items()
         }
         try:
+            # bounded producer lead: without backpressure an infinite or
+            # abandoned stream would pin every sealed chunk in the store
+            # (the consumer-gone signal is only checked when the producer
+            # blocks on the threshold)
             ref_gen = actor.handle_request_streaming.options(
-                num_returns="streaming"
+                num_returns="streaming",
+                _generator_backpressure_num_objects=16,
             ).remote(method, *args, **kwargs)
         except Exception:
             with self._lock:

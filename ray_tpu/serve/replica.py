@@ -63,25 +63,29 @@ class ReplicaActor:
 
     # -- data plane ---------------------------------------------------------
 
+    def _resolve_and_call(self, method: str, args, kwargs):
+        """Shared dispatch: resolve the handler, call it, drive coroutines
+        on a per-request loop (requests already parallelize across the
+        replica's concurrency threads)."""
+        if inspect.isfunction(self._callable) or inspect.isbuiltin(
+            self._callable
+        ):
+            fn = self._callable  # function deployment: one entry point
+        else:
+            fn = getattr(self._callable, method)
+        result = fn(*args, **kwargs)
+        if inspect.iscoroutine(result):
+            import asyncio
+
+            result = asyncio.run(result)
+        return result
+
     def handle_request(self, method: str, *args, **kwargs):
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
-            if inspect.isfunction(self._callable) or inspect.isbuiltin(
-                self._callable
-            ):
-                fn = self._callable  # function deployment: one entry point
-            else:
-                fn = getattr(self._callable, method)
-            result = fn(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                # async handlers run on a per-request loop (requests already
-                # parallelize across the replica's concurrency threads)
-                import asyncio
-
-                result = asyncio.run(result)
-            return result
+            return self._resolve_and_call(method, args, kwargs)
         finally:
             with self._lock:
                 self._ongoing -= 1
@@ -98,17 +102,7 @@ class ReplicaActor:
             self._ongoing += 1
             self._total += 1
         try:
-            if inspect.isfunction(self._callable) or inspect.isbuiltin(
-                self._callable
-            ):
-                fn = self._callable
-            else:
-                fn = getattr(self._callable, method)
-            result = fn(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                import asyncio
-
-                result = asyncio.run(result)
+            result = self._resolve_and_call(method, args, kwargs)
             if hasattr(result, "__anext__"):
                 result = _drive_async_gen(result)
             if inspect.isgenerator(result):

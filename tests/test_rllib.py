@@ -265,3 +265,113 @@ def test_ppo_with_tune(ray_start_thread, tmp_path):
     ).fit()
     assert results.num_errors == 0, results.errors
     assert len(results) == 2
+
+
+def test_impala_learns_cartpole(ray_start_thread):
+    """IMPALA: async sample/learn pipeline improves CartPole return, and the
+    pipeline demonstrably overlaps (samples stay in flight while the learner
+    runs)."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                     rollout_fragment_length=50)
+        .training(lr=5e-4, num_batches_per_iteration=8, entropy_coeff=0.01)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    first = last = None
+    for i in range(25):
+        r = algo.train()
+        # the async pipeline keeps every runner's next sample in flight
+        # while training_step runs its updates — overlap by construction
+        assert r["num_in_flight_samples"] == 2
+        m = r["episode_return_mean"]
+        if not np.isnan(m):
+            if first is None:
+                first = m
+            last = m
+    algo.stop()
+    assert first is not None
+    assert last > first + 20, (first, last)
+
+
+def test_impala_vtrace_offpolicy_correction():
+    """V-trace ratios stay finite and the sync (0-runner) path also learns."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=50)
+        .debugging(seed=1)
+    )
+    algo = config.build()
+    first = last = None
+    for _ in range(45):
+        r = algo.train()
+        assert np.isfinite(r["learner"]["total_loss"])
+        m = r["episode_return_mean"]
+        if not np.isnan(m):
+            if first is None:
+                first = m
+            last = m
+    algo.stop()
+    # one v-trace step per 400-step fragment: slower than the async path's
+    # 8 batches/iter, but the trend must be clearly up
+    assert last > first + 12, (first, last)
+
+
+def test_sac_learns_reach():
+    """SAC (continuous control): twin-Q + tanh-Gaussian actor + auto-alpha
+    drives the Reach env's return up from the random-policy baseline."""
+    from ray_tpu.rllib import SACConfig
+
+    config = (
+        SACConfig()
+        .environment("Reach-v0")
+        .env_runners(num_env_runners=0, rollout_fragment_length=200)
+        .training(
+            num_updates_per_iteration=100,
+            train_batch_size=128,
+            num_steps_sampled_before_learning_starts=400,
+            alpha_lr=1e-3,
+        )
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    for _ in range(12):
+        algo.train()
+    learned = algo.evaluate(n_episodes=10)
+    algo.stop()
+    # eval starts average x0^2 ~ 0.49: doing nothing scores ~-19, random
+    # ~-30; a learned policy drives to the origin and holds (~-2 optimal)
+    assert learned > -8, learned
+
+
+def test_sac_remote_runners_and_checkpoint(ray_start_thread, tmp_path):
+    from ray_tpu.rllib import SACConfig
+
+    config = (
+        SACConfig()
+        .environment("Reach-v0")
+        .env_runners(num_env_runners=2, rollout_fragment_length=100)
+        .training(num_updates_per_iteration=20)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    for _ in range(4):
+        r = algo.train()
+    assert r["replay_size"] >= 700  # 2 runners x 100 steps x 4 iters
+    path = algo.save(str(tmp_path / "sac_ckpt"))
+    state_before = algo.get_state()["sac"]["log_alpha"]
+    algo.stop()
+
+    algo2 = config.build()
+    algo2.restore(path)
+    assert np.allclose(algo2.get_state()["sac"]["log_alpha"], state_before)
+    algo2.train()  # restored state keeps training
+    algo2.stop()
