@@ -1747,14 +1747,23 @@ class Controller:
         if pt is None:
             return
         spec = pt.spec
-        failed = False
+        failed = any(kind == "error" for _, kind, _ in msg.results)
+        if (
+            failed
+            and spec.retry_exceptions
+            and pt.retries_left > 0
+            and not spec.is_actor_creation()
+        ):
+            # application-error retry (reference: retry_exceptions,
+            # task_manager.cc): don't seal the error — resubmit the task and
+            # let blocked getters keep waiting on the same return ids
+            self._retry_failed_task(worker, pt, msg)
+            return
         for oid, kind, payload in msg.results:
             if kind == "plasma":
                 shm_name, size = payload
                 self._seal_plasma(oid, shm_name, size)
             else:
-                if kind == "error":
-                    failed = True
                 self.memory_store.put(oid, (kind, SerializedObject.from_buffer(payload)))
             self._on_object_sealed(oid)
         self.task_events.append(
@@ -1798,6 +1807,37 @@ class Controller:
                     worker.last_idle_t = time.monotonic()
                     self.idle_workers[worker.node_id].append(worker)
             self.sched_cv.notify_all()
+
+    def _retry_failed_task(self, worker: WorkerHandle, pt: PendingTask, msg: P.TaskDone):
+        spec = pt.spec
+        self.task_events.append(
+            {
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "event": "RETRY",
+                "exec_ms": msg.exec_ms,
+                "t": time.time(),
+            }
+        )
+        with self.lock:
+            pt.retries_left -= 1
+            self._release_task_resources(pt)
+            if spec.is_actor_task():
+                actor = self.actors.get(spec.actor_id)
+                if actor is not None:
+                    actor.inflight -= 1
+                    actor.queue.appendleft(pt)  # preserve ordering
+                    self._pump_actor(actor)
+            else:
+                if not worker.dead and worker.actor_id is None:
+                    worker.last_idle_t = time.monotonic()
+                    self.idle_workers[worker.node_id].append(worker)
+                self._enqueue_ready(pt)
+            self.sched_cv.notify_all()
+        logger.warning(
+            "task %s raised; retrying (%d retries left, retry_exceptions)",
+            spec.name, pt.retries_left,
+        )
 
     def _release_task_resources(self, pt: PendingTask):
         node = getattr(pt, "_node", None)

@@ -114,6 +114,7 @@ class WorkerAPI:
         num_returns=1,
         resources: dict[str, float] | None = None,
         max_retries: int = 0,
+        retry_exceptions: bool = False,
         strategy: SchedulingStrategy | None = None,
         runtime_env: dict | None = None,
         function_blob: bytes | None = None,
@@ -133,6 +134,7 @@ class WorkerAPI:
             num_returns=num_returns,
             resources=resources or {"CPU": 1.0},
             max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
             strategy=strategy or SchedulingStrategy(),
             runtime_env=runtime_env,
             generator_backpressure=generator_backpressure,
@@ -192,6 +194,7 @@ class WorkerAPI:
         num_returns=1,
         seq_no: int = 0,
         max_retries: int = 0,
+        retry_exceptions: bool = False,
         generator_backpressure: int = 0,
     ) -> list[ObjectRef]:
         idx = self._next_submit_index()
@@ -209,6 +212,7 @@ class WorkerAPI:
             actor_id=actor_id,
             seq_no=seq_no,
             max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
             generator_backpressure=generator_backpressure,
         )
         return_ids = spec.return_ids()

@@ -108,11 +108,39 @@ class WorkerRuntime:
         # The reader loop must never block on task execution (tasks make
         # controller calls — get/submit — whose replies arrive on the reader).
         self._task_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+        # worker-side rpc chaos (lazily parsed from env)
+        self._chaos_table: Optional[dict] = None
+        import random as _random
+
+        self._chaos_rng = _random.Random(
+            int.from_bytes(worker_id.binary()[:4], "little")
+        )
         # client drivers attach to a foreign cluster: reply pump only, no
         # task execution, and never os._exit on disconnect
         self.client_mode = False
 
     # ------------------------------------------------------------- transport
+
+    def _maybe_inject_failure(self, op: str):
+        """Worker-side RPC chaos (reference: ``rpc_chaos.h:23`` covers EVERY
+        rpc channel, not just GCS ops — this is the worker↔controller and
+        plasma analog of the controller's ``testing_rpc_failure``). Config:
+        env ``RAY_TPU_WORKER_RPC_FAILURE="op=prob,op=prob"``."""
+        spec = os.environ.get("RAY_TPU_WORKER_RPC_FAILURE")
+        if not spec:
+            return
+        if self._chaos_table is None:
+            table = {}
+            for part in spec.split(","):
+                name, _, prob = part.partition("=")
+                table[name.strip()] = float(prob)
+            self._chaos_table = table
+        prob = self._chaos_table.get(op)
+        if prob and self._chaos_rng.random() < prob:
+            raise OSError(
+                f"injected worker rpc failure for {op!r} "
+                f"(RAY_TPU_WORKER_RPC_FAILURE)"
+            )
 
     def _send(self, msg):
         with self._send_lock:
@@ -204,6 +232,7 @@ class WorkerRuntime:
 
     def get_objects(self, object_ids: list[ObjectID], timeout=None) -> list:
         """Returns [(SerializedObject, kind)] parallel to object_ids."""
+        self._maybe_inject_failure("get_objects")
         req_id = next(self._req_counter)
         self._send(P.GetObjects(req_id, object_ids))
         results = self._await_reply(req_id, timeout)
@@ -225,6 +254,7 @@ class WorkerRuntime:
             return self._get_replies.pop(req_id)
 
     def call_controller(self, op: str, payload=None, fire_and_forget: bool = False):
+        self._maybe_inject_failure(op)
         req_id = next(self._req_counter)
         self._send(P.Request(req_id, op, payload))
         if fire_and_forget:
@@ -277,6 +307,7 @@ class WorkerRuntime:
                     self._pull_object(ObjectID(loc[2]), size)
                 )
             try:
+                self._maybe_inject_failure("plasma_read")
                 return self._plasma().read(shm_name, size)
             except (FileNotFoundError, OSError, NativePlasmaError):
                 # the segment/arena isn't attachable from this process — a

@@ -24,6 +24,10 @@ def _normalize(value) -> np.ndarray:
     return arr
 
 
+def _is_arrow_table(data) -> bool:
+    return hasattr(data, "column_names") and hasattr(data, "combine_chunks")
+
+
 class BlockAccessor:
     """Uniform view over a block (reference: ``BlockAccessor.for_block``)."""
 
@@ -32,19 +36,36 @@ class BlockAccessor:
 
     @staticmethod
     def for_block(block) -> "BlockAccessor":
+        if _is_arrow_table(block):
+            return ArrowBlockAccessor(block)
         return BlockAccessor(BlockAccessor.normalize(block))
 
     # -- construction -------------------------------------------------------
 
     @staticmethod
     def normalize(data) -> Block:
-        """Coerce rows/pandas/arrow/ndarray into the columnar numpy block."""
+        """Coerce rows/pandas/arrow/ndarray into the columnar numpy block.
+
+        Arrow tables convert column-wise via ``to_numpy`` — zero-copy for
+        non-null numeric columns (the TPU feed path), never through Python
+        lists.
+        """
         if isinstance(data, dict):
             return {k: _normalize(v) for k, v in data.items()}
         if isinstance(data, np.ndarray):
             return {TENSOR_COLUMN: data}
-        if hasattr(data, "to_pydict"):  # pyarrow.Table
-            return {k: np.asarray(v) for k, v in data.to_pydict().items()}
+        if _is_arrow_table(data):  # pyarrow.Table
+            t = data.combine_chunks()
+            return {
+                name: t.column(name).to_numpy(zero_copy_only=False)
+                for name in t.column_names
+            }
+        if hasattr(data, "to_pydict") and hasattr(data, "schema"):
+            # pyarrow.RecordBatch: column-wise, zero-copy where possible
+            return {
+                name: data.column(i).to_numpy(zero_copy_only=False)
+                for i, name in enumerate(data.schema.names)
+            }
         if hasattr(data, "columns") and hasattr(data, "to_numpy"):  # DataFrame
             return {c: data[c].to_numpy() for c in data.columns}
         if isinstance(data, list):  # rows
@@ -65,6 +86,10 @@ class BlockAccessor:
 
     @staticmethod
     def concat(blocks: list[Block]) -> Block:
+        blocks = [
+            BlockAccessor.normalize(b) if _is_arrow_table(b) else b
+            for b in blocks
+        ]
         blocks = [b for b in blocks if b and BlockAccessor(b).num_rows()]
         if not blocks:
             return {}
@@ -139,3 +164,67 @@ class BlockAccessor:
         if batch_format == "pyarrow":
             return self.to_arrow()
         raise ValueError(f"unknown batch_format: {batch_format}")
+
+
+class ArrowBlockAccessor(BlockAccessor):
+    """Accessor over a ``pyarrow.Table`` block — Arrow IS the block, no
+    up-front conversion (reference: ``_internal/arrow_block.py``
+    ``ArrowBlockAccessor``). Row-range ops (slice/take) are zero-copy table
+    ops; ``to_numpy``/``to_batch`` convert lazily at the compute boundary,
+    zero-copy for non-null numeric columns. Parquet reads produce these
+    natively (``read_parquet``), so scan→slice→batch never round-trips
+    through Python objects."""
+
+    def __init__(self, table):
+        self._b = table
+
+    def num_rows(self) -> int:
+        return self._b.num_rows
+
+    def size_bytes(self) -> int:
+        return self._b.nbytes
+
+    def schema(self) -> dict[str, str]:
+        return {
+            f.name: str(f.type) for f in self._b.schema
+        }
+
+    def columns(self) -> list[str]:
+        return list(self._b.column_names)
+
+    def row(self, i: int) -> dict:
+        return {
+            name: self._b.column(name)[i].as_py()
+            for name in self._b.column_names
+        }
+
+    def iter_rows(self) -> Iterator[dict]:
+        for batch in self._b.to_batches():
+            yield from batch.to_pylist()
+
+    def slice(self, start: int, end: int):
+        return self._b.slice(start, end - start)  # zero-copy view
+
+    def take_indices(self, idx: np.ndarray):
+        return self._b.take(idx)
+
+    def to_numpy(self) -> dict[str, np.ndarray]:
+        return BlockAccessor.normalize(self._b)
+
+    def to_pandas(self):
+        return self._b.to_pandas()
+
+    def to_arrow(self):
+        return self._b
+
+    def to_batch(self, batch_format: Optional[str]):
+        if batch_format == "pyarrow":
+            return self._b
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format not in (None, "numpy", "default", "dict"):
+            raise ValueError(f"unknown batch_format: {batch_format}")
+        b = self.to_numpy()
+        if batch_format != "dict" and set(b) == {TENSOR_COLUMN}:
+            return b[TENSOR_COLUMN]
+        return b

@@ -271,50 +271,76 @@ class MaterializedDataset(Dataset):
 
 
 class GroupedData:
-    """Hash-grouped aggregation (reference: ``grouped_data.py``)."""
+    """Distributed hash-grouped aggregation (reference: ``grouped_data.py``
+    over the shuffle-based aggregate plan).
+
+    Two task phases: hash-partition each block by key (every group lands
+    wholly in one bucket), then reduce each bucket with an exact local
+    aggregate — no driver-side row materialization at any point."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
-    def _grouped_rows(self) -> dict:
-        groups: dict = {}
-        for row in self._ds.take_all():
-            groups.setdefault(row[self._key], []).append(row)
-        return groups
+    def _buckets(self) -> list[list]:
+        """[bucket][mapper] ObjectRefs from the hash-partition phase."""
+        import builtins  # the module-level `range` is the Dataset constructor
+
+        from ray_tpu.data.execution import _hash_partition
+
+        refs = list(self._ds._execute())
+        if not refs:
+            return []
+        n = len(refs)
+        part = ray_tpu.remote(_hash_partition).options(num_returns=n)
+        bucket_refs = [part.remote(r, self._key, n) for r in refs]
+        if n == 1:
+            return [[bucket_refs[0]]]
+        return [
+            [bucket_refs[m][b] for m in builtins.range(n)]
+            for b in builtins.range(n)
+        ]
+
+    def _aggregate(self, aggs: list) -> Dataset:
+        from ray_tpu.data.execution import _group_aggregate
+
+        out = [
+            _group_aggregate.remote(self._key, aggs, *bucket)
+            for bucket in self._buckets()
+        ]
+        return MaterializedDataset(out)
 
     def count(self) -> Dataset:
-        rows = [
-            {self._key: k, "count()": len(v)} for k, v in self._grouped_rows().items()
-        ]
-        return from_items(rows)
-
-    def _agg(self, col: str, fn, label: str) -> Dataset:
-        rows = [
-            {self._key: k, f"{label}({col})": float(fn([r[col] for r in v]))}
-            for k, v in self._grouped_rows().items()
-        ]
-        return from_items(rows)
+        return self._aggregate([("count", None)])
 
     def sum(self, col: str) -> Dataset:
-        return self._agg(col, np.sum, "sum")
+        return self._aggregate([("sum", col)])
 
     def mean(self, col: str) -> Dataset:
-        return self._agg(col, np.mean, "mean")
+        return self._aggregate([("mean", col)])
 
     def min(self, col: str) -> Dataset:
-        return self._agg(col, np.min, "min")
+        return self._aggregate([("min", col)])
 
     def max(self, col: str) -> Dataset:
-        return self._agg(col, np.max, "max")
+        return self._aggregate([("max", col)])
+
+    def std(self, col: str) -> Dataset:
+        return self._aggregate([("std", col)])
+
+    def aggregate(self, *aggs: tuple) -> Dataset:
+        """Multiple aggregates in one pass: ``aggregate(("sum", "x"),
+        ("max", "y"))`` → columns ``sum(x)``, ``max(y)``."""
+        return self._aggregate(list(aggs))
 
     def map_groups(self, fn: Callable) -> Dataset:
-        out = []
-        for _, rows in self._grouped_rows().items():
-            res = fn(BlockAccessor.from_rows(rows))
-            out.append(BlockAccessor.normalize(res))
-        refs = [ray_tpu.put(b) for b in out]
-        return MaterializedDataset(refs)
+        from ray_tpu.data.execution import _group_map
+
+        out = [
+            _group_map.remote(self._key, fn, *bucket)
+            for bucket in self._buckets()
+        ]
+        return MaterializedDataset(out)
 
 
 # -- constructors (read API) -------------------------------------------------

@@ -26,7 +26,12 @@ class ReadTask:
         self.metadata = metadata or {}
 
     def __call__(self) -> Block:
-        return BlockAccessor.normalize(self._fn())
+        from ray_tpu.data.block import _is_arrow_table
+
+        out = self._fn()
+        if _is_arrow_table(out):
+            return out  # Arrow tables are first-class blocks — keep them
+        return BlockAccessor.normalize(out)
 
 
 class StreamingReadTask(ReadTask):
@@ -39,8 +44,10 @@ class StreamingReadTask(ReadTask):
     streaming = True
 
     def iter_blocks(self):
+        from ray_tpu.data.block import _is_arrow_table
+
         for b in self._fn():
-            yield BlockAccessor.normalize(b)
+            yield b if _is_arrow_table(b) else BlockAccessor.normalize(b)
 
 
 class Datasource:
@@ -197,10 +204,32 @@ class JSONDatasource(FileBasedDatasource):
 
 
 class ParquetDatasource(FileBasedDatasource):
+    """Emits Arrow-table blocks natively (zero-copy from the parquet
+    reader); row groups stream as separate blocks with ``stream_row_groups``."""
+
+    def __init__(self, paths, stream_row_groups: bool = False, **reader_kwargs):
+        super().__init__(paths, **reader_kwargs)
+        self.stream_row_groups = stream_row_groups
+
     def _read_file(self, path: str) -> Block:
         import pyarrow.parquet as pq
 
-        return BlockAccessor.normalize(pq.read_table(path, **self.reader_kwargs))
+        return pq.read_table(path, **self.reader_kwargs)
+
+    def _read_row_groups(self, path: str):
+        import pyarrow.parquet as pq
+
+        f = pq.ParquetFile(path)
+        for i in range(f.num_row_groups):
+            yield f.read_row_group(i, **self.reader_kwargs)
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        if not self.stream_row_groups:
+            return super().get_read_tasks(parallelism)
+        return [
+            StreamingReadTask(lambda p=p: self._read_row_groups(p), {"path": p})
+            for p in self.paths
+        ]
 
 
 class NumpyDatasource(FileBasedDatasource):

@@ -38,9 +38,10 @@ def _apply_transforms(block: Block, transforms: list) -> Block:
             bs = op.batch_size or n or 1
             outs = []
             for s in range(0, n, bs):
-                batch = BlockAccessor(acc.slice(s, min(s + bs, n))).to_batch(
-                    op.batch_format
-                )
+                # for_block: a slice of an arrow block is an arrow block
+                batch = BlockAccessor.for_block(
+                    acc.slice(s, min(s + bs, n))
+                ).to_batch(op.batch_format)
                 out = op.fn(batch, **op.fn_kwargs)
                 outs.append(BlockAccessor.normalize(out))
             block = BlockAccessor.concat(outs) if outs else {}
@@ -136,6 +137,93 @@ def _range_partition(block, key, boundaries):
     assignment = np.searchsorted(np.asarray(boundaries), keys, side="right")
     out = [acc.take_indices(np.nonzero(assignment == i)[0]) for i in range(n)]
     return tuple(out) if n > 1 else out[0]
+
+
+def _hash_partition(block, key, n):
+    """Map phase of distributed groupby: rows → n buckets by a deterministic
+    key hash, so every row of one group lands in exactly one bucket
+    (reference: the shuffle-based aggregate, ``_internal/planner/
+    exchange/``)."""
+    import zlib
+
+    acc = BlockAccessor.for_block(block)
+    if not acc.num_rows():
+        return tuple({} for _ in range(n)) if n > 1 else {}
+    keys = np.asarray(block[key] if isinstance(block, dict) else acc.to_numpy()[key])
+    uniq, inv = np.unique(keys, return_inverse=True)
+    # crc32 over the repr of the PYTHON value: .item() strips numpy scalar
+    # wrappers, and integral floats collapse to ints so 5 and 5.0 (equal
+    # keys that np.unique would merge within one block) bucket identically
+    # even when different blocks carry the key at different dtypes
+    def key_repr(u):
+        v = u.item() if hasattr(u, "item") else u
+        if isinstance(v, float) and v.is_integer():
+            v = int(v)
+        return repr(v)
+
+    bucket_of = np.array([zlib.crc32(key_repr(u).encode()) % n for u in uniq])
+    assignment = bucket_of[inv]
+    out = [acc.take_indices(np.nonzero(assignment == i)[0]) for i in range(n)]
+    return tuple(out) if n > 1 else out[0]
+
+
+@ray_tpu.remote
+def _group_aggregate(key, aggs, *blocks):
+    """Reduce phase: every group in these buckets is complete, so aggregates
+    are exact locally — no partial-agg merge. ``aggs``: [(op, col)] with op
+    in count/sum/mean/min/max/std."""
+    merged = BlockAccessor.concat([BlockAccessor.normalize(b) for b in blocks])
+    if not merged:
+        return {}
+    keys = np.asarray(merged[key])
+    uniq, inv = np.unique(keys, return_inverse=True)
+    cols = {key: uniq}
+    order = np.argsort(inv, kind="stable")
+    bounds = np.searchsorted(inv[order], np.arange(len(uniq)))
+    for op, col in aggs:
+        if op == "count":
+            cols["count()"] = np.bincount(inv, minlength=len(uniq))
+            continue
+        vals = np.asarray(merged[col], dtype=np.float64)
+        counts = np.bincount(inv, minlength=len(uniq))
+        sums = np.bincount(inv, weights=vals, minlength=len(uniq))
+        if op == "sum":
+            out = sums
+        elif op == "mean":
+            out = sums / counts
+        elif op == "min":
+            out = np.minimum.reduceat(vals[order], bounds)
+        elif op == "max":
+            out = np.maximum.reduceat(vals[order], bounds)
+        elif op == "std":
+            # sample std (ddof=1), matching Dataset.std and the reference's
+            # Std aggregate default
+            sq = np.bincount(inv, weights=vals * vals, minlength=len(uniq))
+            mean = sums / counts
+            var = np.maximum(sq - counts * mean * mean, 0.0) / np.maximum(
+                counts - 1, 1
+            )
+            out = np.sqrt(var)
+        else:
+            raise ValueError(f"unknown aggregate op: {op}")
+        cols[f"{op}({col})"] = out
+    return cols
+
+
+@ray_tpu.remote
+def _group_map(key, fn, *blocks):
+    """Reduce phase of map_groups: apply fn to each complete group."""
+    merged = BlockAccessor.concat([BlockAccessor.normalize(b) for b in blocks])
+    if not merged:
+        return {}
+    keys = np.asarray(merged[key])
+    uniq, inv = np.unique(keys, return_inverse=True)
+    acc = BlockAccessor(merged)
+    outs = []
+    for g in range(len(uniq)):
+        group = acc.take_indices(np.nonzero(inv == g)[0])
+        outs.append(BlockAccessor.normalize(fn(group)))
+    return BlockAccessor.concat(outs)
 
 
 def _sample_block(block, key, k):

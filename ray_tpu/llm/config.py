@@ -32,6 +32,12 @@ class EngineConfig:
     tensor_parallel_degree: int = 1
     sequence_parallel_degree: int = 1
     dtype: str = "bfloat16"
+    # multi-LoRA serving: number of loadable adapter slots (0 disables) and
+    # their rank. Adapters live STACKED on device; each sequence picks its
+    # adapter by index inside the one compiled program (reference:
+    # llm/_internal/serve LoRA support over vLLM's multi-LoRA).
+    max_loras: int = 0
+    lora_rank: int = 8
 
 
 @dataclasses.dataclass
@@ -51,6 +57,11 @@ class LLMConfig:
     num_replicas: int = 1
     ray_actor_options: Optional[dict] = None
     autoscaling_config: Optional[dict] = None
+    # multi-LoRA: adapter name -> pytree-checkpoint path, loaded into the
+    # engine's stacked adapter slots at replica start; requests select one
+    # with model="<served_name>:<adapter>" (reference: the LoRA model-id
+    # convention in llm/_internal/serve)
+    lora_adapters: dict = dataclasses.field(default_factory=dict)
 
     @property
     def served_name(self) -> str:

@@ -49,7 +49,13 @@ class RequestOutput:
 
 
 class _Request:
-    def __init__(self, request_id: str, token_ids: list[int], params: SamplingParams):
+    def __init__(
+        self,
+        request_id: str,
+        token_ids: list[int],
+        params: SamplingParams,
+        lora_idx: int = 0,
+    ):
         self.request_id = request_id
         self.prompt_token_ids = token_ids
         self.params = params
@@ -60,6 +66,7 @@ class _Request:
         self.submitted_t = time.time()
         self.first_token_t: Optional[float] = None
         self.error: Optional[BaseException] = None
+        self.lora_idx = lora_idx
 
 
 class JaxEngine:
@@ -130,6 +137,17 @@ class JaxEngine:
         self.cache = init_kv_cache(
             self.model_cfg, ec.max_num_seqs, ec.max_seq_len
         )
+        # multi-LoRA: stacked adapters (slot 0 = base/zero), name registry,
+        # per-decode-slot adapter index
+        self.loras = None
+        self._lora_ids: dict[str, int] = {}
+        self._adapter_ids = np.zeros((ec.max_num_seqs,), np.int32)
+        if ec.max_loras > 0:
+            from ray_tpu.models.llama import init_lora_stack
+
+            self.loras = init_lora_stack(
+                self.model_cfg, ec.max_loras, ec.lora_rank
+            )
 
     def _compile(self):
         import jax
@@ -144,11 +162,17 @@ class JaxEngine:
         # sampler — they must agree or seeded runs diverge at token 2
         self._top_k_static = K = min(64, cfg.vocab_size)
 
-        def decode_fn(params, cache, tokens, temps, top_ks, keys):
+        lora_enabled = self.loras is not None
+
+        def decode_fn(params, cache, tokens, temps, top_ks, keys,
+                      loras=None, adapter_ids=None):
             """Decode + in-program sampling: greedy where temp<=0, else
             per-row top-k/temperature categorical with per-slot PRNG keys
             (per-request seeds stay reproducible across batch compositions)."""
-            logits, cache = decode_step(params, cache, tokens, cfg)
+            logits, cache = decode_step(
+                params, cache, tokens, cfg,
+                loras=loras, adapter_ids=adapter_ids,
+            )
             greedy = jnp.argmax(logits, axis=-1)
             vals, idxs = jax.lax.top_k(logits, K)
             # per-row k: mask ranks >= k to -inf before the categorical
@@ -166,14 +190,18 @@ class JaxEngine:
             next_tokens = jnp.where(temps <= 0.0, greedy, sampled)
             return next_tokens, cache, new_keys[:, 0]
 
-        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
 
-        def prefill_one(params, cache, tokens, length, slot):
+        def prefill_one(params, cache, tokens, length, slot,
+                        loras=None, adapter_id=None):
             """Prefill a single sequence (B=1) and scatter into `slot`."""
             from ray_tpu.models.llama import init_kv_cache
 
             one = init_kv_cache(cfg, 1, ec.max_seq_len)
-            last_logits, one = prefill(params, one, tokens, cfg, lengths=length)
+            last_logits, one = prefill(
+                params, one, tokens, cfg, lengths=length,
+                loras=loras, adapter_ids=adapter_id,
+            )
             cache = {
                 "k": cache["k"].at[:, slot].set(one["k"][:, 0]),
                 "v": cache["v"].at[:, slot].set(one["v"][:, 0]),
@@ -181,8 +209,90 @@ class JaxEngine:
             }
             return last_logits[0], cache
 
-        self._prefill = jax.jit(prefill_one, donate_argnums=(1,))
+        self._prefill_jit = jax.jit(prefill_one, donate_argnums=(1,))
         self._rng_key = jax.random.PRNGKey(self.config.model.seed)
+        # device-resident per-slot adapter ids, refreshed only when slot
+        # composition changes — the per-token decode loop must not pay a
+        # host->device transfer per step
+        self._adapter_ids_dev = (
+            jax.numpy.asarray(self._adapter_ids) if lora_enabled else None
+        )
+
+    def _decode(self, params, cache, tokens, temps, top_ks, keys):
+        if self.loras is None:
+            # no-LoRA configuration: the compiled program has no adapter args
+            return self._decode_jit(params, cache, tokens, temps, top_ks, keys)
+        return self._decode_jit(
+            params, cache, tokens, temps, top_ks, keys,
+            loras=self.loras, adapter_ids=self._adapter_ids_dev,
+        )
+
+    def _prefill(self, params, cache, tokens, length, slot, adapter_id=0):
+        import jax.numpy as jnp
+
+        if self.loras is None:
+            return self._prefill_jit(params, cache, tokens, length, slot)
+        return self._prefill_jit(
+            params, cache, tokens, length, slot,
+            loras=self.loras,
+            adapter_id=jnp.asarray([adapter_id], jnp.int32),
+        )
+
+    def _sync_adapter_ids(self):
+        if self.loras is not None:
+            import jax.numpy as jnp
+
+            self._adapter_ids_dev = jnp.asarray(self._adapter_ids)
+
+    # -- multi-LoRA ----------------------------------------------------------
+
+    def add_lora(self, name: str, adapters: dict) -> int:
+        """Load a LoRA adapter into a free stack slot. ``adapters``:
+        {wq_a: [L, e, r], wq_b: [L, r, h, hd], wv_a: [L, e, r],
+        wv_b: [L, r, kv, hd]} (a pytree checkpoint). Returns the slot index."""
+        import jax.numpy as jnp
+
+        if self.loras is None:
+            raise ValueError("engine built with max_loras=0")
+        if name in self._lora_ids:
+            return self._lora_ids[name]
+        used = set(self._lora_ids.values())
+        free = [
+            i
+            for i in range(1, self.config.engine.max_loras + 1)
+            if i not in used
+        ]
+        if not free:
+            raise RuntimeError(
+                f"all {self.config.engine.max_loras} LoRA slots in use"
+            )
+        idx = free[0]
+        new = {}
+        for k in ("wq_a", "wq_b", "wv_a", "wv_b"):
+            stack = self.loras[k]
+            a = jnp.asarray(adapters[k], stack.dtype)
+            if a.shape != stack.shape[:1] + stack.shape[2:]:
+                raise ValueError(
+                    f"{name}.{k}: shape {a.shape} != {stack.shape[:1] + stack.shape[2:]}"
+                )
+            new[k] = stack.at[:, idx].set(a)
+        self.loras = new
+        self._lora_ids[name] = idx
+        return idx
+
+    def remove_lora(self, name: str) -> None:
+        import jax.numpy as jnp
+
+        idx = self._lora_ids.pop(name, None)
+        if idx is None:
+            return
+        self.loras = {
+            k: v.at[:, idx].set(jnp.zeros_like(v[:, idx]))
+            for k, v in self.loras.items()
+        }
+
+    def list_loras(self) -> list[str]:
+        return sorted(self._lora_ids)
 
     # -- public API ---------------------------------------------------------
 
@@ -192,9 +302,11 @@ class JaxEngine:
         *,
         prompt_token_ids: Optional[list[int]] = None,
         sampling_params: Optional[SamplingParams] = None,
+        lora: Optional[str] = None,
     ) -> RequestOutput:
         req = self.submit(
-            prompt, prompt_token_ids=prompt_token_ids, sampling_params=sampling_params
+            prompt, prompt_token_ids=prompt_token_ids,
+            sampling_params=sampling_params, lora=lora,
         )
         req.done.wait()
         if req.error is not None:
@@ -207,10 +319,12 @@ class JaxEngine:
         *,
         prompt_token_ids: Optional[list[int]] = None,
         sampling_params: Optional[SamplingParams] = None,
+        lora: Optional[str] = None,
     ) -> Iterator[dict]:
         """Yields {'token_id', 'text', 'done'} increments."""
         req = self.submit(
-            prompt, prompt_token_ids=prompt_token_ids, sampling_params=sampling_params
+            prompt, prompt_token_ids=prompt_token_ids,
+            sampling_params=sampling_params, lora=lora,
         )
         yield from self.drain(req)
 
@@ -225,7 +339,10 @@ class JaxEngine:
         if req.error is not None:
             raise req.error
 
-    def submit(self, prompt=None, *, prompt_token_ids=None, sampling_params=None) -> _Request:
+    def submit(
+        self, prompt=None, *, prompt_token_ids=None, sampling_params=None,
+        lora: Optional[str] = None,
+    ) -> _Request:
         if prompt_token_ids is None:
             if prompt is None:
                 raise ValueError("prompt or prompt_token_ids required")
@@ -233,9 +350,15 @@ class JaxEngine:
         max_prompt = self.config.engine.max_seq_len - 1
         if len(prompt_token_ids) > max_prompt:
             prompt_token_ids = prompt_token_ids[-max_prompt:]
+        lora_idx = 0
+        if lora:
+            if lora not in self._lora_ids:
+                raise KeyError(f"unknown LoRA adapter: {lora!r}")
+            lora_idx = self._lora_ids[lora]
         req = _Request(
             uuid.uuid4().hex[:12], list(prompt_token_ids),
             sampling_params or SamplingParams(),
+            lora_idx=lora_idx,
         )
         self._waiting.put(req)
         return req
@@ -300,12 +423,15 @@ class JaxEngine:
                     bucket = self._bucket(len(ids))
                     toks = np.zeros((1, bucket), np.int32)
                     toks[0, : len(ids)] = ids
+                    self._adapter_ids[slot] = req.lora_idx
+                    self._sync_adapter_ids()
                     last_logits, self.cache = self._prefill(
                         self.params,
                         self.cache,
                         jnp.asarray(toks),
                         jnp.asarray([len(ids)], jnp.int32),
                         slot,
+                        adapter_id=req.lora_idx,
                     )
                     # sample the first generated token from prefill logits
                     # (same top-K truncation as the decode program, and the
@@ -415,6 +541,9 @@ class JaxEngine:
         if is_stop or len(req.out_tokens) >= p.max_tokens or out_of_room:
             req.finish_reason = "stop" if is_stop else "length"
             self._slots[slot] = None
+            if self._adapter_ids[slot]:
+                self._adapter_ids[slot] = 0
+                self._sync_adapter_ids()
             # a request can finish at admission (max_tokens=1): its queued
             # first token must not leak into the slot's next occupant
             getattr(self, "_pending_first", {}).pop(slot, None)

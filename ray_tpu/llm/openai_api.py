@@ -36,6 +36,13 @@ class OpenAIRouter:
             return {"error": {"message": "invalid JSON body", "code": 400}}
         model = (body or {}).get("model")
         handle = self._models.get(model)
+        if handle is None and model and ":" in model:
+            # multi-LoRA model id "<base>:<adapter>" (reference convention):
+            # route to the base deployment, pass the adapter to the engine
+            base, _, adapter = model.partition(":")
+            handle = self._models.get(base)
+            if handle is not None:
+                body["_lora"] = adapter
         if handle is None:
             if len(self._models) == 1 and model is None:
                 handle = next(iter(self._models.values()))

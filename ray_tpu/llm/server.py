@@ -25,10 +25,54 @@ class LLMServer:
     def __init__(self, llm_config: LLMConfig):
         self.llm_config = llm_config
         self.engine = JaxEngine(llm_config)
+        for name, path in (llm_config.lora_adapters or {}).items():
+            self.load_lora(name, path)
+
+    # -- multi-LoRA ----------------------------------------------------------
+
+    def load_lora(self, name: str, path_or_weights) -> bool:
+        """Load an adapter into THIS replica's engine stack (the
+        reference's LoRA download-and-load role). With num_replicas > 1 a
+        plain handle call reaches one replica — use
+        ``handle.broadcast("load_lora", name, path)`` so every replica
+        serves the adapter (or list it in ``LLMConfig.lora_adapters``,
+        loaded at replica start)."""
+        if isinstance(path_or_weights, str):
+            from ray_tpu.train.checkpoint import restore_pytree
+
+            weights = restore_pytree(path_or_weights)
+        else:
+            weights = path_or_weights
+        self.engine.add_lora(name, weights)
+        return True
+
+    def unload_lora(self, name: str) -> bool:
+        self.engine.remove_lora(name)
+        return True
+
+    def list_loras(self) -> list[str]:
+        return self.engine.list_loras()
+
+    def _lora_error(self, body: dict):
+        """OpenAI-style 404 for an unknown adapter, instead of a raw
+        KeyError escaping through the router as a 500."""
+        lora = body.get("_lora")
+        if lora and lora not in self.engine.list_loras():
+            return {
+                "error": {
+                    "message": f"LoRA adapter {lora!r} not found on "
+                    f"{self.llm_config.served_name}",
+                    "code": 404,
+                }
+            }
+        return None
 
     # -- OpenAI-shaped methods ----------------------------------------------
 
     def completions(self, body: dict) -> dict:
+        err = self._lora_error(body)
+        if err is not None:
+            return err
         prompt = body.get("prompt", "")
         params = _sampling_from_dict(
             {
@@ -37,7 +81,9 @@ class LLMServer:
                 "top_k": body.get("top_k", 50),
             }
         )
-        out = self.engine.generate(prompt, sampling_params=params)
+        out = self.engine.generate(
+            prompt, sampling_params=params, lora=body.get("_lora")
+        )
         return {
             "id": f"cmpl-{out.request_id}",
             "object": "text_completion",
@@ -58,6 +104,9 @@ class LLMServer:
         }
 
     def chat(self, body: dict) -> dict:
+        err = self._lora_error(body)
+        if err is not None:
+            return err
         messages = body.get("messages", [])
         prompt = self._render_chat(messages)
         params = _sampling_from_dict(
@@ -67,7 +116,9 @@ class LLMServer:
                 "top_k": body.get("top_k", 50),
             }
         )
-        out = self.engine.generate(prompt, sampling_params=params)
+        out = self.engine.generate(
+            prompt, sampling_params=params, lora=body.get("_lora")
+        )
         return {
             "id": f"chatcmpl-{out.request_id}",
             "object": "chat.completion",
@@ -91,6 +142,10 @@ class LLMServer:
         """Generator of OpenAI ``text_completion`` chunk dicts — one per
         generated token as the engine emits it (reference: the vLLM-engine
         streaming path in ``llm/_internal/serve/deployments/llm/llm_server.py``)."""
+        err = self._lora_error(body)
+        if err is not None:
+            yield err
+            return
         prompt = body.get("prompt", "")
         params = _sampling_from_dict(
             {
@@ -99,7 +154,9 @@ class LLMServer:
                 "top_k": body.get("top_k", 50),
             }
         )
-        req = self.engine.submit(prompt, sampling_params=params)
+        req = self.engine.submit(
+            prompt, sampling_params=params, lora=body.get("_lora")
+        )
         created = int(time.time())
         for inc in self.engine.drain(req):
             yield {
@@ -123,6 +180,10 @@ class LLMServer:
 
     def chat_stream(self, body: dict):
         """Generator of OpenAI ``chat.completion.chunk`` dicts."""
+        err = self._lora_error(body)
+        if err is not None:
+            yield err
+            return
         prompt = self._render_chat(body.get("messages", []))
         params = _sampling_from_dict(
             {
@@ -131,7 +192,9 @@ class LLMServer:
                 "top_k": body.get("top_k", 50),
             }
         )
-        req = self.engine.submit(prompt, sampling_params=params)
+        req = self.engine.submit(
+            prompt, sampling_params=params, lora=body.get("_lora")
+        )
         created = int(time.time())
         first = True
         for inc in self.engine.drain(req):

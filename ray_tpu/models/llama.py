@@ -468,14 +468,35 @@ def init_kv_cache(cfg: LlamaConfig, batch_size: int, max_len: Optional[int] = No
     }
 
 
+def init_lora_stack(cfg: LlamaConfig, n_adapters: int, rank: int):
+    """Zero-initialized stacked LoRA adapters for the decode path
+    (reference: multi-LoRA serving, ``llm/_internal/serve/.../lora``; on TPU
+    the idiom is a STACKED adapter tensor gathered per slot, so one compiled
+    program serves any adapter mix — no per-adapter recompiles or weight
+    swaps). Slot 0 stays all-zero = the base model. Targets q/v projections
+    (the classic LoRA placement)."""
+    L, e, h, kv, hd = (
+        cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+    )
+    n = n_adapters + 1  # + base slot 0
+    return {
+        "wq_a": jnp.zeros((L, n, e, rank), cfg.dtype),
+        "wq_b": jnp.zeros((L, n, rank, h, hd), cfg.dtype),
+        "wv_a": jnp.zeros((L, n, e, rank), cfg.dtype),
+        "wv_b": jnp.zeros((L, n, rank, kv, hd), cfg.dtype),
+    }
+
+
 def _decode_forward(
-    params, cache, tokens, positions, cfg: LlamaConfig, valid=None
+    params, cache, tokens, positions, cfg: LlamaConfig, valid=None,
+    loras=None, adapter_ids=None,
 ):
     """Shared prefill/decode body. tokens: [B, T]; positions: [B, T].
     New k/v are scattered into the cache before attention so new tokens
     attend to themselves and to all prior cache slots. ``valid`` [B, T]
     marks real (non-padding) tokens; padding writes are dropped so later
-    decode steps never attend to stale slots."""
+    decode steps never attend to stale slots. ``loras``/``adapter_ids``:
+    stacked LoRA adapters + per-sequence adapter index (0 = base)."""
     B, T = tokens.shape
     S = cache["k"].shape[2]
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -492,13 +513,31 @@ def _decode_forward(
     else:
         write_pos = positions
     stacked = {k: params[k] for k in _LAYER_KEYS}
+    scan_xs = (stacked, cache["k"], cache["v"])
+    if loras is not None:
+        scan_xs = scan_xs + (loras,)
 
     def scan_body(x, inp):
-        p, ck, cv = inp
+        if loras is not None:
+            p, ck, cv, lp = inp
+        else:
+            p, ck, cv = inp
         h = _rmsnorm(x, p["attn_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
         q = jnp.einsum("bte,ehd->bthd", h, p["wq"])
         k = jnp.einsum("bte,ehd->bthd", h, p["wk"])
         v = jnp.einsum("bte,ehd->bthd", h, p["wv"])
+        if loras is not None:
+            # per-sequence adapter gather + low-rank delta: W x + B(A x)
+            q = q + jnp.einsum(
+                "btr,brhd->bthd",
+                jnp.einsum("bte,ber->btr", h, lp["wq_a"][adapter_ids]),
+                lp["wq_b"][adapter_ids],
+            )
+            v = v + jnp.einsum(
+                "btr,brhd->bthd",
+                jnp.einsum("bte,ber->btr", h, lp["wv_a"][adapter_ids]),
+                lp["wv_b"][adapter_ids],
+            )
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         ck = ck.at[batch_idx, write_pos].set(k, mode="drop")
@@ -521,9 +560,7 @@ def _decode_forward(
         x = x + jnp.einsum("btf,fe->bte", ff, p["w_down"])
         return x, (ck, cv)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        scan_body, x, (stacked, cache["k"], cache["v"])
-    )
+    x, (new_k, new_v) = jax.lax.scan(scan_body, x, scan_xs)
     x = _rmsnorm(x, params["final_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.einsum(
@@ -534,7 +571,10 @@ def _decode_forward(
     return logits, new_cache
 
 
-def prefill(params, cache, tokens, cfg: LlamaConfig, lengths=None):
+def prefill(
+    params, cache, tokens, cfg: LlamaConfig, lengths=None,
+    loras=None, adapter_ids=None,
+):
     """Process a prompt batch. tokens: [B, T] (right-padded); lengths: [B].
     Returns (last-token logits [B, vocab], cache)."""
     B, T = tokens.shape
@@ -542,16 +582,24 @@ def prefill(params, cache, tokens, cfg: LlamaConfig, lengths=None):
         lengths = jnp.full((B,), T, jnp.int32)
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
     valid = positions < lengths[:, None]
-    logits, cache = _decode_forward(params, cache, tokens, positions, cfg, valid)
+    logits, cache = _decode_forward(
+        params, cache, tokens, positions, cfg, valid,
+        loras=loras, adapter_ids=adapter_ids,
+    )
     cache["length"] = lengths
     last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
     return last, cache
 
 
-def decode_step(params, cache, tokens, cfg: LlamaConfig):
+def decode_step(
+    params, cache, tokens, cfg: LlamaConfig, loras=None, adapter_ids=None
+):
     """One decode step. tokens: [B] or [B, 1] -> (logits [B, vocab], cache)."""
     if tokens.ndim == 1:
         tokens = tokens[:, None]
     positions = cache["length"][:, None]
-    logits, cache = _decode_forward(params, cache, tokens, positions, cfg)
+    logits, cache = _decode_forward(
+        params, cache, tokens, positions, cfg,
+        loras=loras, adapter_ids=adapter_ids,
+    )
     return logits[:, -1], cache

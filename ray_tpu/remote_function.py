@@ -67,6 +67,7 @@ class RemoteFunction:
             num_returns=num_returns,
             resources=_resources_from_options(opts),
             max_retries=opts.get("max_retries", 0),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
             strategy=_strategy_from_options(opts),
             runtime_env=opts.get("runtime_env"),
             function_blob=self._function_blob,

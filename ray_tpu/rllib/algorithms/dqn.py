@@ -19,28 +19,37 @@ from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
 
 
 class ReplayBuffer:
-    """Uniform FIFO replay (reference: ``utils/replay_buffers``)."""
+    """Uniform FIFO replay (reference: ``utils/replay_buffers``).
+    ``act_shape``/``act_dtype`` parameterize the action column so the same
+    ring serves discrete (DQN) and continuous (SAC) learners."""
 
-    def __init__(self, capacity: int, obs_dim: int):
+    def __init__(
+        self,
+        capacity: int,
+        obs_dim: int,
+        act_shape: tuple = (),
+        act_dtype=np.int64,
+    ):
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros(capacity, np.int64)
+        self.actions = np.zeros((capacity, *act_shape), act_dtype)
         self.rewards = np.zeros(capacity, np.float32)
         self.terminals = np.zeros(capacity, np.float32)
         self.size = 0
         self._next = 0
 
+    def add(self, obs, action, reward, next_obs, terminal):
+        j = self._next
+        self.obs[j], self.actions[j] = obs, action
+        self.rewards[j], self.next_obs[j] = reward, next_obs
+        self.terminals[j] = terminal
+        self._next = (self._next + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+
     def add_batch(self, obs, actions, rewards, next_obs, terminals):
         for i in range(len(obs)):
-            j = self._next
-            self.obs[j] = obs[i]
-            self.actions[j] = actions[i]
-            self.rewards[j] = rewards[i]
-            self.next_obs[j] = next_obs[i]
-            self.terminals[j] = terminals[i]
-            self._next = (self._next + 1) % self.capacity
-            self.size = min(self.size + 1, self.capacity)
+            self.add(obs[i], actions[i], rewards[i], next_obs[i], terminals[i])
 
     def sample(self, n: int, rng) -> dict:
         idx = rng.integers(0, self.size, n)

@@ -243,6 +243,27 @@ class IMPALA(Algorithm):
             "num_in_flight_samples": len(self._inflight),
         }
 
+    def set_state(self, state: dict):
+        """Restore must also re-sync the LOCAL training params from the
+        learner group — training_step pushes self._params back each
+        iteration, so stale locals would silently wipe a restored
+        checkpoint. Optimizer moments restart fresh (Adam warms back up in
+        a few steps; the pytree checkpoint stays framework-plain)."""
+        import jax.numpy as jnp
+
+        super().set_state(state)
+        self._params = {
+            k: jnp.asarray(v)
+            for k, v in self.learner_group.get_weights().items()
+        }
+        self._opt_state = self.optimizer.init(self._params)
+        self._batches_consumed = int(state.get("batches_consumed", 0))
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["batches_consumed"] = self._batches_consumed
+        return state
+
     def stop(self):
         self._inflight.clear()
         super().stop()

@@ -16,6 +16,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
 from ray_tpu.rllib.env.continuous import make_continuous_env
 
 LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
@@ -71,36 +72,6 @@ def sample_action(params, obs, key, n_layers):
         2.0 * (np.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)), axis=-1
     )
     return act, logp
-
-
-class _Replay:
-    def __init__(self, capacity, obs_dim, act_dim):
-        self.capacity = capacity
-        self.obs = np.zeros((capacity, obs_dim), np.float32)
-        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros((capacity, act_dim), np.float32)
-        self.rewards = np.zeros(capacity, np.float32)
-        self.terminals = np.zeros(capacity, np.float32)
-        self.size = 0
-        self._next = 0
-
-    def add(self, obs, action, reward, next_obs, terminal):
-        j = self._next
-        self.obs[j], self.actions[j] = obs, action
-        self.rewards[j], self.next_obs[j] = reward, next_obs
-        self.terminals[j] = terminal
-        self._next = (self._next + 1) % self.capacity
-        self.size = min(self.size + 1, self.capacity)
-
-    def sample(self, n, rng):
-        idx = rng.integers(0, self.size, n)
-        return {
-            "obs": self.obs[idx],
-            "actions": self.actions[idx],
-            "rewards": self.rewards[idx],
-            "next_obs": self.next_obs[idx],
-            "terminals": self.terminals[idx],
-        }
 
 
 class ContinuousEnvRunner:
@@ -263,8 +234,11 @@ class SAC(Algorithm):
             ),
             "alpha": self._opt["alpha"].init(self._state["log_alpha"]),
         }
-        self.replay = _Replay(
-            config.replay_buffer_capacity, self.obs_dim, self.act_dim
+        self.replay = ReplayBuffer(
+            config.replay_buffer_capacity,
+            self.obs_dim,
+            act_shape=(self.act_dim,),
+            act_dtype=np.float32,
         )
         self._update_fn = self._build_update()
         self._jax_key = jax.random.PRNGKey(config.seed + 7)

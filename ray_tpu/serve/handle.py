@@ -326,6 +326,23 @@ class DeploymentHandle:
                 self._drainer.start()
         return DeploymentResponseGenerator(ref_gen)
 
+    def broadcast(self, method: str, *args, timeout_s: float = 120.0, **kwargs):
+        """Call ``method`` on EVERY replica and return all results — for
+        replica-state pushes (e.g. ``load_lora``) where routing to one
+        replica would leave the others inconsistent."""
+        self._refresh(force=True)
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            raise RuntimeError(
+                f"no replicas for deployment {self.deployment_name!r}"
+            )
+        refs = [
+            actor.handle_request.remote(method, *args, **kwargs)
+            for _, actor in replicas
+        ]
+        return ray_tpu.get(refs, timeout=timeout_s)
+
     def remote(self, *args, **kwargs):
         if getattr(self, "_stream", False):
             return self._call_streaming("__call__", args, kwargs)

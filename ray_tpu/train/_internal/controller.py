@@ -48,6 +48,11 @@ class ScalingPolicy:
 
 
 class ElasticScalingPolicy(ScalingPolicy):
+    def __init__(self, scaling: ScalingConfig):
+        super().__init__(scaling)
+        self._last_target: Optional[int] = None
+        self._stable_polls = 0
+
     def group_size(self, attempt: int) -> int:
         n = self.scaling.num_workers
         lo = self.scaling.min_workers or n
@@ -55,7 +60,64 @@ class ElasticScalingPolicy(ScalingPolicy):
         for _ in range(attempt):
             if n // 2 >= lo:
                 n //= 2
+        # start with what the cluster can actually schedule (elastic launch:
+        # don't block on full capacity when >= min_workers are available now)
+        cap = self._capacity()
+        if cap is not None and lo <= cap < n:
+            n = cap
         return max(n, lo)
+
+    def _capacity(self) -> Optional[int]:
+        import ray_tpu
+
+        per = self.scaling.resources_per_worker or {"CPU": 1}
+        try:
+            avail = ray_tpu.available_resources()
+        except Exception:
+            return None
+        cap = None
+        for k, v in per.items():
+            if v:
+                fit = int(avail.get(k, 0) // v)
+                cap = fit if cap is None else min(cap, fit)
+        return cap
+
+    def resize_decision(self, current_size: int) -> Optional[int]:
+        """Mid-run UPSCALE: when the cluster regains capacity (node joined,
+        other job finished), grow the group back toward ``num_workers``
+        (reference: ``scaling_policy/`` ResizeDecision; downscale happens
+        through the failure path — losing a node kills its workers anyway).
+        Requires the target to be stable for 3 consecutive checks so a
+        transiently-free slot doesn't trigger a restart."""
+        import ray_tpu
+
+        want = self.scaling.num_workers
+        if current_size >= want:
+            return None
+        per = self.scaling.resources_per_worker or {"CPU": 1}
+        try:
+            avail = ray_tpu.available_resources()
+        except Exception:
+            return None
+        headroom = want - current_size
+        for k, v in per.items():
+            if v:
+                headroom = min(headroom, int(avail.get(k, 0) // v))
+        target = min(want, current_size + max(headroom, 0))
+        if target <= current_size:
+            self._last_target = None
+            self._stable_polls = 0
+            return None
+        if target == self._last_target:
+            self._stable_polls += 1
+        else:
+            self._last_target = target
+            self._stable_polls = 1
+        if self._stable_polls >= 3:
+            self._last_target = None
+            self._stable_polls = 0
+            return target
+        return None
 
 
 class FailurePolicy:
@@ -101,6 +163,8 @@ class TrainController:
         self.metrics_history: list[dict] = []
         self.error: Optional[str] = None
         self._attempt = 0
+        self._resize_to: Optional[int] = None
+        self.num_resizes = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -122,6 +186,16 @@ class TrainController:
             if outcome == "finished":
                 self.state = RunState.FINISHED
                 break
+            if outcome == "resize":
+                # mid-run elastic resize: restart at the new size from the
+                # latest checkpoint — NOT charged to the failure budget
+                self.state = RunState.RESTARTING
+                self.num_resizes += 1
+                logger.info(
+                    "elastic resize: restarting worker group at %d workers",
+                    self._resize_to,
+                )
+                continue
             # worker failure: gang restart (slice granularity)
             if not self.failure_policy.should_retry():
                 self.state = RunState.ERRORED
@@ -144,7 +218,10 @@ class TrainController:
 
     def _start_group(self) -> Optional[WorkerGroup]:
         self.state = RunState.SCHEDULING
-        n = self.scaling_policy.group_size(self._attempt)
+        if self._resize_to is not None:
+            n, self._resize_to = self._resize_to, None
+        else:
+            n = self.scaling_policy.group_size(self._attempt)
         group = WorkerGroup(
             self.scaling,
             experiment_name=self.run_config.name or "train",
@@ -193,8 +270,10 @@ class TrainController:
             )
 
     def _run_until_done(self, group: WorkerGroup, poll_interval: float) -> str:
-        """Poll loop. Returns 'finished' or 'failed'."""
+        """Poll loop. Returns 'finished', 'failed', or 'resize'."""
         stop = self.run_config.stop or {}
+        can_resize = isinstance(self.scaling_policy, ElasticScalingPolicy)
+        last_resize_check = time.monotonic()
         while True:
             polls = group.poll()
             # process rank-0's drained results FIRST: they exist only in this
@@ -220,6 +299,17 @@ class TrainController:
             if all(p["done"] for p in polls):
                 # final drain already happened in this poll
                 return "finished"
+            if can_resize and time.monotonic() - last_resize_check >= 0.5:
+                last_resize_check = time.monotonic()
+                # only resize once a checkpoint exists — restarting without
+                # one would replay the run from scratch
+                if self.checkpoint_manager.latest_checkpoint() is not None:
+                    target = self.scaling_policy.resize_decision(
+                        group.num_workers
+                    )
+                    if target is not None:
+                        self._resize_to = target
+                        return "resize"
             time.sleep(poll_interval)
 
 

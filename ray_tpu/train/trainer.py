@@ -151,6 +151,7 @@ class DataParallelTrainer(BaseTrainer):
             controller.checkpoint_manager.register(
                 self.resume_from_checkpoint, {"resumed": True}, protected=True
             )
+        self._controller = controller  # introspection (elastic stats, state)
         internal = controller.run()
         return Result(
             metrics=internal.metrics,

@@ -10,6 +10,7 @@ from ray_tpu.train.session import get_checkpoint, report
 from ray_tpu.tune.result_grid import ExperimentAnalysis, ResultGrid, TrialResult
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    GPSearcher,
     ConcurrencyLimiter,
     Searcher,
     choice,
@@ -27,6 +28,7 @@ from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
     HyperBandScheduler,
+    PB2,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
@@ -42,10 +44,12 @@ __all__ = [
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
+    "GPSearcher",
     "ConcurrencyLimiter",
     "ExperimentAnalysis",
     "FIFOScheduler",
     "HyperBandScheduler",
+    "PB2",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "ResultGrid",

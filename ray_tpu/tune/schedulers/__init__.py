@@ -5,8 +5,9 @@ from ray_tpu.tune.schedulers.trial_scheduler import (
 from ray_tpu.tune.schedulers.async_hyperband import (
     ASHAScheduler,
     AsyncHyperBandScheduler,
-    HyperBandScheduler,
 )
+from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler
+from ray_tpu.tune.schedulers.pb2 import PB2
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
 from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
 
@@ -16,6 +17,7 @@ __all__ = [
     "FIFOScheduler",
     "HyperBandScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "TrialScheduler",
 ]

@@ -83,13 +83,3 @@ class AsyncHyperBandScheduler(TrialScheduler):
 
 
 ASHAScheduler = AsyncHyperBandScheduler
-
-
-class HyperBandScheduler(AsyncHyperBandScheduler):
-    """Bracketed variant (reference: ``schedulers/hyperband.py``); here
-    implemented as multi-bracket ASHA — the asynchronous formulation
-    dominates the synchronous one on elastic clusters, which is why the
-    reference's docs also steer users to ASHA."""
-
-    def __init__(self, *args, brackets: int = 3, **kwargs):
-        super().__init__(*args, brackets=brackets, **kwargs)

@@ -48,6 +48,17 @@ class TrialScheduler:
     def on_trial_error(self, trial) -> None:
         pass
 
+    def choose_trial_to_run(self, trials: list):
+        """A PAUSED trial this scheduler wants resumed next (sync schedulers
+        promote rung winners here). Must be idempotent: the controller may
+        call it multiple times before starting the returned trial."""
+        return None
+
+    def take_pending_stops(self) -> list:
+        """Trials culled while PAUSED (they have no actor to poll, so the
+        decision is delivered out of band); drained by the controller."""
+        return []
+
 
 class FIFOScheduler(TrialScheduler):
     """No early stopping (reference default)."""

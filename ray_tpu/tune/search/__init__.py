@@ -1,4 +1,5 @@
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator, generate_variants
+from ray_tpu.tune.search.gp_search import GPSearcher
 from ray_tpu.tune.search.sample import (
     choice,
     grid_search,
@@ -26,6 +27,7 @@ from ray_tpu.tune.search.searcher import (
 __all__ = [
     "BasicVariantGenerator",
     "ConcurrencyLimiter",
+    "GPSearcher",
     "Searcher",
     "choice",
     "generate_variants",

@@ -56,6 +56,7 @@ class TuneConfig:
 class TrialStatus(enum.Enum):
     PENDING = "PENDING"
     RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
     TERMINATED = "TERMINATED"
     ERROR = "ERROR"
 
@@ -182,11 +183,18 @@ class TuneController:
     def run(self, poll_interval: float = 0.05) -> list[Trial]:
         while True:
             running = [t for t in self.trials if t.status is TrialStatus.RUNNING]
-            # top up to the concurrency cap
-            while not self._exhausted and len(running) < self._max_concurrent():
-                t = self._maybe_create_trial()
+            # top up to the concurrency cap: scheduler-promoted paused
+            # trials (HyperBand rung winners) resume before new trials start
+            while len(running) < self._max_concurrent():
+                t = self.scheduler.choose_trial_to_run(self.trials)
                 if t is None:
-                    break
+                    if self._exhausted:
+                        break
+                    t = self._maybe_create_trial()
+                    if t is None:
+                        break
+                else:
+                    t.restore_checkpoint = t.checkpoint
                 try:
                     self._start_trial(t)
                     running.append(t)
@@ -196,16 +204,47 @@ class TuneController:
                     self._stop_trial(
                         t, TrialStatus.ERROR, f"failed to start: {e!r}"
                     )
+            self._drain_scheduler_stops()
             if not running:
-                if self._exhausted or all(
+                paused = [
+                    t for t in self.trials if t.status is TrialStatus.PAUSED
+                ]
+                no_new = self._exhausted or all(
                     t.status is not TrialStatus.PENDING for t in self.trials
-                ):
+                )
+                if no_new and not paused:
                     break
+                if no_new and paused:
+                    # nothing can start and the scheduler promoted nothing:
+                    # a sync scheduler must resolve its cohort (it sees all
+                    # statuses in choose_trial_to_run); if it still declines,
+                    # finish the paused trials rather than spin forever
+                    if self.scheduler.choose_trial_to_run(self.trials) is None:
+                        for t in paused:
+                            self._stop_trial(t, TrialStatus.TERMINATED)
+                        continue
                 time.sleep(poll_interval)
                 continue
             self._poll_running(running)
+            self._drain_scheduler_stops()
             time.sleep(poll_interval)
         return self.trials
+
+    def _drain_scheduler_stops(self):
+        """Stop trials the scheduler culled while they were PAUSED (a paused
+        trial has no actor to poll, so decisions arrive out of band)."""
+        for t in self.scheduler.take_pending_stops():
+            if t.status in (TrialStatus.PAUSED, TrialStatus.RUNNING):
+                self._stop_trial(t, TrialStatus.TERMINATED)
+
+    def _pause_trial(self, trial: Trial):
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        trial.status = TrialStatus.PAUSED
 
     def _poll_running(self, running: list[Trial]):
         refs = [t.actor.poll.remote() for t in running]
@@ -232,6 +271,10 @@ class TuneController:
                 self._handle_failure(trial, poll["error"])
             elif decision == TrialScheduler.STOP:
                 self._stop_trial(trial, TrialStatus.TERMINATED)
+            elif decision == TrialScheduler.PAUSE:
+                # sync schedulers (HyperBand) park a trial at a rung until
+                # its cohort completes; resumed via choose_trial_to_run
+                self._pause_trial(trial)
             elif decision == TrialScheduler.RESTART:
                 # PBT exploit: restart with mutated config + donor checkpoint
                 if trial.actor is not None:

@@ -254,3 +254,53 @@ def test_actor_restart_after_worker_death():
             raise AssertionError("actor did not restart")
     finally:
         ray_tpu.shutdown()
+
+
+def test_worker_rpc_chaos_injection(ray_start_process):
+    """Worker-side RPC chaos (reference rpc_chaos covers EVERY channel, not
+    just controller ops): tasks whose in-task get()/submit hit injected
+    channel failures still succeed under retries."""
+
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote(max_retries=12, retry_exceptions=True)
+    def flaky_pipeline(x):
+        # both the nested submit and the get ride the chaos-injected channel
+        ref = inner.remote(x)
+        return ray_tpu.get(ref, timeout=60) + 1
+
+    chaos_env = {"RAY_TPU_WORKER_RPC_FAILURE": "get_objects=0.4,submit_task=0.3"}
+    refs = [
+        flaky_pipeline.options(
+            runtime_env={"env_vars": chaos_env},
+            max_retries=12,
+            retry_exceptions=True,
+        ).remote(i)
+        for i in range(6)
+    ]
+    assert ray_tpu.get(refs, timeout=300) == [i * 2 + 1 for i in range(6)]
+
+
+def test_worker_plasma_chaos_falls_back_to_pull(ray_start_process):
+    """Injected plasma-read failures reroute large-object reads through the
+    chunked pull protocol instead of failing the task."""
+    import numpy as np
+
+    big = ray_tpu.put(np.arange(300_000, dtype=np.float64))
+
+    @ray_tpu.remote(max_retries=4)
+    def total(x):
+        return float(x.sum())
+
+    got = ray_tpu.get(
+        total.options(
+            runtime_env={
+                "env_vars": {"RAY_TPU_WORKER_RPC_FAILURE": "plasma_read=1.0"}
+            },
+            max_retries=4,
+        ).remote(big),
+        timeout=120,
+    )
+    assert got == float(np.arange(300_000, dtype=np.float64).sum())

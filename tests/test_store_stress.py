@@ -1,0 +1,150 @@
+"""Native store concurrency stress + crash recovery.
+
+Reference coverage model: the plasma store's TSAN/stress suites
+(``src/ray/object_manager/plasma/test``) — many processes mutating one
+arena concurrently, and robust-mutex recovery when a process dies while
+holding the store lock (``pthread_mutex_consistent`` path in
+``plasma_store.cc`` ``Guard``).
+"""
+
+import hashlib
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu._native import plasma as native_plasma
+
+pytestmark = pytest.mark.timeout(300) if hasattr(pytest.mark, "timeout") else []
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHURN = r"""
+import hashlib, os, sys
+sys.path.insert(0, {repo!r})
+from ray_tpu._native.plasma import NativeArena, NativeObjectExists, NativePlasmaError
+
+arena = NativeArena({name!r})
+seed = int(sys.argv[1])
+n_ops = int(sys.argv[2])
+import random
+rng = random.Random(seed)
+mine = []
+for i in range(n_ops):
+    op = rng.random()
+    try:
+        if op < 0.5 or not mine:
+            oid = (b"%08d" % seed) + (b"%016d" % i) + b"\x00" * 8
+            size = rng.randrange(64, 4096)
+            payload = hashlib.sha256(oid).digest() * (size // 32 + 1)
+            payload = payload[:size]
+            off = arena.alloc(oid, size)
+            arena.write(off, payload)
+            arena.seal(oid)
+            mine.append((oid, size))
+        elif op < 0.8:
+            oid, size = rng.choice(mine)
+            got = arena.lookup(oid)
+            if got is not None:
+                off, sz = got
+                data = bytes(arena.view(off, sz))
+                expect = (hashlib.sha256(oid).digest() * (sz // 32 + 1))[:sz]
+                assert data == expect, "CORRUPTION for %r" % oid
+        else:
+            oid, _ = mine.pop(rng.randrange(len(mine)))
+            try:
+                arena.delete(oid)
+            except NativePlasmaError:
+                pass
+    except NativeObjectExists:
+        pass
+    except NativePlasmaError as e:
+        if "out of shared memory" not in str(e):
+            raise
+        if mine:
+            oid, _ = mine.pop(0)
+            try:
+                arena.delete(oid)
+            except NativePlasmaError:
+                pass
+print("CHURN-OK", len(mine))
+arena.close()
+"""
+
+
+@pytest.fixture
+def arena():
+    if not native_plasma.available():
+        pytest.skip("native plasma unavailable")
+    name = f"/stress-{os.getpid()}-{time.time_ns() & 0xFFFFFF:x}"
+    a = native_plasma.NativeArena(name, 16 << 20)
+    yield name, a
+    a.close()
+
+
+def _spawn_churn(name: str, seed: int, n_ops: int) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-c", CHURN.format(repo=REPO, name=name), str(seed), str(n_ops)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def test_concurrent_multiprocess_churn(arena):
+    """4 processes hammer one arena: allocations, content-verified reads,
+    deletes — no corruption, no lost updates, no deadlock."""
+    name, a = arena
+    procs = [_spawn_churn(name, seed, 600) for seed in range(4)]
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err[-2000:]
+        assert "CHURN-OK" in out
+    # the table survived the churn: a fresh object still works end to end
+    oid = b"post-stress-check" + b"\x00" * 15
+    payload = hashlib.sha256(oid).digest()
+    off = a.alloc(oid, len(payload))
+    a.write(off, payload)
+    a.seal(oid)
+    got_off, got_sz = a.lookup(oid)
+    assert bytes(a.view(got_off, got_sz)) == payload
+
+
+def test_robust_mutex_recovery_after_kill(arena):
+    """SIGKILL churn processes mid-operation, repeatedly: survivors must
+    keep making progress (EOWNERDEAD → pthread_mutex_consistent recovery),
+    never deadlock on a lock died-with."""
+    name, a = arena
+    rng_kill_delays = [0.05, 0.1, 0.15, 0.2]
+    for round_i, delay in enumerate(rng_kill_delays):
+        victim = _spawn_churn(name, 100 + round_i, 200_000)  # long-running
+        time.sleep(delay)  # land the kill inside the alloc/seal hot loop
+        victim.kill()
+        victim.wait(timeout=30)
+        # the store must still be fully operational from THIS process
+        deadline = time.time() + 20
+        oid = b"recovery-%04d" % round_i + b"\x00" * 18
+        payload = hashlib.sha256(oid).digest()
+        while True:
+            try:
+                off = a.alloc(oid, len(payload))
+                break
+            except native_plasma.NativeObjectExists:
+                a.delete(oid)
+            except native_plasma.NativePlasmaError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+        a.write(off, payload)
+        a.seal(oid)
+        got = a.lookup(oid)
+        assert got is not None
+        assert bytes(a.view(got[0], got[1])) == payload
+    # and a fresh churn process completes normally afterward
+    p = _spawn_churn(name, 999, 300)
+    out, err = p.communicate(timeout=120)
+    assert p.returncode == 0, err[-2000:]
+    assert "CHURN-OK" in out

@@ -223,3 +223,136 @@ def test_trainer_as_trainable(ray_start_thread, run_cfg):
     ).fit()
     assert results.num_errors == 0, results.errors
     assert results.get_best_result().metrics["val"] == 20.0
+
+
+def test_hyperband_sync_brackets(ray_start_thread, run_cfg):
+    """True synchronous HyperBand: cohort pauses at rungs, exact top-1/eta
+    cut, survivors resume from their checkpoints, losers stop early."""
+    iters_seen = {}
+
+    def trainable(config):
+        chk = tune.get_checkpoint()
+        start = chk.to_dict()["i"] if chk else 0
+        for i in range(start, 100):
+            tune.report(
+                {"score": config["q"] * (i + 1), "q": config["q"]},
+                checkpoint=Checkpoint.from_dict({"i": i + 1}),
+            )
+
+    qualities = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0]
+    results = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search(qualities)},
+        tune_config=TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=tune.HyperBandScheduler(max_t=9, reduction_factor=3),
+            max_concurrent_trials=3,
+        ),
+        run_config=run_cfg(name="hb"),
+    ).fit()
+    assert results.num_errors == 0
+    # bad trials must be cut early, good ones trained longer
+    iters_by_q = {
+        r.metrics.get("q"): r.metrics.get("training_iteration", 0) for r in results
+    }
+    best_iters = iters_by_q[9.0]
+    worst_iters = min(v for v in iters_by_q.values())
+    assert best_iters > worst_iters, iters_by_q
+    # total budget must be well under running everything to max_t
+    total = sum(iters_by_q.values())
+    assert total < 9 * 9, (total, iters_by_q)
+
+
+def test_pb2_gp_explore_within_bounds(ray_start_thread, run_cfg):
+    """PB2: exploit copies the donor checkpoint; GP-UCB explore proposes lr
+    strictly inside the declared bounds."""
+    seen_lrs = []
+
+    def trainable(config):
+        import time as _t
+
+        chk = tune.get_checkpoint()
+        score = chk.to_dict()["score"] if chk else 0.0
+        for _ in range(25):
+            score += config["lr"]
+            tune.report(
+                {"score": score, "lr": config["lr"]},
+                checkpoint=Checkpoint.from_dict({"score": score}),
+            )
+            _t.sleep(0.02)
+
+    results = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 0.9])},
+        tune_config=TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=tune.PB2(
+                perturbation_interval=5,
+                hyperparam_bounds={"lr": [0.001, 1.0]},
+                quantile_fraction=0.5,
+                seed=0,
+            ),
+            max_concurrent_trials=2,
+        ),
+        run_config=run_cfg(name="pb2"),
+    ).fit()
+    assert results.num_errors == 0
+    # the weak trial exploited the strong one's checkpoint
+    scores = sorted(r.metrics.get("score", 0) for r in results)
+    assert scores[0] > 0.01 * 30
+    # every explored lr respects the bounds
+    for r in results:
+        assert 0.001 <= r.metrics.get("lr", 0.5) <= 1.0
+
+
+def test_pb2_gp_regressor_sanity():
+    """The internal GP interpolates a smooth function and shrinks variance
+    at observed points."""
+    import numpy as np
+
+    from ray_tpu.tune.schedulers.pb2 import _GP
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(size=(30, 2))
+    y = np.sin(3 * X[:, 0]) + 0.5 * X[:, 1]
+    y_n = (y - y.mean()) / y.std()
+    gp = _GP()
+    gp.fit(X, y_n)
+    mu_obs, sd_obs = gp.predict(X)
+    assert float(np.abs(mu_obs - y_n).mean()) < 0.1
+    assert float(sd_obs.mean()) < 0.3
+    mu_far, sd_far = gp.predict(np.array([[5.0, 5.0]]))
+    assert sd_far[0] > 0.9  # prior variance far from data
+
+
+def test_gp_searcher_beats_random_on_smooth_objective(ray_start_thread, run_cfg):
+    """Native GP-UCB searcher: on a smooth 1-D objective it concentrates
+    suggestions near the optimum after the random warmup."""
+
+    def trainable(config):
+        x = config["x"]
+        tune.report({"score": -((x - 0.7) ** 2)})
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(
+            metric="score",
+            mode="max",
+            search_alg=tune.GPSearcher(n_initial=4, seed=0),
+            num_samples=20,
+            max_concurrent_trials=1,  # sequential: the GP sees each result
+        ),
+        run_config=run_cfg(name="gp"),
+    ).fit()
+    assert results.num_errors == 0
+    xs = [r.config["x"] for r in results]
+    assert len(xs) == 20
+    # post-warmup suggestions concentrate near the optimum at 0.7
+    post = xs[8:]
+    near = [x for x in post if abs(x - 0.7) < 0.15]
+    assert len(near) >= len(post) // 2, xs
+    best = results.get_best_result(metric="score", mode="max")
+    assert abs(best.config["x"] - 0.7) < 0.1, best.config
