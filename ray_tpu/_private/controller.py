@@ -23,6 +23,8 @@ from __future__ import annotations
 import logging
 import os
 import sys
+import itertools
+import tempfile
 import threading
 import time
 import traceback
@@ -231,6 +233,18 @@ class Controller:
         # Streaming-generator consumer progress (backpressure): task_id ->
         # highest item index the consumer has taken. Bounded FIFO.
         self._stream_consumed: dict[TaskID, int] = {}
+        # on-demand profiling: req_id -> (Event, [stack text])
+        self._stack_waiters: dict[int, tuple] = {}
+        self._stack_req_counter = itertools.count(1)
+
+        # general pub/sub (reference: GCS pubsub, src/ray/pubsub/ — actor
+        # and node event channels with long-poll subscribers; the serve
+        # long-poll is the same pattern specialized to replica sets)
+        self._pubsub_events: dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=1000)
+        )
+        self._pubsub_seq: dict[str, int] = defaultdict(int)
+        self._pubsub_cv = threading.Condition()
         # Producer-side pins of streamed items: sealed stream items have no
         # consumer handle yet, so the producer pins them (else the eager
         # refcount-0 free in _on_object_sealed reclaims them instantly).
@@ -496,7 +510,8 @@ class Controller:
             node_id = NodeID.from_random()
             self.nodes[node_id] = NodeState(node_id, resources, labels)
             self.sched_cv.notify_all()
-            return node_id
+        self.publish("nodes", {"node_id": node_id.hex(), "event": "added", "resources": dict(resources)})
+        return node_id
 
     def _store_for_node(self, node_id: NodeID):
         """The node's object store; non-head nodes get their own arena
@@ -532,9 +547,11 @@ class Controller:
     def remove_node(self, node_id: NodeID):
         with self.lock:
             node = self.nodes.get(node_id)
-            if node is None:
-                return
+            if node is None or not node.alive:
+                return  # unknown or already being removed
             node.alive = False
+        self.publish("nodes", {"node_id": node_id.hex(), "event": "removed"})
+        with self.lock:
             victims = [w for w in self.workers.values() if w.node_id == node_id]
             # The node's data plane dies with it: every object resident in
             # its arena is LOST (reference: node failure → plasma contents
@@ -769,6 +786,33 @@ class Controller:
             if object_id not in self.ref_counts:
                 self._free_object(object_id)
 
+    def publish(self, channel: str, event: dict):
+        """Append an event to a pubsub channel and wake long-pollers."""
+        with self._pubsub_cv:
+            self._pubsub_seq[channel] += 1
+            self._pubsub_events[channel].append(
+                (self._pubsub_seq[channel], {**event, "t": time.time()})
+            )
+            self._pubsub_cv.notify_all()
+
+    def pubsub_poll(self, channel: str, after_seq: int, timeout: float):
+        """Long-poll: block until the channel has events newer than
+        ``after_seq`` (or timeout); returns (latest_seq, [events])."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self._pubsub_cv:
+            while True:
+                events = [
+                    (s, e)
+                    for s, e in self._pubsub_events.get(channel, ())
+                    if s > after_seq
+                ]
+                if events:
+                    return (events[-1][0], [e for _, e in events])
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return (self._pubsub_seq.get(channel, 0), [])
+                self._pubsub_cv.wait(remaining)
+
     def _maybe_pin_stream_item(self, object_id: ObjectID):
         """Pin a freshly-sealed stream item on behalf of its producer (the
         consumer has no handle yet; without this the refcount-0 eager free
@@ -837,7 +881,22 @@ class Controller:
 
     # ------------------------------------------------------------- submission
 
+    def _validate_runtime_env(self, spec: TaskSpec):
+        """Reject unusable runtime envs at SUBMISSION (reference:
+        RuntimeEnvSetupError surfaces on the task) — a bad py_modules path
+        discovered at worker-spawn time would otherwise respawn doomed
+        workers forever while the task hangs in the ready queue."""
+        rt = spec.runtime_env or {}
+        for mod in rt.get("py_modules") or ():
+            p = os.path.abspath(os.path.expanduser(str(mod)))
+            if not os.path.exists(p):
+                raise ValueError(
+                    f"runtime_env py_modules path does not exist on the "
+                    f"cluster host: {p}"
+                )
+
     def submit_task(self, spec: TaskSpec):
+        self._validate_runtime_env(spec)
         deps = {a[1] for a in spec.args if a[0] == "ref"}
         pt = PendingTask(spec, deps)
         self._record_lineage(spec)
@@ -1093,6 +1152,7 @@ class Controller:
             bool(spec.resources.get("TPU")),
             tuple(sorted(env_vars.items())),
             rt.get("working_dir"),
+            tuple(str(m) for m in (rt.get("py_modules") or ())),
         )
 
     def _acquire_worker(self, node: NodeState, pt: PendingTask) -> Optional[WorkerHandle]:
@@ -1175,6 +1235,20 @@ class Controller:
             env["PYTHONPATH"] = os.pathsep.join(
                 [working_dir, env.get("PYTHONPATH", "")]
             )
+        # runtime_env py_modules (reference: _private/runtime_env/py_modules
+        # — URI-packaged module dirs; local-path staging here): each entry is
+        # staged into a per-session dir and prepended to the worker's import
+        # path, so workers import code the driver never installed
+        py_modules = (
+            spec_hint.runtime_env.get("py_modules")
+            if spec_hint.runtime_env
+            else None
+        )
+        if py_modules:
+            staged = self._stage_py_modules(py_modules)
+            env["PYTHONPATH"] = os.pathsep.join(
+                staged + [env.get("PYTHONPATH", "")]
+            )
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main", self.address, worker_id.hex()],
             env=env,
@@ -1187,6 +1261,57 @@ class Controller:
         with self.lock:
             self.workers[worker_id] = handle
         return handle
+
+    def _stage_py_modules(self, py_modules: list) -> list[str]:
+        """Copy each module dir/file into the session's runtime-env staging
+        area (once, content-addressed by path+mtime) and return the import
+        roots to prepend."""
+        import shutil
+
+        base = os.path.join(
+            tempfile.gettempdir(), f"rtpu-pymods-{os.getpid()}"
+        )
+        os.makedirs(base, exist_ok=True)
+        roots = []
+        for mod in py_modules:
+            src = os.path.abspath(os.path.expanduser(str(mod)))
+            if not os.path.exists(src):
+                raise ValueError(f"py_modules path does not exist: {src}")
+            tag = self._tree_fingerprint(src)
+            dst_root = os.path.join(base, tag)
+            dst = os.path.join(dst_root, os.path.basename(src))
+            if not os.path.exists(dst):
+                os.makedirs(dst_root, exist_ok=True)
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst, dirs_exist_ok=True)
+                else:
+                    shutil.copy2(src, dst)
+            roots.append(dst_root)
+        return roots
+
+    @staticmethod
+    def _tree_fingerprint(src: str) -> str:
+        """Content fingerprint over every contained file's (path, mtime,
+        size) — a directory's own mtime does NOT change when a nested file
+        is edited, so staging keyed on it would serve stale code."""
+        import hashlib
+
+        h = hashlib.sha256(src.encode())
+        if os.path.isdir(src):
+            for root, _, files in sorted(os.walk(src)):
+                for f in sorted(files):
+                    p = os.path.join(root, f)
+                    try:
+                        st = os.stat(p)
+                    except OSError:
+                        continue
+                    h.update(
+                        f"{os.path.relpath(p, src)}:{st.st_mtime_ns}:{st.st_size}".encode()
+                    )
+        else:
+            st = os.stat(src)
+            h.update(f"{st.st_mtime_ns}:{st.st_size}".encode())
+        return h.hexdigest()[:16]
 
     def _spawn_worker_thread(self, node_id: NodeID) -> WorkerHandle:
         """Thread-mode worker: same execution loop, in-process (local_mode
@@ -1271,7 +1396,7 @@ class Controller:
             elif isinstance(msg, P.Request):
                 if handle.is_driver and msg.op == "add_ref":
                     handle.held_refs.update(msg.payload)
-                if msg.op in ("wait", "pg_ready", "get_entries"):
+                if msg.op in ("wait", "pg_ready", "get_entries", "worker_stacks", "pubsub_poll"):
                     threading.Thread(
                         target=self._handle_request, args=(handle, msg), daemon=True
                     ).start()
@@ -1281,6 +1406,11 @@ class Controller:
                 for oid in msg.object_ids:
                     handle.held_refs.discard(oid)
                     self.remove_ref(oid)
+            elif isinstance(msg, P.StacksReply):
+                waiter = self._stack_waiters.get(msg.req_id)
+                if waiter is not None:
+                    waiter[1].append(msg.text)
+                    waiter[0].set()
             elif isinstance(msg, P.WorkerError):
                 logger.error("worker %s error: %s", handle.worker_id.hex()[:8], msg.message)
         if handle.is_driver:
@@ -1393,6 +1523,50 @@ class Controller:
         if op == "stream_consumed_get":
             with self.lock:
                 return self._stream_consumed.get(payload, 0)
+        if op == "pubsub_poll":
+            channel, after_seq, timeout = payload
+            return self.pubsub_poll(channel, after_seq, min(timeout, 30.0))
+        if op == "pubsub_publish":
+            channel, event = payload
+            self.publish(channel, event)
+            return None
+        if op == "worker_stacks":
+            # on-demand profiling (reference: dashboard reporter py-spy
+            # stack dumps): ask worker(s) to dump all thread stacks
+            target = payload  # worker id hex prefix, or None = all
+            with self.lock:
+                handles = [
+                    h
+                    for h in self.workers.values()
+                    if not h.dead
+                    and h.conn is not None  # still handshaking: no channel yet
+                    and (target is None or h.worker_id.hex().startswith(target))
+                ]
+            # fan out ALL requests first, then collect with one shared
+            # deadline: serial 5s waits would stall this (threaded) handler
+            # for 5s x N dead workers. Note the caller itself replies only
+            # because this op runs OFF its reader thread.
+            pending = []
+            out = {}
+            for h in handles:
+                req_id = next(self._stack_req_counter)
+                ev: threading.Event = threading.Event()
+                box: list = []
+                self._stack_waiters[req_id] = (ev, box)
+                try:
+                    h.send(P.DumpStacks(req_id))
+                    pending.append((h, req_id, ev, box))
+                except (OSError, EOFError):
+                    self._stack_waiters.pop(req_id, None)
+                    out[h.worker_id.hex()] = "<unreachable>"
+            deadline = time.monotonic() + 5.0
+            for h, req_id, ev, box in pending:
+                ev.wait(timeout=max(0.0, deadline - time.monotonic()))
+                out[h.worker_id.hex()] = (
+                    box[0] if box else "<no response within 5s>"
+                )
+                self._stack_waiters.pop(req_id, None)
+            return out
         if op == "head_arena":
             # client drivers probe-attach this arena: same-host clients get
             # the shared-memory data plane, cross-host ones fall back to
@@ -1789,10 +1963,12 @@ class Controller:
                     if failed:
                         actor.state = "DEAD"
                         actor.death_cause = "creation task failed"
+                        self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "DEAD", "reason": "creation task failed"})
                         self._drain_actor_queue(actor)
                     else:
                         actor.state = "ALIVE"
                         actor.worker = worker
+                        self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "ALIVE"})
                         actor.held = (getattr(pt, "_node", None), getattr(pt, "_pg_bundle", None), dict(spec.resources))
                         worker.actor_id = actor.actor_id
                         self._pump_actor(actor)
@@ -1909,6 +2085,7 @@ class Controller:
                 if actor.restarts_left > 0:
                     actor.restarts_left -= 1
                 actor.state = "RESTARTING"
+                self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "RESTARTING", "reason": reason})
                 # Re-pin creation args for the restart run (the original pins
                 # were released when the first creation task completed).
                 deps = {a[1] for a in actor.creation_spec.args if a[0] == "ref"}
@@ -1927,6 +2104,7 @@ class Controller:
             else:
                 actor.state = "DEAD"
                 actor.death_cause = reason
+                self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "DEAD", "reason": reason})
                 self._drain_actor_queue(actor)
 
     def _release_actor_resources(self, actor: ActorState):
@@ -1969,6 +2147,7 @@ class Controller:
     # ----------------------------------------------------------------- actors
 
     def register_actor(self, spec: TaskSpec, name: Optional[str] = None) -> ActorState:
+        self._validate_runtime_env(spec)
         with self.lock:
             actor = ActorState(spec.actor_id, spec)
             actor.name = name
@@ -2006,6 +2185,7 @@ class Controller:
                 if actor is not None:
                     actor.state = "DEAD"
                     actor.death_cause = "killed via ray_tpu.kill"
+                    self.publish("actors", {"actor_id": actor_id.hex(), "state": "DEAD", "reason": "killed via ray_tpu.kill"})
                     self._release_actor_resources(actor)
                     self._drain_actor_queue(actor)
                     if actor.name:

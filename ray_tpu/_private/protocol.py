@@ -107,7 +107,23 @@ class FreeObjects:
     object_ids: list
 
 
+@dataclasses.dataclass
+class StacksReply:
+    """Worker → controller: formatted thread stacks (on-demand profiling,
+    reference: ``dashboard/modules/reporter`` py-spy integration)."""
+
+    req_id: int
+    text: str
+
+
 # ---- controller -> worker ----
+
+@dataclasses.dataclass
+class DumpStacks:
+    """Controller → worker: dump every thread's Python stack."""
+
+    req_id: int
+
 
 @dataclasses.dataclass
 class ExecuteTask:

@@ -177,6 +177,11 @@ class WorkerRuntime:
                 self._route_task(msg)
             elif isinstance(msg, (P.GetReply, P.PutAck, P.Reply)):
                 self._handle_reply(msg)
+            elif isinstance(msg, P.DumpStacks):
+                try:
+                    self._send(P.StacksReply(msg.req_id, self._dump_stacks()))
+                except (OSError, EOFError):
+                    pass
             elif isinstance(msg, P.KillActor):
                 break
             elif isinstance(msg, P.Shutdown):
@@ -184,6 +189,26 @@ class WorkerRuntime:
         self._shutdown = True
         if not self.in_process:
             os._exit(0)
+
+    def _dump_stacks(self) -> str:
+        """Every thread's Python stack, annotated with the running task —
+        the py-spy/dashboard-profiling analog (reference:
+        ``dashboard/modules/reporter/reporter_agent.py`` on-demand stack
+        traces), served in-process so no ptrace capability is needed."""
+        import sys
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        parts = [
+            f"pid={os.getpid()} task={self.current_task_name!r} "
+            f"worker={self.worker_id.hex()[:12]}"
+        ]
+        for tid, frame in sorted(sys._current_frames().items()):
+            parts.append(
+                f"\n--- thread {names.get(tid, '?')} (ident {tid}) ---\n"
+                + "".join(traceback.format_stack(frame))
+            )
+        return "".join(parts)
 
     def _handle_reply(self, msg) -> None:
         with self._get_cv:

@@ -19,6 +19,7 @@ class ActorMethod:
         num_returns=1,
         max_retries: int = 0,
         generator_backpressure: int = 0,
+        retry_exceptions: bool = False,
     ):
         self._handle = handle
         self._method_name = method_name
@@ -27,11 +28,13 @@ class ActorMethod:
         # max_task_retries on actor methods, task_manager.h)
         self._max_retries = max_retries
         self._generator_backpressure = generator_backpressure
+        self._retry_exceptions = retry_exceptions
 
     def options(
         self,
         num_returns=1,
         max_retries: int = 0,
+        retry_exceptions: bool = False,
         _generator_backpressure_num_objects: int = 0,
         **_,
     ):
@@ -41,6 +44,7 @@ class ActorMethod:
             num_returns,
             max_retries,
             _generator_backpressure_num_objects,
+            retry_exceptions,
         )
 
     def remote(self, *args, **kwargs):
@@ -51,6 +55,7 @@ class ActorMethod:
             num_returns=self._num_returns,
             max_retries=self._max_retries,
             generator_backpressure=self._generator_backpressure,
+            retry_exceptions=self._retry_exceptions,
         )
 
     def bind(self, *args, **kwargs):
@@ -91,6 +96,7 @@ class ActorHandle:
         num_returns=1,
         max_retries=0,
         generator_backpressure=0,
+        retry_exceptions=False,
     ):
         from ray_tpu._private.worker import global_worker
 
@@ -106,6 +112,7 @@ class ActorHandle:
             num_returns=num_returns,
             seq_no=seq,
             max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
             generator_backpressure=generator_backpressure,
         )
         if num_returns == "streaming":

@@ -1,0 +1,3 @@
+from ray_tpu.dashboard.app import start_dashboard, stop_dashboard
+
+__all__ = ["start_dashboard", "stop_dashboard"]

@@ -1,0 +1,230 @@
+"""Dashboard web UI: cluster state over HTTP with a single-page frontend.
+
+Reference: ``python/ray/dashboard/head.py:48`` (the dashboard head serving
+the React SPA + REST API). Here the API is the existing state/metrics
+surface re-exposed as JSON, and the frontend is one dependency-free inline
+HTML page (no node toolchain in the image — and none needed for tables,
+resource bars, and stack dumps). Runs as threads in the driver process,
+like the rest of the single-host control plane.
+
+Endpoints:
+  /                     the UI
+  /api/overview         cluster + store + autoscaler summary
+  /api/nodes            node table
+  /api/actors           actor table
+  /api/workers          worker table
+  /api/tasks            recent task events + state summary
+  /api/objects          object-store stats
+  /api/stacks[?worker=] on-demand worker stack dump (py-spy analog)
+  /api/timeline         chrome://tracing JSON of task events
+  /metrics              Prometheus exposition (same registry as util.metrics)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1a1c20}
+ header{background:#15314d;color:#fff;padding:10px 20px;font-size:18px}
+ header small{opacity:.7;margin-left:12px}
+ main{padding:16px 20px;max-width:1200px;margin:auto}
+ .cards{display:flex;gap:12px;flex-wrap:wrap;margin-bottom:16px}
+ .card{background:#fff;border-radius:8px;padding:12px 16px;min-width:150px;
+       box-shadow:0 1px 3px rgba(0,0,0,.08)}
+ .card h3{margin:0 0 4px;font-size:12px;text-transform:uppercase;color:#667}
+ .card .v{font-size:22px;font-weight:600}
+ .bar{height:6px;background:#e4e7ec;border-radius:3px;margin-top:6px}
+ .bar i{display:block;height:100%;background:#2f7bd9;border-radius:3px}
+ table{border-collapse:collapse;width:100%;background:#fff;border-radius:8px;
+       overflow:hidden;box-shadow:0 1px 3px rgba(0,0,0,.08);margin-bottom:16px}
+ th,td{padding:7px 10px;text-align:left;font-size:13px;border-bottom:1px solid #eef0f3}
+ th{background:#fafbfc;color:#556;font-weight:600}
+ h2{font-size:14px;color:#334;margin:18px 0 8px}
+ pre{background:#101418;color:#cde;padding:12px;border-radius:8px;overflow:auto;
+     font-size:11px;max-height:400px}
+ button{background:#2f7bd9;color:#fff;border:0;border-radius:6px;padding:6px 12px;
+        cursor:pointer;font-size:13px}
+ .ok{color:#1a7f37}.bad{color:#c62828}
+</style></head><body>
+<header>ray_tpu dashboard<small id="ts"></small></header>
+<main>
+ <div class="cards" id="cards"></div>
+ <h2>Nodes</h2><table id="nodes"></table>
+ <h2>Actors</h2><table id="actors"></table>
+ <h2>Workers</h2><table id="workers"></table>
+ <h2>Task states</h2><table id="tasks"></table>
+ <h2>Profiling <button onclick="stacks()">Dump worker stacks</button>
+    <a href="/api/timeline" download="timeline.json"><button>Download timeline</button></a></h2>
+ <pre id="stacks" style="display:none"></pre>
+</main>
+<script>
+const fmt=(n)=>typeof n==='number'?(Number.isInteger(n)?n:n.toFixed(2)):n;
+function table(el,rows,cols){
+  const t=document.getElementById(el);
+  if(!rows||!rows.length){t.innerHTML='<tr><td>(none)</td></tr>';return}
+  cols=cols||Object.keys(rows[0]);
+  t.innerHTML='<tr>'+cols.map(c=>`<th>${c}</th>`).join('')+'</tr>'+
+   rows.map(r=>'<tr>'+cols.map(c=>`<td>${fmt(r[c]??'')}</td>`).join('')+'</tr>').join('');
+}
+async function j(u){return (await fetch(u)).json()}
+async function refresh(){
+ try{
+  const o=await j('/api/overview');
+  const cards=[];
+  for(const [k,v] of Object.entries(o.resources||{})){
+    const used=v.total-v.available;
+    cards.push(`<div class="card"><h3>${k}</h3><div class="v">${fmt(used)} / ${fmt(v.total)}</div>
+      <div class="bar"><i style="width:${v.total?100*used/v.total:0}%"></i></div></div>`);
+  }
+  cards.push(`<div class="card"><h3>object store</h3><div class="v">${fmt((o.store.used_bytes/1048576))} MiB</div>
+    <div class="bar"><i style="width:${o.store.capacity_bytes?100*o.store.used_bytes/o.store.capacity_bytes:0}%"></i></div></div>`);
+  cards.push(`<div class="card"><h3>objects</h3><div class="v">${o.store.num_objects??''}</div></div>`);
+  document.getElementById('cards').innerHTML=cards.join('');
+  table('nodes',await j('/api/nodes'));
+  table('actors',(await j('/api/actors')).slice(0,50));
+  table('workers',(await j('/api/workers')).slice(0,50));
+  const ts=await j('/api/tasks');
+  table('tasks',Object.entries(ts.summary||{}).map(([k,v])=>({state:k,count:v})));
+  document.getElementById('ts').textContent=new Date().toLocaleTimeString();
+ }catch(e){document.getElementById('ts').textContent='disconnected: '+e}
+}
+async function stacks(){
+ const el=document.getElementById('stacks');el.style.display='block';
+ el.textContent='collecting...';
+ const s=await j('/api/stacks');
+ el.textContent=Object.entries(s).map(([w,t])=>`=== worker ${w} ===\\n${t}`).join('\\n\\n')||'(no workers)';
+}
+refresh();setInterval(refresh,2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        from ray_tpu.util.state import api as st
+
+        try:
+            parsed = urlparse(self.path)
+            path = parsed.path
+            if path in ("/", "/index.html"):
+                body = _PAGE.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/api/overview":
+                self._json(_overview())
+            elif path == "/api/nodes":
+                self._json(st.list_nodes())
+            elif path == "/api/actors":
+                self._json(st.list_actors())
+            elif path == "/api/workers":
+                self._json(st.list_workers())
+            elif path == "/api/tasks":
+                self._json(
+                    {
+                        "summary": st.summarize_tasks(),
+                        "recent": st.list_tasks(limit=100),
+                    }
+                )
+            elif path == "/api/objects":
+                self._json(st.list_objects())
+            elif path == "/api/stacks":
+                q = parse_qs(parsed.query)
+                target = (q.get("worker") or [None])[0]
+                self._json(st.get_worker_stacks(target))
+            elif path == "/api/timeline":
+                self._json(st.timeline())
+            elif path == "/metrics":
+                from ray_tpu.util.metrics import export_prometheus
+
+                body = export_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json({"error": f"unknown path {path}"}, code=404)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 — surface as a 500 JSON
+            try:
+                self._json({"error": repr(e)}, code=500)
+            except Exception:
+                pass
+
+
+def _overview() -> dict:
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    resources = {
+        k: {"total": v, "available": avail.get(k, 0.0)} for k, v in total.items()
+    }
+    controller = getattr(global_worker(), "controller", None)
+    store = {}
+    if controller is not None:
+        plasma = controller.plasma
+        try:
+            used = int(plasma.used_bytes())
+        except Exception:
+            used = 0
+        cap = int(
+            getattr(plasma, "_capacity", 0)
+            or getattr(plasma, "capacity", 0)
+            or 0
+        )
+        store = {
+            "used_bytes": used,
+            "capacity_bytes": cap,
+            "num_objects": controller.memory_store.size(),
+        }
+    return {"resources": resources, "store": store}
+
+
+_server: Optional[ThreadingHTTPServer] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> int:
+    """Start the dashboard in the driver (idempotent); returns the port.
+    ``port=0`` picks a free one."""
+    global _server
+    if _server is not None:
+        return _server.server_address[1]
+    _server = ThreadingHTTPServer((host, port), _Handler)
+    _server.daemon_threads = True
+    threading.Thread(
+        target=_server.serve_forever, daemon=True, name="dashboard-http"
+    ).start()
+    return _server.server_address[1]
+
+
+def stop_dashboard():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()  # release the listening socket fd
+        _server = None

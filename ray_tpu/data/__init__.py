@@ -13,6 +13,7 @@ from ray_tpu.data.dataset import (
     GroupedData,
     MaterializedDataset,
     from_arrow,
+    from_generator,
     from_items,
     from_numpy,
     from_pandas,
@@ -21,9 +22,11 @@ from ray_tpu.data.dataset import (
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask
@@ -47,6 +50,9 @@ __all__ = [
     "range_tensor",
     "read_binary_files",
     "read_csv",
+    "read_images",
+    "read_sql",
+    "from_generator",
     "read_datasource",
     "read_json",
     "read_numpy",

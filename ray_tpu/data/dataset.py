@@ -428,5 +428,27 @@ def read_binary_files(paths, **kwargs) -> Dataset:
     return _from_source(BinaryDatasource(paths, **kwargs))
 
 
+def read_images(paths, *, size=None, mode: str = "RGB", **kwargs) -> Dataset:
+    from ray_tpu.data.datasource import ImageDatasource
+
+    return _from_source(ImageDatasource(paths, size=size, mode=mode, **kwargs))
+
+
+def read_sql(sql: str, connection_factory=None, *, database: str = None) -> Dataset:
+    from ray_tpu.data.datasource import SQLDatasource
+
+    return _from_source(
+        SQLDatasource(sql, connection_factory=connection_factory, database=database)
+    )
+
+
+def from_generator(fn, *, num_tasks: int = 1) -> Dataset:
+    """Lazy blocks from ``fn(task_index) -> Iterator[block]`` — each shard
+    streams through a streaming-generator read task."""
+    from ray_tpu.data.datasource import GeneratorDatasource
+
+    return _from_source(GeneratorDatasource(fn, num_tasks=num_tasks))
+
+
 def read_datasource(source, *, parallelism: int = -1) -> Dataset:
     return _from_source(source, parallelism)

@@ -250,3 +250,76 @@ class TextDatasource(FileBasedDatasource):
         with open(path) as f:
             lines = [l.rstrip("\n") for l in f]
         return {"text": np.asarray(lines, dtype=object)}
+
+
+class ImageDatasource(FileBasedDatasource):
+    """Decoded images as [H, W, C] uint8 tensors (reference:
+    ``datasource/image_datasource.py``); ``size=(h, w)`` resizes so blocks
+    stack into one tensor column for ``iter_jax_batches``."""
+
+    def __init__(self, paths, size=None, mode: str = "RGB", **kw):
+        super().__init__(paths, **kw)
+        self.size = size
+        self.mode = mode
+
+    def _read_file(self, path: str) -> Block:
+        from PIL import Image
+
+        img = Image.open(path).convert(self.mode)
+        if self.size is not None:
+            img = img.resize((self.size[1], self.size[0]))
+        arr = np.asarray(img, dtype=np.uint8)
+        return {
+            "image": arr[None],
+            "path": np.asarray([path], dtype=object),
+        }
+
+
+class SQLDatasource(Datasource):
+    """SQLite-backed SQL reads (reference: ``datasource/sql_datasource.py``
+    — the reference takes a connection factory; here the stdlib sqlite3 is
+    the zero-dependency default, same row→block semantics)."""
+
+    def __init__(self, sql: str, connection_factory=None, database: str = None):
+        if connection_factory is None:
+            if database is None:
+                raise ValueError("SQLDatasource needs connection_factory or database")
+            import sqlite3
+
+            connection_factory = lambda: sqlite3.connect(database)  # noqa: E731
+        self.sql = sql
+        self.connection_factory = connection_factory
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        sql, factory = self.sql, self.connection_factory
+
+        def read():
+            conn = factory()
+            try:
+                cur = conn.execute(sql)
+                cols = [d[0] for d in cur.description]
+                rows = cur.fetchall()
+            finally:
+                conn.close()
+            if not rows:
+                return {}
+            return {
+                c: np.asarray([r[i] for r in rows]) for i, c in enumerate(cols)
+            }
+
+        return [ReadTask(read, {"sql": sql})]
+
+
+class GeneratorDatasource(Datasource):
+    """Blocks from a user generator factory: each call of ``fn(task_index)``
+    yields blocks lazily (streaming read task per shard)."""
+
+    def __init__(self, fn, num_tasks: int = 1):
+        self.fn = fn
+        self.num_tasks = num_tasks
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        return [
+            StreamingReadTask(lambda i=i: self.fn(i), {"shard": i})
+            for i in range(self.num_tasks)
+        ]

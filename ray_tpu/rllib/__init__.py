@@ -6,6 +6,7 @@ LearnerGroup / EnvRunnerGroup, with PPO as the first algorithm
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig, record_experience
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
@@ -18,6 +19,11 @@ from ray_tpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "BC",
+    "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
+    "record_experience",
     "DQN",
     "DQNConfig",
     "ReplayBuffer",

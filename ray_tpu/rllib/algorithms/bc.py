@@ -1,0 +1,191 @@
+"""Offline RL: behavior cloning and MARWIL over ray_tpu.data datasets.
+
+Reference: ``rllib/algorithms/bc/bc.py`` + ``rllib/algorithms/marwil/``
+(the offline-data stack under ``rllib/offline/``): learn a policy from a
+logged experience dataset with NO environment interaction during training;
+the environment appears only for periodic evaluation.
+
+MARWIL is BC with exponential advantage weighting
+``exp(beta * A)`` (Wang et al.); ``beta=0`` reduces exactly to BC, which is
+how the reference implements BC too — one learner, two configs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import RLModule
+
+
+def record_experience(
+    env_id: str,
+    *,
+    num_fragments: int = 10,
+    num_envs: int = 4,
+    rollout_fragment_length: int = 100,
+    weights: Optional[dict] = None,
+    hidden=(64, 64),
+    seed: int = 0,
+):
+    """Collect a logged-experience Dataset (reference: ``rllib/offline``
+    output writers): rows of {obs, actions, advantages, logp_old}. With
+    ``weights=None`` the behavior policy is a random-init module."""
+    import cloudpickle
+
+    from ray_tpu import data as rd
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+    from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner, env_dims
+
+    obs_dim, act_dim = env_dims(env_id)
+    spec = RLModuleSpec(observation_dim=obs_dim, action_dim=act_dim, hidden=hidden)
+    runner = SingleAgentEnvRunner(
+        env_id,
+        cloudpickle.dumps(spec),
+        num_envs=num_envs,
+        rollout_fragment_length=rollout_fragment_length,
+        seed=seed,
+    )
+    if weights is not None:
+        runner.set_weights(weights)
+    rows = []
+    for _ in range(num_fragments):
+        batch = runner.sample()["batch"]
+        for i in range(len(batch["actions"])):
+            rows.append(
+                {
+                    "obs": batch["obs"][i],
+                    "actions": int(batch["actions"][i]),
+                    "advantages": float(batch["advantages"][i]),
+                    "logp_old": float(batch["logp_old"][i]),
+                }
+            )
+    return rd.from_items(rows)
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=BC)
+        self.beta = 0.0  # 0 = plain BC; >0 = MARWIL advantage weighting
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_updates_per_iteration = 50
+        self.evaluation_interval = 1  # env-eval every N train() calls
+        self.dataset = None
+
+    def offline_data(self, dataset) -> "BCConfig":
+        """The logged-experience Dataset (rows with obs/actions[/advantages])."""
+        self.dataset = dataset
+        return self
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MARWIL
+        self.beta = 1.0
+
+
+class BC(Algorithm):
+    """Trains purely from the dataset; the env-runner group exists only for
+    evaluation rollouts (reference: BC's evaluation workers)."""
+
+    def __init__(self, config: BCConfig):
+        super().__init__(config)
+        import jax.numpy as jnp
+        import optax
+
+        if config.dataset is None:
+            raise ValueError("BCConfig.offline_data(dataset) is required")
+        weights = self.learner_group.get_weights()
+        self._params = {k: jnp.asarray(v) for k, v in weights.items()}
+        self.optimizer = optax.adam(config.lr)
+        self._opt_state = self.optimizer.init(self._params)
+        self._update_fn = self._build_update()
+        self._rng = np.random.default_rng(config.seed)
+        self._rows = self._load_rows(config.dataset)
+
+    @staticmethod
+    def _load_rows(dataset) -> dict:
+        """Materialize the offline dataset into host arrays once — offline
+        RL epochs over the same data; re-reading per epoch buys nothing."""
+        obs, actions, advs = [], [], []
+        for row in dataset.iter_rows():
+            obs.append(np.asarray(row["obs"], np.float32))
+            actions.append(int(row["actions"]))
+            advs.append(float(row.get("advantages", 0.0)))
+        return {
+            "obs": np.stack(obs),
+            "actions": np.asarray(actions, np.int64),
+            "advantages": np.asarray(advs, np.float32),
+        }
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        n_hidden = len(self.module_spec.hidden)
+        beta = self.config.beta
+        optimizer = self.optimizer
+
+        def loss_fn(params, batch):
+            logits, values = RLModule.forward(params, batch["obs"], n_hidden)
+            logp = jax.nn.log_softmax(logits)
+            act_logp = jnp.take_along_axis(
+                logp, batch["actions"][:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            del values  # the GAE advantages are precomputed in the dataset
+            if beta > 0.0:
+                # MARWIL: advantage-exponential imitation weights, clipped
+                # for stability (reference marwil.py c=20)
+                w = jnp.exp(jnp.clip(beta * batch["advantages"], -20.0, 20.0))
+                w = jax.lax.stop_gradient(w)
+                return -jnp.mean(w * act_logp)
+            return -jnp.mean(act_logp)
+
+        def update(params, opt_state, batch):
+            import optax
+
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return jax.jit(update, donate_argnums=(0, 1))
+
+    def training_step(self) -> dict:
+        import jax.numpy as jnp
+
+        n = len(self._rows["actions"])
+        loss = 0.0
+        for _ in range(self.config.num_updates_per_iteration):
+            idx = self._rng.integers(0, n, self.config.train_batch_size)
+            mb = {
+                k: jnp.asarray(v[idx]) for k, v in self._rows.items()
+            }
+            self._params, self._opt_state, loss = self._update_fn(
+                self._params, self._opt_state, mb
+            )
+        weights = {k: np.asarray(v) for k, v in self._params.items()}
+        self.learner_group.set_weights(weights)
+
+        result: dict[str, Any] = {
+            "learner": {"imitation_loss": float(loss)},
+            "num_env_steps_sampled": 0,  # offline: no env interaction
+            "dataset_size": n,
+            "episode_return_mean": float("nan"),
+        }
+        if (
+            self.config.evaluation_interval
+            and (self.iteration + 1) % self.config.evaluation_interval == 0
+        ):
+            _, metrics = self.env_runner_group.sample(weights=weights)
+            result["episode_return_mean"] = metrics["episode_return_mean"]
+            result["evaluation"] = metrics
+        return result
+
+
+class MARWIL(BC):
+    pass

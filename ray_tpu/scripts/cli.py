@@ -37,6 +37,22 @@ def cmd_status(args):
         print(f"  {n['NodeID'][:12]} alive={n['Alive']} {n['Resources']}")
 
 
+def cmd_dashboard(args):
+    """Attach to the running cluster and serve the dashboard UI."""
+    import time
+
+    from ray_tpu.dashboard import start_dashboard
+
+    _ensure_init(args)
+    port = start_dashboard(host=args.host, port=args.port)
+    print(f"dashboard: http://{args.host}:{port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
 def cmd_microbenchmark(args):
     from ray_tpu.scripts.microbenchmark import main
 
@@ -97,6 +113,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--mode", default="thread", choices=["thread", "process"])
     s.add_argument("--num-cpus", type=int, default=8)
     s.set_defaults(fn=cmd_microbenchmark)
+
+    s = sub.add_parser("dashboard", help="serve the web dashboard UI")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8265)
+    s.set_defaults(fn=cmd_dashboard)
 
     s = sub.add_parser("timeline", help="export chrome trace of task events")
     s.add_argument("--output", "-o", default="timeline.json")

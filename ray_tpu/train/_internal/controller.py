@@ -188,9 +188,12 @@ class TrainController:
                 break
             if outcome == "resize":
                 # mid-run elastic resize: restart at the new size from the
-                # latest checkpoint — NOT charged to the failure budget
+                # latest checkpoint — NOT charged to the failure budget, but
+                # a fresh attempt dir (a half-written checkpoint from the
+                # torn-down gang must never be overwritten in place)
                 self.state = RunState.RESTARTING
                 self.num_resizes += 1
+                self._attempt += 1
                 logger.info(
                     "elastic resize: restarting worker group at %d workers",
                     self._resize_to,

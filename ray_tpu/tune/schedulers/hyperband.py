@@ -144,7 +144,7 @@ class HyperBandScheduler(TrialScheduler):
         if b.cohort_complete():
             self._process_rung(b)
 
-    def choose_trial_to_run(self, trials: list):
+    def choose_trial_to_run(self, trials: list, exhausted: bool = False):
         from ray_tpu.tune.tuner import TrialStatus
 
         by_id = {t.trial_id: t for t in trials}
@@ -158,10 +158,15 @@ class HyperBandScheduler(TrialScheduler):
                     return t
                 if t.status is TrialStatus.RUNNING:
                     b.promoted.discard(tid)  # resume took effect
-        # deadlock guard: a rung whose remaining reporters can never report
-        # (errored/stopped outside our control) resolves with what it has
+        # deadlock guard: resolve a rung ONLY when its cohort can never
+        # complete — the bracket must be unable to gain trials (full, or the
+        # experiment is exhausted) AND no live unreported trial can still
+        # report. Without the first condition this would cut early whenever
+        # max_concurrent < capacity (paused early reporters look "complete").
         for b in self._brackets:
-            if b.scores and not any(
+            if not b.scores or not (b.full() or exhausted):
+                continue
+            if not any(
                 tid in b.live
                 and tid not in b.scores
                 and by_id.get(tid) is not None

@@ -48,6 +48,20 @@ class _GP:
         return mu, np.sqrt(var)
 
 
+def suggest_ucb(X, y, cand, kappa: float = 2.0):
+    """argmax of the GP-UCB acquisition over ``cand`` rows, fit on (X, y);
+    falls back to ``cand[0]`` if the kernel matrix is singular. Shared by
+    PB2's explore step and the standalone GPSearcher."""
+    y_n = (y - y.mean()) / (y.std() + 1e-8)
+    try:
+        gp = _GP()
+        gp.fit(X, y_n)
+        mu, sd = gp.predict(cand)
+        return cand[int(np.argmax(mu + kappa * sd))]
+    except np.linalg.LinAlgError:
+        return cand[0]
+
+
 class PB2(PopulationBasedTraining):
     def __init__(
         self,
@@ -118,18 +132,11 @@ class PB2(PopulationBasedTraining):
                 [np.concatenate([[h[0] / t_scale], h[1]]) for h in recent]
             )
             y = np.asarray([h[2] for h in recent])
-            y_std = y.std() + 1e-8
-            gp = _GP()
-            try:
-                gp.fit(X, (y - y.mean()) / y_std)
-                Xs = np.concatenate(
-                    [np.full((len(cand), 1), t_now / t_scale), cand], axis=1
-                )
-                mu, sd = gp.predict(Xs)
-                best = int(np.argmax(mu + self.kappa * sd))  # GP-UCB
-                pick = cand[best]
-            except np.linalg.LinAlgError:
-                pick = cand[0]
+            Xs = np.concatenate(
+                [np.full((len(cand), 1), t_now / t_scale), cand], axis=1
+            )
+            picked = suggest_ucb(X, y, Xs, kappa=self.kappa)
+            pick = picked[1:]  # drop the time feature column
         else:
             pick = cand[0]  # cold start: uniform in bounds
         for i, k in enumerate(names):

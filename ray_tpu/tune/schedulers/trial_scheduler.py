@@ -48,10 +48,12 @@ class TrialScheduler:
     def on_trial_error(self, trial) -> None:
         pass
 
-    def choose_trial_to_run(self, trials: list):
+    def choose_trial_to_run(self, trials: list, exhausted: bool = False):
         """A PAUSED trial this scheduler wants resumed next (sync schedulers
         promote rung winners here). Must be idempotent: the controller may
-        call it multiple times before starting the returned trial."""
+        call it multiple times before starting the returned trial.
+        ``exhausted``: no further trials will ever be created — sync
+        schedulers may resolve under-filled cohorts."""
         return None
 
     def take_pending_stops(self) -> list:

@@ -16,7 +16,7 @@ from typing import Optional
 
 import numpy as np
 
-from ray_tpu.tune.schedulers.pb2 import _GP
+from ray_tpu.tune.schedulers.pb2 import suggest_ucb
 from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
 from ray_tpu.tune.search.searcher import Searcher
 
@@ -100,15 +100,8 @@ class GPSearcher(Searcher):
         else:
             X = np.stack(self._X)
             y = np.asarray(self._y)
-            y_n = (y - y.mean()) / (y.std() + 1e-8)
             cand = self._np_rng.uniform(size=(self.n_candidates, n_dims))
-            try:
-                gp = _GP()
-                gp.fit(X, y_n)
-                mu, sd = gp.predict(cand)
-                u = cand[int(np.argmax(mu + self.kappa * sd))]
-            except np.linalg.LinAlgError:
-                u = cand[0]
+            u = suggest_ucb(X, y, cand, kappa=self.kappa)
         self._vectors[trial_id] = u
         return self._decode(u)
 

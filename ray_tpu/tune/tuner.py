@@ -186,15 +186,13 @@ class TuneController:
             # top up to the concurrency cap: scheduler-promoted paused
             # trials (HyperBand rung winners) resume before new trials start
             while len(running) < self._max_concurrent():
-                t = self.scheduler.choose_trial_to_run(self.trials)
+                t = self.scheduler.choose_trial_to_run(self.trials, exhausted=self._exhausted)
                 if t is None:
                     if self._exhausted:
                         break
                     t = self._maybe_create_trial()
                     if t is None:
                         break
-                else:
-                    t.restore_checkpoint = t.checkpoint
                 try:
                     self._start_trial(t)
                     running.append(t)
@@ -219,7 +217,7 @@ class TuneController:
                     # a sync scheduler must resolve its cohort (it sees all
                     # statuses in choose_trial_to_run); if it still declines,
                     # finish the paused trials rather than spin forever
-                    if self.scheduler.choose_trial_to_run(self.trials) is None:
+                    if self.scheduler.choose_trial_to_run(self.trials, exhausted=True) is None:
                         for t in paused:
                             self._stop_trial(t, TrialStatus.TERMINATED)
                         continue

@@ -58,6 +58,34 @@ def _groups() -> "_OwnerView":
     return _OwnerView()
 
 
+class _RefCell:
+    """Marker wrapper: the tensor travels through the OBJECT STORE (shared
+    memory) and only its ref rides the actor channel — the coordinator would
+    otherwise serialize every large tensor through its control connection
+    twice per rank (the O(world x bytes)-through-one-channel weakness)."""
+
+    __slots__ = ("ref",)
+
+    def __init__(self, ref):
+        self.ref = ref
+
+
+_REF_THRESHOLD = 64 * 1024
+
+
+def _wrap(value):
+    arr = np.asarray(value)
+    if arr.nbytes > _REF_THRESHOLD:
+        return _RefCell(ray_tpu.put(arr))
+    return arr
+
+
+def _resolve(value):
+    if isinstance(value, _RefCell):
+        return np.asarray(ray_tpu.get(value.ref, timeout=120))
+    return np.asarray(value)
+
+
 class _Coordinator:
     """Named actor: rendezvous + reduction point for one group."""
 
@@ -93,10 +121,13 @@ class _Coordinator:
 
     def _combine(self, op_key: str, slot: dict) -> dict:
         kind, _, detail = op_key.partition(":")
-        arrays = [np.asarray(slot[r]) for r in range(self.world_size)]
+        large = any(isinstance(v, _RefCell) for v in slot.values())
+        arrays = [_resolve(slot[r]) for r in range(self.world_size)]
         if kind == "allreduce":
             ops = {"sum": np.sum, "prod": np.prod, "min": np.min, "max": np.max}
             value = ops[detail](np.stack(arrays), axis=0)
+            if large:
+                value = _RefCell(ray_tpu.put(value))
             return {"value": value, "fetched": 0}
         if kind == "allgather":
             return {"value": arrays, "fetched": 0}
@@ -105,8 +136,9 @@ class _Coordinator:
             shards = np.array_split(total, self.world_size)
             return {"per_rank": {r: shards[r] for r in range(self.world_size)}, "fetched": 0}
         if kind == "broadcast":
-            src = int(detail)
-            return {"value": np.asarray(slot[src]), "fetched": 0}
+            # pass the source's cell/array through untouched: fetchers
+            # resolve the SAME store object — one copy for any world size
+            return {"value": slot[int(detail)], "fetched": 0}
         if kind == "barrier":
             return {"value": True, "fetched": 0}
         raise ValueError(f"unknown collective {op_key}")
@@ -200,7 +232,8 @@ def _get(group_name: str) -> _GroupHandle:
 def _run(g: _GroupHandle, op_key: str, value, timeout: float = 120.0):
     rnd = g.next_round()
     ray_tpu.get(
-        g.coordinator.contribute.remote(op_key, rnd, g.rank, value), timeout=timeout
+        g.coordinator.contribute.remote(op_key, rnd, g.rank, _wrap(value)),
+        timeout=timeout,
     )
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -208,7 +241,7 @@ def _run(g: _GroupHandle, op_key: str, value, timeout: float = 120.0):
             g.coordinator.fetch.remote(op_key, rnd, g.rank), timeout=timeout
         )
         if out is not None:
-            return out[0]
+            return _resolve(out[0]) if isinstance(out[0], _RefCell) else out[0]
         time.sleep(0.002)
     raise TimeoutError(f"collective {op_key} round {rnd} timed out")
 

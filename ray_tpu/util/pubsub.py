@@ -1,0 +1,58 @@
+"""Cluster event pub/sub.
+
+Reference: the GCS pubsub layer (``src/ray/pubsub/publisher.h`` +
+``python/ray/_private/gcs_pubsub.py``): subscribers long-poll the control
+plane for ordered per-channel events. Built-in channels published by the
+controller: ``"actors"`` (ALIVE / RESTARTING / DEAD transitions) and
+``"nodes"`` (added / removed). User code can publish to custom channels.
+
+    sub = Subscriber("actors")
+    events = sub.poll(timeout=5)   # blocks until events or timeout
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def publish(channel: str, event: dict) -> None:
+    """Publish an event to a channel (user channels share the bus with the
+    built-ins; events are plain dicts)."""
+    from ray_tpu._private.worker import global_worker
+
+    global_worker().controller_call("pubsub_publish", (channel, dict(event)))
+
+
+class Subscriber:
+    """Ordered, at-least-once event consumption from one channel. Each
+    ``poll`` returns only events newer than the last batch; a subscriber
+    created after events were published sees the channel's retained tail
+    (bounded buffer — slow subscribers may miss old events, like the
+    reference's bounded GCS pubsub buffers)."""
+
+    def __init__(self, channel: str, start_from_beginning: bool = True):
+        self.channel = channel
+        self._seq = 0 if start_from_beginning else self._latest_seq()
+
+    def _latest_seq(self) -> int:
+        from ray_tpu._private.worker import global_worker
+
+        seq, _ = global_worker().controller_call(
+            "pubsub_poll", (self.channel, 1 << 62, 0.0)
+        )
+        return seq
+
+    def poll(self, timeout: Optional[float] = 5.0) -> list[dict]:
+        """Events published since the previous poll; blocks up to
+        ``timeout`` seconds when none are pending (``None`` = block until
+        the next event arrives)."""
+        from ray_tpu._private.worker import global_worker
+
+        while True:
+            seq, events = global_worker().controller_call(
+                "pubsub_poll",
+                (self.channel, self._seq, 30.0 if timeout is None else timeout),
+            )
+            self._seq = max(self._seq, seq)
+            if events or timeout is not None:
+                return events

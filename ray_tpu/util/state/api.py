@@ -51,6 +51,12 @@ def summarize_tasks() -> dict:
     return {name: dict(c) for name, c in by_name.items()}
 
 
+def get_worker_stacks(worker_id: Optional[str] = None) -> dict:
+    """On-demand stack dump of live workers (reference: the dashboard's
+    py-spy stack-trace button). ``worker_id``: hex prefix, or None = all."""
+    return _call("worker_stacks", worker_id)
+
+
 def timeline(path: Optional[str] = None) -> list[dict]:
     """Chrome-trace export of task events (``ray timeline`` analog;
     reference: task events buffered per worker → GcsTaskManager)."""

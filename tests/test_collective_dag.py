@@ -164,3 +164,40 @@ def test_compiled_dag_diamond(ray_start_thread):
         dag = add.bind(left, right)
     compiled = dag.experimental_compile()
     assert ray_tpu.get(compiled.execute(4)) == 20
+
+
+def test_allreduce_large_tensor_via_store(ray_start_thread):
+    """Large collective payloads ride the object store (refs on the actor
+    channel), and the numerics hold at multi-MB scale."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.util import collective
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            collective.init_collective_group(world, rank, group_name="big")
+            self.rank = rank
+
+        def reduce(self):
+            x = np.full((512, 1024), float(self.rank + 1), np.float32)  # 2MB
+            out = collective.allreduce(x, group_name="big")
+            return float(out[0, 0]), out.shape
+
+        def bcast(self):
+            x = (
+                np.arange(600_000, dtype=np.float64)
+                if self.rank == 0
+                else np.zeros(600_000)
+            )
+            out = collective.broadcast(x, src_rank=0, group_name="big")
+            return float(out.sum())
+
+    world = 4
+    ranks = [Rank.remote(i, world) for i in range(world)]
+    outs = ray_tpu.get([r.reduce.remote() for r in ranks], timeout=180)
+    expect = float(sum(range(1, world + 1)))
+    assert all(v == expect and shape == (512, 1024) for v, shape in outs)
+    sums = ray_tpu.get([r.bcast.remote() for r in ranks], timeout=180)
+    assert all(s == float(np.arange(600_000).sum()) for s in sums)

@@ -112,3 +112,44 @@ def test_actor_restart(ray_start_process):
     # After restart the actor lives in a new process.
     pid2 = ray_tpu.get(p.pid_of.remote(), timeout=120)
     assert pid2 != pid1
+
+
+def test_actor_method_retry_exceptions(ray_start_process):
+    """retry_exceptions on actor methods: transient app errors retry on the
+    same actor, preserving call order."""
+
+    @ray_tpu.remote
+    class Flaky:
+        def __init__(self):
+            self.attempts = 0
+
+        def once_flaky(self):
+            self.attempts += 1
+            if self.attempts < 3:
+                raise RuntimeError("transient")
+            return self.attempts
+
+    f = Flaky.remote()
+    out = ray_tpu.get(
+        f.once_flaky.options(max_retries=5, retry_exceptions=True).remote(),
+        timeout=120,
+    )
+    assert out == 3  # two failed attempts + the success, same actor state
+
+
+def test_runtime_env_py_modules(ray_start_process, tmp_path):
+    """runtime_env py_modules: workers import staged module dirs the driver
+    never installed (reference: _private/runtime_env/py_modules)."""
+    mod_dir = tmp_path / "my_helper_pkg"
+    os.makedirs(mod_dir)
+    (mod_dir / "__init__.py").write_text("MAGIC = 1234\n")
+    (mod_dir / "calc.py").write_text("def triple(x):\n    return x * 3\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_module(x):
+        import my_helper_pkg
+        from my_helper_pkg.calc import triple
+
+        return my_helper_pkg.MAGIC + triple(x)
+
+    assert ray_tpu.get(use_module.remote(2), timeout=120) == 1234 + 6

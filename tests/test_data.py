@@ -355,3 +355,46 @@ def test_local_shuffle_buffer(ray_start_thread):
     ids = np.concatenate([b["id"] for b in b1])
     assert sorted(ids.tolist()) == list(range(64))
     assert ids.tolist() != list(range(64))
+
+
+def test_read_sql_sqlite(ray_start_thread, tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE metrics (name TEXT, value REAL)")
+    conn.executemany(
+        "INSERT INTO metrics VALUES (?, ?)",
+        [(f"m{i}", float(i)) for i in range(20)],
+    )
+    conn.commit()
+    conn.close()
+    ds = rd.read_sql("SELECT name, value FROM metrics WHERE value >= 5", database=db)
+    rows = ds.take_all()
+    assert len(rows) == 15
+    assert rows[0]["name"] == "m5"
+    assert ds.sum("value") == sum(range(5, 20))
+
+
+def test_read_images(ray_start_thread, tmp_path):
+    from PIL import Image
+
+    d = tmp_path / "imgs"
+    os.makedirs(d)
+    for i in range(3):
+        Image.new("RGB", (10 + i, 8), color=(i * 10, 0, 0)).save(str(d / f"{i}.png"))
+    ds = rd.read_images(str(d), size=(16, 16))
+    batch = ds.take_batch(3, batch_format="dict")
+    assert batch["image"].shape == (3, 16, 16, 3)
+    assert batch["image"].dtype == np.uint8
+
+
+def test_from_generator_streaming(ray_start_thread):
+    def gen(shard):
+        for j in range(4):
+            yield {"v": np.arange(5) + shard * 100 + j * 10}
+
+    ds = rd.from_generator(gen, num_tasks=2)
+    mat = ds.materialize()
+    assert mat.num_blocks() == 8  # 2 shards x 4 streamed blocks
+    assert mat.count() == 40

@@ -232,3 +232,112 @@ def test_cli_status_and_job(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "cli job output" in r.stdout
     assert "status: SUCCEEDED" in r.stdout
+
+
+def test_dashboard_web_ui(ray_start_process):
+    """Dashboard HTTP server: UI page, JSON state endpoints, prometheus
+    metrics, and the on-demand worker stack dump (py-spy analog)."""
+    import json as _json
+    import time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    class Sleeper:
+        def nap(self, s):
+            import time as _t
+
+            _t.sleep(s)
+            return "awake"
+
+    sleeper = Sleeper.remote()
+    # ensure the actor's worker is fully up before profiling it
+    assert ray_tpu.get(sleeper.nap.remote(0.01), timeout=60) == "awake"
+    ref = sleeper.nap.remote(8.0)  # a live in-flight task to profile
+    time.sleep(0.5)
+
+    port = start_dashboard(port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            page = r.read().decode()
+        assert "ray_tpu dashboard" in page
+
+        with urllib.request.urlopen(base + "/api/overview", timeout=10) as r:
+            ov = _json.loads(r.read())
+        assert "CPU" in ov["resources"]
+        assert ov["store"]["num_objects"] >= 0
+
+        with urllib.request.urlopen(base + "/api/nodes", timeout=10) as r:
+            nodes = _json.loads(r.read())
+        assert len(nodes) >= 1
+
+        with urllib.request.urlopen(base + "/api/actors", timeout=10) as r:
+            actors = _json.loads(r.read())
+        assert any("Sleeper" in str(a) for a in actors)
+
+        # on-demand profiling: the sleeping task's frame shows up
+        with urllib.request.urlopen(base + "/api/stacks", timeout=30) as r:
+            stacks = _json.loads(r.read())
+        assert stacks, "no workers responded"
+        joined = "\n".join(stacks.values())
+        assert "nap" in joined or "sleep" in joined, joined[:2000]
+
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            assert r.status == 200
+    finally:
+        stop_dashboard()
+    assert ray_tpu.get(ref, timeout=60) == "awake"
+
+
+def test_pubsub_actor_and_node_events(ray_start_thread):
+    """GCS-pubsub analog: subscribers observe actor lifecycle and node
+    membership events; custom channels work for user events."""
+    import threading
+    import time
+
+    import ray_tpu
+    from ray_tpu.util.pubsub import Subscriber, publish
+
+    sub_actors = Subscriber("actors")
+    sub_nodes = Subscriber("nodes")
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+    events = sub_actors.poll(timeout=10)
+    assert any(e["state"] == "ALIVE" for e in events), events
+
+    ray_tpu.kill(a)
+    deadline = time.time() + 15
+    dead = []
+    while time.time() < deadline and not dead:
+        dead = [e for e in sub_actors.poll(timeout=2) if e["state"] == "DEAD"]
+    assert dead, "no DEAD event observed"
+
+    import ray_tpu._private.worker as w
+
+    node_id = w.global_worker().controller.add_node({"CPU": 2})
+    ev = sub_nodes.poll(timeout=10)
+    assert any(e["event"] == "added" for e in ev), ev
+    w.global_worker().controller.remove_node(node_id)
+    ev = sub_nodes.poll(timeout=10)
+    assert any(e["event"] == "removed" for e in ev), ev
+
+    # custom channel + long-poll blocking (publisher fires mid-poll)
+    sub_custom = Subscriber("my-channel")
+    t = threading.Thread(
+        target=lambda: (time.sleep(0.4), publish("my-channel", {"k": 42}))
+    )
+    t0 = time.monotonic()
+    t.start()
+    got = sub_custom.poll(timeout=10)
+    assert [e["k"] for e in got] == [42]
+    assert 0.3 < time.monotonic() - t0 < 5.0  # actually blocked, then woke
+    t.join()

@@ -375,3 +375,98 @@ def test_sac_remote_runners_and_checkpoint(ray_start_thread, tmp_path):
     assert np.allclose(algo2.get_state()["sac"]["log_alpha"], state_before)
     algo2.train()  # restored state keeps training
     algo2.stop()
+
+
+def test_bc_learns_from_expert_dataset(ray_start_thread):
+    """Offline RL: BC clones an expert's CartPole policy from a logged
+    dataset with zero env interaction during training."""
+    from ray_tpu.rllib import BCConfig, PPOConfig, record_experience
+
+    # quick expert via PPO
+    expert = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=128)
+        .training(lr=1e-3, minibatch_size=256, num_epochs=8,
+                  entropy_coeff=0.01, vf_clip_param=100.0)
+        .debugging(seed=0)
+        .build()
+    )
+    expert_return = 0.0
+    for _ in range(40):
+        r = expert.train()
+        m = r["episode_return_mean"]
+        if not np.isnan(m):
+            expert_return = m
+        if expert_return > 90:
+            break
+    weights = expert.learner_group.get_weights()
+    expert.stop()
+    assert expert_return > 50, expert_return
+
+    ds = record_experience(
+        "CartPole-v1", num_fragments=8, num_envs=4,
+        rollout_fragment_length=100, weights=weights, seed=1,
+    )
+    assert ds.count() == 8 * 4 * 100
+
+    bc = (
+        BCConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                     rollout_fragment_length=100)
+        .training(lr=1e-3, num_updates_per_iteration=100)
+        .offline_data(ds)
+        .debugging(seed=2)
+        .build()
+    )
+    last = float("nan")
+    for _ in range(8):
+        r = bc.train()
+        assert r["num_env_steps_sampled"] == 0  # pure offline
+        if not np.isnan(r["episode_return_mean"]):
+            last = r["episode_return_mean"]
+    bc.stop()
+    # the clone should recover most of the expert's performance
+    assert last > expert_return * 0.5, (expert_return, last)
+
+
+def test_marwil_beats_bc_on_mixed_data(ray_start_thread):
+    """MARWIL's advantage weighting filters a half-random dataset better
+    than unweighted BC."""
+    from ray_tpu.rllib import BCConfig, MARWILConfig, record_experience
+
+    # mixed-quality behavior data from a RANDOM policy: advantages mark the
+    # (relatively) good actions
+    ds = record_experience(
+        "CartPole-v1", num_fragments=10, num_envs=4,
+        rollout_fragment_length=100, weights=None, seed=3,
+    )
+
+    def train(config_cls, beta=None):
+        cfg = (
+            config_cls()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                         rollout_fragment_length=100)
+            .training(num_updates_per_iteration=80)
+            .offline_data(ds)
+            .debugging(seed=4)
+        )
+        if beta is not None:
+            cfg.training(beta=beta)
+        algo = cfg.build()
+        last = float("nan")
+        for _ in range(6):
+            r = algo.train()
+            if not np.isnan(r["episode_return_mean"]):
+                last = r["episode_return_mean"]
+        algo.stop()
+        return last
+
+    marwil_ret = train(MARWILConfig)
+    bc_ret = train(BCConfig)
+    # random-policy CartPole averages ~20; MARWIL should do clearly better
+    # than cloning the random behavior outright
+    assert marwil_ret > bc_ret + 10, (bc_ret, marwil_ret)
