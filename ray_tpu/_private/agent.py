@@ -1,0 +1,691 @@
+"""Node agent: the per-host daemon that makes multi-host real.
+
+Analog of the reference's raylet (``src/ray/raylet/node_manager.h:124``) +
+per-node plasma store + object manager (``object_manager.h:119``), started
+with ``ray-tpu start --address=<head>`` (reference:
+``python/ray/scripts/scripts.py:226`` ``ray start``). One agent per host:
+
+- registers its host's resources with the head controller as a REAL node
+  over the TCP control plane;
+- owns a local plasma arena (C++ store) — the node's data plane. Workers on
+  this host attach ONLY this arena; objects cross hosts via the chunked
+  pull protocol, never shared memory;
+- spawns/supervises worker processes on demand (remote half of
+  ``WorkerPool::StartWorkerProcess``, ``worker_pool.h:283``) and relays
+  their control-plane traffic to the head through ``FromWorker``/``ToWorker``
+  envelopes;
+- serves chunk reads of its resident objects to peers (controller, client
+  drivers, other agents) over a TCP data listener (``ObjectManager::Push``
+  analog, chunked as in ``object_buffer_pool.h``);
+- heartbeats; on head-connection loss it tears down its workers.
+
+Worker processes are completely unaware of the agent: they speak the same
+unix-socket protocol as head-local workers. The agent intercepts only the
+node-local data-plane ops (``shm_create`` allocation, plasma seals inside
+``PutObject``/``TaskDone``, ``pull_object_chunk``) and forwards the rest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import traceback
+import zipfile
+from io import BytesIO
+from multiprocessing.connection import Client, Listener
+from typing import Any, Optional
+
+from ray_tpu._private import protocol as P
+from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+
+logger = logging.getLogger("ray_tpu.agent")
+
+_CHUNK = 4 * 1024**2
+
+
+class AgentError(RuntimeError):
+    pass
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        address: str,
+        authkey: bytes,
+        resources: Optional[dict] = None,
+        labels: Optional[dict] = None,
+        base_dir: Optional[str] = None,
+        object_store_memory: int = 1 * 1024**3,
+        data_port: int = 0,
+        node_ip: Optional[str] = None,
+    ):
+        self.node_id = NodeID.from_random()
+        self.authkey = authkey
+        self.head_address = address
+        self.resources = dict(resources or {"CPU": float(os.cpu_count() or 1)})
+        self.labels = dict(labels or {})
+        self.base_dir = base_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), f"rtpu-agent-{os.getpid()}"
+        )
+        os.makedirs(self.base_dir, mode=0o700, exist_ok=True)
+        self.node_ip = node_ip or P.routable_host()
+        self.shutting_down = False
+
+        # Local data plane: this node's arena (native C++ store required —
+        # cross-host pulls need arena-format locations).
+        from ray_tpu._native import plasma as native_plasma
+        from ray_tpu._private.object_store import NativePlasmaStore
+
+        if not native_plasma.available():
+            raise AgentError(
+                "node agents require the native plasma store (g++ build); "
+                "the Python fallback store cannot serve cross-host pulls"
+            )
+        self.arena_name = f"/rtpu-a{os.getpid():x}-{time.time_ns() & 0xFFFFFF:x}"
+        self.store = NativePlasmaStore(object_store_memory, self.arena_name)
+
+        # Workers on this host.
+        self.workers: dict[WorkerID, dict] = {}  # wid -> {conn, proc, lock}
+        self.workers_lock = threading.Lock()
+        # kills that arrived before their spawn finished
+        self._pending_kills: set[WorkerID] = set()
+
+        # Own-request plumbing (agent → controller RPCs).
+        self._req_counter = itertools.count(1)
+        self._replies: dict[int, Any] = {}
+        self._reply_cv = threading.Condition()
+
+        # Node-local object lifecycle: seal order for LRU spilling when the
+        # arena fills (the agent owns its data plane's spilling the way the
+        # raylet's LocalObjectManager does, local_object_manager.h:43), and
+        # the spill table for serving spilled objects to readers.
+        self._resident: "dict[bytes, tuple[str, int]]" = {}
+        self._resident_order: list[bytes] = []
+        self._resident_lock = threading.Lock()
+        self._spilled: dict[bytes, tuple[str, int]] = {}
+        self.spill_dir = os.path.join(self.base_dir, "spill")
+
+        # Peer data connections (agent/controller chunk pulls).
+        self._peers = P.ChunkConnPool(authkey)
+        # object-owner lookup cache: oid -> (data_address|None, expiry)
+        self._owner_cache: dict[bytes, tuple] = {}
+
+        # Data listener: serve chunk reads of local objects to peers.
+        self._data_listener = Listener(
+            ("0.0.0.0", data_port), family="AF_INET", authkey=authkey
+        )
+        self.data_address = f"{self.node_ip}:{self._data_listener.address[1]}"
+        threading.Thread(
+            target=self._data_accept_loop, daemon=True, name="agent-data"
+        ).start()
+
+        # Worker listener (unix socket, same protocol the head controller
+        # speaks to its local workers).
+        self.worker_sock = os.path.join(self.base_dir, "agent.sock")
+        self._worker_listener = Listener(
+            self.worker_sock, family="AF_UNIX", authkey=authkey
+        )
+        threading.Thread(
+            target=self._worker_accept_loop, daemon=True, name="agent-accept"
+        ).start()
+
+        # Control channel to the head.
+        host, _, port = address.rpartition(":")
+        self.conn = Client((host, int(port)), authkey=authkey)
+        self._send_lock = threading.Lock()
+        self._send(
+            P.RegisterAgent(
+                self.node_id,
+                self.resources,
+                self.labels,
+                self.arena_name,
+                self.data_address,
+                pid=os.getpid(),
+                hostname=socket.gethostname(),
+            )
+        )
+        ack = self.conn.recv()
+        if not isinstance(ack, P.AgentAck):
+            raise AgentError(f"unexpected registration reply: {ack!r}")
+        logger.info(
+            "agent registered: node=%s head=%s data=%s arena=%s",
+            self.node_id.hex()[:8], address, self.data_address, self.arena_name,
+        )
+        threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="agent-hb"
+        ).start()
+
+    # ------------------------------------------------------------- transport
+
+    def _send(self, msg):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def call_controller(self, op: str, payload=None, timeout: float = 60.0):
+        req_id = next(self._req_counter)
+        self._send(P.Request(req_id, op, payload))
+        deadline = time.monotonic() + timeout
+        with self._reply_cv:
+            while req_id not in self._replies:
+                remaining = deadline - time.monotonic()
+                if self.shutting_down:
+                    raise AgentError("agent shutting down")
+                if remaining <= 0:
+                    raise TimeoutError(f"controller call {op} timed out")
+                self._reply_cv.wait(remaining)
+            reply = self._replies.pop(req_id)
+        if reply.error is not None:
+            raise RuntimeError(f"controller call {op} failed: {reply.error}")
+        return reply.payload
+
+    def serve_forever(self):
+        """Main loop: dispatch controller → agent traffic until shutdown."""
+        while not self.shutting_down:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                logger.warning("lost connection to head; shutting down")
+                break
+            try:
+                self._dispatch_head_msg(msg)
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.error("agent dispatch failed:\n%s", traceback.format_exc())
+        self.shutdown()
+
+    def _dispatch_head_msg(self, msg):
+        if isinstance(msg, P.ToWorker):
+            with self.workers_lock:
+                w = self.workers.get(msg.worker_id)
+            if w is not None:
+                try:
+                    with w["lock"]:
+                        w["conn"].send(msg.msg)
+                except (OSError, EOFError):
+                    pass
+        elif isinstance(msg, P.Reply):
+            with self._reply_cv:
+                self._replies[msg.req_id] = msg
+                self._reply_cv.notify_all()
+        elif isinstance(msg, P.SpawnWorker):
+            threading.Thread(
+                target=self._spawn_worker, args=(msg,), daemon=True
+            ).start()
+        elif isinstance(msg, P.KillWorker):
+            with self.workers_lock:
+                w = self.workers.get(msg.worker_id)
+                if w is None:
+                    # spawn still in flight (runtime-env staging): leave a
+                    # tombstone so _spawn_worker kills the process on arrival
+                    self._pending_kills.add(msg.worker_id)
+            if w is not None and w.get("proc") is not None:
+                try:
+                    w["proc"].terminate()
+                except OSError:
+                    pass
+        elif isinstance(msg, P.FreeLocal):
+            for oid in msg.object_ids:
+                key = oid.binary()
+                with self._resident_lock:
+                    if self._resident.pop(key, None) is not None:
+                        try:
+                            self._resident_order.remove(key)
+                        except ValueError:
+                            pass
+                spilled = self._spilled.pop(key, None)
+                if spilled is not None:
+                    try:
+                        os.unlink(spilled[0])
+                    except OSError:
+                        pass
+                try:
+                    self.store.delete(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+        elif isinstance(msg, P.Shutdown):
+            self.shutting_down = True
+
+    def _heartbeat_loop(self):
+        while not self.shutting_down:
+            try:
+                self._send(
+                    P.Heartbeat(
+                        self.node_id,
+                        {
+                            "arena_used_bytes": self.store.used_bytes(),
+                            "num_workers": len(self.workers),
+                        },
+                    )
+                )
+            except (OSError, EOFError):
+                return
+            time.sleep(2.0)
+
+    # --------------------------------------------------------- worker plane
+
+    def _spawn_worker(self, msg: P.SpawnWorker):
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER"] = "1"
+        env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
+        env["RAY_TPU_ARENA"] = self.arena_name
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        paths = [pkg_root]
+        cwd = None
+        for kind, name, blob in msg.packages:
+            root = self._stage_package(name, blob)
+            if kind == "working_dir":
+                cwd = os.path.join(root, name)
+                paths.insert(0, cwd)
+            else:
+                paths.append(root)
+        existing = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = os.pathsep.join(paths + ([existing] if existing else []))
+        if not msg.needs_tpu:
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update({k: str(v) for k, v in msg.env_vars.items()})
+        try:
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "ray_tpu._private.worker_main",
+                    self.worker_sock,
+                    msg.worker_id.hex(),
+                ],
+                env=env,
+                cwd=cwd,
+            )
+        except OSError as e:
+            self._send(P.WorkerDied(msg.worker_id, f"spawn failed: {e}"))
+            return
+        with self.workers_lock:
+            self.workers[msg.worker_id] = {
+                "conn": None,
+                "proc": proc,
+                "lock": threading.Lock(),
+            }
+            killed = msg.worker_id in self._pending_kills
+            self._pending_kills.discard(msg.worker_id)
+        if killed:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+
+    def _stage_package(self, name: str, blob: bytes) -> str:
+        """Unpack a shipped runtime-env zip into the agent's staging area,
+        content-addressed so repeat spawns reuse it."""
+        import hashlib
+
+        tag = hashlib.sha256(blob).hexdigest()[:16]
+        root = os.path.join(self.base_dir, "pkgs", tag)
+        done = os.path.join(root, ".done")
+        if not os.path.exists(done):
+            os.makedirs(root, exist_ok=True)
+            with zipfile.ZipFile(BytesIO(blob)) as zf:
+                zf.extractall(root)
+            with open(done, "w"):
+                pass
+        return root
+
+    def _worker_accept_loop(self):
+        while not self.shutting_down:
+            try:
+                conn = self._worker_listener.accept()
+            except (OSError, EOFError):
+                return
+            except Exception:  # noqa: BLE001 — failed authkey handshake
+                continue
+            threading.Thread(
+                target=self._worker_handshake, args=(conn,), daemon=True
+            ).start()
+
+    def _worker_handshake(self, conn):
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            conn.close()
+            return
+        if not isinstance(msg, P.RegisterWorker):
+            conn.close()
+            return
+        with self.workers_lock:
+            w = self.workers.get(msg.worker_id)
+            if w is None:
+                conn.close()
+                return
+            w["conn"] = conn
+        self._send(P.FromWorker(msg.worker_id, msg))
+        self._worker_reader(msg.worker_id, conn)
+
+    def _worker_reader(self, worker_id: WorkerID, conn):
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            try:
+                self._route_worker_msg(worker_id, conn, msg)
+            except Exception:  # noqa: BLE001
+                logger.error(
+                    "worker %s message failed:\n%s",
+                    worker_id.hex()[:8], traceback.format_exc(),
+                )
+        with self.workers_lock:
+            w = self.workers.pop(worker_id, None)
+        reason = "connection closed"
+        if w is not None and w.get("proc") is not None:
+            rc = w["proc"].poll()
+            if rc is not None:
+                reason = f"worker process exited with code {rc}"
+        try:
+            self._send(P.WorkerDied(worker_id, reason))
+        except (OSError, EOFError):
+            pass
+
+    def _route_worker_msg(self, worker_id: WorkerID, conn, msg):
+        """Intercept node-local data-plane ops; relay the rest to the head."""
+        if isinstance(msg, P.Request) and msg.op == "shm_create":
+            # Local arena allocation (the plasma CreateRequest; the head
+            # controller does the same for ITS node's workers).
+            self._reply_worker(conn, worker_id, msg.req_id, self._shm_create, msg.payload)
+            return
+        if isinstance(msg, P.Request) and msg.op == "pull_object_chunk":
+            # Serve locally / pull from the owning peer — threaded so a slow
+            # remote pull can't stall this worker's other replies.
+            threading.Thread(
+                target=self._reply_worker,
+                args=(conn, worker_id, msg.req_id, self._pull_chunk, msg.payload),
+                daemon=True,
+            ).start()
+            return
+        if isinstance(msg, P.PutObject) and msg.kind == "plasma":
+            # Seal locally before the head learns the location: a reader
+            # that sees the entry must find the object already sealed.
+            name, size = msg.payload
+            self.store.seal(msg.object_id, name, size)
+            self._track_seal(msg.object_id, name, size)
+        elif isinstance(msg, P.TaskDone):
+            for oid, kind, payload in msg.results:
+                if kind == "plasma":
+                    self.store.seal(oid, payload[0], payload[1])
+                    self._track_seal(oid, payload[0], payload[1])
+        self._send(P.FromWorker(worker_id, msg))
+
+    def _track_seal(self, object_id: ObjectID, name: str, size: int):
+        key = object_id.binary()
+        with self._resident_lock:
+            if key not in self._resident:
+                self._resident_order.append(key)
+            self._resident[key] = (name, size)
+
+    def _reply_worker(self, conn, worker_id, req_id, fn, payload):
+        try:
+            reply = P.Reply(req_id, fn(payload))
+        except Exception as e:  # noqa: BLE001
+            reply = P.Reply(req_id, None, error=f"{type(e).__name__}: {e}")
+        with self.workers_lock:
+            w = self.workers.get(worker_id)
+        lock = w["lock"] if w is not None else threading.Lock()
+        try:
+            with lock:
+                conn.send(reply)
+        except (OSError, EOFError):
+            pass
+
+    def _shm_create(self, payload):
+        from ray_tpu.exceptions import ObjectStoreFullError
+        from ray_tpu._private.object_store import ObjectExistsError
+
+        object_id, size = payload
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                return self.store.create_remote(object_id, size)
+            except ObjectExistsError:
+                entry = self.store.lookup(object_id)
+                if entry is not None:
+                    return ("exists", entry[0], entry[1])
+                raise
+            except ObjectStoreFullError:
+                if self._spill_for(size):
+                    continue
+                if time.monotonic() > deadline:
+                    raise
+                # concurrent producers may seal (→ become spillable) soon
+                time.sleep(0.1)
+
+    def _spill_for(self, need_bytes: int) -> bool:
+        """Move the coldest sealed residents to this host's disk until
+        ``need_bytes`` is freed (the raylet-side half of object spilling,
+        ``local_object_manager.h:113``). Readers holding stale arena
+        locations re-resolve via validate-after-copy; the head entry is
+        repointed through ``report_agent_spill``."""
+        os.makedirs(self.spill_dir, exist_ok=True)
+        freed = 0
+        while freed < need_bytes:
+            with self._resident_lock:
+                if not self._resident_order:
+                    return freed > 0
+                key = self._resident_order.pop(0)
+                entry = self._resident.pop(key, None)
+            if entry is None:
+                continue
+            object_id = ObjectID(key)
+            name, size = entry
+            try:
+                total, data = self._read_local_chunk(object_id, entry, 0, size)
+                path = os.path.join(self.spill_dir, f"{object_id.hex()}.bin")
+                with open(path, "wb") as f:
+                    f.write(data)
+            except Exception:  # noqa: BLE001 — skip unreadable victims
+                logger.warning("spill failed for %s", object_id.hex(), exc_info=True)
+                continue
+            self._spilled[key] = (path, size)
+            try:
+                verdict = self.call_controller(
+                    "report_agent_spill", (object_id, path, size)
+                )
+            except Exception:  # noqa: BLE001
+                # head unreachable: keep serving from the spill table; the
+                # stale plasma entry still routes pulls here by object id
+                verdict = None
+                logger.warning("spill report failed for %s", object_id.hex())
+            if verdict == "freed":
+                # last ref dropped while we spilled: the object is dead
+                self._spilled.pop(key, None)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            self.store.delete(object_id)
+            freed += size
+            logger.info("spilled %s (%d bytes) to disk", object_id.hex(), size)
+        return True
+
+    # ----------------------------------------------------------- data plane
+
+    def _pull_chunk(self, payload):
+        """A local worker wants [offset, offset+length) of an object that is
+        not in this node's arena (or was relocated). Resolution order:
+        local arena → owning peer agent (direct) → head relay."""
+        object_id, offset, length = payload
+        local = self._serve_local(object_id, offset, length)
+        if local is not None:
+            return local
+        owner = self._object_owner(object_id)
+        if owner is not None and owner != self.data_address:
+            try:
+                return self._peers.pull_chunk(
+                    owner, object_id.binary(), offset, length
+                )
+            except (P.ChunkPullError, OSError, EOFError, ConnectionError):
+                # peer died or no longer has it: fall through to the head,
+                # which serves the recovered copy or raises ObjectLostError
+                self._owner_cache.pop(object_id.binary(), None)
+        return self.call_controller("pull_object_chunk", (object_id, offset, length))
+
+    def _serve_local(self, object_id: ObjectID, offset: int, length: int):
+        """Chunk of a locally resident object (arena or spill), else None."""
+        entry = self.store.lookup(object_id)
+        if entry is not None:
+            try:
+                return self._read_local_chunk(object_id, entry, offset, length)
+            except Exception:  # noqa: BLE001 — relocated mid-read
+                pass
+        spilled = self._spilled.get(object_id.binary())
+        if spilled is not None:
+            path, size = spilled
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return (size, f.read(min(length, size - offset)))
+            except OSError:
+                return None
+        return None
+
+    def _object_owner(self, object_id: ObjectID) -> Optional[str]:
+        key = object_id.binary()
+        now = time.monotonic()
+        hit = self._owner_cache.get(key)
+        if hit is not None and hit[1] > now:
+            return hit[0]
+        owner = self.call_controller("object_owner", object_id)
+        self._owner_cache[key] = (owner, now + 30.0)
+        if len(self._owner_cache) > 4096:
+            self._owner_cache = {
+                k: v for k, v in self._owner_cache.items() if v[1] > now
+            }
+        return owner
+
+    def _read_local_chunk(self, object_id: ObjectID, entry, offset: int, length: int):
+        from ray_tpu._private.object_store import (
+            ObjectRelocatedError,
+            parse_arena_location,
+        )
+
+        name, size = entry
+        loc = parse_arena_location(name)
+        chunk = bytes(self.store.arena.view(loc[1] + offset, min(length, size - offset)))
+        got = self.store.arena.lookup(object_id.binary())
+        if got is None or got[0] != loc[1]:
+            raise ObjectRelocatedError(name)
+        return (size, chunk)
+
+    def _data_accept_loop(self):
+        while not self.shutting_down:
+            try:
+                conn = self._data_listener.accept()
+            except (OSError, EOFError):
+                return
+            except Exception:  # noqa: BLE001
+                continue
+            threading.Thread(
+                target=self._data_serve, args=(conn,), daemon=True
+            ).start()
+
+    def _data_serve(self, conn):
+        """Serve chunk reads of locally resident objects to one peer."""
+        while not self.shutting_down:
+            try:
+                req = conn.recv()
+            except (EOFError, OSError):
+                return
+            try:
+                kind, oid_bytes, offset, length = req
+                assert kind == "chunk"
+                object_id = ObjectID(oid_bytes)
+                reply = self._serve_local(object_id, offset, length)
+                if reply is None:
+                    reply = ("error", f"object {object_id.hex()} not resident")
+            except Exception as e:  # noqa: BLE001
+                reply = ("error", f"{type(e).__name__}: {e}")
+            try:
+                conn.send(reply)
+            except (EOFError, OSError):
+                return
+
+    # -------------------------------------------------------------- lifecycle
+
+    def shutdown(self):
+        self.shutting_down = True
+        with self.workers_lock:
+            workers = list(self.workers.values())
+            self.workers.clear()
+        for w in workers:
+            proc = w.get("proc")
+            if proc is not None:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+        for listener in (self._worker_listener, self._data_listener):
+            try:
+                listener.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.worker_sock)
+        except OSError:
+            pass
+        try:
+            self.store.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        self._peers.close()
+        import shutil
+
+        shutil.rmtree(self.spill_dir, ignore_errors=True)
+        with self._reply_cv:
+            self._reply_cv.notify_all()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="ray-tpu node agent (raylet analog)"
+    )
+    parser.add_argument("--address", required=True, help="head host:port")
+    parser.add_argument("--authkey", default=None, help="cluster authkey hex")
+    parser.add_argument("--resources", default="{}", help="JSON resource dict")
+    parser.add_argument("--labels", default="{}", help="JSON label dict")
+    parser.add_argument("--base-dir", default=None)
+    parser.add_argument("--object-store-memory", type=int, default=1 * 1024**3)
+    parser.add_argument("--data-port", type=int, default=0)
+    parser.add_argument("--node-ip", default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    authkey_hex = args.authkey or os.environ.get("RAY_TPU_AUTHKEY")
+    if not authkey_hex:
+        from ray_tpu._private.protocol import token_to_authkey
+
+        token = os.environ.get("RAY_TPU_CLUSTER_TOKEN")
+        if not token:
+            raise SystemExit(
+                "pass --authkey, RAY_TPU_AUTHKEY, or RAY_TPU_CLUSTER_TOKEN"
+            )
+        authkey_hex = token_to_authkey(token).hex()
+    resources = json.loads(args.resources) or None
+    agent = NodeAgent(
+        args.address,
+        bytes.fromhex(authkey_hex),
+        resources=resources,
+        labels=json.loads(args.labels),
+        base_dir=args.base_dir,
+        object_store_memory=args.object_store_memory,
+        data_port=args.data_port,
+        node_ip=args.node_ip,
+    )
+    agent.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

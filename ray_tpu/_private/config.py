@@ -73,6 +73,13 @@ class Config:
     # analog of the reference's gRPC server, src/ray/rpc/grpc_server.h).
     # None = unix socket only; 0 = ephemeral port; >0 = fixed port.
     tcp_port: Optional[int] = None
+    # Shared cluster secret: when set, the control-plane authkey is derived
+    # from it (sha256) so node agents and drivers on other hosts can join
+    # without reading the head's session file (reference: --redis-password).
+    cluster_token: Optional[str] = None
+    # Node agents silent for longer than this are declared dead and their
+    # nodes removed (reference: gcs_health_check_manager.h failure window).
+    agent_heartbeat_timeout_s: float = 10.0
     # --- fault tolerance ---
     task_max_retries: int = 3
     # Lineage kept for object reconstruction (reference: task_manager.h:177

@@ -64,6 +64,10 @@ class NodeState:
         self.available = dict(resources)
         self.labels = labels or {}
         self.alive = True
+        # Set for REAL remote nodes (agent-backed); None for the head node
+        # and fake test nodes (reference: raylet vs. cluster_utils nodes).
+        self.agent: Optional["AgentHandle"] = None
+        self.last_heartbeat = time.monotonic()
 
     def fits(self, demand: dict[str, float]) -> bool:
         return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
@@ -103,10 +107,76 @@ class WorkerHandle:
         self.is_driver = False  # client drivers are never scheduling targets
         # refs this client driver holds — released if it detaches uncleanly
         self.held_refs: set = set()
+        # set for workers on agent-backed remote nodes
+        self.agent = None
 
     def send(self, msg):
         with self.send_lock:
             self.conn.send(msg)
+
+
+class AgentHandle:
+    """Controller-side handle to a registered node agent (the raylet RPC
+    client analog, ``src/ray/raylet_client/``). All traffic to the agent's
+    host — worker envelopes, spawn/kill requests, frees — rides this one
+    authenticated connection."""
+
+    def __init__(self, node_id: NodeID, conn, arena_name, data_address):
+        self.node_id = node_id
+        self.conn = conn
+        self.arena_name = arena_name
+        self.data_address = data_address
+        self.send_lock = threading.Lock()
+        self.load: dict = {}
+
+    def send(self, msg):
+        with self.send_lock:
+            self.conn.send(msg)
+
+
+class _RelayConn:
+    """Connection facade for a REMOTE worker: sends wrap in a ``ToWorker``
+    envelope on the agent's control connection."""
+
+    def __init__(self, agent: AgentHandle, worker_id: WorkerID):
+        self._agent = agent
+        self._worker_id = worker_id
+
+    def send(self, msg):
+        self._agent.send(P.ToWorker(self._worker_id, msg))
+
+    def close(self):
+        pass
+
+
+class RemoteArenaProxy:
+    """Controller-side stand-in for an agent-owned arena. The agent seals
+    objects locally before forwarding their locations, so ``seal`` is a
+    no-op here; ``delete`` relays the owner-driven free."""
+
+    is_remote = True
+
+    def __init__(self, agent: AgentHandle):
+        self.agent = agent
+        self.arena_name = agent.arena_name
+
+    def seal(self, object_id, shm_name, size):
+        pass
+
+    def delete(self, object_id):
+        try:
+            self.agent.send(P.FreeLocal([object_id]))
+        except (OSError, EOFError):
+            pass
+
+    def used_bytes(self) -> int:
+        return int(self.agent.load.get("arena_used_bytes", 0))
+
+    def num_objects(self) -> int:
+        return 0
+
+    def shutdown(self):
+        pass
 
 
 class PendingTask:
@@ -146,12 +216,39 @@ class PlacementGroupState:
         self.removed = False
 
 
+def _package_path(path: str) -> tuple[str, bytes]:
+    """Zip a file/directory for shipment to an agent host; returns
+    (basename, zip bytes). Arcnames are rooted at the basename so the agent
+    can stage ``<root>/<basename>`` as cwd or an import root."""
+    import zipfile
+    from io import BytesIO
+
+    base = os.path.basename(path.rstrip(os.sep))
+    bio = BytesIO()
+    with zipfile.ZipFile(bio, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isdir(path):
+            for root, _, files in os.walk(path):
+                for f in files:
+                    p = os.path.join(root, f)
+                    zf.write(p, os.path.join(base, os.path.relpath(p, path)))
+        else:
+            zf.write(path, base)
+    return base, bio.getvalue()
+
+
 class Controller:
     def __init__(self, config: Config, head_resources: dict[str, float], mode: str = "process"):
         self.config = config
         self.mode = mode
         self.lock = threading.RLock()
         self.shutting_down = False
+        # A shared cluster token derives a stable authkey so agents/drivers
+        # on other hosts can join without the head's session file.
+        self._authkey = (
+            P.token_to_authkey(config.cluster_token)
+            if config.cluster_token
+            else os.urandom(16)
+        )
 
         # Object plane. Prefer the native (C++) arena store; fall back to the
         # Python per-segment store if the toolchain can't build it.
@@ -252,6 +349,18 @@ class Controller:
         # leftovers release when the completion record is freed.
         self._stream_pins: dict[TaskID, set[int]] = {}
 
+        # Real remote nodes (agent-backed): node_id -> AgentHandle; plus
+        # which objects are resident on each remote arena (the controller
+        # can't enumerate a remote store, so it tracks seals/frees itself).
+        self.agents: dict[NodeID, AgentHandle] = {}
+        self._remote_resident: dict[str, set[ObjectID]] = defaultdict(set)
+        # objects an agent spilled to ITS disk: oid -> AgentHandle (their
+        # "spilled" entries hold agent-local paths the head cannot open)
+        self._agent_spills: dict[ObjectID, AgentHandle] = {}
+        # pooled data-plane connections to agents' chunk listeners
+        self._data_pool = P.ChunkConnPool(self._authkey)
+        self._hb_monitor_started = False
+
         # Internal KV (GCS KV analog).
         self.kv: dict[tuple[str, bytes], bytes] = {}
         # GCS fault-tolerance analog (reference: RedisStoreClient +
@@ -330,7 +439,6 @@ class Controller:
         # Control-plane listener for worker processes.
         self.address = None
         self.listener = None
-        self._authkey = os.urandom(16)
         self._threads: list[threading.Thread] = []
         self.tcp_address = None
         self._tcp_listener = None
@@ -550,6 +658,18 @@ class Controller:
             if node is None or not node.alive:
                 return  # unknown or already being removed
             node.alive = False
+            agent = self.agents.pop(node_id, None)
+        if agent is not None:
+            try:
+                agent.send(P.Shutdown())
+            except (OSError, EOFError):
+                pass
+            try:
+                agent.conn.close()
+            except (OSError, EOFError):
+                pass
+            if agent.data_address:
+                self._data_pool.drop(agent.data_address)
         self.publish("nodes", {"node_id": node_id.hex(), "event": "removed"})
         with self.lock:
             victims = [w for w in self.workers.values() if w.node_id == node_id]
@@ -562,12 +682,17 @@ class Controller:
                 arena = getattr(store, "arena_name", None)
                 if arena is not None:
                     self._stores_by_arena.pop(arena, None)
-                    prefix = f"@{arena}#"
-                    lost = [
-                        oid
-                        for oid, (name, _) in list(self.plasma_resident.items())
-                        if name.startswith(prefix)
-                    ]
+                    if getattr(store, "is_remote", False):
+                        lost = list(self._remote_resident.pop(arena, set()))
+                        for oid in lost:
+                            self._agent_spills.pop(oid, None)
+                    else:
+                        prefix = f"@{arena}#"
+                        lost = [
+                            oid
+                            for oid, (name, _) in list(self.plasma_resident.items())
+                            if name.startswith(prefix)
+                        ]
                     for oid in lost:
                         self.plasma_resident.pop(oid, None)
                         self.memory_store.delete([oid])
@@ -653,11 +778,17 @@ class Controller:
         return self._create_with_spill_retry(self.plasma.create, object_id, size)
 
     def _seal_plasma(self, object_id: ObjectID, name: str, size: int):
-        self._store_for_location(name).seal(object_id, name, size)  # idempotent
+        store = self._store_for_location(name)
+        store.seal(object_id, name, size)  # idempotent
         self.memory_store.put(object_id, ("plasma", (name, size)))
         with self.lock:
-            self.plasma_resident[object_id] = (name, size)
-            self.plasma_resident.move_to_end(object_id)
+            if getattr(store, "is_remote", False):
+                # resident on an agent's arena: the agent owns spilling;
+                # the controller only tracks membership for loss accounting
+                self._remote_resident[store.arena_name].add(object_id)
+            else:
+                self.plasma_resident[object_id] = (name, size)
+                self.plasma_resident.move_to_end(object_id)
 
     def _spill_objects(self, need_bytes: int, store=None) -> bool:
         """Move the coldest plasma-resident objects to disk files until
@@ -739,6 +870,31 @@ class Controller:
             freed += size
         return freed
 
+    # ------------------------------------------- agent data plane (pull side)
+
+    def _pull_chunk_from_agent(
+        self, address: str, object_id: ObjectID, offset: int, length: int
+    ):
+        try:
+            return self._data_pool.pull_chunk(
+                address, object_id.binary(), offset, length
+            )
+        except P.ChunkPullError as e:
+            raise ObjectLostError(f"agent pull failed: {e}") from e
+
+    def _pull_whole_from_agent(
+        self, address: str, object_id: ObjectID, size: int
+    ) -> bytes:
+        try:
+            return self._data_pool.pull_whole(
+                address,
+                object_id.binary(),
+                size,
+                chunk_bytes=self.config.object_transfer_chunk_bytes,
+            )
+        except P.ChunkPullError as e:
+            raise ObjectLostError(f"agent pull failed: {e}") from e
+
     def resolve_object(self, entry, object_id: ObjectID = None) -> SerializedObject:
         from ray_tpu._private.object_store import ObjectRelocatedError
 
@@ -747,9 +903,29 @@ class Controller:
             return payload
         if kind == "spilled":
             path, size = payload
+            agent = self._agent_spills.get(object_id) if object_id else None
+            if agent is not None:
+                return SerializedObject.from_buffer(
+                    self._pull_whole_from_agent(agent.data_address, object_id, size)
+                )
             with open(path, "rb") as f:
                 return SerializedObject.from_buffer(f.read())
         shm_name, size = payload
+        store = self._store_for_location(shm_name)
+        if getattr(store, "is_remote", False):
+            # resident on an agent's host: fetch over its data listener
+            # (always — even same-host in tests — so the cross-host path is
+            # the one that's exercised)
+            if object_id is None:
+                from ray_tpu._private.object_store import parse_arena_location
+
+                loc = parse_arena_location(shm_name)
+                object_id = ObjectID(loc[2]) if loc and loc[2] else None
+            if object_id is None:
+                raise ObjectLostError(f"cannot pull unkeyed location {shm_name}")
+            return SerializedObject.from_buffer(
+                self._pull_whole_from_agent(store.agent.data_address, object_id, size)
+            )
         try:
             return self.plasma_client.read(shm_name, size)
         except ObjectRelocatedError:
@@ -870,14 +1046,29 @@ class Controller:
             self.memory_store.delete([object_id])
             self.plasma_resident.pop(object_id, None)
         if entry is not None and entry[0] == "plasma":
-            self._store_for_location(entry[1][0]).delete(object_id)
+            store = self._store_for_location(entry[1][0])
+            store.delete(object_id)
+            if getattr(store, "is_remote", False):
+                with self.lock:
+                    self._remote_resident[store.arena_name].discard(object_id)
         else:
             self.plasma.delete(object_id)
         if entry is not None and entry[0] == "spilled":
-            try:
-                os.unlink(entry[1][0])
-            except OSError:
-                pass
+            with self.lock:
+                agent = self._agent_spills.pop(object_id, None)
+            if agent is not None:
+                # the spill file lives on the agent's host
+                with self.lock:
+                    self._remote_resident[agent.arena_name].discard(object_id)
+                try:
+                    agent.send(P.FreeLocal([object_id]))
+                except (OSError, EOFError):
+                    pass
+            else:
+                try:
+                    os.unlink(entry[1][0])
+                except OSError:
+                    pass
 
     # ------------------------------------------------------------- submission
 
@@ -1195,6 +1386,9 @@ class Controller:
             handle = self._spawn_worker_thread(node_id)
             handle.fingerprint = self._env_fingerprint(spec_hint)
             return handle
+        node = self.nodes.get(node_id)
+        if node is not None and node.agent is not None:
+            return self._spawn_remote_worker(node.agent, node_id, spec_hint)
         import subprocess
 
         worker_id = WorkerID.from_random()
@@ -1260,6 +1454,43 @@ class Controller:
         handle.fingerprint = self._env_fingerprint(spec_hint)
         with self.lock:
             self.workers[worker_id] = handle
+        return handle
+
+    def _spawn_remote_worker(
+        self, agent: AgentHandle, node_id: NodeID, spec_hint: TaskSpec
+    ) -> WorkerHandle:
+        """Start a worker on an agent's host (the RequestWorkerLease →
+        WorkerPool::StartWorkerProcess path across a real process/host
+        boundary). Runtime-env directories are shipped by value — the agent
+        host shares no filesystem with the driver (reference: working_dir
+        packaging through the GCS KV, _private/runtime_env/packaging.py)."""
+        worker_id = WorkerID.from_random()
+        rt = spec_hint.runtime_env or {}
+        packages: list[tuple] = []
+        working_dir = rt.get("working_dir")
+        if working_dir:
+            name, blob = _package_path(os.path.abspath(os.path.expanduser(working_dir)))
+            packages.append(("working_dir", name, blob))
+        for mod in rt.get("py_modules") or ():
+            name, blob = _package_path(os.path.abspath(os.path.expanduser(str(mod))))
+            packages.append(("py_module", name, blob))
+        env_vars = {k: str(v) for k, v in (rt.get("env_vars") or {}).items()}
+        handle = WorkerHandle(
+            worker_id, node_id, proc=None, conn=_RelayConn(agent, worker_id)
+        )
+        handle.agent = agent
+        handle.fingerprint = self._env_fingerprint(spec_hint)
+        with self.lock:
+            self.workers[worker_id] = handle
+        agent.send(
+            P.SpawnWorker(
+                worker_id,
+                env_vars,
+                bool(spec_hint.resources.get("TPU")),
+                handle.fingerprint,
+                packages,
+            )
+        )
         return handle
 
     def _stage_py_modules(self, py_modules: list) -> list[str]:
@@ -1362,6 +1593,9 @@ class Controller:
             logger.info("client driver %s attached", msg.driver_id.hex()[:8])
             self._worker_reader(handle)
             return
+        if isinstance(msg, P.RegisterAgent):
+            self._register_agent(msg, conn)
+            return
         if not isinstance(msg, P.RegisterWorker):
             conn.close()
             return
@@ -1374,6 +1608,113 @@ class Controller:
             handle.registered.set()
         self._worker_reader(handle)
 
+    # ------------------------------------------------------------ node agents
+
+    def _register_agent(self, msg: P.RegisterAgent, conn):
+        """A REAL node joins (reference: NodeManager registration with the
+        GCS, ``gcs_node_manager``). The agent owns its host's worker pool
+        and arena; the controller records the node, routes spawns through
+        the agent, and reads the node's objects over its data listener."""
+        agent = AgentHandle(msg.node_id, conn, msg.arena_name, msg.data_address)
+        # Ack BEFORE the node becomes schedulable: once the scheduler can
+        # pick this node, a SpawnWorker may be serialized onto the conn, and
+        # the joining agent's blocking recv expects the ack first.
+        try:
+            agent.send(P.AgentAck(msg.node_id.hex()))
+        except (OSError, EOFError):
+            conn.close()
+            return
+        with self.lock:
+            node = NodeState(msg.node_id, msg.resources, msg.labels)
+            node.agent = agent
+            self.nodes[msg.node_id] = node
+            self.agents[msg.node_id] = agent
+            proxy = RemoteArenaProxy(agent)
+            self.node_stores[msg.node_id] = proxy
+            if msg.arena_name:
+                self._stores_by_arena[msg.arena_name] = proxy
+            if not self._hb_monitor_started:
+                self._hb_monitor_started = True
+                t = threading.Thread(
+                    target=self._heartbeat_monitor, daemon=True, name="ctrl-hb"
+                )
+                t.start()
+                self._threads.append(t)
+            self.sched_cv.notify_all()
+        logger.info(
+            "node agent registered: %s host=%s resources=%s",
+            msg.node_id.hex()[:8], msg.hostname, msg.resources,
+        )
+        self.publish(
+            "nodes",
+            {
+                "node_id": msg.node_id.hex(),
+                "event": "added",
+                "resources": dict(msg.resources),
+                "hostname": msg.hostname,
+            },
+        )
+        self._agent_reader(agent)
+
+    def _agent_reader(self, agent: AgentHandle):
+        conn = agent.conn
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            if isinstance(msg, P.FromWorker):
+                with self.lock:
+                    handle = self.workers.get(msg.worker_id)
+                if handle is not None:
+                    self._route_worker_msg(handle, msg.msg)
+            elif isinstance(msg, P.Heartbeat):
+                with self.lock:
+                    node = self.nodes.get(agent.node_id)
+                    if node is not None:
+                        node.last_heartbeat = time.monotonic()
+                agent.load = msg.load
+            elif isinstance(msg, P.WorkerDied):
+                with self.lock:
+                    handle = self.workers.get(msg.worker_id)
+                if handle is not None:
+                    self._on_worker_death(handle, reason=msg.reason)
+            elif isinstance(msg, P.Request):
+                # the agent's own control RPCs. object_owner/pull can block
+                # on a not-yet-sealed entry whose seal arrives on THIS
+                # thread — never handle them inline.
+                if msg.op in ("pull_object_chunk", "pubsub_poll", "object_owner"):
+                    threading.Thread(
+                        target=self._handle_request, args=(agent, msg), daemon=True
+                    ).start()
+                else:
+                    self._handle_request(agent, msg)
+        logger.warning("node agent %s disconnected", agent.node_id.hex()[:8])
+        self.remove_node(agent.node_id)
+
+    def _heartbeat_monitor(self):
+        """Declare agent nodes dead after a silent window (reference:
+        ``gcs_health_check_manager.h``). Connection EOF usually fires first;
+        this catches half-open TCP (host crash, network partition)."""
+        timeout = self.config.agent_heartbeat_timeout_s
+        while not self.shutting_down:
+            time.sleep(min(timeout / 3.0, 2.0))
+            now = time.monotonic()
+            with self.lock:
+                stale = [
+                    nid
+                    for nid, agent in self.agents.items()
+                    if (n := self.nodes.get(nid)) is not None
+                    and n.alive
+                    and now - n.last_heartbeat > timeout
+                ]
+            for nid in stale:
+                logger.warning(
+                    "node %s missed heartbeats for %.0fs: removing",
+                    nid.hex()[:8], timeout,
+                )
+                self.remove_node(nid)
+
     def _worker_reader(self, handle: WorkerHandle):
         conn = handle.conn
         while True:
@@ -1381,38 +1722,7 @@ class Controller:
                 msg = conn.recv()
             except (EOFError, OSError):
                 break
-            if isinstance(msg, P.RegisterWorker):
-                handle.registered.set()
-            elif isinstance(msg, P.TaskDone):
-                self._on_task_done(handle, msg)
-            elif isinstance(msg, P.GetObjects):
-                # Blocking op: dedicated thread so waiters can't starve the
-                # control plane (no bounded pool → no waiter deadlock).
-                threading.Thread(
-                    target=self._handle_get, args=(handle, msg), daemon=True
-                ).start()
-            elif isinstance(msg, P.PutObject):
-                self._handle_put(handle, msg)
-            elif isinstance(msg, P.Request):
-                if handle.is_driver and msg.op == "add_ref":
-                    handle.held_refs.update(msg.payload)
-                if msg.op in ("wait", "pg_ready", "get_entries", "worker_stacks", "pubsub_poll"):
-                    threading.Thread(
-                        target=self._handle_request, args=(handle, msg), daemon=True
-                    ).start()
-                else:
-                    self._handle_request(handle, msg)
-            elif isinstance(msg, P.FreeObjects):
-                for oid in msg.object_ids:
-                    handle.held_refs.discard(oid)
-                    self.remove_ref(oid)
-            elif isinstance(msg, P.StacksReply):
-                waiter = self._stack_waiters.get(msg.req_id)
-                if waiter is not None:
-                    waiter[1].append(msg.text)
-                    waiter[0].set()
-            elif isinstance(msg, P.WorkerError):
-                logger.error("worker %s error: %s", handle.worker_id.hex()[:8], msg.message)
+            self._route_worker_msg(handle, msg)
         if handle.is_driver:
             with self.lock:
                 self.driver_conns.pop(handle.worker_id, None)
@@ -1428,6 +1738,42 @@ class Controller:
             logger.info("client driver %s detached", handle.worker_id.hex()[:8])
             return
         self._on_worker_death(handle, reason="connection closed")
+
+    def _route_worker_msg(self, handle: WorkerHandle, msg):
+        """Dispatch one worker-originated message (shared between direct
+        connections and agent-relayed envelopes)."""
+        if isinstance(msg, P.RegisterWorker):
+            handle.registered.set()
+        elif isinstance(msg, P.TaskDone):
+            self._on_task_done(handle, msg)
+        elif isinstance(msg, P.GetObjects):
+            # Blocking op: dedicated thread so waiters can't starve the
+            # control plane (no bounded pool → no waiter deadlock).
+            threading.Thread(
+                target=self._handle_get, args=(handle, msg), daemon=True
+            ).start()
+        elif isinstance(msg, P.PutObject):
+            self._handle_put(handle, msg)
+        elif isinstance(msg, P.Request):
+            if handle.is_driver and msg.op == "add_ref":
+                handle.held_refs.update(msg.payload)
+            if msg.op in ("wait", "pg_ready", "get_entries", "worker_stacks", "pubsub_poll", "pull_object_chunk"):
+                threading.Thread(
+                    target=self._handle_request, args=(handle, msg), daemon=True
+                ).start()
+            else:
+                self._handle_request(handle, msg)
+        elif isinstance(msg, P.FreeObjects):
+            for oid in msg.object_ids:
+                handle.held_refs.discard(oid)
+                self.remove_ref(oid)
+        elif isinstance(msg, P.StacksReply):
+            waiter = self._stack_waiters.get(msg.req_id)
+            if waiter is not None:
+                waiter[1].append(msg.text)
+                waiter[0].set()
+        elif isinstance(msg, P.WorkerError):
+            logger.error("worker %s error: %s", handle.worker_id.hex()[:8], msg.message)
 
     def _handle_get(self, handle: WorkerHandle, msg: P.GetObjects):
         self._maybe_recover(msg.object_ids)
@@ -1661,6 +2007,12 @@ class Controller:
             kind, p = entry
             if kind == "spilled":
                 path, size = p
+                agent = self._agent_spills.get(object_id)
+                if agent is not None:
+                    # spilled onto an AGENT's disk: its data listener serves
+                    return self._pull_chunk_from_agent(
+                        agent.data_address, object_id, offset, length
+                    )
                 with open(path, "rb") as f:
                     f.seek(offset)
                     return (size, f.read(length))
@@ -1677,6 +2029,13 @@ class Controller:
                     sobj = self.plasma_client.read(name, size)
                     return (size, sobj.to_bytes()[offset : offset + length])
                 store = self._store_for_location(name)
+                if getattr(store, "is_remote", False):
+                    # resident on an agent: relay the chunk read to the
+                    # owner's data listener (client drivers and head-local
+                    # workers pull through here)
+                    return self._pull_chunk_from_agent(
+                        store.agent.data_address, object_id, offset, length
+                    )
                 chunk = bytes(
                     store.arena.view(loc[1] + offset, min(length, size - offset))
                 )
@@ -1688,6 +2047,38 @@ class Controller:
             # inline/error entries are small: serve from their bytes
             data = p.to_bytes()
             return (len(data), data[offset : offset + length])
+        if op == "object_owner":
+            # Which agent (if any) serves this object's chunks directly —
+            # agents use it for peer-to-peer pulls that bypass the head
+            # (reference: OwnershipObjectDirectory location lookup).
+            entry = self.memory_store.get([payload], timeout=10)[0]
+            if entry is None:
+                return None
+            if entry[0] == "spilled":
+                agent = self._agent_spills.get(payload)
+                return agent.data_address if agent is not None else None
+            if entry[0] != "plasma":
+                return None
+            store = self._store_for_location(entry[1][0])
+            if getattr(store, "is_remote", False):
+                return store.agent.data_address
+            return None
+        if op == "report_agent_spill":
+            # An agent moved a resident object to ITS disk; the entry now
+            # points at an agent-local spill path (same-host workers open it
+            # directly; everyone else pulls chunks from the agent). Commit
+            # atomically vs _free_object: if the last ref dropped while the
+            # agent was spilling, the put would resurrect a freed object —
+            # tell the agent to discard the spill file instead.
+            object_id, path, size = payload
+            if not isinstance(caller, AgentHandle):
+                raise ValueError("report_agent_spill requires an agent caller")
+            with self.lock:
+                if object_id not in self._remote_resident.get(caller.arena_name, ()):
+                    return "freed"
+                self._agent_spills[object_id] = caller
+                self.memory_store.put(object_id, ("spilled", (path, size)))
+            return None
         if op == "kill_actor":
             actor_id, no_restart = payload
             self.kill_actor(actor_id, no_restart)
@@ -2179,6 +2570,11 @@ class Controller:
             # Process-mode: terminate outright (SIGKILL analog of ray.kill).
             if worker.proc is not None:
                 worker.proc.terminate()
+            elif worker.agent is not None:
+                try:
+                    worker.agent.send(P.KillWorker(worker.worker_id))
+                except (OSError, EOFError):
+                    pass
         with self.lock:
             if no_restart:
                 actor = self.actors.get(actor_id)
@@ -2335,7 +2731,19 @@ class Controller:
             self.shutting_down = True
             workers = list(self.workers.values())
             drivers = list(self.driver_conns.values())
+            agents = list(self.agents.values())
+            self.agents.clear()
             self.sched_cv.notify_all()
+        for a in agents:
+            try:
+                a.send(P.Shutdown())
+            except (OSError, EOFError):
+                pass
+            try:
+                a.conn.close()
+            except (OSError, EOFError):
+                pass
+        self._data_pool.close()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
         self.flush_kv_now()

@@ -17,6 +17,109 @@ from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.task_spec import TaskSpec
 
 
+class ChunkPullError(RuntimeError):
+    """The owner reported it cannot serve the object (not resident)."""
+
+
+class ChunkConnPool:
+    """Pooled, authenticated connections to chunk listeners (agents' data
+    plane). One connection per peer address, serialized per-connection; a
+    transport error drops the pooled conn and retries on a fresh one
+    (per-chunk retry, matching the worker-side pull loop)."""
+
+    def __init__(self, authkey: bytes):
+        import threading
+
+        self._authkey = authkey
+        # address -> [conn_or_None, per_address_lock]; connects happen under
+        # the PER-ADDRESS lock only, so one unreachable peer (SYN-retry
+        # stall) cannot block pulls to healthy peers
+        self._conns: dict[str, list] = {}
+        self._lock = threading.Lock()
+
+    def _entry(self, address: str) -> list:
+        import threading
+
+        with self._lock:
+            entry = self._conns.get(address)
+            if entry is None:
+                entry = [None, threading.Lock()]
+                self._conns[address] = entry
+            return entry
+
+    def drop(self, address: str):
+        with self._lock:
+            entry = self._conns.pop(address, None)
+        if entry is not None and entry[0] is not None:
+            try:
+                entry[0].close()
+            except OSError:
+                pass
+
+    def pull_chunk(
+        self, address: str, oid_bytes: bytes, offset: int, length: int,
+        retries: int = 3,
+    ):
+        """Returns (total_size, chunk_bytes). Raises ChunkPullError when the
+        owner does not have the object; OSError after transport retries."""
+        import time as _time
+        from multiprocessing.connection import Client
+
+        last_err: Optional[BaseException] = None
+        for attempt in range(retries):
+            entry = self._entry(address)
+            try:
+                with entry[1]:
+                    if entry[0] is None:
+                        host, _, port = address.rpartition(":")
+                        entry[0] = Client((host, int(port)), authkey=self._authkey)
+                    conn = entry[0]
+                    conn.send(("chunk", oid_bytes, offset, length))
+                    result = conn.recv()
+            except (OSError, EOFError, ConnectionError) as e:
+                self.drop(address)
+                last_err = e
+                _time.sleep(0.05 * (attempt + 1))
+                continue
+            if isinstance(result, tuple) and result and result[0] == "error":
+                raise ChunkPullError(result[1])
+            return result
+        raise last_err  # type: ignore[misc]
+
+    def pull_whole(
+        self, address: str, oid_bytes: bytes, size: int,
+        chunk_bytes: int = 8 * 1024**2,
+    ) -> bytes:
+        buf = bytearray()
+        offset = 0
+        while offset < size:
+            _, chunk = self.pull_chunk(
+                address, oid_bytes, offset, min(chunk_bytes, size - offset)
+            )
+            if not chunk:
+                raise ChunkPullError(f"empty chunk at {offset}/{size}")
+            buf.extend(chunk)
+            offset += len(chunk)
+        return bytes(buf)
+
+    def close(self):
+        with self._lock:
+            for entry in self._conns.values():
+                if entry[0] is not None:
+                    try:
+                        entry[0].close()
+                    except OSError:
+                        pass
+            self._conns.clear()
+
+
+def token_to_authkey(token: str) -> bytes:
+    """Derive the control-plane authkey from a shared cluster token."""
+    import hashlib
+
+    return hashlib.sha256(b"rtpu-cluster:" + token.encode()).digest()[:16]
+
+
 def routable_host() -> str:
     """Best-effort externally-routable IP of this host. The UDP-connect
     trick sends no packets; the kernel just resolves the egress interface."""
@@ -153,3 +256,94 @@ class KillActor:
 @dataclasses.dataclass
 class Shutdown:
     pass
+
+
+# ---- node agent <-> controller (real multi-host worker plane; reference:
+# the raylet's NodeManager gRPC surface, src/ray/raylet/node_manager.h:124,
+# and `ray start --address=<head>`, python/ray/scripts/scripts.py:226) ----
+
+@dataclasses.dataclass
+class RegisterAgent:
+    """Agent → controller: a REAL node joining the cluster. The agent owns
+    its host's worker pool and plasma arena; objects it seals are served to
+    peers over its ``data_address`` chunk listener (reference:
+    ObjectManager, object_manager.h:119)."""
+
+    node_id: Any  # NodeID
+    resources: dict
+    labels: dict
+    arena_name: Optional[str]
+    data_address: Optional[str]  # "host:port" peers pull chunks from
+    pid: int = 0
+    hostname: str = ""
+
+
+@dataclasses.dataclass
+class AgentAck:
+    """Controller → agent: registration accepted."""
+
+    node_id_hex: str
+    head_data_address: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SpawnWorker:
+    """Controller → agent: start one worker process on the agent's host
+    (remote half of WorkerPool::StartWorkerProcess, worker_pool.h:283)."""
+
+    worker_id: WorkerID
+    env_vars: dict
+    needs_tpu: bool
+    fingerprint: tuple
+    # runtime-env payloads shipped by value: [(kind, name, zip_bytes)] where
+    # kind in {"working_dir", "py_module"} (reference: working_dir packaging
+    # via GCS KV upload, _private/runtime_env/packaging.py)
+    packages: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class KillWorker:
+    """Controller → agent: hard-kill a worker process (ray.kill path)."""
+
+    worker_id: WorkerID
+
+
+@dataclasses.dataclass
+class ToWorker:
+    """Controller → agent envelope: deliver ``msg`` to a local worker."""
+
+    worker_id: WorkerID
+    msg: Any
+
+
+@dataclasses.dataclass
+class FromWorker:
+    """Agent → controller envelope: ``msg`` originated from a local worker."""
+
+    worker_id: WorkerID
+    msg: Any
+
+
+@dataclasses.dataclass
+class WorkerDied:
+    """Agent → controller: a local worker's connection/process died."""
+
+    worker_id: WorkerID
+    reason: str
+
+
+@dataclasses.dataclass
+class Heartbeat:
+    """Agent → controller: periodic liveness + load (reference: the GCS
+    health-check service, gcs_health_check_manager.h)."""
+
+    node_id: Any  # NodeID
+    load: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class FreeLocal:
+    """Controller → agent: drop these objects from the agent's arena (the
+    owner-driven free path of the distributed ref counter)."""
+
+    object_ids: list

@@ -472,9 +472,13 @@ class WorkerRuntime:
         """
         from ray_tpu._private.worker import _marker_state
 
+        # spec.args keeps the ("ref", oid) entries in marker order, so each
+        # resolved payload can carry its object id — required for the pull
+        # fallback when this worker is on another host than the payload.
+        ref_ids = [a[1] for a in spec.args if a[0] == "ref"]
         ref_values = []
-        for kind, payload in resolved_args[1:]:
-            sobj = self._materialize(kind, payload)
+        for (kind, payload), oid in zip(resolved_args[1:], ref_ids):
+            sobj = self._materialize(kind, payload, object_id=oid)
             value = self.serialization.deserialize(sobj)
             if kind == "error":
                 if isinstance(value, TaskError):

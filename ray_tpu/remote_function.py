@@ -25,6 +25,17 @@ def _resources_from_options(options: dict) -> dict[str, float]:
 def _strategy_from_options(options: dict) -> SchedulingStrategy:
     strat = options.get("scheduling_strategy")
     if strat is None:
+        # legacy kwargs API (reference: options(placement_group=...,
+        # placement_group_bundle_index=...), remote_function.py:314)
+        pg = options.get("placement_group")
+        if pg is not None:
+            from ray_tpu.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy,
+            )
+
+            return PlacementGroupSchedulingStrategy(
+                pg, options.get("placement_group_bundle_index", -1)
+            ).to_spec()
         return SchedulingStrategy()
     if isinstance(strat, str):
         return SchedulingStrategy(kind=strat.lower())

@@ -67,6 +67,72 @@ def cmd_timeline(args):
     print(f"wrote {len(trace)} trace events to {args.output}")
 
 
+def cmd_start(args):
+    """``ray-tpu start``: run a head controller or join as a node agent
+    (reference: ``ray start`` / ``ray start --address=<head>``,
+    ``python/ray/scripts/scripts.py:226``)."""
+    import time
+
+    if args.head:
+        import ray_tpu
+
+        config = {"tcp_port": args.port}
+        if args.token:
+            config["cluster_token"] = args.token
+        if args.gcs_snapshot:
+            config["gcs_snapshot_path"] = args.gcs_snapshot
+        resources = json.loads(args.resources) if args.resources else None
+        ray_tpu.init(
+            num_cpus=args.num_cpus,
+            resources=resources,
+            mode="process",
+            config=config,
+        )
+        from ray_tpu._private.worker import global_worker
+
+        controller = global_worker().controller
+        print(f"head started: tcp={controller.tcp_address}")
+        if not args.token:
+            print(f"authkey={controller._authkey.hex()}")
+        print(
+            "join with: ray-tpu start --address "
+            f"{controller.tcp_address}"
+            + (f" --token <token>" if args.token else " --authkey <authkey>")
+        )
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            ray_tpu.shutdown()
+        return
+    if not args.address:
+        print("error: pass --head or --address <head-host:port>", file=sys.stderr)
+        sys.exit(2)
+    from ray_tpu._private.agent import NodeAgent
+    from ray_tpu._private.protocol import token_to_authkey
+
+    if args.token:
+        authkey = token_to_authkey(args.token)
+    elif args.authkey:
+        authkey = bytes.fromhex(args.authkey)
+    else:
+        print("error: pass --token or --authkey", file=sys.stderr)
+        sys.exit(2)
+    resources = json.loads(args.resources) if args.resources else None
+    if resources is None and args.num_cpus is not None:
+        resources = {"CPU": float(args.num_cpus)}
+    agent = NodeAgent(
+        args.address,
+        authkey,
+        resources=resources,
+        base_dir=args.base_dir,
+        object_store_memory=args.object_store_memory,
+        node_ip=args.node_ip,
+    )
+    print(f"agent started: node={agent.node_id.hex()[:12]} data={agent.data_address}")
+    agent.serve_forever()
+
+
 def cmd_job(args):
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -104,6 +170,20 @@ def cmd_job(args):
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("start", help="start a head node or join as a node agent")
+    s.add_argument("--head", action="store_true", help="start the head controller")
+    s.add_argument("--address", default=None, help="head host:port to join")
+    s.add_argument("--port", type=int, default=0, help="head TCP port (0=ephemeral)")
+    s.add_argument("--token", default=None, help="shared cluster token")
+    s.add_argument("--authkey", default=None, help="cluster authkey hex (agents)")
+    s.add_argument("--num-cpus", type=int, default=None)
+    s.add_argument("--resources", default=None, help="JSON resource dict")
+    s.add_argument("--base-dir", default=None, help="agent working directory")
+    s.add_argument("--object-store-memory", type=int, default=1 * 1024**3)
+    s.add_argument("--node-ip", default=None)
+    s.add_argument("--gcs-snapshot", default=None, help="head state snapshot path")
+    s.set_defaults(fn=cmd_start)
 
     s = sub.add_parser("status", help="cluster resources + nodes")
     s.add_argument("--num-cpus", type=int, default=4)
