@@ -540,6 +540,11 @@ struct Chan {
   std::atomic<uint64_t> write_seq;  // items committed by the writer
   std::atomic<uint64_t> read_seq;   // items released by the reader
   std::atomic<uint32_t> closed;
+  // peers between a begin (slot offset handed out) and its commit/done:
+  // ch_destroy must not free the block while a peer may still copy
+  // into/out of it (the lease is taken under the store lock, so destroy's
+  // free-when-zero check under the same lock cannot race it)
+  std::atomic<uint32_t> inflight;
   uint32_t num_slots;
   uint64_t slot_size;  // payload bytes per slot (8-byte size header extra)
   // followed by num_slots * (uint64_t size + uint8_t payload[slot_size])
@@ -561,6 +566,25 @@ Entry* chan_entry(Store* s, const uint8_t* id) {
   Entry* e = find_entry(s, id, false);
   if (!e || e->state != kSealed) return nullptr;
   return e;
+}
+
+// Take an inflight lease under the store lock (entry verified live).
+// Returns the entry, or nullptr (missing) / (Entry*)-1 (closed, and the
+// caller does not drain closed channels). Readers pass allow_closed=true:
+// a closed channel stays readable until drained.
+Entry* chan_acquire(Store* s, const uint8_t* id, bool allow_closed) {
+  Guard g(&s->hdr->lock);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state != kSealed) return nullptr;
+  Chan* c = reinterpret_cast<Chan*>(s->base + e->offset);
+  if (!allow_closed && c->closed.load(std::memory_order_acquire))
+    return reinterpret_cast<Entry*>(-1);
+  c->inflight.fetch_add(1, std::memory_order_acq_rel);
+  return e;
+}
+
+void chan_release(Chan* c) {
+  c->inflight.fetch_sub(1, std::memory_order_release);
 }
 
 void chan_pause() {
@@ -602,6 +626,7 @@ int ch_create(int handle, const uint8_t* id, uint64_t slot_size,
   c->write_seq.store(0, std::memory_order_relaxed);
   c->read_seq.store(0, std::memory_order_relaxed);
   c->closed.store(0, std::memory_order_relaxed);
+  c->inflight.store(0, std::memory_order_relaxed);
   c->num_slots = num_slots;
   c->slot_size = slot_size;
   return 0;
@@ -614,22 +639,35 @@ int ch_write_begin(int handle, const uint8_t* id, uint64_t size,
                    uint64_t* out_off, int timeout_ms) {
   Store* s = get_store(handle);
   if (!s) return -3;
-  Entry* e = chan_entry(s, id);
+  Entry* e = chan_acquire(s, id, /*allow_closed=*/false);
   if (!e) return -1;
+  if (e == reinterpret_cast<Entry*>(-1)) return -5;
   Chan* c = chan_at(s, e);
-  if (size > c->slot_size) return -7;
+  if (size > c->slot_size) {
+    chan_release(c);
+    return -7;
+  }
+  // The inflight lease is HELD on success (released by ch_write_commit):
+  // the caller is about to memcpy into the slot, and ch_destroy must not
+  // free the block underneath that copy.
   // wall-clock deadline: nanosleep(5us) really costs ~50us+ with default
   // timer slack, so counting iterations would overshoot timeouts ~10x
   int64_t deadline = timeout_ms >= 0 ? mono_us() + (int64_t)timeout_ms * 1000 : 0;
   for (;;) {
-    if (c->closed.load(std::memory_order_acquire)) return -5;
+    if (c->closed.load(std::memory_order_acquire)) {
+      chan_release(c);
+      return -5;
+    }
     uint64_t w = c->write_seq.load(std::memory_order_relaxed);
     uint64_t r = c->read_seq.load(std::memory_order_acquire);
     if (w - r < c->num_slots) {
       *out_off = chan_slot_off(e, c, w) + kChanSlotHdr;
-      return 0;
+      return 0;  // lease held
     }
-    if (timeout_ms >= 0 && mono_us() >= deadline) return -6;
+    if (timeout_ms >= 0 && mono_us() >= deadline) {
+      chan_release(c);
+      return -6;
+    }
     chan_pause();
   }
 }
@@ -644,6 +682,7 @@ int ch_write_commit(int handle, const uint8_t* id, uint64_t size) {
   uint64_t slot_off = chan_slot_off(e, c, w);
   *reinterpret_cast<uint64_t*>(s->base + slot_off) = size;
   c->write_seq.store(w + 1, std::memory_order_release);
+  chan_release(c);  // pairs with ch_write_begin's lease
   return 0;
 }
 
@@ -653,7 +692,8 @@ int ch_read_begin(int handle, const uint8_t* id, uint64_t* out_off,
                   uint64_t* out_size, int timeout_ms) {
   Store* s = get_store(handle);
   if (!s) return -3;
-  Entry* e = chan_entry(s, id);
+  // closed channels stay readable until drained
+  Entry* e = chan_acquire(s, id, /*allow_closed=*/true);
   if (!e) return -1;
   Chan* c = chan_at(s, e);
   int64_t deadline = timeout_ms >= 0 ? mono_us() + (int64_t)timeout_ms * 1000 : 0;
@@ -664,10 +704,16 @@ int ch_read_begin(int handle, const uint8_t* id, uint64_t* out_off,
       uint64_t slot_off = chan_slot_off(e, c, r);
       *out_size = *reinterpret_cast<uint64_t*>(s->base + slot_off);
       *out_off = slot_off + kChanSlotHdr;
-      return 0;
+      return 0;  // lease held until ch_read_done
     }
-    if (c->closed.load(std::memory_order_acquire)) return -5;
-    if (timeout_ms >= 0 && mono_us() >= deadline) return -6;
+    if (c->closed.load(std::memory_order_acquire)) {
+      chan_release(c);
+      return -5;
+    }
+    if (timeout_ms >= 0 && mono_us() >= deadline) {
+      chan_release(c);
+      return -6;
+    }
     chan_pause();
   }
 }
@@ -679,29 +725,51 @@ int ch_read_done(int handle, const uint8_t* id) {
   if (!e) return -1;
   Chan* c = chan_at(s, e);
   c->read_seq.fetch_add(1, std::memory_order_release);
+  chan_release(c);  // pairs with ch_read_begin's lease
   return 0;
 }
 
 int ch_close(int handle, const uint8_t* id) {
   Store* s = get_store(handle);
   if (!s) return -3;
-  Entry* e = chan_entry(s, id);
-  if (!e) return -1;
+  Guard g(&s->hdr->lock);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state != kSealed) return -1;
   chan_at(s, e)->closed.store(1, std::memory_order_release);
   return 0;
 }
 
 int ch_destroy(int handle, const uint8_t* id) {
+  // Deferred free: a peer between a begin (slot offset in hand) and its
+  // commit/done may still be copying into/out of the block, and freeing it
+  // would let the arena recycle memory a live memcpy scribbles over. Close
+  // the channel, then free only once the inflight leases quiesce — checked
+  // UNDER the store lock, where leases are taken. If a peer crashed
+  // mid-copy (lease never released), leak the block instead: a bounded
+  // waste, never a corruption.
   Store* s = get_store(handle);
   if (!s) return -3;
-  {
-    Entry* e = chan_entry(s, id);
-    if (!e) return -1;
-    chan_at(s, e)->closed.store(1, std::memory_order_release);
-    Guard g(&s->hdr->lock);
-    e->pins = 0;
+  int64_t deadline = mono_us() + 2 * 1000 * 1000;  // 2s quiesce window
+  for (;;) {
+    {
+      Guard g(&s->hdr->lock);
+      Entry* e = find_entry(s, id, false);
+      if (!e || e->state != kSealed) return -1;
+      Chan* c = chan_at(s, e);
+      c->closed.store(1, std::memory_order_release);
+      if (c->inflight.load(std::memory_order_acquire) == 0) {
+        e->pins = 0;
+        uint64_t block_off = e->offset - sizeof(Block);
+        e->state = kTombstone;
+        s->hdr->num_objects--;
+        free_block(s, block_off);
+        decay_tombstones(s, e);
+        return 0;
+      }
+    }
+    if (mono_us() >= deadline) return 0;  // leak, don't corrupt
+    chan_pause();
   }
-  return ps_delete(handle, id);
 }
 
 }  // extern "C"

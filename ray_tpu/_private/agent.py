@@ -204,7 +204,8 @@ class NodeAgent:
         if isinstance(msg, P.ToWorker):
             with self.workers_lock:
                 w = self.workers.get(msg.worker_id)
-            if w is not None:
+            # conn is None until the worker process handshakes
+            if w is not None and w.get("conn") is not None:
                 try:
                     with w["lock"]:
                         w["conn"].send(msg.msg)

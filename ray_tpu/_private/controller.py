@@ -1469,11 +1469,11 @@ class Controller:
         packages: list[tuple] = []
         working_dir = rt.get("working_dir")
         if working_dir:
-            name, blob = _package_path(os.path.abspath(os.path.expanduser(working_dir)))
-            packages.append(("working_dir", name, blob))
+            path = os.path.abspath(os.path.expanduser(working_dir))
+            packages.append(("working_dir", *self._package_cached(path)))
         for mod in rt.get("py_modules") or ():
-            name, blob = _package_path(os.path.abspath(os.path.expanduser(str(mod))))
-            packages.append(("py_module", name, blob))
+            path = os.path.abspath(os.path.expanduser(str(mod)))
+            packages.append(("py_module", *self._package_cached(path)))
         env_vars = {k: str(v) for k, v in (rt.get("env_vars") or {}).items()}
         handle = WorkerHandle(
             worker_id, node_id, proc=None, conn=_RelayConn(agent, worker_id)
@@ -1492,6 +1492,26 @@ class Controller:
             )
         )
         return handle
+
+    def _package_cached(self, path: str) -> tuple[str, bytes]:
+        """Zip a runtime-env path for shipment, cached by content
+        fingerprint — respawns must not re-walk + re-compress the tree
+        (mirrors _stage_py_modules' content-addressed staging)."""
+        tag = self._tree_fingerprint(path)
+        with self.lock:
+            cache = getattr(self, "_pkg_cache", None)
+            if cache is None:
+                cache = self._pkg_cache = {}
+            hit = cache.get((path, tag))
+            if hit is not None:
+                return hit
+        result = _package_path(path)
+        with self.lock:
+            cache[(path, tag)] = result
+            # bound memory: keep only the most recent handful of packages
+            while len(cache) > 8:
+                cache.pop(next(iter(cache)))
+        return result
 
     def _stage_py_modules(self, py_modules: list) -> list[str]:
         """Copy each module dir/file into the session's runtime-env staging
@@ -1847,7 +1867,11 @@ class Controller:
             # already add_ref'd it — FIFO on the channel guarantees order)
             task_id, count = payload
             with self.lock:
-                if count > self._stream_consumed.get(task_id, 0):
+                # -1 (consumer abandoned the stream) is STICKY: a progress
+                # report processed after the abandon marker must not revive
+                # a dead-stream producer's poll loop
+                current = self._stream_consumed.get(task_id, 0)
+                if current >= 0 and count > current:
                     self._stream_consumed[task_id] = count
                 if len(self._stream_consumed) > 4096:
                     # evict only finished streams: dropping a live counter
@@ -1865,6 +1889,17 @@ class Controller:
                         self.remove_ref(ObjectID.for_return(task_id, idx))
                     if not pins:
                         self._stream_pins.pop(task_id, None)
+            return None
+        if op == "stream_abandoned":
+            # Explicit consumer-gone: the serve handle's finalize watcher
+            # reports an abandoned stream directly instead of relying on the
+            # completion refcount reaching zero (a stray interpreter-held
+            # ObjectRef instance must not keep a dead stream's producer
+            # polling). Force-drops the completion record; _free_object's
+            # stream branch releases producer pins and sets the sticky -1.
+            with self.lock:
+                self.ref_counts.pop(payload, None)
+                self._free_object(payload)
             return None
         if op == "stream_consumed_get":
             with self.lock:

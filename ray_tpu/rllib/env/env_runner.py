@@ -269,7 +269,15 @@ class EnvRunnerGroup:
 
     def replace_runner(self, index: int):
         """Respawn a dead runner in place; returns the new handle (used by
-        async consumers like IMPALA that manage their own in-flight refs)."""
+        async consumers like IMPALA that manage their own in-flight refs).
+        The old actor is killed best-effort first: callers replace on ANY
+        sampling error, and an application-level error would otherwise leak
+        a live runner actor plus its CPU reservation."""
+        old = self._remote[index]
+        try:
+            ray_tpu.kill(old, no_restart=True)
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
         self._remote[index] = self._spawn(index)
         return self._remote[index]
 

@@ -147,6 +147,11 @@ class _MethodCaller:
         return self._handle._call(self._method, args, kwargs)
 
 
+def _rebuild_handle(deployment_name: str, stream: bool) -> "DeploymentHandle":
+    h = DeploymentHandle(deployment_name)
+    return h.options(stream=True) if stream else h
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str):
         self.deployment_name = deployment_name
@@ -157,6 +162,10 @@ class DeploymentHandle:
         self._done_queue: "queue.Queue" = queue.Queue()
         self._drainer: Optional[threading.Thread] = None
         self._applied_version = -(1 << 62)  # any real version exceeds this
+        # completion-record ids of streams whose consumer generator was GC'd
+        # mid-stream (abandoned HTTP client): id -> mark time. The drainer
+        # drops its pin on these so the producer's consumer-gone signal fires.
+        self._abandoned: dict = {}
 
     # -- replica cache ------------------------------------------------------
 
@@ -272,6 +281,33 @@ class DeploymentHandle:
                 pass
             if not pending:
                 continue
+            # consumer-abandoned streams: drop our completion pin so the
+            # controller-side refcount reaches zero and the -1 marker stops
+            # the producer; the replica thread and stream items then free
+            with self._lock:
+                if self._abandoned:
+                    import time as _time
+
+                    for ref in list(pending):
+                        if ref.id() in self._abandoned:
+                            name = pending.pop(ref)
+                            self._abandoned.pop(ref.id(), None)
+                            self._inflight[name] = max(
+                                0, self._inflight.get(name, 1) - 1
+                            )
+                    # drop the loop binding NOW: the upcoming `continue`
+                    # paths would otherwise keep the popped ObjectRef alive
+                    # in this long-lived frame, pinning its refcount
+                    ref = name = None
+                    # evict stale marks (streams that drained normally
+                    # before their generator was collected)
+                    cutoff = _time.monotonic() - 60.0
+                    for k in [
+                        k for k, t in self._abandoned.items() if t < cutoff
+                    ]:
+                        del self._abandoned[k]
+            if not pending:
+                continue
             try:
                 ready, _ = ray_tpu.wait(
                     list(pending), num_returns=1, timeout=0.5
@@ -282,6 +318,10 @@ class DeploymentHandle:
                 name = pending.pop(ref)
                 with self._lock:
                     self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
+            # this frame is long-lived: loop variables would otherwise keep
+            # the LAST popped completion ObjectRef alive indefinitely,
+            # pinning a freed/abandoned stream's refcount above zero
+            ref = name = ready = None
 
     def _call_streaming(self, method: str, args: tuple, kwargs: dict):
         """Streaming call (reference: ``handle.options(stream=True)``): the
@@ -324,7 +364,43 @@ class DeploymentHandle:
                     name=f"handle-drain-{self.deployment_name}",
                 )
                 self._drainer.start()
-        return DeploymentResponseGenerator(ref_gen)
+        gen = DeploymentResponseGenerator(ref_gen)
+        self._watch_abandon(gen, ref_gen.completed().id())
+        return gen
+
+    def _watch_abandon(self, gen, completion_id):
+        """Mark the stream abandoned if its consumer generator is collected
+        before the stream finished (HTTP client disconnect): the drainer
+        holds the last completion-record pin, and without dropping it the
+        backpressured producer would poll a dead stream forever."""
+        import time as _time
+        import weakref
+
+        state = gen._done_state
+        abandoned = self._abandoned
+        lock = self._lock
+
+        def _notify_controller():
+            try:
+                from ray_tpu._private.worker import global_worker
+
+                global_worker().controller_call("stream_abandoned", completion_id)
+            except Exception:  # noqa: BLE001 — cluster may be shutting down
+                pass
+
+        def _mark_and_notify():
+            with lock:
+                abandoned[completion_id] = _time.monotonic()
+            _notify_controller()
+
+        def _on_gc():
+            # runs on whatever thread triggered GC — possibly one already
+            # holding self._lock (non-reentrant), so NO locking here; the
+            # spawned thread takes the lock and signals the controller
+            if not state["done"]:
+                threading.Thread(target=_mark_and_notify, daemon=True).start()
+
+        weakref.finalize(gen, _on_gc)
 
     def broadcast(self, method: str, *args, timeout_s: float = 120.0, **kwargs):
         """Call ``method`` on EVERY replica and return all results — for
@@ -369,13 +445,16 @@ class DeploymentHandle:
                 h._lock = self._lock
                 h._inflight = self._inflight
                 h._done_queue = self._done_queue
+                h._abandoned = self._abandoned
                 h._variant = self
                 self._variant = h
                 cached = h
         return cached
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name,))
+        # the stream flag must survive pickling (a handle.options(stream=
+        # True) passed into another deployment stays a streaming handle)
+        return (_rebuild_handle, (self.deployment_name, getattr(self, "_stream", False)))
 
     def __repr__(self):
         return f"DeploymentHandle({self.deployment_name!r})"

@@ -37,6 +37,11 @@ class DeploymentResponseGenerator:
         # than yielded: handle-level consumers see only user chunks; the
         # proxy reads .stream_start to pick content type
         self.stream_start: Optional[StreamStart] = None
+        # Shared with the handle's abandon watcher (weakref.finalize): when
+        # this generator is GC'd with done=False, the consumer walked away
+        # mid-stream and the drainer must drop its completion pin so the
+        # backpressured producer sees the consumer-gone (-1) marker.
+        self._done_state = {"done": False}
 
     def __iter__(self) -> "DeploymentResponseGenerator":
         return self
@@ -50,11 +55,18 @@ class DeploymentResponseGenerator:
         while True:
             ref = self._ref_gen._next_ref(timeout_s)
             if ref is None:
+                self._done_state["done"] = True
                 if self._on_done is not None:
                     self._on_done()
                     self._on_done = None
                 raise StopIteration
-            value = ray_tpu.get(ref)
+            try:
+                value = ray_tpu.get(ref)
+            except Exception:
+                # producer error ends the stream: completion seals normally,
+                # so the drainer pops it — not an abandonment
+                self._done_state["done"] = True
+                raise
             if isinstance(value, StreamStart):
                 self.stream_start = value
                 continue
