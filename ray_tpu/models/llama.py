@@ -61,6 +61,18 @@ class LlamaConfig:
     # — trades a little activation memory for ~25% fewer backward FLOPs
     remat_policy: str = "full"
     tie_embeddings: bool = False
+    # --- mixture of experts (expert parallelism over the ep mesh axis) ---
+    # 0 = dense FFN; >0 replaces every layer's FFN with a top-k routed
+    # expert bank (ray_tpu.parallel.moe — all_to_all dispatch over ICI).
+    # Reference delegates EP to vLLM engine kwargs (SURVEY §2.4); native here.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # --- pipeline parallelism (pp mesh axis) ---
+    # microbatch count for the GPipe schedule when the mesh has pp>1;
+    # 0 = default 2*pp. Layers split into pp equal stages.
+    pp_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -69,11 +81,15 @@ class LlamaConfig:
     def num_params(self) -> int:
         e, f, v = self.d_model, self.d_ff, self.vocab_size
         h, kv, hd = self.n_heads, self.n_kv_heads, self.head_dim
+        if self.moe_experts:
+            ffn = e * self.moe_experts + self.moe_experts * 3 * e * f
+        else:
+            ffn = 3 * e * f  # w1, w3 (gate/up) + w2 (down)
         per_layer = (
             e * h * hd  # wq
             + 2 * e * kv * hd  # wk, wv
             + h * hd * e  # wo
-            + 3 * e * f  # w1, w3 (gate/up) + w2 (down)
+            + ffn
             + 2 * e  # norms
         )
         out_head = 0 if self.tie_embeddings else v * e
@@ -156,6 +172,11 @@ _PARAM_DIMS = {
     "w_down": (None, "mlp", "embed"),
     "attn_norm": (None, "norm"),
     "mlp_norm": (None, "norm"),
+    # MoE variant: per-layer expert banks (expert dim -> ep mesh axis)
+    "moe_router": (None, "embed", None),
+    "moe_w_gate": (None, "expert", "embed", "mlp"),
+    "moe_w_up": (None, "expert", "embed", "mlp"),
+    "moe_w_down": (None, "expert", "mlp", "embed"),
 }
 
 
@@ -184,15 +205,33 @@ def _param_shapes(cfg: LlamaConfig) -> dict[str, tuple]:
         "wk": (L, e, kv, hd),
         "wv": (L, e, kv, hd),
         "wo": (L, h, hd, e),
-        "w_gate": (L, e, f),
-        "w_up": (L, e, f),
-        "w_down": (L, f, e),
         "attn_norm": (L, e),
         "mlp_norm": (L, e),
     }
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        shapes.update(
+            {
+                "moe_router": (L, e, E),
+                "moe_w_gate": (L, E, e, f),
+                "moe_w_up": (L, E, e, f),
+                "moe_w_down": (L, E, f, e),
+            }
+        )
+    else:
+        shapes.update(
+            {"w_gate": (L, e, f), "w_up": (L, e, f), "w_down": (L, f, e)}
+        )
     if not cfg.tie_embeddings:
         shapes["unembed"] = (e, v)
     return shapes
+
+
+def _layer_keys(cfg: LlamaConfig) -> tuple:
+    base = ("wq", "wk", "wv", "wo", "attn_norm", "mlp_norm")
+    if cfg.moe_experts:
+        return base + ("moe_router", "moe_w_gate", "moe_w_up", "moe_w_down")
+    return base + ("w_gate", "w_up", "w_down")
 
 
 def init_params(key, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
@@ -327,6 +366,8 @@ def _flash_attention(q, k, v):
 
 
 def _layer(layer_params, x, positions, cfg: LlamaConfig, mesh: Optional[Mesh]):
+    """One transformer block. Returns (x, aux) — aux is the MoE
+    load-balancing loss (0.0 for dense layers)."""
     p = layer_params
 
     def c(y, *dims):
@@ -342,13 +383,56 @@ def _layer(layer_params, x, positions, cfg: LlamaConfig, mesh: Optional[Mesh]):
     x = x + c(jnp.einsum("bthd,hde->bte", attn, p["wo"]), "batch", "seq", "embed")
 
     h = _rmsnorm(x, p["mlp_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
+    if cfg.moe_experts:
+        x2, aux = _moe_ffn(p, h, cfg, mesh)
+        return x + c(x2, "batch", "seq", "embed"), aux
     gate = jnp.einsum("bte,ef->btf", h, p["w_gate"])
     up = jnp.einsum("bte,ef->btf", h, p["w_up"])
     ff = c(jax.nn.silu(gate) * up, "batch", "seq", "mlp")
     x = x + c(jnp.einsum("btf,fe->bte", ff, p["w_down"]), "batch", "seq", "embed")
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
+def _moe_ffn(p, h, cfg: LlamaConfig, mesh: Optional[Mesh]):
+    """Routed expert FFN for one layer. h: [B, T, e] -> ([B, T, e], aux)."""
+    from ray_tpu.parallel.moe import moe_dense, moe_layer
+
+    B, T, e = h.shape
+    bank = {
+        "router": p["moe_router"],
+        "w_gate": p["moe_w_gate"],
+        "w_up": p["moe_w_up"],
+        "w_down": p["moe_w_down"],
+    }
+    tokens2d = h.reshape(B * T, e)
+    ep = (
+        mesh.shape.get("ep", 1)
+        if mesh is not None and "ep" in mesh.axis_names
+        else 1
+    )
+    if mesh is not None and ep > 1:
+        y, aux = moe_layer(
+            bank,
+            tokens2d,
+            mesh,
+            num_experts=cfg.moe_experts,
+            top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+            tokens_axis_names=("dp", "fsdp", "sp"),
+        )
+    else:
+        y, aux = moe_dense(
+            bank,
+            tokens2d,
+            num_experts=cfg.moe_experts,
+            top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+        )
+    return y.reshape(B, T, e).astype(h.dtype), aux
+
+
+# Dense (non-MoE) stacked block params; use _layer_keys(cfg) for the
+# config-dependent set.
 _LAYER_KEYS = (
     "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm",
 )
@@ -374,8 +458,16 @@ def forward_hidden(
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
     positions=None,
+    with_aux: bool = False,
 ):
-    """tokens: [B, T] int32 -> final hidden states [B, T, d_model]."""
+    """tokens: [B, T] int32 -> final hidden states [B, T, d_model].
+
+    ``with_aux=True`` returns (hidden, aux) where aux is the summed MoE
+    load-balancing loss (0 for dense configs). When the mesh has pp>1 the
+    layer stack runs as a GPipe pipeline over the pp axis
+    (``parallel/pipeline.py`` — native PP where the reference only passes
+    ``pipeline_parallel_size`` to vLLM, ``vllm_models.py:176-190``)."""
+    custom_positions = positions is not None
     if positions is None:
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape
@@ -384,21 +476,83 @@ def forward_hidden(
     if mesh is not None:
         x = with_sharding(mesh, x, "batch", "seq", "embed")
 
-    layer = lambda p, y: _layer(p, y, positions, cfg, mesh)
-    if cfg.remat:
-        policy = (
-            jax.checkpoint_policies.dots_saveable
-            if cfg.remat_policy == "dots"
-            else None
+    pp = (
+        mesh.shape.get("pp", 1)
+        if mesh is not None and "pp" in mesh.axis_names
+        else 1
+    )
+    if pp > 1 and custom_positions:
+        # the pipeline path recomputes default positions per microbatch;
+        # silently dropping packed/offset positions would corrupt RoPE
+        raise NotImplementedError("pp>1 with custom positions is not supported")
+    remat_policy = (
+        jax.checkpoint_policies.dots_saveable
+        if cfg.remat_policy == "dots"
+        else None
+    )
+    stacked = {k: params[k] for k in _layer_keys(cfg)}
+    if pp > 1:
+        x, aux = _pipeline_hidden(stacked, x, cfg, mesh, pp, remat_policy)
+    else:
+        layer = lambda p, y: _layer(p, y, positions, cfg, mesh)
+        if cfg.remat:
+            layer = jax.checkpoint(layer, policy=remat_policy)
+
+        def body(y, p):
+            return layer(p, y)
+
+        x, auxs = jax.lax.scan(body, x, stacked)
+        aux = auxs.sum()
+    x = _rmsnorm(x, params["final_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
+    return (x, aux) if with_aux else x
+
+
+def _pipeline_hidden(stacked, x, cfg: LlamaConfig, mesh: Mesh, pp: int, policy):
+    """Run the layer stack as pp GPipe stages (L/pp layers each) over
+    microbatches of the batch dim."""
+    from ray_tpu.parallel.pipeline import gpipe_spmd
+
+    if cfg.moe_experts:
+        raise NotImplementedError("pp>1 with MoE layers is not supported yet")
+    if cfg.attention != "full":
+        # inside the vmapped stage the layers see mesh=None, so ring/ulysses
+        # would silently degrade to dense and flash/splash would misclassify
+        # the sharded program as single-device (no SPMD partitioning rule)
+        raise NotImplementedError(
+            f"pp>1 requires attention='full' (got {cfg.attention!r})"
         )
-        layer = jax.checkpoint(layer, policy=policy)
-    stacked = {k: params[k] for k in _LAYER_KEYS}
+    L = cfg.n_layers
+    if L % pp:
+        raise ValueError(f"n_layers {L} not divisible by pp={pp}")
+    B, T, e = x.shape
+    M = cfg.pp_microbatches or 2 * pp
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    stage_params = {
+        k: v.reshape((pp, L // pp) + v.shape[1:]) for k, v in stacked.items()
+    }
+    x_mb = x.reshape(M, B // M, T, e)
+    pos = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None, :], (B // M, T)
+    )
 
-    def body(y, p):
-        return layer(p, y), None
+    def stage_fn(p_stage, y):
+        # mesh=None inside the vmapped stage: activation constraints can't
+        # name mesh axes under the stage vmap; tp still applies via the
+        # params' shardings and XLA propagation
+        lyr = lambda p, z: _layer(p, z, pos, cfg, None)
+        if cfg.remat:
+            lyr = jax.checkpoint(lyr, policy=policy)
 
-    x, _ = jax.lax.scan(body, x, stacked)
-    return _rmsnorm(x, params["final_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
+        def body(z, p):
+            z2, _ = lyr(p, z)
+            return z2, None
+
+        y, _ = jax.lax.scan(body, y, p_stage)
+        return y
+
+    out = gpipe_spmd(stage_params, x_mb, stage_fn, mesh)
+    return out.reshape(B, T, e), jnp.zeros((), jnp.float32)
 
 
 def forward(
@@ -438,19 +592,29 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
         mask = batch.get("mask")
         if mask is not None:
             mask = mask[:, 1:]
+    x, aux = forward_hidden(params, tokens, cfg, mesh, with_aux=True)
+    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     if cfg.fused_ce:
         from ray_tpu.ops.cross_entropy import fused_cross_entropy
 
-        x = forward_hidden(params, tokens, cfg, mesh)
-        unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
-        return fused_cross_entropy(x, unembed, labels, mask=mask)
-    logits = forward(params, tokens, cfg, mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    if mask is not None:
-        denom = jnp.maximum(mask.sum(), 1)
-        return (nll * mask).sum() / denom
-    return nll.mean()
+        base = fused_cross_entropy(x, unembed, labels, mask=mask)
+    else:
+        logits = jnp.einsum(
+            "bte,ev->btv", x, unembed.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        if mesh is not None:
+            logits = with_sharding(mesh, logits, "batch", "seq", "vocab")
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        if mask is not None:
+            denom = jnp.maximum(mask.sum(), 1)
+            base = (nll * mask).sum() / denom
+        else:
+            base = nll.mean()
+    if cfg.moe_experts:
+        return base + cfg.moe_aux_weight * aux
+    return base
 
 
 # ---------------------------------------------------------------------------
@@ -497,6 +661,8 @@ def _decode_forward(
     marks real (non-padding) tokens; padding writes are dropped so later
     decode steps never attend to stale slots. ``loras``/``adapter_ids``:
     stacked LoRA adapters + per-sequence adapter index (0 = base)."""
+    if cfg.moe_experts:
+        raise NotImplementedError("MoE decode path is not supported yet")
     B, T = tokens.shape
     S = cache["k"].shape[2]
     x = params["embed"][tokens].astype(cfg.dtype)

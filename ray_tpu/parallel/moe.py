@@ -38,18 +38,15 @@ def _expert_ffn(params, x):
     return jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, params["w_down"])
 
 
-def _moe_local(params, x, *, axis_name: str, num_experts: int, top_k: int, capacity: int, token_axes: tuple = ()):
-    """Per-device body under shard_map.
+def _route(params, x, num_experts: int, top_k: int, capacity: int):
+    """Shared top-k routing: dispatch/combine one-hot tensors + aux loss
+    inputs. Single source of truth for the routing math — ``_moe_local``
+    (sharded) and ``moe_dense`` must stay numerically identical.
 
-    x: [G_local, d] local tokens; experts sharded over ``axis_name``
-    (params' leading expert dim is E_local = E / ep locally).
+    Returns (disp [G,E,C], comb [G,E,C], aux scalar).
     """
-    ep = jax.lax.psum(1, axis_name)
     G, d = x.shape
-    E = num_experts
-    C = capacity
-
-    E_l = E // ep
+    E, C = num_experts, capacity
 
     logits = x @ params["router"]  # [G, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -70,6 +67,27 @@ def _moe_local(params, x, *, axis_name: str, num_experts: int, top_k: int, capac
     # combine[g,e,c]: dispatch weighted by (renormalized) gate value.
     comb = jnp.einsum("gke,gkc,gk->gec", oe, oc, keep * gate_vals.astype(x.dtype))
 
+    # Aux load-balancing loss (Switch style): mean_prob · mean_assignment.
+    me = probs.mean(axis=0)  # [E]
+    ce = onehot_e.astype(jnp.float32).sum(axis=1).mean(axis=0)  # [E]
+    aux = (me * ce).sum() * E
+    return disp, comb, aux
+
+
+def _moe_local(params, x, *, axis_name: str, num_experts: int, top_k: int, capacity: int, token_axes: tuple = ()):
+    """Per-device body under shard_map.
+
+    x: [G_local, d] local tokens; experts sharded over ``axis_name``
+    (params' leading expert dim is E_local = E / ep locally).
+    """
+    ep = jax.lax.psum(1, axis_name)
+    G, d = x.shape
+    E = num_experts
+    C = capacity
+
+    E_l = E // ep
+
+    disp, comb, aux = _route(params, x, E, top_k, C)
     expert_in = jnp.einsum("gd,gec->ecd", x, disp)  # [E, C, d]
 
     # Ship buffers to expert owners over ICI. Symmetric untiled all_to_all on
@@ -87,13 +105,32 @@ def _moe_local(params, x, *, axis_name: str, num_experts: int, top_k: int, capac
 
     y = jnp.einsum("ecd,gec->gd", returned, comb)
 
-    # Aux load-balancing loss (Switch style): mean_prob · mean_assignment,
-    # psum'd over token shards so every device sees the global value.
-    me = probs.mean(axis=0)  # [E]
-    ce = onehot_e.astype(jnp.float32).sum(axis=1).mean(axis=0)  # [E]
-    aux = (me * ce).sum() * E
+    # psum the aux loss over token shards so every device sees the global
+    # value (the routing itself computed the local-shard statistic).
     if token_axes:
         aux = jax.lax.pmean(aux, axis_name=token_axes)
+    return y, aux
+
+
+def moe_dense(
+    params,
+    x,
+    *,
+    num_experts: int,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+):
+    """Single-device (no mesh / ep=1) evaluation of the same routed MoE:
+    identical dispatch/combine math as ``_moe_local`` minus the all_to_all,
+    so MoE configs run unchanged on one chip or an ep=1 mesh.
+
+    x: [tokens, d] -> (y: [tokens, d], aux scalar).
+    """
+    C = max(1, int(capacity_factor * x.shape[0] * top_k / num_experts))
+    disp, comb, aux = _route(params, x, num_experts, top_k, C)
+    expert_in = jnp.einsum("gd,gec->ecd", x, disp)
+    out = _expert_ffn(params, expert_in)
+    y = jnp.einsum("ecd,gec->gd", out, comb)
     return y, aux
 
 

@@ -102,6 +102,68 @@ def pipeline_apply(
     return fn(stage_params, x_microbatches)
 
 
+def gpipe_spmd(
+    stage_params,
+    x_mb,
+    stage_fn: Callable,
+    mesh: Mesh,
+    *,
+    axis_name: str = "pp",
+):
+    """GPipe inside one jit/GSPMD program (no shard_map).
+
+    The stage dim (leading, size pp) is SHARDED over the ``axis_name`` mesh
+    axis; the tick rotation is a ``jnp.roll`` on that dim, which GSPMD
+    lowers to a collective-permute between stage neighbors. Because the body
+    stays in the auto-sharded world, inner dims compose freely with
+    tp/fsdp/dp shardings on params and activations — this is the
+    praxis-style pipelined-layer formulation, vs. the explicit shard_map
+    ring in ``pipeline_apply``.
+
+    Args:
+      stage_params: pytree, each leaf [pp, ...] (one slice per stage).
+      x_mb: [M, mb, ...] microbatched input.
+      stage_fn: (stage_param_slice, activation [mb, ...]) -> activation.
+    Returns [M, mb, ...] outputs.
+    """
+    from jax.sharding import NamedSharding
+
+    pp = mesh.shape[axis_name]
+    M = x_mb.shape[0]
+    ticks = M + pp - 1
+
+    def cst(v):
+        spec = P(*((axis_name,) + (None,) * (v.ndim - 1)))
+        return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+
+    stage_params = jax.tree.map(cst, stage_params)
+    buf = cst(jnp.zeros((pp,) + x_mb.shape[1:], x_mb.dtype))
+    outs = jnp.zeros_like(x_mb)
+    vmapped = jax.vmap(stage_fn)
+
+    def tick(carry, t):
+        buf, outs = carry
+        # previous stage's output becomes this stage's input (roll on the
+        # pp-sharded dim = collective permute); stage 0 takes the next
+        # fresh microbatch (clipped reads past M feed bubbles whose outputs
+        # are never stored)
+        shifted = jnp.roll(buf, 1, axis=0)
+        fresh = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
+        )
+        inp = cst(shifted.at[0].set(fresh))
+        out = cst(vmapped(stage_params, inp))
+        # last stage's output for microbatch t-(pp-1); early garbage writes
+        # at clipped index 0 are overwritten by the real store at t=pp-1
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, out[pp - 1], jnp.clip(t - (pp - 1), 0, M - 1), 0
+        )
+        return (out, outs), None
+
+    (_, outs), _ = jax.lax.scan(tick, (buf, outs), jnp.arange(ticks))
+    return outs
+
+
 def _strip_stage_dim(stage_fn):
     """shard_map leaves a leading length-1 stage dim on pp-sharded params;
     strip it before calling user code."""

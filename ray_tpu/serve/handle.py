@@ -398,7 +398,12 @@ class DeploymentHandle:
             # holding self._lock (non-reentrant), so NO locking here; the
             # spawned thread takes the lock and signals the controller
             if not state["done"]:
-                threading.Thread(target=_mark_and_notify, daemon=True).start()
+                try:
+                    threading.Thread(target=_mark_and_notify, daemon=True).start()
+                except RuntimeError:
+                    # interpreter shutdown: no new threads — the cluster is
+                    # dying with us, nothing to clean up
+                    pass
 
         weakref.finalize(gen, _on_gc)
 

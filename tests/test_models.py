@@ -137,3 +137,69 @@ def test_param_count_formula():
     params = init_params(jax.random.PRNGKey(0), CFG)
     actual = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
     assert actual == CFG.num_params()
+
+
+def test_pipeline_model_matches_sequential():
+    """pp>1 in the FLAGSHIP model: GPipe over the pp mesh axis produces the
+    same hidden states as the plain layer scan (same params)."""
+    from ray_tpu.models.llama import forward_hidden
+
+    cfg = LlamaConfig.tiny(n_layers=4, attention="full")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 16)), jnp.int32
+    )
+    ref = forward_hidden(params, tokens, cfg)
+    mesh = build_mesh(MeshSpec(dp=2, pp=2, tp=2))
+    out = jax.jit(lambda p, t: forward_hidden(p, t, cfg, mesh))(params, tokens)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=3e-4)
+
+
+def test_pipeline_train_step():
+    """Full train step through the pipelined model: finite loss, loss moves."""
+    cfg = LlamaConfig.tiny(n_layers=4, attention="full")
+    mesh = build_mesh(MeshSpec(dp=2, pp=2, tp=2))
+    init_fn, step_fn = make_train_step(cfg, mesh)
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 33)), jnp.int32
+    )
+    state, m1 = step_fn(state, {"tokens": tokens})
+    for _ in range(3):
+        state, m2 = step_fn(state, {"tokens": tokens})
+    assert np.isfinite(float(m1["loss"]))
+    assert float(m2["loss"]) < float(m1["loss"])
+
+
+def test_moe_model_ep_mesh_matches_dense_path():
+    """MoE FLAGSHIP variant: ep=2 sharded routing equals the single-device
+    dense-path evaluation of the same params."""
+    cfg = LlamaConfig.tiny(n_layers=2, moe_experts=4, moe_top_k=2,
+                           moe_capacity_factor=8.0)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (4, 16)), jnp.int32
+    )
+    dense = loss_fn(params, {"tokens": tokens}, cfg)
+    mesh = build_mesh(MeshSpec(dp=4, ep=2))
+    sharded = jax.jit(
+        lambda p, b: loss_fn(p, b, cfg, mesh)
+    )(params, {"tokens": tokens})
+    # sharded dispatch splits capacity per token-shard; with a generous
+    # capacity factor no tokens drop on either path and losses agree
+    np.testing.assert_allclose(float(dense), float(sharded), rtol=2e-3)
+
+
+def test_moe_train_step_learns():
+    cfg = LlamaConfig.tiny(n_layers=2, moe_experts=4, moe_top_k=2)
+    mesh = build_mesh(MeshSpec(dp=4, ep=2))
+    init_fn, step_fn = make_train_step(cfg, mesh)
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 33)), jnp.int32
+    )
+    state, m1 = step_fn(state, {"tokens": tokens})
+    for _ in range(4):
+        state, m2 = step_fn(state, {"tokens": tokens})
+    assert np.isfinite(float(m1["loss"]))
+    assert float(m2["loss"]) < float(m1["loss"])
