@@ -90,6 +90,7 @@ class NodeAgent:
                 "the Python fallback store cannot serve cross-host pulls"
             )
         self.arena_name = f"/rtpu-a{os.getpid():x}-{time.time_ns() & 0xFFFFFF:x}"
+        self._store_capacity = object_store_memory
         self.store = NativePlasmaStore(object_store_memory, self.arena_name)
 
         # Workers on this host.
@@ -186,19 +187,95 @@ class NodeAgent:
             raise RuntimeError(f"controller call {op} failed: {reply.error}")
         return reply.payload
 
-    def serve_forever(self):
-        """Main loop: dispatch controller → agent traffic until shutdown."""
+    def serve_forever(self, reconnect_window_s: float = 60.0):
+        """Main loop: dispatch controller → agent traffic until shutdown.
+
+        On head-connection loss the agent RECONNECTS (reference: raylet
+        ``NotifyGCSRestart`` reconnect, ``node_manager.cc:947``): local
+        workers are torn down (their control-plane state died with the old
+        head), the arena is recycled, and the agent re-registers as a fresh
+        node so the restored controller can re-place restartable actors."""
         while not self.shutting_down:
             try:
                 msg = self.conn.recv()
             except (EOFError, OSError):
-                logger.warning("lost connection to head; shutting down")
-                break
+                if self.shutting_down:
+                    break
+                logger.warning("lost connection to head; reconnecting")
+                if not self._reconnect(reconnect_window_s):
+                    logger.warning("could not re-reach head; shutting down")
+                    break
+                continue
             try:
                 self._dispatch_head_msg(msg)
             except Exception:  # noqa: BLE001 — the loop must survive
                 logger.error("agent dispatch failed:\n%s", traceback.format_exc())
         self.shutdown()
+
+    def _reconnect(self, window_s: float) -> bool:
+        self._reset_local_state()
+        host, _, port = self.head_address.rpartition(":")
+        deadline = time.monotonic() + window_s
+        while time.monotonic() < deadline and not self.shutting_down:
+            try:
+                conn = Client((host, int(port)), authkey=self.authkey)
+                # swap + register atomically: the heartbeat thread must not
+                # slip a Heartbeat in as the new connection's first message
+                # (the head closes conns whose first message isn't Register*)
+                with self._send_lock:
+                    self.conn = conn
+                    conn.send(
+                        P.RegisterAgent(
+                            self.node_id,
+                            self.resources,
+                            self.labels,
+                            self.arena_name,
+                            self.data_address,
+                            pid=os.getpid(),
+                            hostname=socket.gethostname(),
+                        )
+                    )
+                ack = conn.recv()
+                if isinstance(ack, P.AgentAck):
+                    logger.info("re-registered with restarted head")
+                    return True
+                conn.close()
+            except (OSError, EOFError, ConnectionError):
+                pass
+            time.sleep(1.0)
+        return False
+
+    def _reset_local_state(self):
+        """Tear down workers + data plane for a clean re-registration."""
+        from ray_tpu._private.object_store import NativePlasmaStore
+
+        with self.workers_lock:
+            workers = list(self.workers.values())
+            self.workers.clear()
+            self._pending_kills.clear()
+        for w in workers:
+            proc = w.get("proc")
+            if proc is not None:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+        with self._resident_lock:
+            self._resident.clear()
+            self._resident_order.clear()
+        for path, _ in self._spilled.values():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._spilled.clear()
+        self._owner_cache.clear()
+        try:
+            self.store.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        self.arena_name = f"/rtpu-a{os.getpid():x}-{time.time_ns() & 0xFFFFFF:x}"
+        self.store = NativePlasmaStore(self._store_capacity, self.arena_name)
 
     def _dispatch_head_msg(self, msg):
         if isinstance(msg, P.ToWorker):
@@ -266,7 +343,9 @@ class NodeAgent:
                     )
                 )
             except (OSError, EOFError):
-                return
+                # conn mid-reconnect: keep the loop alive, the main loop
+                # swaps self.conn in after re-registration
+                pass
             time.sleep(2.0)
 
     # --------------------------------------------------------- worker plane
@@ -664,6 +743,12 @@ def main(argv=None):
     parser.add_argument("--node-ip", default=None)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    # stack dumps on demand (kill -USR1 <agent-pid>): the debugging analog
+    # of the dashboard's worker stack-dump channel, for the agent itself
+    import faulthandler
+    import signal as _signal
+
+    faulthandler.register(_signal.SIGUSR1)
     authkey_hex = args.authkey or os.environ.get("RAY_TPU_AUTHKEY")
     if not authkey_hex:
         from ray_tpu._private.protocol import token_to_authkey

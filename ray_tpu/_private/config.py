@@ -80,6 +80,11 @@ class Config:
     # Node agents silent for longer than this are declared dead and their
     # nodes removed (reference: gcs_health_check_manager.h failure window).
     agent_heartbeat_timeout_s: float = 10.0
+    # Pending-task specs captured per state snapshot: bounds the per-flush
+    # cost under deep queues (capture is O(n) under the scheduler lock);
+    # beyond the cap, the oldest tasks are persisted and the rest rely on
+    # resubmission by surviving drivers.
+    gcs_snapshot_max_pending: int = 10_000
     # --- fault tolerance ---
     task_max_retries: int = 3
     # Lineage kept for object reconstruction (reference: task_manager.h:177

@@ -188,6 +188,7 @@ class PendingTask:
         self.worker: Optional[WorkerHandle] = None
         self.cancelled = False
         self.dispatch_t: float = 0.0  # set when handed to a worker
+        self.seq = 0  # global submission order (FIFO across shape queues)
 
 
 class ActorState:
@@ -293,7 +294,14 @@ class Controller:
             self._stores_by_arena[self.plasma.arena_name] = self.plasma
 
         # Scheduling state.
-        self.ready_queue: deque[PendingTask] = deque()
+        # shape-keyed ready queues: (resources, strategy, env fingerprint)
+        # -> FIFO of placeable tasks (see _try_dispatch_locked). Dispatch
+        # order across shapes follows each head task's global submission
+        # seq, preserving the global-FIFO fairness a single queue had —
+        # shapes competing for the same slots (nested submits!) interleave
+        # by arrival instead of starving each other.
+        self.ready_queues: dict[tuple, deque] = {}
+        self._enqueue_seq = itertools.count()
         self.waiting_on_deps: dict[ObjectID, list[PendingTask]] = defaultdict(list)
         self.pending_by_id: dict[TaskID, PendingTask] = {}
         self.sched_cv = threading.Condition(self.lock)
@@ -387,18 +395,26 @@ class Controller:
         # serializes snapshot+rename: without it an in-flight background
         # write (stale snapshot) can land AFTER the shutdown flush
         self._kv_write_lock = threading.Lock()
+        self._boot_snapshot = None
         if self._kv_snapshot_path and os.path.exists(self._kv_snapshot_path):
             try:
                 import pickle as _pickle
 
                 with open(self._kv_snapshot_path, "rb") as f:
-                    self.kv.update(_pickle.load(f))
+                    snap = _pickle.load(f)
+                if isinstance(snap, dict) and snap.get("version", 0) >= 2:
+                    self.kv.update(snap.get("kv", {}))
+                    # actors/tasks/pgs restore at the end of __init__ once
+                    # the scheduler is live
+                    self._boot_snapshot = snap
+                else:
+                    self.kv.update(snap)  # legacy KV-only snapshot
                 logger.info(
                     "restored %d KV entries from %s",
                     len(self.kv), self._kv_snapshot_path,
                 )
             except Exception:
-                logger.warning("KV snapshot restore failed", exc_info=True)
+                logger.warning("state snapshot restore failed", exc_info=True)
 
         # Observability: task events ring buffer.
         self.task_events: deque[dict] = deque(maxlen=config.event_buffer_size)
@@ -479,6 +495,13 @@ class Controller:
         t.start()
         self._threads.append(t)
 
+        if self._boot_snapshot is not None:
+            try:
+                self._restore_snapshot(self._boot_snapshot)
+            except Exception:
+                logger.warning("snapshot state restore failed", exc_info=True)
+            self._boot_snapshot = None
+
     @staticmethod
     def _session_file_path() -> str:
         # per-uid dir: the file holds the cluster authkey, which grants the
@@ -523,59 +546,180 @@ class Controller:
             pass
 
     def _persist_kv(self):
-        """Mark the KV table dirty; a background flusher writes the snapshot
-        (inline per-put writes would be O(table) on every connection thread
-        and racy on the shared tmp path)."""
+        """Mark controller state dirty; a background flusher writes the
+        snapshot (inline per-put writes would be O(table) on every
+        connection thread and racy on the shared tmp path)."""
         if not self._kv_snapshot_path:
             return
         self._kv_dirty.set()
         with self.lock:
             if self._kv_flusher is None:
                 self._kv_flusher = threading.Thread(
-                    target=self._kv_flush_loop, daemon=True, name="kv-flusher"
+                    target=self._kv_flush_loop, daemon=True, name="gcs-flusher"
                 )
                 self._kv_flusher.start()
 
-    def _kv_flush_loop(self):
+    # alias: every table mutation funnels through the same dirty flag
+    _persist_state = _persist_kv
+
+    def _build_snapshot(self) -> dict:
+        """Full control-plane state for fault tolerance (reference: the GCS
+        table storage reloaded by gcs_init_data on boot,
+        ``redis_store_client.h:111``). Captured under the lock:
+
+        - KV table
+        - named actors (creation spec + restart budget) — the restartable
+          population; anonymous actors fate-share with their owner
+        - placement groups (bundles + strategy; placement is recomputed)
+        - pending normal-task specs (queued work drains after a restart)
+        """
+        with self.lock:
+            actors = [
+                {
+                    "spec": a.creation_spec,
+                    "name": a.name,
+                    "restarts_left": a.restarts_left,
+                }
+                for a in self.actors.values()
+                if a.name and a.state != "DEAD"
+            ]
+            cap = self.config.gcs_snapshot_max_pending
+            pending = []
+            for pt in self.pending_by_id.values():
+                if (
+                    pt.spec.task_type == TaskType.NORMAL_TASK
+                    and not pt.cancelled
+                ):
+                    pending.append(pt.spec)
+                    if len(pending) >= cap:
+                        logger.warning(
+                            "state snapshot truncated at %d pending tasks",
+                            cap,
+                        )
+                        break
+            # actor tasks queued on restartable (named) actors
+            for a in self.actors.values():
+                if a.name and a.state != "DEAD":
+                    pending.extend(pt.spec for pt in a.queue)
+            pgs = [
+                {
+                    "pg_id": pg_id,
+                    "bundles": pg.bundles,
+                    "strategy": pg.strategy,
+                }
+                for pg_id, pg in self.placement_groups.items()
+                if not pg.removed
+            ]
+            return {
+                "version": 2,
+                "kv": dict(self.kv),
+                "actors": actors,
+                "placement_groups": pgs,
+                "pending_tasks": pending,
+            }
+
+    def _write_snapshot(self, suffix: str):
         import pickle as _pickle
 
+        with self._kv_write_lock:
+            snapshot = self._build_snapshot()
+            tmp = self._kv_snapshot_path + suffix
+            with open(tmp, "wb") as f:
+                _pickle.dump(snapshot, f)
+            os.replace(tmp, self._kv_snapshot_path)
+
+    def _kv_flush_loop(self):
         while not self.shutting_down:
             self._kv_dirty.wait(timeout=1.0)
             if not self._kv_dirty.is_set():
                 continue
             self._kv_dirty.clear()
             try:
-                with self._kv_write_lock:
-                    with self.lock:
-                        snapshot = dict(self.kv)
-                    tmp = (
-                        self._kv_snapshot_path
-                        + f".tmp{os.getpid()}-{threading.get_ident()}"
-                    )
-                    with open(tmp, "wb") as f:
-                        _pickle.dump(snapshot, f)
-                    os.replace(tmp, self._kv_snapshot_path)
+                self._write_snapshot(f".tmp{os.getpid()}-{threading.get_ident()}")
             except Exception:
-                logger.warning("KV snapshot write failed", exc_info=True)
-            time.sleep(0.2)  # batch bursts of puts
+                logger.warning("state snapshot write failed", exc_info=True)
+            time.sleep(0.2)  # batch bursts of mutations
 
     def flush_kv_now(self):
         """Synchronous flush (used at shutdown so the last writes persist)."""
         if not self._kv_snapshot_path:
             return
-        import pickle as _pickle
-
         try:
-            with self._kv_write_lock:
-                with self.lock:
-                    snapshot = dict(self.kv)
-                tmp = self._kv_snapshot_path + f".final{os.getpid()}"
-                with open(tmp, "wb") as f:
-                    _pickle.dump(snapshot, f)
-                os.replace(tmp, self._kv_snapshot_path)
-                self._kv_dirty.clear()
+            self._write_snapshot(f".final{os.getpid()}")
+            self._kv_dirty.clear()
         except Exception:
-            logger.warning("final KV snapshot failed", exc_info=True)
+            logger.warning("final state snapshot failed", exc_info=True)
+
+    def _restore_snapshot(self, snap: dict):
+        """Rebuild restorable state from a snapshot (run at the END of
+        __init__, once the scheduler is live). Named actors are re-created
+        (their processes died with the old head/agents — reference restarts
+        them through GcsActorManager the same way); pending tasks resubmit;
+        placement groups re-place as capacity registers."""
+        for entry in snap.get("placement_groups", ()):
+            pg = PlacementGroupState(
+                entry["pg_id"], entry["bundles"], entry["strategy"]
+            )
+            with self.lock:
+                self.placement_groups[entry["pg_id"]] = pg
+        for entry in snap.get("actors", ()):
+            spec = entry["spec"]
+            try:
+                with self.lock:
+                    actor = ActorState(spec.actor_id, spec)
+                    actor.name = entry["name"]
+                    actor.restarts_left = entry["restarts_left"]
+                    self.actors[spec.actor_id] = actor
+                    if entry["name"]:
+                        self.named_actors[entry["name"]] = spec.actor_id
+                self.submit_task(spec)
+            except Exception:
+                logger.warning(
+                    "could not restore actor %s", entry["name"], exc_info=True
+                )
+        restored = 0
+        for spec in snap.get("pending_tasks", ()):
+            try:
+                self.submit_task(spec)
+                restored += 1
+            except Exception:
+                logger.warning(
+                    "could not restore task %s", spec.name, exc_info=True
+                )
+        # tasks whose ref args died with the old object store and have no
+        # producer to rebuild them must fail, not hang
+        self._fail_unrecoverable_waiters()
+        if snap.get("actors") or restored:
+            logger.info(
+                "restored %d named actor(s), %d pending task(s), %d pg(s) "
+                "from snapshot",
+                len(snap.get("actors", ())), restored,
+                len(snap.get("placement_groups", ())),
+            )
+
+    def _fail_unrecoverable_waiters(self):
+        with self.lock:
+            doomed = []
+            for oid, waiters in list(self.waiting_on_deps.items()):
+                if self.memory_store.contains(oid):
+                    continue
+                producer = TaskID(oid.binary()[: TaskID.SIZE])
+                if (
+                    producer in self.pending_by_id
+                    or producer in self._recovering
+                    or oid in self.lineage
+                ):
+                    continue
+                doomed.extend((oid, pt) for pt in waiters)
+                del self.waiting_on_deps[oid]
+        for oid, pt in doomed:
+            self._fail_task(
+                pt,
+                ObjectLostError(
+                    f"dependency {oid.hex()} was lost with the previous "
+                    f"controller and has no lineage"
+                ),
+            )
 
     # -------------------------------------------------------- memory monitor
 
@@ -923,9 +1067,21 @@ class Controller:
                 object_id = ObjectID(loc[2]) if loc and loc[2] else None
             if object_id is None:
                 raise ObjectLostError(f"cannot pull unkeyed location {shm_name}")
-            return SerializedObject.from_buffer(
-                self._pull_whole_from_agent(store.agent.data_address, object_id, size)
-            )
+            try:
+                return SerializedObject.from_buffer(
+                    self._pull_whole_from_agent(
+                        store.agent.data_address, object_id, size
+                    )
+                )
+            except (OSError, EOFError, ConnectionError, ObjectLostError):
+                # the owner died between the entry read and the pull: node
+                # removal deletes the entry and lineage reconstruction
+                # reseals it — re-resolve against the FRESH entry
+                self._maybe_recover([object_id])
+                fresh = self.memory_store.get([object_id], timeout=60)[0]
+                if fresh is None or fresh == entry:
+                    raise
+                return self.resolve_object(fresh, object_id=object_id)
         try:
             return self.plasma_client.read(shm_name, size)
         except ObjectRelocatedError:
@@ -1098,6 +1254,7 @@ class Controller:
                 self.ref_counts[d] += 1
             if spec.task_type == TaskType.ACTOR_TASK:
                 self._submit_actor_task(pt)
+                self._persist_state()
                 return
             unresolved = {d for d in pt.unresolved if not self.memory_store.contains(d)}
             pt.unresolved = unresolved
@@ -1109,6 +1266,7 @@ class Controller:
             else:
                 self._enqueue_ready(pt)
             self.sched_cv.notify_all()
+        self._persist_state()
 
     # -------------------------------------------------- lineage reconstruction
 
@@ -1169,8 +1327,28 @@ class Controller:
             )
             self.submit_task(spec)
 
+    def _shape_key(self, spec: TaskSpec) -> tuple:
+        s = spec.strategy
+        return (
+            tuple(sorted(spec.resources.items())),
+            s.kind,
+            getattr(s, "node_id", None),
+            getattr(s, "placement_group_id", None),
+            getattr(s, "bundle_index", -1),
+            self._env_fingerprint(spec),
+        )
+
     def _enqueue_ready(self, pt: PendingTask):
-        self.ready_queue.append(pt)
+        pt.seq = next(self._enqueue_seq)
+        shape = self._shape_key(pt.spec)
+        q = self.ready_queues.get(shape)
+        if q is None:
+            q = self.ready_queues[shape] = deque()
+        q.append(pt)
+
+    def _iter_ready(self):
+        for q in self.ready_queues.values():
+            yield from q
 
     def _submit_actor_task(self, pt: PendingTask):
         actor = self.actors.get(pt.spec.actor_id)
@@ -1227,24 +1405,53 @@ class Controller:
                     self.sched_cv.wait(timeout=0.5)
 
     def _try_dispatch_locked(self) -> bool:
+        """One scheduling round over the shape-indexed ready queues.
+
+        Tasks with the same (resources, strategy, env) shape are scheduled
+        FIFO from one queue; the first head-of-queue that cannot place
+        blocks ONLY its shape for this round. A round therefore costs
+        O(shapes + dispatched), not O(queued) — with 100k+ queued tasks of
+        one shape and busy workers, a flat scan per completion would be
+        O(n²) over the drain (reference: the scheduling-class queues in
+        ``cluster_task_manager.h:44``, keyed the same way)."""
         progressed = False
-        remaining = deque()
-        while self.ready_queue:
-            pt = self.ready_queue.popleft()
-            if pt.cancelled:
-                continue
+        blocked: set = set()
+        while True:
+            # oldest head task across unblocked shapes — global FIFO order
+            best_shape = None
+            best_seq = None
+            emptied = []
+            for shape, q in self.ready_queues.items():
+                if shape in blocked:
+                    continue
+                while q and q[0].cancelled:
+                    q.popleft()
+                if not q:
+                    emptied.append(shape)  # cancelled-out: reap the key
+                    continue
+                seq = q[0].seq
+                if best_seq is None or seq < best_seq:
+                    best_seq, best_shape = seq, shape
+            for shape in emptied:
+                del self.ready_queues[shape]
+            if best_shape is None:
+                break
+            q = self.ready_queues[best_shape]
+            pt = q[0]
             if pt.spec.task_type == TaskType.ACTOR_TASK:
+                q.popleft()
                 actor = self.actors.get(pt.spec.actor_id)
                 if actor is not None:
                     actor.queue.appendleft(pt)
                     self._pump_actor(actor)
                 progressed = True
-                continue
-            if self._try_place(pt):
+            elif self._try_place(pt):
+                q.popleft()
                 progressed = True
             else:
-                remaining.append(pt)
-        self.ready_queue = remaining
+                blocked.add(best_shape)
+            if not q:
+                del self.ready_queues[best_shape]
         return progressed
 
     def _pick_node(self, pt: PendingTask) -> Optional[NodeState]:
@@ -1635,6 +1842,15 @@ class Controller:
         GCS, ``gcs_node_manager``). The agent owns its host's worker pool
         and arena; the controller records the node, routes spawns through
         the agent, and reads the node's objects over its data listener."""
+        with self.lock:
+            existing = self.nodes.get(msg.node_id)
+        if existing is not None and existing.alive:
+            # re-registration after a transient disconnect (the head never
+            # died): retire the old incarnation first — its workers/arena
+            # are gone on the agent side, and overwriting the NodeState
+            # in place would corrupt resource accounting (releases against
+            # a fresh full-capacity table)
+            self.remove_node(msg.node_id)
         agent = AgentHandle(msg.node_id, conn, msg.arena_name, msg.data_address)
         # Ack BEFORE the node becomes schedulable: once the scheduler can
         # pick this node, a SpawnWorker may be serialized onto the conn, and
@@ -2204,9 +2420,9 @@ class Controller:
                 queued = [
                     {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
                      "state": "PENDING_SCHEDULING", "worker_id": None}
-                    for pt in self.ready_queue
+                    for pt in self._iter_ready()
                 ]
-                ready_ids = {pt.spec.task_id for pt in self.ready_queue}
+                ready_ids = {pt.spec.task_id for pt in self._iter_ready()}
                 running_ids = {
                     pt.spec.task_id
                     for w in self.workers.values()
@@ -2409,6 +2625,7 @@ class Controller:
                     worker.last_idle_t = time.monotonic()
                     self.idle_workers[worker.node_id].append(worker)
             self.sched_cv.notify_all()
+        self._persist_state()
 
     def _retry_failed_task(self, worker: WorkerHandle, pt: PendingTask, msg: P.TaskDone):
         spec = pt.spec
@@ -2532,6 +2749,7 @@ class Controller:
                 actor.death_cause = reason
                 self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "DEAD", "reason": reason})
                 self._drain_actor_queue(actor)
+                self._persist_state()
 
     def _release_actor_resources(self, actor: ActorState):
         if actor.held is None:
@@ -2583,6 +2801,7 @@ class Controller:
                     raise ValueError(f"actor name {name!r} already taken")
                 self.named_actors[name] = spec.actor_id
         self.submit_task(spec)
+        self._persist_state()
         return actor
 
     def get_named_actor(self, name: str) -> Optional[ActorID]:
@@ -2621,6 +2840,7 @@ class Controller:
                     self._drain_actor_queue(actor)
                     if actor.name:
                         self.named_actors.pop(actor.name, None)
+        self._persist_state()
 
     def cancel_task(self, object_id: ObjectID):
         task_id = object_id.task_id()
@@ -2644,6 +2864,7 @@ class Controller:
         with self.lock:
             self.placement_groups[pg_id] = pg
             self._try_place_pg(pg)
+        self._persist_state()
         return pg_id
 
     def _try_place_pg(self, pg: PlacementGroupState):
@@ -2712,6 +2933,7 @@ class Controller:
                 node = self.nodes.get(nid)
                 if node is not None:
                     node.release(pg.bundles[i])
+        self._persist_state()
 
     def pg_ready(self, pg_id: PlacementGroupID, timeout=None) -> bool:
         with self.lock:

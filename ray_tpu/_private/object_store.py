@@ -39,49 +39,111 @@ class ObjectRelocatedError(RuntimeError):
     points at the spill file or a new location)."""
 
 
+class _Waiter:
+    """One blocked get()/wait() call: sealed when ``remaining`` distinct
+    watched objects have arrived."""
+
+    __slots__ = ("remaining", "event")
+
+    def __init__(self, remaining: int):
+        self.remaining = remaining
+        self.event = threading.Event()
+
+
 class MemoryStore:
-    """Thread-safe in-process object map with blocking get."""
+    """Thread-safe in-process object map with blocking get.
+
+    Blocking calls register per-object waiters instead of re-scanning their
+    full id list on every seal — a get() over N refs draining through N
+    completions would otherwise cost O(N²) (the scalability-envelope
+    cliff; reference: the future-based CoreWorkerMemoryStore,
+    ``memory_store.h:45``, has the same shape)."""
 
     def __init__(self):
         self._objects: dict[ObjectID, SerializedObject] = {}
         self._errors: dict[ObjectID, SerializedObject] = {}
-        self._cv = threading.Condition()
+        self._lock = threading.Lock()
+        # object id -> list of waiters blocked on it
+        self._waiters: dict[ObjectID, list[_Waiter]] = {}
 
     def put(self, object_id: ObjectID, obj: SerializedObject, is_error: bool = False):
-        with self._cv:
+        to_wake = []
+        with self._lock:
+            fresh = object_id not in self._objects
             self._objects[object_id] = obj
             if is_error:
                 self._errors[object_id] = obj
-            self._cv.notify_all()
+            waiters = self._waiters.pop(object_id, None) if fresh else None
+            if waiters:
+                for w in waiters:
+                    w.remaining -= 1  # under the lock: concurrent puts race
+                    if w.remaining <= 0:
+                        to_wake.append(w)
+        for w in to_wake:
+            w.event.set()
 
     def contains(self, object_id: ObjectID) -> bool:
-        with self._cv:
+        with self._lock:
             return object_id in self._objects
+
+    def _register(self, object_ids: list[ObjectID], threshold: int):
+        """Under lock: count missing ids; if ready-count < threshold,
+        register a waiter on every missing id. Returns (waiter|None,
+        missing_list)."""
+        missing = [o for o in object_ids if o not in self._objects]
+        ready = len(object_ids) - len(missing)
+        if ready >= threshold:
+            return None, missing
+        w = _Waiter(threshold - ready)
+        for o in missing:
+            self._waiters.setdefault(o, []).append(w)
+        return w, missing
+
+    def _unregister(self, waiter: _Waiter, missing: list[ObjectID]):
+        with self._lock:
+            for o in missing:
+                lst = self._waiters.get(o)
+                if lst is not None:
+                    try:
+                        lst.remove(waiter)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        del self._waiters[o]
 
     def get(
         self, object_ids: Iterable[ObjectID], timeout: Optional[float] = None
     ) -> list[Optional[SerializedObject]]:
         object_ids = list(object_ids)
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._cv:
-            while True:
-                missing = [o for o in object_ids if o not in self._objects]
-                if not missing:
+        while True:
+            with self._lock:
+                waiter, missing = self._register(object_ids, len(object_ids))
+                if waiter is None:
                     return [self._objects[o] for o in object_ids]
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
+            try:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    with self._lock:
                         return [self._objects.get(o) for o in object_ids]
-                    self._cv.wait(remaining)
-                else:
-                    self._cv.wait()
+                sealed = waiter.event.wait(timeout=remaining)
+            finally:
+                self._unregister(waiter, missing)
+            if not sealed and deadline is not None and time.monotonic() >= deadline:
+                with self._lock:
+                    return [self._objects.get(o) for o in object_ids]
+            # sealed (or spurious): loop re-checks — a watched object may
+            # have been deleted and re-put, miscounting remaining; the
+            # re-register pass is authoritative
 
     def wait(
         self, object_ids: list[ObjectID], num_returns: int, timeout: Optional[float]
     ) -> tuple[list[ObjectID], list[ObjectID]]:
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._cv:
-            while True:
+        while True:
+            with self._lock:
                 ready = [o for o in object_ids if o in self._objects]
                 if len(ready) >= num_returns:
                     ready_set = set(ready[:num_returns])
@@ -89,26 +151,38 @@ class MemoryStore:
                         [o for o in object_ids if o in ready_set],
                         [o for o in object_ids if o not in ready_set],
                     )
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        ready_set = set(ready)
-                        return (
-                            [o for o in object_ids if o in ready_set],
-                            [o for o in object_ids if o not in ready_set],
-                        )
-                    self._cv.wait(remaining)
-                else:
-                    self._cv.wait()
+                waiter, missing = self._register(object_ids, num_returns)
+            try:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    with self._lock:
+                        ready_set = {o for o in object_ids if o in self._objects}
+                    return (
+                        [o for o in object_ids if o in ready_set],
+                        [o for o in object_ids if o not in ready_set],
+                    )
+                sealed = waiter.event.wait(timeout=remaining)
+            finally:
+                if waiter is not None:
+                    self._unregister(waiter, missing)
+            if not sealed and deadline is not None and time.monotonic() >= deadline:
+                with self._lock:
+                    ready_set = {o for o in object_ids if o in self._objects}
+                return (
+                    [o for o in object_ids if o in ready_set],
+                    [o for o in object_ids if o not in ready_set],
+                )
 
     def delete(self, object_ids: Iterable[ObjectID]):
-        with self._cv:
+        with self._lock:
             for o in object_ids:
                 self._objects.pop(o, None)
                 self._errors.pop(o, None)
 
     def size(self) -> int:
-        with self._cv:
+        with self._lock:
             return len(self._objects)
 
 

@@ -132,7 +132,9 @@ class WorkerAPI:
             args=spec_args,
             kwargs_included=True,
             num_returns=num_returns,
-            resources=resources or {"CPU": 1.0},
+            # {} is a REAL value: num_cpus=0 tasks take no resources
+            # (reference: zero-cpu tasks schedule without capacity)
+            resources={"CPU": 1.0} if resources is None else resources,
             max_retries=max_retries,
             retry_exceptions=retry_exceptions,
             strategy=strategy or SchedulingStrategy(),
@@ -364,12 +366,11 @@ class WorkerProcAPI(WorkerAPI):
         self.runtime.call_controller("add_ref", list(object_ids), fire_and_forget=True)
 
     def remove_ref(self, object_id):
-        from ray_tpu._private import protocol as P
-
-        try:
-            self.runtime._send(P.FreeObjects([object_id]))
-        except (OSError, EOFError):
-            pass
+        # NEVER send from here: remove_ref runs from ObjectRef.__del__,
+        # which GC can fire on a thread that is ALREADY inside _send
+        # holding the (non-reentrant) send lock mid-pickle — a direct send
+        # would self-deadlock. Queue the free; a flusher thread batches.
+        self.runtime.queue_free(object_id)
 
 
 class RuntimeContext:
@@ -535,6 +536,9 @@ def _connect_client(address: str) -> "WorkerAPI":
         ) from e
     runtime = WorkerRuntime(WorkerID.from_random(), conn, in_process=False)
     runtime.client_mode = True
+    # reconnect-after-head-restart support (reference: the ray client's
+    # reconnect grace): the reply pump re-dials this target on EOF
+    runtime.client_target = (target, family, authkey)
     # registration must hit the wire BEFORE any API request (the handshake
     # closes connections whose first message isn't a Register*)
     runtime.register_driver()

@@ -118,6 +118,14 @@ class WorkerRuntime:
         # client drivers attach to a foreign cluster: reply pump only, no
         # task execution, and never os._exit on disconnect
         self.client_mode = False
+        # (target, family, authkey) for client reconnect after head restart
+        self.client_target = None
+        # bumped on reconnect: in-flight waiters of the old epoch fail fast
+        self._conn_epoch = 0
+        # async ref-release queue (see queue_free)
+        self._free_queue: list = []
+        self._free_flusher: Optional[threading.Thread] = None
+        self._free_flusher_lock = threading.Lock()
 
     # ------------------------------------------------------------- transport
 
@@ -146,6 +154,36 @@ class WorkerRuntime:
         with self._send_lock:
             self.conn.send(msg)
 
+    def queue_free(self, object_id) -> None:
+        """Asynchronous ref release (called from ObjectRef.__del__ — must
+        never touch the connection OR any non-reentrant lock: GC can
+        interrupt a thread that is already inside a locked region, and a
+        nested acquire would self-deadlock). Append only; the flusher
+        thread (started eagerly, see _ensure_free_flusher) batches sends."""
+        self._free_queue.append(object_id)
+
+    def _ensure_free_flusher(self):
+        """Start the free flusher OUTSIDE any __del__ path (plain call
+        sites only, so the lock here can never be re-entered by GC)."""
+        with self._free_flusher_lock:
+            if self._free_flusher is None or not self._free_flusher.is_alive():
+                self._free_flusher = threading.Thread(
+                    target=self._free_flush_loop, daemon=True,
+                    name="free-flusher",
+                )
+                self._free_flusher.start()
+
+    def _free_flush_loop(self):
+        while not self._shutdown:
+            time.sleep(0.1)
+            if not self._free_queue:
+                continue
+            batch, self._free_queue = self._free_queue, []
+            try:
+                self._send(P.FreeObjects(batch))
+            except (OSError, EOFError):
+                return
+
     def register_driver(self):
         """Synchronous client-driver registration: MUST be on the wire before
         any API request, or the controller's handshake closes the conn."""
@@ -153,6 +191,7 @@ class WorkerRuntime:
 
     def run(self):
         # Register with the controller, then serve the task loop.
+        self._ensure_free_flusher()
         if self.client_mode:
             # client driver: this loop only pumps replies; no tasks arrive
             # (registration already sent synchronously by _connect_client)
@@ -221,12 +260,17 @@ class WorkerRuntime:
             self._get_cv.notify_all()
 
     def _client_loop(self):
-        """Reply pump for client-driver mode."""
+        """Reply pump for client-driver mode. On connection loss the pump
+        re-dials the head (restart grace window): pending calls fail fast
+        with an error reply so callers can retry against the restored
+        cluster (reference: ray client reconnect grace period)."""
         while not self._shutdown:
             try:
                 msg = self.conn.recv()
             except (EOFError, OSError):
-                break
+                if self._shutdown or not self._client_reconnect():
+                    break
+                continue
             if isinstance(msg, (P.GetReply, P.PutAck, P.Reply)):
                 self._handle_reply(msg)
             elif isinstance(msg, P.Shutdown):
@@ -235,23 +279,54 @@ class WorkerRuntime:
         with self._get_cv:
             self._get_cv.notify_all()
 
+    def _client_reconnect(self, window_s: float = 30.0) -> bool:
+        if self.client_target is None:
+            return False
+        from multiprocessing.connection import Client
+
+        # fail all in-flight calls: their replies died with the old conn
+        # (epoch bump wakes _await_reply waiters, who raise and let callers
+        # retry against the restored head)
+        with self._get_cv:
+            self._conn_epoch += 1
+            self._get_cv.notify_all()
+        target, family, authkey = self.client_target
+        deadline = time.monotonic() + window_s
+        while time.monotonic() < deadline and not self._shutdown:
+            try:
+                conn = Client(target, family=family, authkey=authkey)
+                # swap + register atomically: another thread's request must
+                # not become the new connection's first message (the head
+                # closes conns whose first message isn't a Register*)
+                with self._send_lock:
+                    self.conn = conn
+                    conn.send(P.RegisterDriver(self.worker_id, os.getpid()))
+                return True
+            except (OSError, EOFError, ConnectionError):
+                time.sleep(1.0)
+        return False
+
     def _route_task(self, msg: P.ExecuteTask):
         spec = msg.spec
-        if spec.task_type == TaskType.ACTOR_TASK:
-            # concurrency is a property of the ACTOR (set at creation), not of
-            # the method-call spec — always route through the actor's pool
-            pool = self.actor_pools.get(spec.actor_id.binary())
-            if pool is not None:
-                pool.submit(self._execute_task, msg)
-                return
-        if spec.task_type == TaskType.ACTOR_TASK:
-            # async-ness is likewise an actor property; method-call specs
-            # don't carry is_async_actor
-            loop = self.actor_loops.get(spec.actor_id.binary())
-            if loop is not None:
-                asyncio.run_coroutine_threadsafe(self._execute_async(msg), loop)
-                return
-        self._task_pool.submit(self._execute_task, msg)
+        try:
+            if spec.task_type == TaskType.ACTOR_TASK:
+                # concurrency is a property of the ACTOR (set at creation),
+                # not of the method-call spec — route through the actor's pool
+                pool = self.actor_pools.get(spec.actor_id.binary())
+                if pool is not None:
+                    pool.submit(self._execute_task, msg)
+                    return
+                # async-ness is likewise an actor property; method-call
+                # specs don't carry is_async_actor
+                loop = self.actor_loops.get(spec.actor_id.binary())
+                if loop is not None:
+                    asyncio.run_coroutine_threadsafe(self._execute_async(msg), loop)
+                    return
+            self._task_pool.submit(self._execute_task, msg)
+        except RuntimeError:
+            # pool shut down: this worker is going away; the controller
+            # reschedules the task when the death is observed
+            pass
 
     # -------------------------------------------------------- object plane
 
@@ -259,19 +334,29 @@ class WorkerRuntime:
         """Returns [(SerializedObject, kind)] parallel to object_ids."""
         self._maybe_inject_failure("get_objects")
         req_id = next(self._req_counter)
+        epoch = self._conn_epoch
         self._send(P.GetObjects(req_id, object_ids))
-        results = self._await_reply(req_id, timeout)
+        results = self._await_reply(req_id, timeout, epoch=epoch)
         return [
             (self._materialize(kind, payload, object_id=oid), kind)
             for oid, kind, payload in results
         ]
 
-    def _await_reply(self, req_id: int, timeout=None):
+    def _await_reply(self, req_id: int, timeout=None, epoch=None):
+        """``epoch`` must be the _conn_epoch captured BEFORE the request was
+        sent — capturing at wait time would miss a reconnect that lands
+        between send and wait, leaving the waiter blocked forever."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._get_cv:
+            if epoch is None:
+                epoch = self._conn_epoch
             while req_id not in self._get_replies:
                 if self._shutdown:
                     raise OSError("worker shutting down")
+                if self._conn_epoch != epoch:
+                    # head connection was lost and re-dialed: this request's
+                    # reply died with the old connection
+                    raise OSError("connection to head lost (reconnected)")
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     raise TimeoutError("controller reply timed out")
@@ -281,18 +366,19 @@ class WorkerRuntime:
     def call_controller(self, op: str, payload=None, fire_and_forget: bool = False):
         self._maybe_inject_failure(op)
         req_id = next(self._req_counter)
+        epoch = self._conn_epoch
         self._send(P.Request(req_id, op, payload))
         if fire_and_forget:
             # Still consume the reply asynchronously to keep the table clean.
             def drain():
                 try:
-                    self._await_reply(req_id)
+                    self._await_reply(req_id, epoch=epoch)
                 except (OSError, TimeoutError):
                     pass
 
             threading.Thread(target=drain, daemon=True).start()
             return None
-        reply = self._await_reply(req_id)
+        reply = self._await_reply(req_id, epoch=epoch)
         if reply.error is not None:
             raise RuntimeError(f"controller call {op} failed: {reply.error}")
         return reply.payload
@@ -349,8 +435,9 @@ class WorkerRuntime:
                 if loc is None or loc[2] is None:
                     raise
                 req_id = next(self._req_counter)
+                epoch = self._conn_epoch
                 self._send(P.GetObjects(req_id, [ObjectID(loc[2])]))
-                results = self._await_reply(req_id, 30.0)
+                results = self._await_reply(req_id, 30.0, epoch=epoch)
                 _, kind, payload = results[0]
         raise ObjectRelocatedError(f"object kept relocating: {payload!r}")
 
@@ -405,12 +492,13 @@ class WorkerRuntime:
             self._push_object(object_id, sobj.to_bytes())
             return
         req_id = next(self._req_counter)
+        epoch = self._conn_epoch
         if sobj.total_bytes() <= self.max_inline:
             self._send(P.PutObject(req_id, object_id, "inline", sobj.to_bytes()))
         else:
             name, size = self._write_shm(object_id, sobj)
             self._send(P.PutObject(req_id, object_id, "plasma", (name, size)))
-        self._await_reply(req_id)
+        self._await_reply(req_id, epoch=epoch)
 
     def _push_object(
         self, object_id: ObjectID, data: bytes, chunk_bytes: int = 4 * 1024**2
@@ -622,8 +710,9 @@ class WorkerRuntime:
         payload = self._store_error(spec, exc)[0][2]
         oid = ObjectID.for_return(spec.task_id, count)
         req_id = next(self._req_counter)
+        epoch = self._conn_epoch
         self._send(P.PutObject(req_id, oid, "error", payload))
-        self._await_reply(req_id)
+        self._await_reply(req_id, epoch=epoch)
         return count
 
     def _stream_completion(self, spec: TaskSpec, count: int) -> list:

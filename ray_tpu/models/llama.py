@@ -555,6 +555,23 @@ def _pipeline_hidden(stacked, x, cfg: LlamaConfig, mesh: Mesh, pp: int, policy):
     return out.reshape(B, T, e), jnp.zeros((), jnp.float32)
 
 
+def _project_logits(x, params, cfg: LlamaConfig, mesh: Optional[Mesh]):
+    """Vocab projection shared by forward() and the training loss.
+
+    bf16 operands + fp32 accumulation: the MXU's native mode. Casting the
+    OPERANDS to fp32 would quarter matmul throughput on the vocab
+    projection (~20% of total train FLOPs) for no meaningful precision
+    gain — accumulation is fp32 either way."""
+    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    logits = jnp.einsum(
+        "bte,ev->btv", x, unembed.astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    if mesh is not None:
+        logits = with_sharding(mesh, logits, "batch", "seq", "vocab")
+    return logits
+
+
 def forward(
     params,
     tokens,
@@ -564,20 +581,7 @@ def forward(
 ):
     """tokens: [B, T] int32 -> logits [B, T, vocab] (fp32)."""
     x = forward_hidden(params, tokens, cfg, mesh, positions)
-    unembed = (
-        params["embed"].T if cfg.tie_embeddings else params["unembed"]
-    )
-    # bf16 operands + fp32 accumulation: the MXU's native mode. Casting the
-    # OPERANDS to fp32 would quarter matmul throughput on the vocab
-    # projection (~20% of total train FLOPs) for no meaningful precision
-    # gain — accumulation is fp32 either way.
-    logits = jnp.einsum(
-        "bte,ev->btv", x, unembed.astype(x.dtype),
-        preferred_element_type=jnp.float32,
-    )
-    if mesh is not None:
-        logits = with_sharding(mesh, logits, "batch", "seq", "vocab")
-    return logits
+    return _project_logits(x, params, cfg, mesh)
 
 
 def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
@@ -593,18 +597,13 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
         if mask is not None:
             mask = mask[:, 1:]
     x, aux = forward_hidden(params, tokens, cfg, mesh, with_aux=True)
-    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     if cfg.fused_ce:
         from ray_tpu.ops.cross_entropy import fused_cross_entropy
 
+        unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
         base = fused_cross_entropy(x, unembed, labels, mask=mask)
     else:
-        logits = jnp.einsum(
-            "bte,ev->btv", x, unembed.astype(x.dtype),
-            preferred_element_type=jnp.float32,
-        )
-        if mesh is not None:
-            logits = with_sharding(mesh, logits, "batch", "seq", "vocab")
+        logits = _project_logits(x, params, cfg, mesh)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         if mask is not None:

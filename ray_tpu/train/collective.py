@@ -1,0 +1,132 @@
+"""Train-loop collectives over the control plane.
+
+Reference: ``python/ray/train/collective/collectives.py`` —
+``broadcast_from_rank_zero`` (config/seed fan-out from the coordinator
+worker) and ``barrier``. These are CONTROL-plane collectives between the
+gang's worker processes; tensor collectives belong inside jitted programs
+(``psum``/``all_gather`` over the mesh) or ``ray_tpu.util.collective``.
+
+Transport: the cluster KV (GCS KV analog) keyed by the trial's identity +
+a per-worker call counter — every worker must call each collective the same
+number of times in the same order (the standard collective contract).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu.train.session import get_context
+
+_NS = "train-collective"
+_counters = threading.local()
+
+
+def _next_seq(kind: str) -> int:
+    key = f"{kind}_seq"
+    n = getattr(_counters, key, 0)
+    setattr(_counters, key, n + 1)
+    return n
+
+
+def _incarnation() -> str:
+    """A token identical across the gang but unique per (re)start, so a
+    restarted gang's collectives can never observe a previous incarnation's
+    keys (each start gets a fresh run_NNN storage dir)."""
+    from ray_tpu.train.session import _get_session
+
+    s = _get_session()
+    if s is not None and s.storage_dir:
+        import os
+
+        return os.path.basename(s.storage_dir.rstrip("/"))
+    return "run"
+
+
+def _kv_call(op: str, payload):
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller_call(op, payload)
+
+
+def broadcast_from_rank_zero(
+    data: Any = None, *, timeout_s: float = 300.0
+) -> Any:
+    """Rank 0 provides ``data``; every rank returns rank 0's value.
+
+    All ranks must call this collectively; non-zero ranks' ``data`` is
+    ignored (reference: ``collectives.py broadcast_from_rank_zero``)."""
+    ctx = get_context()
+    seq = _next_seq("bcast")
+    key = (
+        f"{ctx.experiment_name}/{ctx.trial_id}/{_incarnation()}/bcast/{seq}"
+    ).encode()
+    if ctx.world_rank == 0:
+        _kv_call("kv_put", (_NS, key, cloudpickle.dumps(data)))
+        _ack_and_cleanup(key, ctx, timeout_s)
+        return data
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        blob = _kv_call("kv_get", (_NS, key))
+        if blob is not None:
+            value = cloudpickle.loads(blob)
+            _kv_call("kv_put", (_NS, key + b"/ack/%d" % ctx.world_rank, b"1"))
+            return value
+        time.sleep(0.02)
+    raise TimeoutError(
+        f"broadcast_from_rank_zero: rank 0 never published (seq {seq})"
+    )
+
+
+def _ack_and_cleanup(key: bytes, ctx, timeout_s: float) -> None:
+    """Rank 0: wait for every peer's ack, then drop the payload keys so a
+    long-running job does not grow the KV unboundedly."""
+    deadline = time.monotonic() + timeout_s
+    needed = set(range(1, ctx.world_size))
+    while needed and time.monotonic() < deadline:
+        needed = {
+            r
+            for r in needed
+            if _kv_call("kv_get", (_NS, key + b"/ack/%d" % r)) is None
+        }
+        if needed:
+            time.sleep(0.02)
+    _kv_call("kv_del", (_NS, key))
+    for r in range(1, ctx.world_size):
+        _kv_call("kv_del", (_NS, key + b"/ack/%d" % r))
+
+
+def barrier(*, timeout_s: float = 300.0) -> None:
+    """Block until every worker in the gang reaches this barrier
+    (reference: ``collectives.py barrier``).
+
+    Two phases: arrive (each rank writes its key; rank 0 polls for all),
+    then release via ``broadcast_from_rank_zero`` — whose ack protocol both
+    guarantees every rank saw the release AND lets rank 0 reap all keys, so
+    the KV never grows with barrier traffic."""
+    ctx = get_context()
+    if ctx.world_size <= 1:
+        return
+    seq = _next_seq("barrier")
+    base = (
+        f"{ctx.experiment_name}/{ctx.trial_id}/{_incarnation()}/barrier/{seq}"
+    ).encode()
+    _kv_call("kv_put", (_NS, base + b"/%d" % ctx.world_rank, b"1"))
+    if ctx.world_rank == 0:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            present = _kv_call("kv_keys", (_NS, base + b"/"))
+            if len(present) >= ctx.world_size:
+                break
+            time.sleep(0.02)
+        else:
+            raise TimeoutError(f"barrier timed out (seq {seq})")
+    broadcast_from_rank_zero(
+        "release" if ctx.world_rank == 0 else None, timeout_s=timeout_s
+    )
+    if ctx.world_rank == 0:
+        for r in range(ctx.world_size):
+            _kv_call("kv_del", (_NS, base + b"/%d" % r))

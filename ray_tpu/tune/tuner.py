@@ -108,6 +108,93 @@ class TuneController:
         self.trials: list[Trial] = []
         self._exhausted = False
         self.resources = dict(getattr(trainable, "_tune_resources", {"CPU": 1}))
+        self._last_snapshot_t = 0.0
+
+    # -- experiment-level fault tolerance ------------------------------------
+
+    def save_state(self, throttle_s: float = 2.0):
+        """Write the experiment snapshot (reference: the TuneController
+        experiment checkpoints behind ``Tuner.restore``,
+        ``tune/execution/tune_controller.py:68``). Trial table + enough of
+        the tune spec to resume after driver death."""
+        import pickle
+
+        import cloudpickle
+
+        now = time.monotonic()
+        if throttle_s and now - self._last_snapshot_t < throttle_s:
+            return
+        self._last_snapshot_t = now
+        state = {
+            "version": 1,
+            "trainable_blob": cloudpickle.dumps(self.trainable),
+            "param_space": self.param_space,
+            "metric": self.tune_config.metric,
+            "mode": self.tune_config.mode,
+            "num_samples": self.tune_config.num_samples,
+            "max_concurrent_trials": self.tune_config.max_concurrent_trials,
+            "run_config_blob": cloudpickle.dumps(self.run_config),
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "status": t.status.value,
+                    "iteration": t.iteration,
+                    "last_result": t.last_result,
+                    "metrics_history": t.metrics_history,
+                    "checkpoint_dir": t.checkpoint.path if t.checkpoint else None,
+                    "num_failures": t.num_failures,
+                    "num_starts": t.num_starts,
+                    "error": t.error,
+                    "resources": t.resources,
+                }
+                for t in self.trials
+            ],
+        }
+        path = os.path.join(self.experiment_dir, "experiment_state.pkl")
+        tmp = path + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f)
+            os.replace(tmp, path)
+        except OSError:
+            logger.warning("experiment snapshot failed", exc_info=True)
+
+    def restore_trials(self, saved_trials: list[dict]):
+        """Rebuild the trial table from a snapshot; interrupted trials
+        (RUNNING/PAUSED/PENDING at crash time) restart from their last
+        checkpoint. The searcher is fast-forwarded so it does not re-suggest
+        restored configs."""
+        for entry in saved_trials:
+            t = Trial(
+                entry["trial_id"],
+                entry["config"],
+                os.path.join(self.experiment_dir, entry["trial_id"]),
+                entry.get("resources") or self.resources,
+            )
+            t.iteration = entry["iteration"]
+            t.last_result = entry["last_result"]
+            t.metrics_history = entry["metrics_history"]
+            t.num_failures = entry["num_failures"]
+            t.num_starts = entry["num_starts"]
+            t.error = entry["error"]
+            if entry["checkpoint_dir"]:
+                t.checkpoint = Checkpoint(entry["checkpoint_dir"])
+            status = TrialStatus(entry["status"])
+            # fast-forward the searcher: consume one suggestion per restored
+            # trial (discarding it — the SAVED config is authoritative)
+            self.searcher.suggest(t.trial_id)
+            if status in (TrialStatus.TERMINATED, TrialStatus.ERROR):
+                t.status = status
+                self.searcher.on_trial_complete(
+                    t.trial_id, t.last_result, error=status is TrialStatus.ERROR
+                )
+            else:
+                # interrupted mid-flight: resume from the last checkpoint
+                t.status = TrialStatus.PENDING
+                t.restore_checkpoint = t.checkpoint
+            self.trials.append(t)
+            self.scheduler.on_trial_add(t)
 
     # -- trial lifecycle ----------------------------------------------------
 
@@ -186,7 +273,13 @@ class TuneController:
             # top up to the concurrency cap: scheduler-promoted paused
             # trials (HyperBand rung winners) resume before new trials start
             while len(running) < self._max_concurrent():
-                t = self.scheduler.choose_trial_to_run(self.trials, exhausted=self._exhausted)
+                # restored (interrupted) trials resume before anything new
+                t = next(
+                    (x for x in self.trials if x.status is TrialStatus.PENDING),
+                    None,
+                )
+                if t is None:
+                    t = self.scheduler.choose_trial_to_run(self.trials, exhausted=self._exhausted)
                 if t is None:
                     if self._exhausted:
                         break
@@ -225,7 +318,9 @@ class TuneController:
                 continue
             self._poll_running(running)
             self._drain_scheduler_stops()
+            self.save_state()
             time.sleep(poll_interval)
+        self.save_state(throttle_s=0)
         return self.trials
 
     def _drain_scheduler_stops(self):
@@ -339,10 +434,63 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
 
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        trainable: Optional[Callable] = None,
+        *,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ) -> "Tuner":
+        """Resume an experiment after driver death (reference:
+        ``Tuner.restore`` over TuneController experiment snapshots,
+        ``tune/execution/tune_controller.py:68``). ``path`` is the
+        experiment directory. Finished trials keep their results;
+        interrupted trials restart from their last checkpoint; the searcher
+        continues from where the sweep stopped. Pass ``trainable`` when the
+        saved one isn't importable in this process; pass ``tune_config`` to
+        reattach a custom scheduler/searcher (their internal state restarts
+        fresh — trial fast-forwarding keeps suggestions consistent)."""
+        import pickle
+
+        import cloudpickle
+
+        state_path = os.path.join(os.path.expanduser(path), "experiment_state.pkl")
+        with open(state_path, "rb") as f:
+            state = pickle.load(f)
+        if trainable is None:
+            trainable = cloudpickle.loads(state["trainable_blob"])
+        if run_config is None:
+            run_config = cloudpickle.loads(state["run_config_blob"])
+        if tune_config is None:
+            tune_config = TuneConfig(
+                metric=state["metric"],
+                mode=state["mode"],
+                num_samples=state["num_samples"],
+                max_concurrent_trials=state["max_concurrent_trials"],
+            )
+        tuner = cls(
+            trainable,
+            param_space=state["param_space"],
+            tune_config=tune_config,
+            run_config=run_config,
+        )
+        tuner._restore_dir = os.path.expanduser(path)
+        tuner._restore_trials = state["trials"]
+        return tuner
+
     def fit(self) -> ResultGrid:
-        name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
-        self.run_config.name = name
-        exp_dir = os.path.join(os.path.expanduser(self.run_config.storage_path), name)
+        restore_dir = getattr(self, "_restore_dir", None)
+        if restore_dir is not None:
+            exp_dir = restore_dir
+            self.run_config.name = self.run_config.name or os.path.basename(exp_dir)
+        else:
+            name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+            self.run_config.name = name
+            exp_dir = os.path.join(
+                os.path.expanduser(self.run_config.storage_path), name
+            )
         os.makedirs(exp_dir, exist_ok=True)
         controller = TuneController(
             self.trainable,
@@ -351,6 +499,8 @@ class Tuner:
             self.run_config,
             exp_dir,
         )
+        if restore_dir is not None:
+            controller.restore_trials(getattr(self, "_restore_trials", []))
         trials = controller.run()
         results = [
             TrialResult(

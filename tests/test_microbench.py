@@ -6,6 +6,7 @@ rates on this 1-CPU host — loose enough to survive CI noise, tight enough to
 catch an order-of-magnitude control-plane regression.
 """
 
+import os
 import time
 
 import pytest
@@ -90,3 +91,45 @@ def test_compiled_dag_floor(ray_start_thread):
         assert _rate(lambda: ray_tpu.get(compiled.execute(1))) > 100
     finally:
         compiled.teardown()
+
+
+@pytest.mark.slow
+def test_envelope_no_queue_cliff():
+    """Per-task cost must stay roughly flat as the queue deepens: the
+    shape-indexed scheduler + waiter-based store keep rounds O(shapes)
+    (reference envelope row: 1M+ queued tasks on one node)."""
+    import subprocess
+    import sys
+    import json as _json
+
+    code = (
+        "import json\n"
+        "import time\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=8, mode='thread')\n"
+        "@ray_tpu.remote(num_cpus=0)\n"
+        "def tick(i):\n"
+        "    return i\n"
+        "rows = {}\n"
+        "for depth in (2000, 40000):\n"
+        "    t0 = time.perf_counter()\n"
+        "    refs = [tick.remote(i) for i in range(depth)]\n"
+        "    out = ray_tpu.get(refs, timeout=900)\n"
+        "    assert out[-1] == depth - 1\n"
+        "    rows[depth] = depth / (time.perf_counter() - t0)\n"
+        "ray_tpu.shutdown()\n"
+        "print('ENVELOPE ' + json.dumps(rows))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=900, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("ENVELOPE")][0]
+    rows = _json.loads(line.split(" ", 1)[1])
+    small, big = rows["2000"], rows["40000"]
+    # 20x deeper queue must not cost more than ~3x per task (a quadratic
+    # scheduler/store would be ~20x slower)
+    assert big > small / 3, f"queue cliff: {small:.0f}/s @2k vs {big:.0f}/s @40k"

@@ -17,6 +17,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

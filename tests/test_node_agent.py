@@ -24,9 +24,12 @@ def _native_available():
     return plasma.available()
 
 
-pytestmark = pytest.mark.skipif(
-    not _native_available(), reason="node agents require the native store"
-)
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not _native_available(), reason="node agents require the native store"
+    ),
+]
 
 
 def _start_agent(tcp_address, authkey_hex, base_dir, resources,

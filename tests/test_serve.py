@@ -287,14 +287,22 @@ def test_abandoned_stream_releases_producer(serve_instance):
     del gen
     gc.collect()
 
+    # success = the -1 marker was set (producer told to stop) OR the
+    # producer already acted on it and finished (the marker is popped when
+    # its task completes — observing either proves the release worked)
     controller = global_worker().controller
     deadline = time.monotonic() + 30
+    released = False
     while time.monotonic() < deadline:
-        if controller._stream_consumed.get(task_id) == -1:
+        marker = controller._stream_consumed.get(task_id)
+        producer_done = task_id not in controller.pending_by_id
+        if marker == -1 or (producer_done and marker is None):
+            released = True
             break
         time.sleep(0.2)
-    assert controller._stream_consumed.get(task_id) == -1, (
-        "consumer-gone marker never set: producer still pinned by drainer"
+    assert released, (
+        f"producer never released: marker={controller._stream_consumed.get(task_id)}, "
+        f"pending={task_id in controller.pending_by_id}"
     )
     # in-flight count released → P2C routing sees an idle replica again
     deadline = time.monotonic() + 15

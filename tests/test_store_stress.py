@@ -18,7 +18,7 @@ import pytest
 
 from ray_tpu._native import plasma as native_plasma
 
-pytestmark = pytest.mark.timeout(300) if hasattr(pytest.mark, "timeout") else []
+pytestmark = [pytest.mark.slow]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -356,3 +356,104 @@ def test_gp_searcher_beats_random_on_smooth_objective(ray_start_thread, run_cfg)
     assert len(near) >= len(post) // 2, xs
     best = results.get_best_result(metric="score", mode="max")
     assert abs(best.config["x"] - 0.7) < 0.1, best.config
+
+
+def test_tuner_restore_after_driver_death(tmp_path):
+    """Kill the driver mid-sweep; Tuner.restore(dir) finishes the remaining
+    trials and keeps completed results (reference: Tuner.restore over
+    experiment snapshots, tune/execution/tune_controller.py:68)."""
+    import json
+    import subprocess
+    import sys
+
+    exp_root = tmp_path / "results"
+    marker = tmp_path / "progress"
+    marker.mkdir()
+    code = f"""
+import os, time
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import Checkpoint, RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+
+ray_tpu.init(num_cpus=2, mode="thread")
+
+def trainable(config):
+    for i in range(40):
+        open(os.path.join({str(marker)!r}, f"{{config['x']}}-{{i}}"), "w").close()
+        tune.report(
+            {{"score": config["x"] * (i + 1), "training_iteration": i + 1}},
+            checkpoint=Checkpoint.from_pytree({{"i": i, "x": config["x"]}}),
+        )
+        time.sleep(0.3)
+
+Tuner(
+    trainable,
+    param_space={{"x": tune.grid_search([1, 2, 3, 4])}},
+    tune_config=TuneConfig(metric="score", mode="max", max_concurrent_trials=2),
+    run_config=RunConfig(name="resume-exp", storage_path={str(exp_root)!r}),
+).fit()
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env)
+    # wait until the sweep is visibly mid-flight, then kill -9 the driver
+    import time as _time
+
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline:
+        if len(list(marker.iterdir())) >= 4:
+            break
+        _time.sleep(0.2)
+    proc.kill()
+    proc.wait()
+
+    exp_dir = exp_root / "resume-exp"
+    assert (exp_dir / "experiment_state.pkl").exists()
+
+    # resume in a fresh "driver" (this process)
+    ray_tpu.init(num_cpus=4, mode="thread", ignore_reinit_error=True)
+    try:
+        def trainable(config):
+            for i in range(3):  # shorter finish: just prove trials complete
+                tune.report({"score": config["x"] * 100 + i,
+                             "training_iteration": i + 1})
+
+        results = Tuner.restore(str(exp_dir), trainable).fit()
+        assert len(results) == 4  # the full grid, restored + newly created
+        assert all(r.error is None for r in results)
+        xs = sorted(r.config["x"] for r in results)
+        assert xs == [1, 2, 3, 4]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_broadcast_from_rank_zero_and_barrier(tmp_path):
+    """Gang workers fan out rank 0's value (reference:
+    train/collective/collectives.py)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ray_tpu.init(num_cpus=4, mode="thread", ignore_reinit_error=True)
+    try:
+        def loop():
+            from ray_tpu.train import collective
+            from ray_tpu.train.session import get_context
+
+            ctx = get_context()
+            value = collective.broadcast_from_rank_zero(
+                {"seed": 1234} if ctx.world_rank == 0 else None
+            )
+            collective.barrier()
+            assert value == {"seed": 1234}
+            from ray_tpu import train as train_api
+            train_api.report({"seed": value["seed"], "rank": ctx.world_rank})
+
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(storage_path=str(tmp_path / "bc")),
+        )
+        result = trainer.fit()
+        assert result.error is None
+    finally:
+        ray_tpu.shutdown()
