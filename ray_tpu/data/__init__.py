@@ -8,6 +8,7 @@ device-put) as the TPU-native consumption path.
 
 from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.context import DataContext
+from ray_tpu.data.execution import ActorPoolStrategy
 from ray_tpu.data.dataset import (
     Dataset,
     GroupedData,
@@ -36,6 +37,7 @@ __all__ = [
     "Block",
     "BlockAccessor",
     "DataContext",
+    "ActorPoolStrategy",
     "DataIterator",
     "Dataset",
     "Datasource",

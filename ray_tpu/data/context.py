@@ -13,8 +13,12 @@ class DataContext:
 
     target_max_block_size: int = 128 * 1024 * 1024
     target_min_block_size: int = 1 * 1024 * 1024
-    # backpressure: max concurrently running block tasks per stage
+    # backpressure: per-stage in-flight task caps. Each stage adapts its cap
+    # inside [min, max] by observed starvation: a consumer blocking on an
+    # unfinished head grows the cap; a stage running ahead shrinks it
+    # (reference: ``_internal/execution/backpressure_policy/``).
     max_tasks_in_flight: int = 8
+    min_tasks_in_flight: int = 2
     # rows per read task when a datasource doesn't decide for itself
     default_read_block_size: int = 1000
     preserve_order: bool = True

@@ -40,9 +40,16 @@ class Dataset:
         batch_size: Optional[int] = None,
         batch_format: Optional[str] = "numpy",
         fn_kwargs: Optional[dict] = None,
+        compute=None,
         **_ignored,
     ) -> "Dataset":
-        return self._with(L.MapBatches(fn, batch_size, batch_format, fn_kwargs))
+        """``compute=ActorPoolStrategy(...)`` runs the UDF on a warm actor
+        pool — classes are instantiated once per actor and reused across
+        batches (stateful UDFs, e.g. a model loaded once; reference:
+        ``ActorPoolStrategy``, ``python/ray/data/_internal/compute.py``)."""
+        return self._with(
+            L.MapBatches(fn, batch_size, batch_format, fn_kwargs, compute=compute)
+        )
 
     def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
         return self._with(L.Filter(fn))
@@ -89,6 +96,27 @@ class Dataset:
 
     def union(self, *others: "Dataset") -> "Dataset":
         return self._with(L.Union([o._plan for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Positional column concat: row i of the result has this dataset's
+        columns plus ``other``'s (collisions suffixed ``_1``). Both sides
+        must have the same number of rows (reference: ``Dataset.zip``)."""
+        return self._with(L.Zip(other._plan))
+
+    def join(
+        self,
+        other: "Dataset",
+        on: str,
+        *,
+        how: str = "inner",
+        suffix: str = "_right",
+    ) -> "Dataset":
+        """Hash join on column ``on`` (``how``: inner | left). Runs as a
+        two-phase hash-partition exchange — every row of one key lands in
+        one bucket, joined locally (reference: ``Dataset.join``)."""
+        if how not in ("inner", "left"):
+            raise ValueError(f"how must be 'inner' or 'left', got {how!r}")
+        return self._with(L.Join(other._plan, on, how, suffix))
 
     # -- consumption (eager) ------------------------------------------------
 

@@ -235,6 +235,130 @@ def _sample_block(block, key, k):
     return np.sort(np.asarray(block[key])[idx])
 
 
+@ray_tpu.remote
+def _zip_blocks(left, right):
+    """Column-concat two row-aligned blocks; collisions get a ``_1`` suffix
+    (reference zip semantics)."""
+    la = BlockAccessor.normalize(left)
+    ra = BlockAccessor.normalize(right)
+    out = dict(la)
+    for k, v in ra.items():
+        out[k if k not in out else f"{k}_1"] = v
+    return out
+
+
+@ray_tpu.remote
+def _join_bucket(on, how, suffix, n_left, *blocks):
+    """Join one hash bucket: first ``n_left`` blocks are the left side.
+
+    Inner/left hash join with numpy: every row of a key is in this bucket
+    on both sides, so the join is complete locally."""
+    left = BlockAccessor.concat(
+        [BlockAccessor.normalize(b) for b in blocks[:n_left]]
+    )
+    right = BlockAccessor.concat(
+        [BlockAccessor.normalize(b) for b in blocks[n_left:]]
+    )
+    if not left:
+        return {}
+    lacc = BlockAccessor.for_block(left)
+    if not right:
+        if how == "left":
+            return left
+        return {}
+    lk = np.asarray(left[on])
+    rk = np.asarray(right[on])
+    # index right rows by key
+    r_order = np.argsort(rk, kind="stable")
+    rk_sorted = rk[r_order]
+    starts = np.searchsorted(rk_sorted, lk, side="left")
+    ends = np.searchsorted(rk_sorted, lk, side="right")
+    # vectorized match expansion: left row i repeats once per right match
+    counts = ends - starts
+    total = int(counts.sum())
+    li = np.repeat(np.arange(len(lk)), counts)
+    run_starts = np.cumsum(counts) - counts  # output offset of row i's run
+    pos = np.arange(total) - np.repeat(run_starts, counts) + np.repeat(
+        starts, counts
+    )
+    ri = r_order[pos] if total else np.asarray([], dtype=np.int64)
+    unmatched = np.nonzero(counts == 0)[0].tolist() if how == "left" else []
+    lsel = lacc.take_indices(li.astype(np.int64))
+    racc = BlockAccessor.for_block(right)
+    rsel = racc.take_indices(np.asarray(ri, dtype=np.int64))
+    out = dict(BlockAccessor.normalize(lsel))
+    n_rows = total
+    for k, v in BlockAccessor.normalize(rsel).items():
+        if k == on:
+            continue
+        out[k if k not in out else f"{k}{suffix}"] = v
+    if how == "left" and unmatched:
+        lun = BlockAccessor.normalize(
+            lacc.take_indices(np.asarray(unmatched, dtype=np.int64))
+        )
+        pad = dict(lun)
+        for k, v in BlockAccessor.normalize(rsel).items():
+            if k == on:
+                continue
+            name = k if k not in lun else f"{k}{suffix}"
+            arr = np.asarray(v)
+            shape = (len(unmatched),) + arr.shape[1:]
+            if np.issubdtype(arr.dtype, np.floating):
+                fill = np.full(shape, np.nan, dtype=arr.dtype)
+            elif np.issubdtype(arr.dtype, np.integer):
+                fill = np.full(shape, np.nan, dtype=np.float64)
+            else:
+                # strings/bools/objects: a None sentinel, never a
+                # fabricated value indistinguishable from real data
+                fill = np.full(shape, None, dtype=object)
+            pad[name] = fill
+        if n_rows:
+            return BlockAccessor.concat([out, pad])
+        return pad
+    return out
+
+
+class _TransformActor:
+    """Warm per-actor transform executor: the fused op chain (with its
+    stateful callables) is built ONCE per actor (reference: the actor-pool
+    map operator — UDF classes construct in the actor, not per batch)."""
+
+    def __init__(self, transforms_blob: bytes):
+        import cloudpickle
+
+        transforms = cloudpickle.loads(transforms_blob)
+        # callable classes instantiate once here
+        self._transforms = []
+        for op in transforms:
+            fn = op.fn if hasattr(op, "fn") else None
+            if isinstance(fn, type):
+                op.fn = fn()
+            self._transforms.append(op)
+
+    def apply(self, block):
+        return _apply_transforms(block, self._transforms)
+
+
+class ActorPoolStrategy:
+    """Compute strategy for ``map_batches``: a warm, autoscaling actor pool
+    (reference: ``python/ray/data/_internal/compute.py`` ActorPoolStrategy).
+    """
+
+    def __init__(
+        self,
+        size: Optional[int] = None,
+        *,
+        min_size: int = 1,
+        max_size: Optional[int] = None,
+        resources: Optional[dict] = None,
+    ):
+        if size is not None:
+            min_size = max_size = size
+        self.min_size = max(1, min_size)
+        self.max_size = max_size or max(self.min_size, 4)
+        self.resources = resources or {}
+
+
 # -- streaming driver --------------------------------------------------------
 
 
@@ -258,6 +382,12 @@ def _transform_submits(refs, transforms):
         yield lambda r=r: _transform_block.remote(r, transforms)
 
 
+def _same_compute(a, b) -> bool:
+    """Fusable iff both task-compute (None); actor pools never fuse with a
+    neighbor (each pool's actors hold different state)."""
+    return a is None and b is None
+
+
 class StreamingExecutor:
     def __init__(self, ctx: Optional[DataContext] = None):
         self.ctx = ctx or DataContext.get_current()
@@ -267,12 +397,20 @@ class StreamingExecutor:
     def _stream_stage(
         self, submit_iter: Iterator[Callable[[], Any]]
     ) -> Iterator[Any]:
-        """Dispatch tasks with an in-flight cap; yield refs in order."""
-        cap = self.ctx.max_tasks_in_flight
+        """Dispatch tasks with an ADAPTIVE in-flight cap; yield refs in
+        order. The cap moves inside [min, max]: a starved consumer (head
+        not finished when popped) grows it; a stage consistently ahead
+        shrinks it, releasing cluster capacity to slower stages
+        (reference: per-op backpressure policies,
+        ``_internal/execution/backpressure_policy/``)."""
+        from ray_tpu.object_ref import ObjectRef, ObjectRefGenerator
+
+        max_cap = self.ctx.max_tasks_in_flight
+        cap = max(self.ctx.min_tasks_in_flight, min(4, max_cap))
+        ahead_streak = 0
         pending: deque = deque()
         exhausted = False
         it = iter(submit_iter)
-        from ray_tpu.object_ref import ObjectRefGenerator
 
         while pending or not exhausted:
             while not exhausted and len(pending) < cap:
@@ -282,12 +420,74 @@ class StreamingExecutor:
                     exhausted = True
             if pending:
                 head = pending.popleft()
+                if isinstance(head, ObjectRef):
+                    ready, _ = ray_tpu.wait([head], num_returns=1, timeout=0)
+                    if not ready:
+                        # consumer starved: widen the pipeline
+                        cap = min(cap * 2, max_cap)
+                        ahead_streak = 0
+                    else:
+                        ahead_streak += 1
+                        if ahead_streak >= 2 * cap and cap > self.ctx.min_tasks_in_flight:
+                            cap = max(cap - 1, self.ctx.min_tasks_in_flight)
+                            ahead_streak = 0
                 if isinstance(head, ObjectRefGenerator):
                     # streaming read task: its block refs flatten into the
                     # stage output in production order
                     yield from head
                 else:
                     yield head
+
+    def _actor_pool_stage(
+        self, stream: Iterator[Any], transforms: list, strategy: "ActorPoolStrategy"
+    ) -> Iterator[Any]:
+        """Run a fused transform chain on a warm actor pool: blocks go to
+        idle actors; outputs yield in input order. The pool autoscales
+        between min_size and max_size — a new actor spawns when every actor
+        is busy and input is waiting (reference: the autoscaling actor pool,
+        ``_internal/execution/operators/actor_pool_map_operator.py``)."""
+        import cloudpickle
+
+        blob = cloudpickle.dumps(transforms)
+        cls = ray_tpu.remote(_TransformActor)
+        opts = {"num_cpus": 1, **({"resources": strategy.resources} if strategy.resources else {})}
+        actors = [cls.options(**opts).remote(blob) for _ in range(strategy.min_size)]
+        inflight: dict[int, int] = {i: 0 for i in range(len(actors))}
+        pending: deque = deque()  # (ref, actor_idx) in input order
+        per_actor = 2  # pipeline depth per actor
+        it = iter(stream)
+        exhausted = False
+        try:
+            while pending or not exhausted:
+                while not exhausted and len(pending) < per_actor * len(actors):
+                    idx = min(inflight, key=inflight.get)
+                    try:
+                        block_ref = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    ref = actors[idx].apply.remote(block_ref)
+                    inflight[idx] += 1
+                    pending.append((ref, idx))
+                if (
+                    not exhausted
+                    and len(actors) < strategy.max_size
+                    and len(pending) >= per_actor * len(actors)
+                ):
+                    # every actor saturated with more input waiting: grow
+                    actors.append(cls.options(**opts).remote(blob))
+                    inflight[len(actors) - 1] = 0
+                    continue
+                if pending:
+                    ref, idx = pending.popleft()
+                    yield ref
+                    inflight[idx] -= 1
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def execute(self, plan: L.LogicalPlan) -> Iterator[Any]:
         """Returns an iterator of block refs."""
@@ -297,8 +497,12 @@ class StreamingExecutor:
         while i < len(ops):
             op = ops[i]
             if isinstance(op, (L.Read, L.InputBlocks)):
-                # fuse following per-block ops into the read tasks
-                transforms, i = self._collect_fused(ops, i + 1)
+                # fuse following per-block ops into the read tasks (task
+                # compute only; actor-pool segments become their own stages)
+                segments, i = self._collect_segments(ops, i + 1)
+                head_transforms = []
+                if segments and segments[0][0] is None:
+                    head_transforms = segments.pop(0)[1]
                 if isinstance(op, L.Read):
                     parallelism = op.parallelism
                     if parallelism in (-1, None):
@@ -309,21 +513,24 @@ class StreamingExecutor:
                     stream = self._stream_stage(
                         _read_submits(
                             tasks,
-                            transforms,
+                            head_transforms,
                             backpressure=self.ctx.max_tasks_in_flight,
                         )
                     )
                 else:
                     refs = op.refs
-                    if transforms:
+                    if head_transforms:
                         stream = self._stream_stage(
-                            _transform_submits(refs, transforms)
+                            _transform_submits(refs, head_transforms)
                         )
                     else:
                         stream = iter(refs)
+                for compute, transforms in segments:
+                    stream = self._make_stage(stream, compute, transforms)
             elif op.is_per_block():
-                transforms, i = self._collect_fused(ops, i)
-                stream = self._stream_stage(_transform_submits(stream, transforms))
+                segments, i = self._collect_segments(ops, i)
+                for compute, transforms in segments:
+                    stream = self._make_stage(stream, compute, transforms)
             elif isinstance(op, L.Limit):
                 stream = self._apply_limit(stream, op.n)
                 i += 1
@@ -335,6 +542,14 @@ class StreamingExecutor:
                 i += 1
             elif isinstance(op, L.Sort):
                 stream = iter(self._sort(list(stream), op.key, op.descending))
+                i += 1
+            elif isinstance(op, L.Zip):
+                stream = iter(self._zip(list(stream), op.other))
+                i += 1
+            elif isinstance(op, L.Join):
+                stream = iter(
+                    self._join(list(stream), op.other, op.on, op.how, op.suffix)
+                )
                 i += 1
             elif isinstance(op, L.Union):
                 head = stream
@@ -358,6 +573,27 @@ class StreamingExecutor:
             transforms.append(ops[i])
             i += 1
         return transforms, i
+
+    def _collect_segments(self, ops, start) -> tuple[list, int]:
+        """Consecutive per-block ops grouped by compute strategy:
+        [(None | ActorPoolStrategy, [transforms])] — same-compute neighbors
+        fuse; a compute change breaks fusion (reference:
+        ``OperatorFusionRule`` fuses only same-compute map operators)."""
+        segments: list = []
+        i = start
+        while i < len(ops) and ops[i].is_per_block():
+            compute = getattr(ops[i], "compute", None)
+            if segments and _same_compute(segments[-1][0], compute):
+                segments[-1][1].append(ops[i])
+            else:
+                segments.append((compute, [ops[i]]))
+            i += 1
+        return segments, i
+
+    def _make_stage(self, stream, compute, transforms):
+        if compute is None:
+            return self._stream_stage(_transform_submits(stream, transforms))
+        return self._actor_pool_stage(stream, transforms, compute)
 
     # .. all-to-all stages ..................................................
 
@@ -410,6 +646,57 @@ class StreamingExecutor:
             )
             for r in range(n)
         ]
+
+    def _zip(self, refs: list, other_plan) -> list:
+        """Row-align the other side to this side's block boundaries, then
+        column-concat pairwise (reference: ``Dataset.zip``)."""
+        other_refs = list(StreamingExecutor(self.ctx).execute(other_plan))
+        counts = ray_tpu.get([_count_rows.remote(r) for r in refs])
+        other_counts = ray_tpu.get([_count_rows.remote(r) for r in other_refs])
+        if sum(counts) != sum(other_counts):
+            raise ValueError(
+                f"zip requires equal row counts: {sum(counts)} vs "
+                f"{sum(other_counts)}"
+            )
+        # slice the other side to this side's row ranges
+        bounds = np.cumsum([0] + counts)
+        pieces: list[list] = [[] for _ in refs]
+        offset = 0
+        for ref, cnt in zip(other_refs, other_counts):
+            for j in range(len(refs)):
+                s = max(bounds[j] - offset, 0)
+                e = min(bounds[j + 1] - offset, cnt)
+                if e > s:
+                    pieces[j].append(_slice_block.remote(ref, int(s), int(e)))
+            offset += cnt
+        aligned = [
+            _concat_blocks.remote(*p) if p else ray_tpu.put({}) for p in pieces
+        ]
+        return [
+            _zip_blocks.remote(l, r) for l, r in zip(refs, aligned)
+        ]
+
+    def _join(self, refs: list, other_plan, on, how, suffix) -> list:
+        """Two-phase hash join: both sides hash-partition on the key (same
+        exchange as the distributed groupby), then each bucket joins
+        locally."""
+        other_refs = list(StreamingExecutor(self.ctx).execute(other_plan))
+        n = max(len(refs), len(other_refs), 1)
+        part = ray_tpu.remote(_hash_partition).options(num_returns=n)
+        l_buckets = [part.remote(r, on, n) for r in refs]
+        r_buckets = [part.remote(r, on, n) for r in other_refs]
+
+        def bucket(b, j):
+            return b[j] if n > 1 else b
+
+        out = []
+        for j in range(n):
+            lparts = [bucket(b, j) for b in l_buckets]
+            rparts = [bucket(b, j) for b in r_buckets]
+            out.append(
+                _join_bucket.remote(on, how, suffix, len(lparts), *lparts, *rparts)
+            )
+        return out
 
     def _sort(self, refs: list, key: str, descending: bool) -> list:
         if not refs:

@@ -43,11 +43,15 @@ class MapBatches(LogicalOp):
     name = "MapBatches"
 
     def __init__(self, fn: Callable, batch_size: Optional[int], batch_format: Optional[str],
-                 fn_kwargs: Optional[dict] = None):
+                 fn_kwargs: Optional[dict] = None, compute: Any = None):
         self.fn = fn
         self.batch_size = batch_size
         self.batch_format = batch_format
         self.fn_kwargs = fn_kwargs or {}
+        # None = stateless tasks; ActorPoolStrategy = warm actor pool
+        # (stateful UDFs, e.g. models loaded once per actor — reference:
+        # ``python/ray/data/_internal/compute.py`` ActorPoolStrategy)
+        self.compute = compute
 
     def is_per_block(self) -> bool:
         return True
@@ -117,6 +121,30 @@ class Union(LogicalOp):
 
     def __init__(self, others: list):  # list[LogicalPlan]
         self.others = others
+
+
+class Zip(LogicalOp):
+    """Positional column concatenation with another dataset (reference:
+    ``Dataset.zip``, ``python/ray/data/dataset.py``)."""
+
+    name = "Zip"
+
+    def __init__(self, other):  # LogicalPlan
+        self.other = other
+
+
+class Join(LogicalOp):
+    """Hash join on a key column (reference: ``Dataset.join``) — built on
+    the same hash-partition exchange as the distributed groupby."""
+
+    name = "Join"
+
+    def __init__(self, other, on: str, how: str = "inner",
+                 suffix: str = "_right"):
+        self.other = other  # LogicalPlan
+        self.on = on
+        self.how = how
+        self.suffix = suffix
 
 
 class LogicalPlan:

@@ -46,6 +46,11 @@ class ProcessorConfig:
     prompt_column: str = "prompt"
     output_column: str = "generated_text"
     sampling_params: Optional[dict] = None
+    # Engine replicas: >0 runs the inference stage on a warm actor pool of
+    # this size (each actor holds ONE engine for its lifetime — the
+    # reference's vLLM stage actors); 0 = stateless tasks with the
+    # per-process engine cache.
+    concurrency: int = 1
 
 
 def build_llm_processor(
@@ -83,8 +88,16 @@ def build_llm_processor(
     def apply(ds):
         if preprocess is not None:
             ds = ds.map(preprocess)
+        compute = None
+        if config.concurrency and config.concurrency > 0:
+            from ray_tpu.data import ActorPoolStrategy
+
+            compute = ActorPoolStrategy(size=config.concurrency)
         ds = ds.map_batches(
-            _infer, batch_size=config.batch_size, batch_format="dict"
+            _infer,
+            batch_size=config.batch_size,
+            batch_format="dict",
+            compute=compute,
         )
         if postprocess is not None:
             ds = ds.map(postprocess)

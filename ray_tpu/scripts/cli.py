@@ -133,6 +133,24 @@ def cmd_start(args):
     agent.serve_forever()
 
 
+def cmd_serve(args):
+    """``ray-tpu serve deploy/status/shutdown`` (reference: the serve CLI,
+    ``python/ray/serve/scripts.py``)."""
+    _ensure_init(args)
+    from ray_tpu.serve import schema
+
+    if args.serve_cmd == "deploy":
+        names = schema.deploy(args.config_file)
+        print(f"deployed applications: {', '.join(names)}")
+    elif args.serve_cmd == "status":
+        print(json.dumps(schema.status(), indent=1, default=str))
+    elif args.serve_cmd == "shutdown":
+        from ray_tpu import serve
+
+        serve.shutdown()
+        print("serve shut down")
+
+
 def cmd_job(args):
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -202,6 +220,15 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("timeline", help="export chrome trace of task events")
     s.add_argument("--output", "-o", default="timeline.json")
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("serve", help="declarative serve deploy/status")
+    ssub = s.add_subparsers(dest="serve_cmd", required=True)
+    sd = ssub.add_parser("deploy")
+    sd.add_argument("config_file")
+    ssub.add_parser("status")
+    ssub.add_parser("shutdown")
+    s.add_argument("--num-cpus", type=int, default=4)
+    s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("job", help="job submission")
     jsub = s.add_subparsers(dest="job_cmd", required=True)

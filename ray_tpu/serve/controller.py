@@ -40,6 +40,8 @@ class _DeploymentState:
         self.last_health_t = 0.0
         self.replica_started_t: dict[str, float] = {}
         self.replica_healthy_once: set[str] = set()
+        # replica name -> code_version it was started with (rolling updates)
+        self.replica_code: dict[str, str] = {}
         # long-poll versioning: RANDOMIZED start (reference long_poll uses
         # random snapshot ids) so a restarted controller's counter can never
         # coincide with a listener's stale version and silently block
@@ -72,9 +74,18 @@ class ServeControllerActor:
 
     def deploy_application(self, app_name: str, route_prefix: str,
                            deployments: list[dict], ingress_name: str):
+        import hashlib
+
         with self._lock:
             for spec in deployments:
                 name = spec["name"]
+                # code version: replicas running a different version are
+                # ROLLED (replaced one at a time with graceful drain) by the
+                # reconciler — reference: DeploymentState version rollout,
+                # ``_private/deployment_state.py:1391``
+                spec["code_version"] = hashlib.sha256(
+                    spec["serialized_target"] + spec["init_args_payload"]
+                ).hexdigest()[:16]
                 existing = self._deployments.get(name)
                 if existing is None:
                     self._deployments[name] = _DeploymentState(name, spec)
@@ -83,6 +94,7 @@ class ServeControllerActor:
                     existing.target = spec["initial_replicas"]
                     existing.status = "UPDATING"
                     # config rollout: reconfigure live replicas in place
+                    # (code rollout happens in reconcile via code_version)
                     for h in list(existing.replicas.values()):
                         try:
                             h.reconfigure.remote(spec.get("user_config"))
@@ -216,26 +228,75 @@ class ServeControllerActor:
             for state in states:
                 self._health_check(state)
                 with self._lock:
-                    delta = state.target - len(state.replicas)
+                    cur = state.spec.get("code_version", "")
+                    stale = [
+                        n
+                        for n in state.replicas
+                        if state.replica_code.get(n, cur) != cur
+                    ]
+                    # rolling code update: surge ONE extra replica of the
+                    # new version, drain one stale replica once a new one
+                    # is healthy — repeat until no stale remain (reference:
+                    # the replica rollout state machine,
+                    # deployment_state.py:1391)
+                    surge = 1 if stale else 0
+                    delta = state.target + surge - len(state.replicas)
                 if delta > 0:
                     for _ in range(delta):
                         self._start_replica(state)
                 elif delta < 0:
                     with self._lock:
-                        victims = list(state.replicas.items())[delta:]
+                        # prefer retiring stale-version replicas first
+                        ordered = sorted(
+                            state.replicas.items(),
+                            key=lambda kv: (
+                                state.replica_code.get(kv[0], cur) == cur
+                            ),
+                        )
+                        victims = ordered[: -delta]
                         for name, h in victims:
                             del state.replicas[name]
                             state.replica_started_t.pop(name, None)
                             state.replica_healthy_once.discard(name)
+                            state.replica_code.pop(name, None)
                         if victims:
                             self._bump_version(state)
                     grace = state.spec.get("graceful_shutdown_timeout_s", 20.0)
                     for _, h in victims:
                         self._graceful_stop(h, grace)
+                if stale and delta == 0:
+                    # at surge capacity: retire one stale replica as soon as
+                    # a new-version replica has passed its health check
+                    with self._lock:
+                        new_ready = [
+                            n
+                            for n in state.replicas
+                            if state.replica_code.get(n) == cur
+                            and n in state.replica_healthy_once
+                        ]
+                        victim = None
+                        if new_ready:
+                            name = stale[0]
+                            h = state.replicas.pop(name, None)
+                            if h is not None:
+                                victim = (name, h)
+                                state.replica_started_t.pop(name, None)
+                                state.replica_healthy_once.discard(name)
+                                state.replica_code.pop(name, None)
+                                self._bump_version(state)
+                    if victim is not None:
+                        grace = state.spec.get(
+                            "graceful_shutdown_timeout_s", 20.0
+                        )
+                        self._graceful_stop(victim[1], grace)
                 with self._lock:
+                    rolled = all(
+                        state.replica_code.get(n, "") == cur
+                        for n in state.replicas
+                    )
                     state.status = (
                         "RUNNING"
-                        if len(state.replicas) == state.target
+                        if len(state.replicas) == state.target and rolled
                         else "UPDATING"
                     )
 
@@ -280,6 +341,7 @@ class ServeControllerActor:
         with self._lock:
             state.replicas[replica_name] = h
             state.replica_started_t[replica_name] = time.time()
+            state.replica_code[replica_name] = spec.get("code_version", "")
             self._bump_version(state)
 
     def _health_check(self, state: _DeploymentState):
@@ -322,6 +384,7 @@ class ServeControllerActor:
                 state.replicas.pop(name, None)
                 state.replica_started_t.pop(name, None)
                 state.replica_healthy_once.discard(name)
+                state.replica_code.pop(name, None)
                 self._bump_version(state)
             self._kill_replica(h)
 

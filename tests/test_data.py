@@ -398,3 +398,85 @@ def test_from_generator_streaming(ray_start_thread):
     mat = ds.materialize()
     assert mat.num_blocks() == 8  # 2 shards x 4 streamed blocks
     assert mat.count() == 40
+
+
+def test_zip(ray_start_thread):
+    left = rd.from_items([{"a": i} for i in range(10)])
+    right = rd.from_items([{"b": i * 10} for i in range(10)])
+    rows = left.zip(right).take_all()
+    assert [r["a"] for r in rows] == list(range(10))
+    assert [r["b"] for r in rows] == [i * 10 for i in range(10)]
+
+
+def test_zip_name_collision_and_mismatch(ray_start_thread):
+    left = rd.from_items([{"a": i} for i in range(4)])
+    right = rd.from_items([{"a": -i} for i in range(4)])
+    rows = left.zip(right).take_all()
+    assert [r["a_1"] for r in rows] == [0, -1, -2, -3]
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="equal row counts"):
+        left.zip(rd.from_items([{"b": 1}])).take_all()
+
+
+def test_join_inner(ray_start_thread):
+    users = rd.from_items(
+        [{"uid": i, "name": f"u{i}"} for i in range(8)]
+    )
+    orders = rd.from_items(
+        [{"uid": i % 4, "amount": float(i)} for i in range(12)]
+    )
+    rows = users.join(orders, on="uid").take_all()
+    # uids 0..3 each match 3 orders; uids 4..7 match none
+    assert len(rows) == 12
+    assert all(r["uid"] < 4 for r in rows)
+    by_uid = {}
+    for r in rows:
+        by_uid.setdefault(r["uid"], []).append(r["amount"])
+    assert sorted(by_uid[1]) == [1.0, 5.0, 9.0]
+    assert all(r["name"] == f"u{r['uid']}" for r in rows)
+
+
+def test_join_left(ray_start_thread):
+    left = rd.from_items([{"k": i, "l": i} for i in range(6)])
+    right = rd.from_items([{"k": i, "r": i * 2} for i in range(3)])
+    rows = left.join(right, on="k", how="left").take_all()
+    assert len(rows) == 6
+    matched = [r for r in rows if r["k"] < 3]
+    assert all(r["r"] == r["k"] * 2 for r in matched)
+
+
+def test_map_batches_actor_pool(ray_start_thread):
+    """compute=ActorPoolStrategy: a callable CLASS constructs once per actor
+    and is reused across batches (stateful UDF contract)."""
+
+    class AddModelValue:
+        def __init__(self):
+            self.offset = 100  # "model load" — once per actor
+
+        def __call__(self, batch):
+            return {"x": batch["id"] + self.offset}
+
+    ds = rd.range(64).map_batches(
+        AddModelValue,
+        batch_size=8,
+        compute=rd.ActorPoolStrategy(size=2),
+    )
+    rows = ds.take_all()
+    assert sorted(r["x"] for r in rows) == [i + 100 for i in range(64)]
+
+
+def test_actor_pool_chains_with_task_stage(ray_start_thread):
+    class Doubler:
+        def __call__(self, batch):
+            return {"x": batch["x"] * 2}
+
+    ds = (
+        rd.range(32)
+        .map_batches(lambda b: {"x": b["id"] + 1}, batch_size=8)
+        .map_batches(Doubler, batch_size=8, compute=rd.ActorPoolStrategy(size=2))
+        .map_batches(lambda b: {"x": b["x"] - 1}, batch_size=8)
+    )
+    assert sorted(r["x"] for r in ds.take_all()) == [
+        (i + 1) * 2 - 1 for i in range(32)
+    ]

@@ -458,3 +458,73 @@ def test_autoscaling_config_math():
     assert ac.desired_replicas(total_ongoing=8, current=2) == 4
     assert ac.desired_replicas(total_ongoing=0, current=4) == 1
     assert ac.desired_replicas(total_ongoing=100, current=4) == 8
+
+
+def test_declarative_deploy_and_status(serve_instance, tmp_path):
+    """YAML config → running app; re-deploy with new options reconciles
+    (reference: serve deploy CLI over ServeDeploySchema)."""
+    mod = tmp_path / "my_serve_app.py"
+    mod.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "class Greeter:\n"
+        "    def __init__(self, greeting='hello'):\n"
+        "        self.greeting = greeting\n"
+        "    def __call__(self, name='world'):\n"
+        "        return f'{self.greeting} {name}'\n"
+        "app = Greeter.bind()\n"
+    )
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        cfg = tmp_path / "serve.yaml"
+        cfg.write_text(
+            "applications:\n"
+            "  - name: greeter\n"
+            "    route_prefix: /greet\n"
+            "    import_path: my_serve_app:app\n"
+            "    deployments:\n"
+            "      - name: Greeter\n"
+            "        num_replicas: 2\n"
+        )
+        from ray_tpu.serve import schema
+
+        names = schema.deploy(str(cfg))
+        assert names == ["greeter"]
+        h = serve.get_app_handle("greeter")
+        assert h.remote("ray").result(timeout_s=60) == "hello ray"
+        st = schema.status()
+        assert "Greeter" in str(st)
+    finally:
+        sys.path.remove(str(tmp_path))
+
+
+def test_rolling_update_with_drain(serve_instance):
+    """Re-deploying changed code rolls replicas: new version serves, old
+    replicas drain gracefully, and the deployment converges to RUNNING."""
+
+    def make_app(version):
+        @serve.deployment(num_replicas=2, name="Versioned")
+        class Versioned:
+            def __call__(self):
+                return version
+
+        return Versioned.bind()
+
+    h = serve.run(make_app("v1"), name="roll")
+    assert h.remote().result(timeout_s=60) == "v1"
+
+    serve.run(make_app("v2"), name="roll")
+    deadline = time.monotonic() + 90
+    seen_v2 = False
+    while time.monotonic() < deadline:
+        out = h.remote().result(timeout_s=30)
+        if out == "v2":
+            seen_v2 = True
+            # converged? every response must now be v2
+            if all(h.remote().result(timeout_s=30) == "v2" for _ in range(6)):
+                break
+        time.sleep(0.5)
+    assert seen_v2, "new version never served"
+    assert all(h.remote().result(timeout_s=30) == "v2" for _ in range(4))
