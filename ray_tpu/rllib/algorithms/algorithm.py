@@ -22,7 +22,9 @@ from ray_tpu.rllib.env.env_runner import EnvRunnerGroup, env_dims
 class AlgorithmConfig:
     def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
         self.algo_class = algo_class
-        self.env: Optional[str] = None
+        self.env = None  # env id str, or callable for multi-agent envs
+        self.policies: Optional[dict] = None
+        self.policy_mapping_fn = None
         self.seed = 0
         # env runners
         self.num_env_runners = 0
@@ -34,15 +36,36 @@ class AlgorithmConfig:
         self.train_batch_size = 4000
         self.minibatch_size = 128
         self.num_epochs = 10
-        self.model: dict = {"hidden": (64, 64)}
+        # per-env defaults apply when a key is absent (MLP (64, 64);
+        # pixel torso picks its own head) — see Algorithm.__init__
+        self.model: dict = {}
         # learners
         self.num_learners = 0
         self.resources_per_learner: Optional[dict] = None
 
     # -- fluent builder (reference API names) -------------------------------
 
-    def environment(self, env: str, **_) -> "AlgorithmConfig":
+    def environment(self, env, **_) -> "AlgorithmConfig":
+        """``env``: an env id string, or (multi-agent) a callable returning
+        a ``MultiAgentEnv`` instance."""
         self.env = env
+        return self
+
+    def multi_agent(
+        self,
+        *,
+        policies: dict,
+        policy_mapping_fn,
+        **_,
+    ) -> "AlgorithmConfig":
+        """Multi-agent setup (reference: ``AlgorithmConfig.multi_agent`` +
+        ``MultiRLModuleSpec``): ``policies`` maps policy id →
+        RLModuleSpec (or None to infer from the env); agents route to
+        policies via ``policy_mapping_fn(agent_id)``. Several agents
+        mapping to one id SHARE that policy; distinct ids train
+        independent modules."""
+        self.policies = dict(policies)
+        self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def env_runners(
@@ -100,17 +123,42 @@ class Algorithm:
     """Base trainer: owns env-runner group + learner group."""
 
     learner_hparam_keys = ("lr",)
+    # algorithms whose learner understands conv (pixel) modules set True
+    # (others fall back to flattened-vector obs, the pre-conv behavior)
+    supports_pixel_obs = False
 
     def __init__(self, config: AlgorithmConfig):
         if config.env is None:
             raise ValueError("config.environment(env=...) is required")
         self.config = config
-        obs_dim, act_dim = env_dims(config.env)
-        self.module_spec = RLModuleSpec(
-            observation_dim=obs_dim,
-            action_dim=act_dim,
-            hidden=tuple(config.model.get("hidden", (64, 64))),
-        )
+        self.is_multi_agent = config.policies is not None
+        if self.is_multi_agent:
+            self._setup_multi_agent()
+            self.iteration = 0
+            self._total_env_steps = 0
+            return
+        from ray_tpu.rllib.env.env_runner import env_spec
+
+        obs_shape, act_dim = env_spec(config.env)
+        if len(obs_shape) == 3 and self.supports_pixel_obs:
+            # pixel env: conv torso (Atari-CNN-style defaults scaled down)
+            self.module_spec = RLModuleSpec(
+                observation_dim=int(np.prod(obs_shape)),
+                action_dim=act_dim,
+                hidden=tuple(config.model.get("hidden", (128,))),  # conv head
+                obs_shape=obs_shape,
+                conv_filters=tuple(
+                    config.model.get(
+                        "conv_filters", ((16, 4, 2), (32, 3, 2))
+                    )
+                ),
+            )
+        else:
+            self.module_spec = RLModuleSpec(
+                observation_dim=int(np.prod(obs_shape)),
+                action_dim=act_dim,
+                hidden=tuple(config.model.get("hidden", (64, 64))),
+            )
         self.learner_group = LearnerGroup(
             self.module_spec,
             num_learners=config.num_learners,
@@ -130,6 +178,58 @@ class Algorithm:
         )
         self.iteration = 0
         self._total_env_steps = 0
+
+    def _setup_multi_agent(self):
+        """Per-policy learner groups + the multi-agent runner group
+        (reference: MultiRLModule + MultiAgentEnvRunner)."""
+        from ray_tpu.rllib.env.multi_agent import MultiAgentEnvRunnerGroup
+
+        config = self.config
+        env_maker = config.env
+        if not callable(env_maker):
+            raise ValueError(
+                "multi-agent configs need environment(env=<callable>) "
+                "returning a MultiAgentEnv"
+            )
+        probe = env_maker()
+        specs: dict[str, RLModuleSpec] = {}
+        for pid, spec in config.policies.items():
+            if spec is None:
+                if not hasattr(probe, "observation_dim") or not hasattr(
+                    probe, "action_dim"
+                ):
+                    raise ValueError(
+                        f"policies[{pid!r}] is None, so the env must expose "
+                        f"observation_dim and action_dim to infer the module "
+                        f"spec — {type(probe).__name__} does not; pass an "
+                        f"explicit RLModuleSpec"
+                    )
+                spec = RLModuleSpec(
+                    observation_dim=int(probe.observation_dim),
+                    action_dim=int(probe.action_dim),
+                    hidden=tuple(config.model.get("hidden", (64, 64))),
+                )
+            specs[pid] = spec
+        self.module_specs = specs
+        self.learner_groups = {
+            pid: LearnerGroup(
+                spec,
+                num_learners=config.num_learners,
+                learner_kwargs=self._learner_kwargs(),
+                resources_per_learner=config.resources_per_learner,
+            )
+            for pid, spec in specs.items()
+        }
+        self.env_runner_group = MultiAgentEnvRunnerGroup(
+            env_maker,
+            specs,
+            config.policy_mapping_fn,
+            num_env_runners=config.num_env_runners,
+            rollout_fragment_length=config.rollout_fragment_length,
+            gamma=config.gamma,
+            lambda_=getattr(config, "lambda_", 0.95),
+            seed=config.seed,
+        )
 
     def _learner_kwargs(self) -> dict:
         return {"lr": self.config.lr, "seed": self.config.seed}
@@ -155,19 +255,33 @@ class Algorithm:
 
     def stop(self):
         self.env_runner_group.shutdown()
-        self.learner_group.shutdown()
+        if self.is_multi_agent:
+            for lg in self.learner_groups.values():
+                lg.shutdown()
+        else:
+            self.learner_group.shutdown()
 
     # -- checkpointing (Checkpointable contract) ----------------------------
 
     def get_state(self) -> dict:
+        if self.is_multi_agent:
+            learner = {
+                pid: lg.get_state() for pid, lg in self.learner_groups.items()
+            }
+        else:
+            learner = self.learner_group.get_state()
         return {
-            "learner": self.learner_group.get_state(),
+            "learner": learner,
             "iteration": self.iteration,
             "total_env_steps": self._total_env_steps,
         }
 
     def set_state(self, state: dict):
-        self.learner_group.set_state(state["learner"])
+        if self.is_multi_agent:
+            for pid, s in state["learner"].items():
+                self.learner_groups[pid].set_state(s)
+        else:
+            self.learner_group.set_state(state["learner"])
         self.iteration = state.get("iteration", 0)
         self._total_env_steps = state.get("total_env_steps", 0)
 

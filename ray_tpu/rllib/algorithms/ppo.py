@@ -24,6 +24,8 @@ class PPOConfig(AlgorithmConfig):
 
 
 class PPO(Algorithm):
+    supports_pixel_obs = True  # the PPO learner uses the spec's conv arch
+
     def _learner_kwargs(self) -> dict:
         c = self.config
         return {
@@ -37,6 +39,8 @@ class PPO(Algorithm):
         }
 
     def training_step(self) -> dict:
+        if self.is_multi_agent:
+            return self._multi_agent_training_step()
         weights = self.learner_group.get_weights()
         batch, env_metrics = self.env_runner_group.sample(weights=weights)
         learner_stats = self.learner_group.update_from_batch(
@@ -44,6 +48,27 @@ class PPO(Algorithm):
             minibatch_size=self.config.minibatch_size,
             num_epochs=self.config.num_epochs,
         )
+        return {
+            "env_runners": env_metrics,
+            "learner": learner_stats,
+            "episode_return_mean": env_metrics["episode_return_mean"],
+            "num_env_steps_sampled": env_metrics["num_env_steps"],
+        }
+
+    def _multi_agent_training_step(self) -> dict:
+        """Per-policy PPO updates over one multi-agent sample (reference:
+        the multi-module Learner update, ``multi_rl_module.py``)."""
+        weights = {
+            pid: lg.get_weights() for pid, lg in self.learner_groups.items()
+        }
+        batches, env_metrics = self.env_runner_group.sample(weights=weights)
+        learner_stats = {}
+        for pid, batch in batches.items():
+            learner_stats[pid] = self.learner_groups[pid].update_from_batch(
+                batch,
+                minibatch_size=self.config.minibatch_size,
+                num_epochs=self.config.num_epochs,
+            )
         return {
             "env_runners": env_metrics,
             "learner": learner_stats,

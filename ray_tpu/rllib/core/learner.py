@@ -52,12 +52,12 @@ class JaxLearner:
         import jax
         import jax.numpy as jnp
 
-        n_hidden = len(self.spec.hidden)
+        arch = self.spec.arch()
         hp = self.hparams
         optimizer = self.optimizer
 
         def loss_fn(params, batch):
-            logits, value = RLModule.forward(params, batch["obs"], n_hidden)
+            logits, value = RLModule.forward(params, batch["obs"], arch)
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1

@@ -24,6 +24,15 @@ class RLModuleSpec:
     hidden: Sequence[int] = (64, 64)
     # discrete only for now (PPO on classic control / Atari-ram scale)
     free_log_std: bool = False
+    # pixel observations: obs_shape (H, W, C) + conv torso
+    # [(out_channels, kernel, stride), ...] ahead of the MLP (reference:
+    # the Atari CNN catalog defaults, rllib/core/models/catalog.py)
+    obs_shape: Optional[tuple] = None
+    conv_filters: Sequence[tuple] = ()
+
+    def arch(self) -> tuple:
+        """Static (hashable) architecture descriptor for jit closures."""
+        return (tuple(tuple(c) for c in self.conv_filters), len(self.hidden))
 
     def build(self, seed: int = 0) -> "RLModule":
         return RLModule(self, seed)
@@ -37,9 +46,9 @@ class RLModule:
         import jax
 
         self.params = self.init_params(jax.random.PRNGKey(seed))
-        n_hidden = len(spec.hidden)
+        arch = spec.arch()
         self._jit_fwd = jax.jit(
-            lambda p, o: RLModule.forward(p, o, n_hidden)
+            lambda p, o: RLModule.forward(p, o, arch)
         )
 
     def init_params(self, key):
@@ -47,15 +56,36 @@ class RLModule:
         import jax.numpy as jnp
 
         spec = self.spec
-        sizes = [spec.observation_dim, *spec.hidden]
         params: dict[str, Any] = {}
-        keys = jax.random.split(key, len(sizes) + 2)
+        convs = tuple(tuple(c) for c in spec.conv_filters)
+        keys = jax.random.split(key, len(convs) + len(spec.hidden) + 3)
+        ki = 0
+        if convs:
+            if spec.obs_shape is None:
+                raise ValueError("conv_filters requires obs_shape (H, W, C)")
+            h, w, c_in = spec.obs_shape
+            for i, (c_out, k, s) in enumerate(convs):
+                fan_in = k * k * c_in
+                params[f"conv{i}"] = (
+                    jax.random.normal(keys[ki], (k, k, c_in, c_out))
+                    / np.sqrt(fan_in)
+                ).astype(jnp.float32)
+                params[f"cb{i}"] = jnp.zeros((c_out,), jnp.float32)
+                ki += 1
+                h = -(-h // s)
+                w = -(-w // s)
+                c_in = c_out
+            flat = h * w * c_in
+        else:
+            flat = spec.observation_dim
+        sizes = [flat, *spec.hidden]
         for i in range(len(sizes) - 1):
             fan_in = sizes[i]
             params[f"w{i}"] = (
-                jax.random.normal(keys[i], (sizes[i], sizes[i + 1])) / np.sqrt(fan_in)
+                jax.random.normal(keys[ki], (sizes[i], sizes[i + 1])) / np.sqrt(fan_in)
             ).astype(jnp.float32)
             params[f"b{i}"] = jnp.zeros((sizes[i + 1],), jnp.float32)
+            ki += 1
         h = sizes[-1]
         params["w_pi"] = (
             jax.random.normal(keys[-2], (h, spec.action_dim)) * 0.01
@@ -68,11 +98,31 @@ class RLModule:
         return params
 
     @staticmethod
-    def forward(params: dict, obs, n_hidden: int):
-        """(logits [B, A], value [B]) — pure, jit-able."""
+    def forward(params: dict, obs, arch):
+        """(logits [B, A], value [B]) — pure, jit-able.
+
+        ``arch``: an int n_hidden (MLP torso, legacy callers) or the
+        ``RLModuleSpec.arch()`` tuple (conv_filters, n_hidden) — conv
+        torsos take [B, H, W, C] observations (the pixel path)."""
+        import jax
         import jax.numpy as jnp
 
+        if isinstance(arch, int):
+            convs, n_hidden = (), arch
+        else:
+            convs, n_hidden = arch
         x = obs
+        for i, (_c_out, _k, s) in enumerate(convs):
+            x = jax.lax.conv_general_dilated(
+                x,
+                params[f"conv{i}"],
+                window_strides=(s, s),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + params[f"cb{i}"]
+            x = jax.nn.relu(x)
+        if convs:
+            x = x.reshape(x.shape[0], -1)
         for i in range(n_hidden):
             x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
         logits = x @ params["w_pi"] + params["b_pi"]

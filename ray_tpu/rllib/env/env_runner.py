@@ -18,6 +18,10 @@ from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
 
 
 def _make_env(env_id: str, seed: Optional[int] = None):
+    if env_id in ("MiniBreakout-v0", "MiniBreakout"):
+        from ray_tpu.rllib.env.breakout import MiniBreakout
+
+        return MiniBreakout()
     if env_id == "CartPole-v1":
         try:
             import gymnasium as gym
@@ -40,6 +44,15 @@ def env_dims(env_id: str) -> tuple[int, int]:
     return obs_dim, act_dim
 
 
+def env_spec(env_id: str) -> tuple[tuple, int]:
+    """(observation shape, action count) — shape-preserving (pixel envs)."""
+    env = _make_env(env_id)
+    shape = tuple(env.observation_space.shape)
+    act_dim = int(env.action_space.n)
+    env.close() if hasattr(env, "close") else None
+    return shape, act_dim
+
+
 class SingleAgentEnvRunner:
     """Steps ``num_envs`` environments with the current module weights."""
 
@@ -59,6 +72,10 @@ class SingleAgentEnvRunner:
 
         spec: RLModuleSpec = cloudpickle.loads(module_spec_payload)
         self.module = spec.build(seed)
+        # MLP modules consume flat vectors even from pixel envs (the
+        # pre-conv behavior every non-PPO learner depends on); conv
+        # modules keep [H, W, C]
+        self._flatten = not spec.conv_filters
         self.envs = [_make_env(env_id) for _ in range(num_envs)]
         self.rollout_fragment_length = rollout_fragment_length
         self.gamma = gamma
@@ -70,7 +87,7 @@ class SingleAgentEnvRunner:
         self._obs = []
         for i, e in enumerate(self.envs):
             obs, _ = e.reset(seed=seed + i)
-            self._obs.append(np.asarray(obs, np.float32))
+            self._obs.append(self._to_obs(obs))
         from collections import deque
 
         self._ep_return = np.zeros(num_envs)
@@ -80,6 +97,10 @@ class SingleAgentEnvRunner:
         self.completed_lengths: "deque[int]" = deque(maxlen=500)
         self._episodes_this_sample = 0
 
+    def _to_obs(self, o) -> np.ndarray:
+        a = np.asarray(o, np.float32)
+        return a.reshape(-1) if self._flatten else a
+
     def set_weights(self, weights: dict) -> bool:
         self.module.set_state(weights)
         return True
@@ -88,8 +109,9 @@ class SingleAgentEnvRunner:
         """Collect one fragment per env; returns a GAE-processed batch plus
         episode metrics."""
         T, N = self.rollout_fragment_length, len(self.envs)
-        obs_buf = np.zeros((T, N, self._obs[0].shape[0]), np.float32)
-        next_obs_buf = np.zeros((T, N, self._obs[0].shape[0]), np.float32)
+        obs_shape = self._obs[0].shape  # vector OR pixel [H, W, C]
+        obs_buf = np.zeros((T, N, *obs_shape), np.float32)
+        next_obs_buf = np.zeros((T, N, *obs_shape), np.float32)
         act_buf = np.zeros((T, N), np.int64)
         rew_buf = np.zeros((T, N), np.float32)
         term_buf = np.zeros((T, N), np.float32)  # true termination: boot 0
@@ -115,7 +137,7 @@ class SingleAgentEnvRunner:
                 o2, r, term, trunc, _ = env.step(int(actions[i]))
                 # pre-reset successor: value-based learners (DQN) need the
                 # true transition even at episode boundaries
-                next_obs_buf[t, i] = np.asarray(o2, np.float32)
+                next_obs_buf[t, i] = self._to_obs(o2)
                 rew_buf[t, i] = r
                 self._ep_return[i] += r
                 self._ep_len[i] += 1
@@ -124,7 +146,7 @@ class SingleAgentEnvRunner:
                 end_buf[t, i] = float(done)
                 if trunc and not term:
                     # bootstrap from the PRE-reset obs, not the next episode's
-                    trunc_bootstrap.append((t, i, np.asarray(o2, np.float32)))
+                    trunc_bootstrap.append((t, i, self._to_obs(o2)))
                 if done:
                     self.completed_returns.append(float(self._ep_return[i]))
                     self.completed_lengths.append(int(self._ep_len[i]))
@@ -132,7 +154,7 @@ class SingleAgentEnvRunner:
                     self._ep_return[i] = 0.0
                     self._ep_len[i] = 0
                     o2, _ = env.reset()
-                self._obs[i] = np.asarray(o2, np.float32)
+                self._obs[i] = self._to_obs(o2)
         # bootstrap values for the final obs
         _, last_vals = self.module.forward_inference(np.stack(self._obs))
         val_buf[T] = last_vals
@@ -178,14 +200,15 @@ class SingleAgentEnvRunner:
         }
         out = {
             "batch": {
-                "obs": obs_buf.reshape(T * N, -1),
+                # pixel obs keep [B, H, W, C]; vector obs stay [B, D]
+                "obs": obs_buf.reshape(T * N, *obs_shape),
                 "actions": act_buf.reshape(-1),
                 "logp_old": logp_buf.reshape(-1),
                 "advantages": adv.reshape(-1),
                 "value_targets": value_targets.reshape(-1),
                 # raw transitions for value-based learners (DQN replay)
                 "rewards": rew_buf.reshape(-1),
-                "next_obs": next_obs_buf.reshape(T * N, -1),
+                "next_obs": next_obs_buf.reshape(T * N, *obs_shape),
                 "terminals": term_buf.reshape(-1),
             },
             "metrics": metrics,
