@@ -72,6 +72,19 @@ def main():
     tokens_per_sec = batch * seq * steps / dt
     achieved_mfu = tokens_per_sec * flops_per_token(cfg) / peak_flops
     baseline_mfu = 0.40  # BASELINE.json north-star target
+    final_loss = float(metrics["loss"])  # materialize BEFORE freeing state
+
+    # free the training working set before the serving engine allocates its
+    # params + KV pools (a 7B engine does not fit next to train state)
+    del state, metrics, step_fn, init_fn, batch_data
+    import gc
+
+    gc.collect()
+    decode = {}
+    try:
+        decode = decode_bench(on_tpu)
+    except Exception as e:  # noqa: BLE001 — decode numbers are additive
+        decode = {"decode_error": repr(e)}
 
     print(
         json.dumps(
@@ -83,10 +96,85 @@ def main():
                 "tokens_per_sec": round(tokens_per_sec, 1),
                 "platform": platform,
                 "model_params": cfg.num_params(),
-                "loss": float(metrics["loss"]),
+                "loss": final_loss,
+                **decode,
             }
         )
     )
+
+
+def decode_bench(on_tpu: bool) -> dict:
+    """Serving-side numbers (VERDICT r2 weak #4: BENCH covered training
+    only): continuous-batching decode throughput + time-to-first-token on
+    the JaxEngine, plus the prefix-cache TTFT win on a shared prompt."""
+    import numpy as np
+
+    from ray_tpu.llm import EngineConfig, JaxEngine, LLMConfig, ModelConfig
+    from ray_tpu.llm.config import SamplingParams
+
+    if on_tpu:
+        # 3B bf16 params (~6.4 GB incl. tied embeddings) + KV pools fit a
+        # v5e chip with room for transients; 7B is at the 16 GB edge with
+        # full-logit prefill and OOMs on the second program execution
+        model_id, seqs, seq_len, gen_tokens = "llama3.2-3b", 4, 1024, 64
+    else:
+        model_id, seqs, seq_len, gen_tokens = "tiny", 4, 128, 16
+    cfg = LLMConfig(
+        model=ModelConfig(model_id=model_id, tokenizer="byte", seed=0),
+        engine=EngineConfig(
+            max_num_seqs=seqs,
+            max_seq_len=seq_len,
+            prefill_buckets=(32, 64, 128, 256, 512, 1024)[
+                : 4 if not on_tpu else 6
+            ],
+            # tunneled chips pay a host round trip per decode program;
+            # 8 steps per program amortize it (token-exact, tested)
+            decode_steps=8 if on_tpu else 1,
+        ),
+    )
+    engine = JaxEngine(cfg)
+    try:
+        sp = SamplingParams(max_tokens=gen_tokens, temperature=0.0,
+                            ignore_eos=True)
+        prompt = "benchmark prompt: the quick brown fox jumps. " * 2
+        # warmup: compile the decode program AND every prefill bucket the
+        # timed prompts will use (cold TTFT must measure prefill, not XLA
+        # compilation)
+        engine.generate(prompt, sampling_params=sp)
+        engine.generate("warmup pass two " * 12 + prompt, sampling_params=sp)
+
+        # COLD prompts: each starts with unique leading text so no
+        # bucket-aligned prefix of the warmup (or of each other) hits the
+        # prefix cache — ttft_ms_mean is the uncached baseline
+        t0 = time.perf_counter()
+        reqs = [
+            engine.submit(f"request {i}: " * 4 + prompt, sampling_params=sp)
+            for i in range(seqs)
+        ]
+        for r in reqs:
+            r.done.wait()
+        dt = time.perf_counter() - t0
+        total_tokens = sum(len(r.out_tokens) for r in reqs)
+        ttfts = [r.first_token_t - r.submitted_t for r in reqs]
+
+        # prefix-cache TTFT: same long shared preamble, fresh question.
+        # Two warm passes first: one populates the cache, one compiles the
+        # suffix-prefill program — the measured hit is steady-state.
+        shared = "system preamble: " + "context " * 20
+        engine.generate(shared + "warm?", sampling_params=sp)  # populate
+        engine.generate(shared + "compile", sampling_params=sp)  # hit+compile
+        cold_hits = engine.get_stats()["prefix_cache_hits"]
+        r = engine.generate(shared + "question two", sampling_params=sp)
+        hit = engine.get_stats()["prefix_cache_hits"] > cold_hits
+        return {
+            "decode_tokens_per_sec": round(total_tokens / dt, 1),
+            "decode_batch": seqs,
+            "ttft_ms_mean": round(1e3 * float(np.mean(ttfts)), 1),
+            "prefix_cache_hit": bool(hit),
+            "prefix_hit_ttft_ms": round(1e3 * r.metrics["ttft_s"], 1),
+        }
+    finally:
+        engine.shutdown()
 
 
 if __name__ == "__main__":

@@ -38,6 +38,27 @@ class EngineConfig:
     # llm/_internal/serve LoRA support over vLLM's multi-LoRA).
     max_loras: int = 0
     lora_rank: int = 8
+    # prefix caching: reuse the KV of previously-computed prompt prefixes
+    # (shared system prompts / repeated few-shot preambles). Prefixes are
+    # cached at bucket-aligned lengths; hits copy the cached stripe and
+    # prefill only the suffix (the TPU-static analog of vLLM's paged
+    # prefix caching — reference: vllm_engine.py's reason to exist).
+    enable_prefix_caching: bool = True
+    prefix_cache_entries: int = 32
+    prefix_cache_max_bytes: int = 512 * 1024 * 1024
+    # KV stripe pools: slots come in these sequence-length classes so short
+    # chats don't pin max_seq_len-sized KV memory; a request routes to the
+    # smallest class covering prompt+max_tokens. () = one pool at
+    # max_seq_len. Each pool runs its own compiled decode program.
+    seq_len_buckets: tuple = ()
+    # slots per pool (parallel to seq_len_buckets; () = spread evenly)
+    seqs_per_bucket: tuple = ()
+    # decode steps per host loop iteration: >1 runs a lax.scan of K steps
+    # in ONE device program, amortizing host<->device round trips (the
+    # dominant decode cost on tunneled/remote chips). Stop tokens are
+    # honored host-side after the fact (over-decoded tokens discarded);
+    # admission latency grows by up to K steps.
+    decode_steps: int = 1
 
 
 @dataclasses.dataclass

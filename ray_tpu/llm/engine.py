@@ -67,6 +67,27 @@ class _Request:
         self.first_token_t: Optional[float] = None
         self.error: Optional[BaseException] = None
         self.lora_idx = lora_idx
+        self.prefix_hit_tokens = 0
+
+
+class _Pool:
+    """One KV stripe class: ``n_slots`` decode slots of ``stripe_len``
+    positions each, with its own compiled decode program. Short requests
+    route to short pools so they never pin max_seq_len-sized KV memory."""
+
+    def __init__(self, stripe_len: int, n_slots: int, model_cfg):
+        from ray_tpu.models.llama import init_kv_cache
+
+        self.stripe_len = stripe_len
+        self.n_slots = n_slots
+        self.cache = init_kv_cache(model_cfg, n_slots, stripe_len)
+        self.slots: list[Optional[_Request]] = [None] * n_slots
+        self.temps = np.zeros((n_slots,), np.float32)
+        self.top_ks = np.full((n_slots,), 50, np.int32)
+        self.keys = None  # per-slot PRNG keys, set by the engine loop
+        self.pending_first: dict[int, int] = {}
+        self.adapter_ids = np.zeros((n_slots,), np.int32)
+        self.adapter_ids_dev = None
 
 
 class JaxEngine:
@@ -77,14 +98,49 @@ class JaxEngine:
         self.tokenizer = get_tokenizer(config.model.tokenizer)
         self._mesh = mesh
         self._build_model()
+        self._build_pools()
         self._compile()
         self._waiting: "queue.Queue[_Request]" = queue.Queue()
-        self._slots: list[Optional[_Request]] = [None] * config.engine.max_num_seqs
+        self._backlog: list[_Request] = []  # engine-thread-owned FIFO
         self._stop = threading.Event()
+        # prefix cache: sha1(prompt[:bucket]) -> {k, v} device stripes
+        # (bucket-aligned lengths only, so jit specializations stay bounded)
+        from collections import OrderedDict
+
+        self._prefix_cache: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._prefix_bytes = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
         self._thread = threading.Thread(
             target=self._engine_loop, daemon=True, name="llm-engine"
         )
         self._thread.start()
+
+    def _build_pools(self):
+        ec = self.config.engine
+        buckets = tuple(ec.seq_len_buckets) or (ec.max_seq_len,)
+        if sorted(buckets)[-1] != ec.max_seq_len:
+            raise ValueError(
+                f"seq_len_buckets must end at max_seq_len={ec.max_seq_len}"
+            )
+        if ec.seqs_per_bucket:
+            counts = tuple(ec.seqs_per_bucket)
+            if len(counts) != len(buckets) or sum(counts) != ec.max_num_seqs:
+                raise ValueError(
+                    "seqs_per_bucket must parallel seq_len_buckets and sum "
+                    "to max_num_seqs"
+                )
+        else:
+            base = ec.max_num_seqs // len(buckets)
+            counts = tuple(
+                base + (1 if i < ec.max_num_seqs % len(buckets) else 0)
+                for i in range(len(buckets))
+            )
+        self._pools = [
+            _Pool(b, n, self.model_cfg)
+            for b, n in sorted(zip(buckets, counts))
+            if n > 0
+        ]
 
     # -- model setup --------------------------------------------------------
 
@@ -104,6 +160,7 @@ class JaxEngine:
             "tiny": LlamaConfig.tiny,
             "llama2-7b": LlamaConfig.llama2_7b,
             "llama3-8b": LlamaConfig.llama3_8b,
+            "llama3.2-3b": LlamaConfig.llama32_3b,
             "llama3-70b": LlamaConfig.llama3_70b,
         }
         kw = dict(
@@ -134,14 +191,10 @@ class JaxEngine:
             self.params = init_params(
                 jax.random.PRNGKey(mc.seed), self.model_cfg, mesh=self._mesh
             )
-        self.cache = init_kv_cache(
-            self.model_cfg, ec.max_num_seqs, ec.max_seq_len
-        )
         # multi-LoRA: stacked adapters (slot 0 = base/zero), name registry,
-        # per-decode-slot adapter index
+        # per-decode-slot adapter index (kept per pool)
         self.loras = None
         self._lora_ids: dict[str, int] = {}
-        self._adapter_ids = np.zeros((ec.max_num_seqs,), np.int32)
         if ec.max_loras > 0:
             from ray_tpu.models.llama import init_lora_stack
 
@@ -192,12 +245,37 @@ class JaxEngine:
 
         self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
 
+        n_steps = max(1, ec.decode_steps)
+
+        def decode_multi(params, cache, tokens, temps, top_ks, keys,
+                         loras=None, adapter_ids=None):
+            """K decode steps in one program (lax.scan): one host round
+            trip per K tokens — the tunnel/dispatch amortization knob."""
+            def body(carry, _):
+                toks, cache, keys = carry
+                nt, cache, keys = decode_fn(
+                    params, cache, toks, temps, top_ks, keys,
+                    loras=loras, adapter_ids=adapter_ids,
+                )
+                return (nt, cache, keys), nt
+
+            (toks, cache, keys), out = jax.lax.scan(
+                body, (tokens, cache, keys), None, length=n_steps
+            )
+            return out, cache, keys  # out: [K, slots]
+
+        self._decode_multi_jit = jax.jit(decode_multi, donate_argnums=(1,))
+        self._decode_n_steps = n_steps
+
         def prefill_one(params, cache, tokens, length, slot,
                         loras=None, adapter_id=None):
-            """Prefill a single sequence (B=1) and scatter into `slot`."""
+            """Prefill a single sequence (B=1) and scatter into `slot`.
+            The scratch cache takes the POOL's stripe length (static from
+            the cache operand's shape)."""
             from ray_tpu.models.llama import init_kv_cache
 
-            one = init_kv_cache(cfg, 1, ec.max_seq_len)
+            stripe = cache["k"].shape[2]
+            one = init_kv_cache(cfg, 1, stripe)
             last_logits, one = prefill(
                 params, one, tokens, cfg, lengths=length,
                 loras=loras, adapter_ids=adapter_id,
@@ -210,39 +288,136 @@ class JaxEngine:
             return last_logits[0], cache
 
         self._prefill_jit = jax.jit(prefill_one, donate_argnums=(1,))
-        self._rng_key = jax.random.PRNGKey(self.config.model.seed)
-        # device-resident per-slot adapter ids, refreshed only when slot
-        # composition changes — the per-token decode loop must not pay a
-        # host->device transfer per step
-        self._adapter_ids_dev = (
-            jax.numpy.asarray(self._adapter_ids) if lora_enabled else None
-        )
 
-    def _decode(self, params, cache, tokens, temps, top_ks, keys):
+        def prefill_suffix(params, cache, pk, pv, tokens, length, slot,
+                           loras=None, adapter_id=None):
+            """Prefix-cache hit: copy the cached prefix KV (length m =
+            pk.shape[1], static per bucket) into the scratch stripe, then
+            prefill only the SUFFIX at absolute positions m.. — the
+            attention inside sees the prefix through the cache."""
+            from ray_tpu.models.llama import init_kv_cache
+
+            stripe = cache["k"].shape[2]
+            m = pk.shape[1]
+            one = init_kv_cache(cfg, 1, stripe)
+            one = {
+                "k": one["k"].at[:, 0, :m].set(pk),
+                "v": one["v"].at[:, 0, :m].set(pv),
+                "length": one["length"],
+            }
+            start = jnp.full((1,), m, jnp.int32)
+            last_logits, one = prefill(
+                params, one, tokens, cfg, lengths=length, start_pos=start,
+                loras=loras, adapter_ids=adapter_id,
+            )
+            cache = {
+                "k": cache["k"].at[:, slot].set(one["k"][:, 0]),
+                "v": cache["v"].at[:, slot].set(one["v"][:, 0]),
+                "length": cache["length"].at[slot].set(m + length[0]),
+            }
+            return last_logits[0], cache
+
+        self._prefill_suffix_jit = jax.jit(prefill_suffix, donate_argnums=(1,))
+        self._rng_key = jax.random.PRNGKey(self.config.model.seed)
+
+    def _decode(self, pool: _Pool, tokens, temps, top_ks, keys):
+        """Returns ([K, slots] tokens, cache, keys) — K = decode_steps."""
+        fn = (
+            self._decode_multi_jit
+            if self._decode_n_steps > 1
+            else self._decode_jit
+        )
         if self.loras is None:
             # no-LoRA configuration: the compiled program has no adapter args
-            return self._decode_jit(params, cache, tokens, temps, top_ks, keys)
-        return self._decode_jit(
-            params, cache, tokens, temps, top_ks, keys,
-            loras=self.loras, adapter_ids=self._adapter_ids_dev,
-        )
+            out, cache, keys = fn(
+                self.params, pool.cache, tokens, temps, top_ks, keys
+            )
+        else:
+            out, cache, keys = fn(
+                self.params, pool.cache, tokens, temps, top_ks, keys,
+                loras=self.loras, adapter_ids=pool.adapter_ids_dev,
+            )
+        if self._decode_n_steps == 1:
+            out = out[None]  # unify to [K, slots]
+        return out, cache, keys
 
-    def _prefill(self, params, cache, tokens, length, slot, adapter_id=0):
+    def _prefill(self, pool: _Pool, tokens, length, slot, adapter_id=0,
+                 prefix=None):
         import jax.numpy as jnp
 
-        if self.loras is None:
-            return self._prefill_jit(params, cache, tokens, length, slot)
-        return self._prefill_jit(
-            params, cache, tokens, length, slot,
-            loras=self.loras,
-            adapter_id=jnp.asarray([adapter_id], jnp.int32),
+        lora_kw = {}
+        if self.loras is not None:
+            lora_kw = dict(
+                loras=self.loras,
+                adapter_id=jnp.asarray([adapter_id], jnp.int32),
+            )
+        if prefix is None:
+            return self._prefill_jit(
+                self.params, pool.cache, tokens, length, slot, **lora_kw
+            )
+        return self._prefill_suffix_jit(
+            self.params, pool.cache, prefix["k"], prefix["v"],
+            tokens, length, slot, **lora_kw
         )
 
-    def _sync_adapter_ids(self):
+    def _sync_adapter_ids(self, pool: _Pool):
         if self.loras is not None:
             import jax.numpy as jnp
 
-            self._adapter_ids_dev = jnp.asarray(self._adapter_ids)
+            pool.adapter_ids_dev = jnp.asarray(pool.adapter_ids)
+
+    # -- prefix cache --------------------------------------------------------
+
+    def _prefix_key(self, ids: list[int], m: int) -> bytes:
+        import hashlib
+
+        return hashlib.sha1(
+            np.asarray(ids[:m], np.int32).tobytes()
+        ).digest()
+
+    def _prefix_lookup(self, ids: list[int]):
+        """Longest bucket-aligned cached prefix strictly shorter than the
+        prompt (>=1 suffix token must remain to produce last-logits)."""
+        if not self.config.engine.enable_prefix_caching:
+            return None, 0
+        for b in sorted(self.config.engine.prefill_buckets, reverse=True):
+            if b >= len(ids):
+                continue
+            key = self._prefix_key(ids, b)
+            entry = self._prefix_cache.get(key)
+            if entry is not None:
+                self._prefix_cache.move_to_end(key)
+                self._prefix_hits += 1
+                return entry, b
+        self._prefix_misses += 1
+        return None, 0
+
+    def _prefix_store(self, pool: _Pool, slot: int, ids: list[int]):
+        """After a miss prefill: cache this prompt's KV at every bucket
+        length it covers, bounded by BOTH an entry count and an HBM byte
+        budget (long-context entries are tens of MB each; an entry-only
+        cap could pin gigabytes)."""
+        ec = self.config.engine
+        if not ec.enable_prefix_caching:
+            return
+        for b in ec.prefill_buckets:
+            if b >= len(ids) or b > pool.stripe_len:
+                continue
+            key = self._prefix_key(ids, b)
+            if key in self._prefix_cache:
+                self._prefix_cache.move_to_end(key)
+                continue
+            k = pool.cache["k"][:, slot, :b]
+            v = pool.cache["v"][:, slot, :b]
+            nbytes = int(k.nbytes + v.nbytes)
+            self._prefix_cache[key] = {"k": k, "v": v, "nbytes": nbytes}
+            self._prefix_bytes += nbytes
+        while self._prefix_cache and (
+            len(self._prefix_cache) > ec.prefix_cache_entries
+            or self._prefix_bytes > ec.prefix_cache_max_bytes
+        ):
+            _, old = self._prefix_cache.popitem(last=False)
+            self._prefix_bytes -= old.get("nbytes", 0)
 
     # -- multi-LoRA ----------------------------------------------------------
 
@@ -373,6 +548,7 @@ class JaxEngine:
             metrics={
                 "ttft_s": (req.first_token_t or time.time()) - req.submitted_t,
                 "num_generated": len(req.out_tokens),
+                "prefix_hit_tokens": req.prefix_hit_tokens,
             },
         )
 
@@ -382,9 +558,19 @@ class JaxEngine:
 
     def get_stats(self) -> dict:
         return {
-            "active_slots": sum(s is not None for s in self._slots),
+            "active_slots": sum(
+                s is not None for p in self._pools for s in p.slots
+            ),
             "waiting": self._waiting.qsize(),
-            "max_num_seqs": len(self._slots),
+            "max_num_seqs": sum(p.n_slots for p in self._pools),
+            "pools": [
+                {"stripe_len": p.stripe_len, "n_slots": p.n_slots,
+                 "active": sum(s is not None for s in p.slots)}
+                for p in self._pools
+            ],
+            "prefix_cache_hits": self._prefix_hits,
+            "prefix_cache_misses": self._prefix_misses,
+            "prefix_cache_entries": len(self._prefix_cache),
         }
 
     # -- engine loop --------------------------------------------------------
@@ -395,130 +581,186 @@ class JaxEngine:
                 return b
         return self.config.engine.max_seq_len
 
+    def _pool_for(self, req: _Request) -> "_Pool":
+        """Smallest stripe class covering prompt + generation budget; if
+        none fits, the largest pool (out_of_room truncates there)."""
+        budget = len(req.prompt_token_ids) + req.params.max_tokens + 1
+        for pool in self._pools:  # sorted ascending by stripe_len
+            if pool.stripe_len >= budget:
+                return pool
+        return self._pools[-1]
+
+    def _admit(self, pool: "_Pool", slot: int, req: _Request) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        ids = req.prompt_token_ids
+        if len(ids) > pool.stripe_len - 1:
+            ids = ids[-(pool.stripe_len - 1):]
+            req.prompt_token_ids = ids
+        # LoRA'd requests never reuse base-model KV (the cached V lacks
+        # the adapter delta) — and their prefixes are never stored either
+        if req.lora_idx == 0:
+            prefix, m = self._prefix_lookup(ids)
+        else:
+            prefix, m = None, 0
+        suffix = ids[m:]
+        bucket = self._bucket(len(suffix))
+        bucket = min(bucket, pool.stripe_len)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, : len(suffix)] = suffix
+        pool.adapter_ids[slot] = req.lora_idx
+        self._sync_adapter_ids(pool)
+        last_logits, pool.cache = self._prefill(
+            pool,
+            jnp.asarray(toks),
+            jnp.asarray([len(suffix)], jnp.int32),
+            slot,
+            adapter_id=req.lora_idx,
+            prefix=prefix,
+        )
+        req.prefix_hit_tokens = m
+        if prefix is None and req.lora_idx == 0:
+            # LoRA'd prefixes are adapter-specific: never shared
+            self._prefix_store(pool, slot, ids)
+        # sample the first generated token from prefill logits (same top-K
+        # truncation as the decode program, and the request's own PRNG
+        # chain when seeded, so seeded generations reproduce regardless of
+        # batch composition)
+        first = int(np.argmax(np.asarray(last_logits)))
+        K = self._top_k_static
+        if req.params.seed is not None:
+            req_key = jax.random.PRNGKey(req.params.seed)
+        else:
+            self._rng_key, req_key = jax.random.split(self._rng_key)
+        req_key, sub = jax.random.split(req_key)
+        if req.params.temperature > 0:
+            l = jnp.asarray(last_logits)
+            k = min(max(1, req.params.top_k), K)
+            v, ix = jax.lax.top_k(l, k)
+            c = jax.random.categorical(
+                sub, v / max(req.params.temperature, 1e-6)
+            )
+            first = int(ix[c])
+        pool.slots[slot] = req
+        pool.temps[slot] = req.params.temperature
+        # decode truncates to the program's static top-K; clamp here so
+        # first token and all later tokens agree
+        pool.top_ks[slot] = min(max(1, req.params.top_k), K)
+        pool.keys = pool.keys.at[slot].set(req_key)
+        pool.pending_first[slot] = first
+        req.first_token_t = time.time()
+        self._emit(pool, slot, first)
+
     def _engine_loop(self):
         import jax
         import jax.numpy as jnp
 
-        ec = self.config.engine
-        temps = np.zeros((ec.max_num_seqs,), np.float32)
-        top_ks = np.full((ec.max_num_seqs,), 50, np.int32)
-        slot_keys = jax.random.split(
-            jax.random.PRNGKey(self.config.model.seed ^ 0x5EED), ec.max_num_seqs
-        )
-        self._pending_first: dict[int, int] = {}  # slot -> first sampled token
-        pending_first = self._pending_first
+        for i, pool in enumerate(self._pools):
+            pool.keys = jax.random.split(
+                jax.random.PRNGKey(self.config.model.seed ^ (0x5EED + i)),
+                pool.n_slots,
+            )
 
         while not self._stop.is_set():
-            # 1) admit waiting requests into free slots (prefill)
+            # 1) admit waiting requests into free slots (prefill). The
+            # backlog is engine-thread-owned and order-preserving: a head
+            # request whose stripe class is full must NOT starve shorter
+            # requests that fit other pools' free slots.
             admitted = False
-            for slot in range(ec.max_num_seqs):
-                if self._slots[slot] is not None:
+            try:
+                while True:
+                    self._backlog.append(self._waiting.get_nowait())
+            except queue.Empty:
+                pass
+            still_waiting = []
+            for req in self._backlog:
+                preferred = self._pool_for(req)
+                budget = len(req.prompt_token_ids) + req.params.max_tokens + 1
+                target = None
+                candidates = [preferred] + [
+                    p for p in self._pools
+                    if p is not preferred and p.stripe_len >= min(
+                        budget, preferred.stripe_len
+                    )
+                ]
+                for pool in candidates:
+                    for slot in range(pool.n_slots):
+                        if pool.slots[slot] is None:
+                            target = (pool, slot)
+                            break
+                    if target:
+                        break
+                if target is None:
+                    still_waiting.append(req)
                     continue
                 try:
-                    req = self._waiting.get_nowait()
-                except queue.Empty:
-                    break
-                try:
-                    ids = req.prompt_token_ids
-                    bucket = self._bucket(len(ids))
-                    toks = np.zeros((1, bucket), np.int32)
-                    toks[0, : len(ids)] = ids
-                    self._adapter_ids[slot] = req.lora_idx
-                    self._sync_adapter_ids()
-                    last_logits, self.cache = self._prefill(
-                        self.params,
-                        self.cache,
-                        jnp.asarray(toks),
-                        jnp.asarray([len(ids)], jnp.int32),
-                        slot,
-                        adapter_id=req.lora_idx,
-                    )
-                    # sample the first generated token from prefill logits
-                    # (same top-K truncation as the decode program, and the
-                    # request's own PRNG chain when seeded, so seeded
-                    # generations reproduce regardless of batch composition)
-                    first = int(np.argmax(np.asarray(last_logits)))
-                    K = self._top_k_static
-                    if req.params.seed is not None:
-                        req_key = jax.random.PRNGKey(req.params.seed)
-                    else:
-                        self._rng_key, req_key = jax.random.split(self._rng_key)
-                    req_key, sub = jax.random.split(req_key)
-                    if req.params.temperature > 0:
-                        l = jnp.asarray(last_logits)
-                        k = min(max(1, req.params.top_k), K)
-                        v, ix = jax.lax.top_k(l, k)
-                        c = jax.random.categorical(
-                            sub, v / max(req.params.temperature, 1e-6)
-                        )
-                        first = int(ix[c])
-                    self._slots[slot] = req
-                    temps[slot] = req.params.temperature
-                    # decode truncates to the program's static top-K; clamp
-                    # here so first token and all later tokens agree
-                    top_ks[slot] = min(max(1, req.params.top_k), K)
-                    slot_keys = slot_keys.at[slot].set(req_key)
-                    pending_first[slot] = first
-                    req.first_token_t = time.time()
-                    self._emit(slot, first)
+                    self._admit(target[0], target[1], req)
                     admitted = True
                 except BaseException as e:  # noqa: BLE001
                     req.error = e
                     req.done.set()
                     req.stream_queue.put(None)
+            self._backlog = still_waiting
 
-            active = [s for s, r in enumerate(self._slots) if r is not None]
-            if not active:
-                time.sleep(0.002 if admitted else 0.005)
-                continue
-
-            # 2) one decode step over ALL slots (static shape)
-            tokens = np.zeros((ec.max_num_seqs,), np.int32)
-            for slot in active:
-                req = self._slots[slot]
-                tokens[slot] = (
-                    pending_first.pop(slot)
-                    if slot in pending_first
-                    else req.out_tokens[-1]
-                )
-            try:
-                next_tokens, self.cache, slot_keys = self._decode(
-                    self.params,
-                    self.cache,
-                    jnp.asarray(tokens),
-                    jnp.asarray(temps),
-                    jnp.asarray(top_ks),
-                    slot_keys,
-                )
-                next_np = np.asarray(next_tokens)
-            except BaseException as e:  # noqa: BLE001 — device/runtime failure
-                # fail every in-flight request (callers must never hang on a
-                # dead engine loop) and keep the loop alive for new work
-                logger.error("decode step failed: %r", e)
+            any_active = False
+            # 2) one decode step per pool with active slots (each pool is
+            # its own compiled program; static shapes per pool)
+            for pool in self._pools:
+                active = [s for s, r in enumerate(pool.slots) if r is not None]
+                if not active:
+                    continue
+                any_active = True
+                tokens = np.zeros((pool.n_slots,), np.int32)
                 for slot in active:
-                    req = self._slots[slot]
-                    self._slots[slot] = None
-                    pending_first.pop(slot, None)
-                    req.error = e
-                    req.stream_queue.put(None)
-                    req.done.set()
-                from ray_tpu.models.llama import init_kv_cache
+                    req = pool.slots[slot]
+                    tokens[slot] = (
+                        pool.pending_first.pop(slot)
+                        if slot in pool.pending_first
+                        else req.out_tokens[-1]
+                    )
+                try:
+                    step_tokens, pool.cache, pool.keys = self._decode(
+                        pool,
+                        jnp.asarray(tokens),
+                        jnp.asarray(pool.temps),
+                        jnp.asarray(pool.top_ks),
+                        pool.keys,
+                    )
+                    next_np = np.asarray(step_tokens)  # [K, slots]
+                except BaseException as e:  # noqa: BLE001 — device failure
+                    # fail every in-flight request of THIS pool (callers
+                    # must never hang on a dead engine loop) and keep going
+                    logger.error("decode step failed: %r", e)
+                    from ray_tpu.models.llama import init_kv_cache
 
-                self.cache = init_kv_cache(
-                    self.model_cfg, ec.max_num_seqs, ec.max_seq_len
-                )
-                continue
+                    for slot in active:
+                        req = pool.slots[slot]
+                        pool.slots[slot] = None
+                        pool.pending_first.pop(slot, None)
+                        req.error = e
+                        req.stream_queue.put(None)
+                        req.done.set()
+                    pool.cache = init_kv_cache(
+                        self.model_cfg, pool.n_slots, pool.stripe_len
+                    )
+                    continue
 
-            # 3) bookkeeping: emit tokens, finish slots
-            for slot in active:
-                req = self._slots[slot]
-                tok = int(next_np[slot])
-                self._emit(slot, tok)
+                # 3) bookkeeping: emit tokens, finish slots. With
+                # multi-step decode, a slot that finishes mid-scan simply
+                # ignores its remaining over-decoded tokens.
+                for k in range(next_np.shape[0]):
+                    for slot in active:
+                        if pool.slots[slot] is None:
+                            continue
+                        self._emit(pool, slot, int(next_np[k, slot]))
+            if not any_active:
+                time.sleep(0.002 if admitted else 0.005)
 
-    def _emit(self, slot: int, token: int):
+    def _emit(self, pool: "_Pool", slot: int, token: int):
         """Record a generated token for the request in `slot`; finish on
-        eos/max_tokens/cache-full."""
-        req = self._slots[slot]
+        eos/max_tokens/stripe-full."""
+        req = pool.slots[slot]
         if req is None:
             return
         p = req.params
@@ -537,15 +779,15 @@ class JaxEngine:
                 }
             )
         total = len(req.prompt_token_ids) + len(req.out_tokens)
-        out_of_room = total >= self.config.engine.max_seq_len
+        out_of_room = total >= pool.stripe_len
         if is_stop or len(req.out_tokens) >= p.max_tokens or out_of_room:
             req.finish_reason = "stop" if is_stop else "length"
-            self._slots[slot] = None
-            if self._adapter_ids[slot]:
-                self._adapter_ids[slot] = 0
-                self._sync_adapter_ids()
+            pool.slots[slot] = None
+            if pool.adapter_ids[slot]:
+                pool.adapter_ids[slot] = 0
+                self._sync_adapter_ids(pool)
             # a request can finish at admission (max_tokens=1): its queued
             # first token must not leak into the slot's next occupant
-            getattr(self, "_pending_first", {}).pop(slot, None)
+            pool.pending_first.pop(slot, None)
             req.stream_queue.put(None)
             req.done.set()

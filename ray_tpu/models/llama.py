@@ -143,6 +143,22 @@ class LlamaConfig:
         return LlamaConfig(**d)
 
     @staticmethod
+    def llama32_3b(**kw) -> "LlamaConfig":
+        d = dict(
+            vocab_size=128256,
+            d_model=3072,
+            n_layers=28,
+            n_heads=24,
+            n_kv_heads=8,
+            d_ff=8192,
+            max_seq_len=8192,
+            rope_theta=500000.0,
+            tie_embeddings=True,
+        )
+        d.update(kw)
+        return LlamaConfig(**d)
+
+    @staticmethod
     def llama3_70b(**kw) -> "LlamaConfig":
         d = dict(
             vocab_size=128256,
@@ -738,20 +754,27 @@ def _decode_forward(
 
 def prefill(
     params, cache, tokens, cfg: LlamaConfig, lengths=None,
-    loras=None, adapter_ids=None,
+    loras=None, adapter_ids=None, start_pos=None,
 ):
     """Process a prompt batch. tokens: [B, T] (right-padded); lengths: [B].
-    Returns (last-token logits [B, vocab], cache)."""
+    Returns (last-token logits [B, vocab], cache).
+
+    ``start_pos`` [B]: absolute position of tokens[:, 0] — the SUFFIX
+    prefill used by prefix caching (the cache already holds positions
+    0..start_pos-1 copied from a cached prefix; this call extends it)."""
     B, T = tokens.shape
     if lengths is None:
         lengths = jnp.full((B,), T, jnp.int32)
-    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
-    valid = positions < lengths[:, None]
+    rel = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    if start_pos is None:
+        start_pos = jnp.zeros((B,), jnp.int32)
+    positions = rel + start_pos[:, None]
+    valid = rel < lengths[:, None]
     logits, cache = _decode_forward(
         params, cache, tokens, positions, cfg, valid,
         loras=loras, adapter_ids=adapter_ids,
     )
-    cache["length"] = lengths
+    cache["length"] = start_pos + lengths
     last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
     return last, cache
 

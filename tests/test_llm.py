@@ -428,3 +428,88 @@ def test_lora_openai_model_id_routing(ray_start_thread):
     bad_adapter = post(f"{cfg.served_name}:absent")
     assert bad_adapter["error"]["code"] == 404
     serve.shutdown()
+
+
+def test_prefix_cache_hit_and_equivalence(engine):
+    """Requests sharing a prompt prefix reuse cached KV (hit recorded) and
+    produce EXACTLY the same tokens as a cold computation (reference role:
+    vLLM's prefix caching, vllm_engine.py)."""
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    system = "You are a helpful assistant. " * 2  # > smallest bucket
+    cold = engine.generate(system + "What is 2+2?", sampling_params=sp)
+    hits_before = engine.get_stats()["prefix_cache_hits"]
+    warm_same = engine.generate(system + "What is 2+2?", sampling_params=sp)
+    warm_other = engine.generate(system + "Name a color.", sampling_params=sp)
+    stats = engine.get_stats()
+    assert stats["prefix_cache_hits"] > hits_before
+    assert warm_same.metrics["prefix_hit_tokens"] > 0
+    # prefix reuse must not change results (greedy)
+    assert warm_same.token_ids == cold.token_ids
+    assert warm_other.metrics["prefix_hit_tokens"] > 0
+
+
+def test_seq_len_bucket_pools():
+    """Stripe pools: short chats run in short-stripe slots; long requests
+    land in the long pool; both produce identical results to a single-pool
+    engine (greedy)."""
+    base = LLMConfig(
+        model=ModelConfig(model_id="tiny", tokenizer="byte", seed=0),
+        engine=EngineConfig(
+            max_num_seqs=4, max_seq_len=128,
+            prefill_buckets=(16, 32, 64, 128),
+        ),
+    )
+    pooled = LLMConfig(
+        model=ModelConfig(model_id="tiny", tokenizer="byte", seed=0),
+        engine=EngineConfig(
+            max_num_seqs=4, max_seq_len=128,
+            prefill_buckets=(16, 32, 64, 128),
+            seq_len_buckets=(32, 128), seqs_per_bucket=(2, 2),
+            enable_prefix_caching=False,
+        ),
+    )
+    e1 = JaxEngine(base)
+    e2 = JaxEngine(pooled)
+    try:
+        sp_short = SamplingParams(max_tokens=6, temperature=0.0)
+        sp_long = SamplingParams(max_tokens=40, temperature=0.0)
+        short_prompt = "hi there"
+        long_prompt = "tell me a long story " * 3
+        r1s = e1.generate(short_prompt, sampling_params=sp_short)
+        r2s = e2.generate(short_prompt, sampling_params=sp_short)
+        assert r1s.token_ids == r2s.token_ids
+        r1l = e1.generate(long_prompt, sampling_params=sp_long)
+        r2l = e2.generate(long_prompt, sampling_params=sp_long)
+        assert r1l.token_ids == r2l.token_ids
+        pools = e2.get_stats()["pools"]
+        assert [p["stripe_len"] for p in pools] == [32, 128]
+    finally:
+        e1.shutdown()
+        e2.shutdown()
+
+
+def test_multi_step_decode_equivalence():
+    """decode_steps=4 (K steps per device program) produces exactly the
+    single-step greedy tokens — only host round trips differ."""
+    one = LLMConfig(
+        model=ModelConfig(model_id="tiny", tokenizer="byte", seed=0),
+        engine=EngineConfig(max_num_seqs=2, max_seq_len=128,
+                            prefill_buckets=(16, 32, 64, 128),
+                            enable_prefix_caching=False),
+    )
+    multi = LLMConfig(
+        model=ModelConfig(model_id="tiny", tokenizer="byte", seed=0),
+        engine=EngineConfig(max_num_seqs=2, max_seq_len=128,
+                            prefill_buckets=(16, 32, 64, 128),
+                            enable_prefix_caching=False, decode_steps=4),
+    )
+    e1, e2 = JaxEngine(one), JaxEngine(multi)
+    try:
+        sp = SamplingParams(max_tokens=11, temperature=0.0, ignore_eos=True)
+        r1 = e1.generate("multi step decode test", sampling_params=sp)
+        r2 = e2.generate("multi step decode test", sampling_params=sp)
+        assert r1.token_ids == r2.token_ids
+        assert len(r2.token_ids) == 11  # max_tokens honored despite K=4
+    finally:
+        e1.shutdown()
+        e2.shutdown()
