@@ -141,7 +141,9 @@ def decode_bench(on_tpu: bool) -> dict:
         # timed prompts will use (cold TTFT must measure prefill, not XLA
         # compilation)
         engine.generate(prompt, sampling_params=sp)
-        engine.generate("warmup pass two " * 12 + prompt, sampling_params=sp)
+        # warm the exact shape class the timed prompts use (same pattern,
+        # different leading tokens so it cannot seed a prefix hit for them)
+        engine.generate("request w: " * 4 + prompt, sampling_params=sp)
 
         # COLD prompts: each starts with unique leading text so no
         # bucket-aligned prefix of the warmup (or of each other) hits the

@@ -132,9 +132,21 @@ class JaxEngine:
                 )
         else:
             base = ec.max_num_seqs // len(buckets)
-            counts = tuple(
+            counts = list(
                 base + (1 if i < ec.max_num_seqs % len(buckets) else 0)
                 for i in range(len(buckets))
+            )
+            # the max_seq_len class must always exist: without it, long
+            # requests silently truncate to a shorter stripe
+            ordered = sorted(range(len(buckets)), key=lambda i: buckets[i])
+            if counts[ordered[-1]] == 0:
+                donor = max(ordered, key=lambda i: counts[i])
+                counts[donor] -= 1
+                counts[ordered[-1]] = 1
+        if dict(zip(buckets, counts)).get(ec.max_seq_len, 0) <= 0:
+            raise ValueError(
+                "seqs_per_bucket must give the max_seq_len bucket at least "
+                "one slot (long requests would silently truncate)"
             )
         self._pools = [
             _Pool(b, n, self.model_cfg)
@@ -561,7 +573,7 @@ class JaxEngine:
             "active_slots": sum(
                 s is not None for p in self._pools for s in p.slots
             ),
-            "waiting": self._waiting.qsize(),
+            "waiting": self._waiting.qsize() + len(self._backlog),
             "max_num_seqs": sum(p.n_slots for p in self._pools),
             "pools": [
                 {"stripe_len": p.stripe_len, "n_slots": p.n_slots,

@@ -167,15 +167,19 @@ def envelope(num_cpus: int = 8) -> list[dict]:
 
     import ray_tpu
 
-    ray_tpu.init(num_cpus=num_cpus, mode="thread")
     results = []
 
-    @ray_tpu.remote(num_cpus=0)
-    def tick(i):
-        return i
-
-    # --- queued-task depth sweep: submit into a deep queue, then drain ---
+    # --- queued-task depth sweep: submit into a deep queue, then drain.
+    # Each depth runs in a FRESH cluster so rows are comparable and free of
+    # cross-row interpreter-heap effects (the reference's release
+    # benchmarks likewise isolate workloads).
     for depth in (5_000, 50_000, 100_000):
+        ray_tpu.init(num_cpus=num_cpus, mode="thread")
+
+        @ray_tpu.remote(num_cpus=0)
+        def tick(i):
+            return i
+
         t0 = time.perf_counter()
         refs = [tick.remote(i) for i in range(depth)]
         submit_dur = time.perf_counter() - t0
@@ -194,6 +198,9 @@ def envelope(num_cpus: int = 8) -> list[dict]:
         )
         results.append(row)
         del refs, out
+        ray_tpu.shutdown()
+
+    ray_tpu.init(num_cpus=num_cpus, mode="thread")
 
     # --- many actors: create 1000, call each once ---
     @ray_tpu.remote(num_cpus=0)
