@@ -44,6 +44,14 @@ class Config:
     worker_pool_prestart: bool = True
     idle_worker_kill_s: float = 300.0
     maximum_startup_concurrency: int = 2
+    # Soft cap on pooled (non-actor) workers per node; 0 = auto (the node's
+    # CPU count + 4). Beyond the cap the pool grows only while it is
+    # *blocked* — no task has completed on the node for
+    # ``worker_pool_growth_idle_s`` — so long/blocking zero-CPU tasks still
+    # fan out, but short-task churn can't spawn-storm the host (reference:
+    # the WorkerPool soft limit keyed to num_cpus, worker_pool.h:283).
+    worker_pool_soft_limit: int = 0
+    worker_pool_growth_idle_s: float = 0.25
     # --- object store ---
     # Objects <= this many bytes are returned inline through the control plane
     # (reference: max_direct_call_object_size, ray_config_def.h).

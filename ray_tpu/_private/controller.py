@@ -68,6 +68,13 @@ class NodeState:
         # and fake test nodes (reference: raylet vs. cluster_utils nodes).
         self.agent: Optional["AgentHandle"] = None
         self.last_heartbeat = time.monotonic()
+        # Worker-pool discipline (see Config.worker_pool_soft_limit): pooled
+        # task workers alive + starting on this node, and when a task last
+        # finished here (a recent completion means the pool is churning and
+        # will free a worker shortly — growing it would spawn-storm).
+        self.task_workers = 0
+        self.starting_workers = 0
+        self.last_task_done_t = 0.0
 
     def fits(self, demand: dict[str, float]) -> bool:
         return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
@@ -104,6 +111,10 @@ class WorkerHandle:
         # Environment fingerprint this worker was spawned with (TPU
         # visibility, runtime_env vars); only matching tasks may reuse it.
         self.fingerprint = (False, ())
+        # True while this worker is counted in its node's task_workers pool
+        # gauge — flipped exactly once each way so retirement paths can't
+        # double- or miss-decrement (pool-cap accounting).
+        self.pooled_counted = False
         self.is_driver = False  # client drivers are never scheduling targets
         # refs this client driver holds — released if it detaches uncleanly
         self.held_refs: set = set()
@@ -1553,6 +1564,11 @@ class Controller:
             tuple(str(m) for m in (rt.get("py_modules") or ())),
         )
 
+    def _worker_pool_cap(self, node: NodeState) -> int:
+        if self.config.worker_pool_soft_limit > 0:
+            return self.config.worker_pool_soft_limit
+        return int(node.total.get("CPU", 0)) + 4
+
     def _acquire_worker(self, node: NodeState, pt: PendingTask) -> Optional[WorkerHandle]:
         idle = self.idle_workers.get(node.node_id, [])
         want = self._env_fingerprint(pt.spec)
@@ -1565,11 +1581,61 @@ class Controller:
                 return w
         if self.starting_workers >= self.config.maximum_startup_concurrency:
             return None
+        # Soft pool cap: past it, grow only while the pool is *blocked*
+        # (nothing completed recently). Short-task churn keeps completing, so
+        # a deep queue of cheap tasks reuses a bounded pool instead of
+        # spawning a worker per scheduling round (the 100k-queue cliff was
+        # exactly this: thousands of one-shot worker threads strangling the
+        # host). Blocking workloads (e.g. zero-CPU gates) stop completing, so
+        # the pool still fans out — rate-limited by startup concurrency.
+        if node.task_workers + node.starting_workers >= self._worker_pool_cap(node):
+            if time.monotonic() - node.last_task_done_t < self.config.worker_pool_growth_idle_s:
+                # A mismatched-fingerprint idle worker at cap would deadlock
+                # the shape; evict one to make room for the right env.
+                evicted = False
+                for i in range(len(idle) - 1, -1, -1):
+                    if not idle[i].dead and idle[i].fingerprint != want:
+                        w = idle.pop(i)
+                        self._kill_pooled_worker(w)
+                        evicted = True
+                        break
+                if not evicted:
+                    return None
         self.starting_workers += 1
+        node.starting_workers += 1
         threading.Thread(
             target=self._start_worker, args=(node.node_id, pt.spec), daemon=True
         ).start()
         return None
+
+    def _uncount_pooled(self, w: WorkerHandle):
+        """Remove a worker from its node's pool gauge (idempotent via the
+        per-worker flag; call under self.lock)."""
+        if not w.pooled_counted:
+            return
+        w.pooled_counted = False
+        node = self.nodes.get(w.node_id)
+        if node is not None and node.task_workers > 0:
+            node.task_workers -= 1
+
+    def _pool_worker_freed(self, w: WorkerHandle):
+        """A pooled worker finished its task and returned to idle: stamp the
+        churn clock (the growth throttle keys off pooled-worker completions
+        only — actor method completions never free a pooled worker and must
+        not suppress growth). Call under self.lock."""
+        node = self.nodes.get(w.node_id)
+        if node is not None:
+            node.last_task_done_t = time.monotonic()
+
+    def _kill_pooled_worker(self, w: WorkerHandle):
+        """Retire an idle pooled worker (fingerprint eviction / idle reap)."""
+        w.dead = True
+        try:
+            w.send(P.Shutdown())
+        except Exception:
+            pass
+        self._uncount_pooled(w)
+        self.workers.pop(w.worker_id, None)
 
     def _start_worker(self, node_id: NodeID, spec_hint: TaskSpec):
         try:
@@ -1577,15 +1643,27 @@ class Controller:
             ok = worker.registered.wait(self.config.worker_register_timeout_s)
             with self.lock:
                 self.starting_workers -= 1
-                if ok:
+                node = self.nodes.get(node_id)
+                if node is not None and node.starting_workers > 0:
+                    node.starting_workers -= 1
+                if ok and not worker.dead:
+                    # registered-then-died race: _on_worker_death may have run
+                    # already (worker.dead set under this lock) — don't count
+                    # or pool a corpse
+                    worker.pooled_counted = True
+                    if node is not None:
+                        node.task_workers += 1
                     self.idle_workers[node_id].append(worker)
-                else:
+                elif not ok:
                     worker.dead = True
                     logger.error("worker failed to register in time")
                 self.sched_cv.notify_all()
         except Exception:
             with self.lock:
                 self.starting_workers -= 1
+                node = self.nodes.get(node_id)
+                if node is not None and node.starting_workers > 0:
+                    node.starting_workers -= 1
             logger.error("worker spawn failed:\n%s", traceback.format_exc())
 
     def _spawn_worker_process(self, node_id: NodeID, spec_hint: TaskSpec) -> WorkerHandle:
@@ -2537,6 +2615,7 @@ class Controller:
                         self._release_task_resources(pt)
                         if not worker.dead and worker.actor_id is None:
                             self.idle_workers[worker.node_id].append(worker)
+                            self._pool_worker_freed(worker)
                     self._fail_task(pt, ObjectLostError(a[1].hex()))
                     return
                 kind, payload = entry
@@ -2607,12 +2686,20 @@ class Controller:
                         actor.death_cause = "creation task failed"
                         self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "DEAD", "reason": "creation task failed"})
                         self._drain_actor_queue(actor)
+                        # the worker survives a raising __init__ — back to
+                        # the pool, not a leaked cap slot
+                        if not worker.dead and worker.actor_id is None:
+                            worker.last_idle_t = time.monotonic()
+                            self.idle_workers[worker.node_id].append(worker)
+                            self._pool_worker_freed(worker)
                     else:
                         actor.state = "ALIVE"
                         actor.worker = worker
                         self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "ALIVE"})
                         actor.held = (getattr(pt, "_node", None), getattr(pt, "_pg_bundle", None), dict(spec.resources))
                         worker.actor_id = actor.actor_id
+                        # dedicated to the actor now — no longer a pooled worker
+                        self._uncount_pooled(worker)
                         self._pump_actor(actor)
             elif spec.is_actor_task():
                 actor = self.actors.get(spec.actor_id)
@@ -2624,6 +2711,7 @@ class Controller:
                 if not worker.dead and worker.actor_id is None:
                     worker.last_idle_t = time.monotonic()
                     self.idle_workers[worker.node_id].append(worker)
+                    self._pool_worker_freed(worker)
             self.sched_cv.notify_all()
         self._persist_state()
 
@@ -2651,6 +2739,7 @@ class Controller:
                 if not worker.dead and worker.actor_id is None:
                     worker.last_idle_t = time.monotonic()
                     self.idle_workers[worker.node_id].append(worker)
+                    self._pool_worker_freed(worker)
                 self._enqueue_ready(pt)
             self.sched_cv.notify_all()
         logger.warning(
@@ -2686,6 +2775,7 @@ class Controller:
                 return
             worker.dead = True
             self.workers.pop(worker.worker_id, None)
+            self._uncount_pooled(worker)
             pool = self.idle_workers.get(worker.node_id)
             if pool and worker in pool:
                 pool.remove(worker)
