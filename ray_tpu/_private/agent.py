@@ -99,6 +99,36 @@ class NodeAgent:
         # kills that arrived before their spawn finished
         self._pending_kills: set[WorkerID] = set()
 
+        # ---- local task dispatch (LocalTaskManager analog) ----
+        # The head leases normal tasks to this node; the agent owns worker
+        # pop/spawn and a local queue (two-level scheduling,
+        # local_task_manager.h:60). Keyed by env fingerprint so workers are
+        # only reused by compatible tasks.
+        self._lease_lock = threading.RLock()
+        self._leased: dict[bytes, P.LeaseTask] = {}  # task_id -> lease msg
+        # workers THIS agent spawned for leased tasks (vs head-managed
+        # spawns): wid -> env fingerprint, set at spawn time
+        self._agent_owned: dict[WorkerID, tuple] = {}
+        self._fp_idle: dict[tuple, list[WorkerID]] = {}
+        self._wid_fp: dict[WorkerID, tuple] = {}
+        self._busy: dict[WorkerID, set[bytes]] = {}  # wid -> running task_ids
+        self._local_queue: "list[P.LeaseTask]" = []
+        self._spawning = 0
+        # same knobs that govern the head's pool (RAY_TPU_* env-overridable
+        # on this host): soft cap, blocked-growth window, register timeout
+        from ray_tpu._private.config import get_config
+
+        cfg = get_config()
+        self._pool_cap = cfg.worker_pool_soft_limit or (
+            int(self.resources.get("CPU", 0)) + 4
+        )
+        self._growth_idle_s = max(cfg.worker_pool_growth_idle_s, 0.05)
+        self._register_timeout_s = cfg.worker_register_timeout_s
+        self._last_local_done = 0.0
+        # local queue beyond this spills back to the head for re-placement
+        # (the head caps its outstanding leases to the same bound)
+        self._spill_threshold = max(4 * (int(self.resources.get("CPU", 0)) + 4), 64)
+
         # Own-request plumbing (agent → controller RPCs).
         self._req_counter = itertools.count(1)
         self._replies: dict[int, Any] = {}
@@ -162,6 +192,9 @@ class NodeAgent:
         )
         threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="agent-hb"
+        ).start()
+        threading.Thread(
+            target=self._pump_loop, daemon=True, name="agent-pump"
         ).start()
 
     # ------------------------------------------------------------- transport
@@ -253,6 +286,14 @@ class NodeAgent:
             workers = list(self.workers.values())
             self.workers.clear()
             self._pending_kills.clear()
+        with self._lease_lock:
+            self._leased.clear()
+            self._local_queue.clear()
+            self._agent_owned.clear()
+            self._fp_idle.clear()
+            self._wid_fp.clear()
+            self._busy.clear()
+            self._spawning = 0
         for w in workers:
             proc = w.get("proc")
             if proc is not None:
@@ -296,6 +337,8 @@ class NodeAgent:
             threading.Thread(
                 target=self._spawn_worker, args=(msg,), daemon=True
             ).start()
+        elif isinstance(msg, P.LeaseTask):
+            self._on_lease_task(msg)
         elif isinstance(msg, P.KillWorker):
             with self.workers_lock:
                 w = self.workers.get(msg.worker_id)
@@ -348,6 +391,161 @@ class NodeAgent:
                 pass
             time.sleep(2.0)
 
+    # ------------------------------------------------- local task dispatch
+
+    @staticmethod
+    def _lease_fp(lease: P.LeaseTask) -> tuple:
+        return (lease.needs_tpu, tuple(sorted(lease.env_vars.items())))
+
+    def _on_lease_task(self, lease: P.LeaseTask):
+        """Second-level dispatch: the head picked this node; the agent picks
+        (or spawns) the worker (reference: LocalTaskManager dispatch,
+        local_task_manager.h:60)."""
+        spill = None
+        with self._lease_lock:
+            self._leased[lease.spec.task_id.binary()] = lease
+            if not self._try_dispatch_local(lease):
+                self._local_queue.append(lease)
+                if len(self._local_queue) > self._spill_threshold:
+                    # overload spillback: hand the newest tasks back for
+                    # re-placement on another node
+                    excess = self._local_queue[self._spill_threshold :]
+                    del self._local_queue[self._spill_threshold :]
+                    spill = []
+                    for lt in excess:
+                        k = lt.spec.task_id.binary()
+                        self._leased.pop(k, None)
+                        spill.append(k)
+        if spill:
+            try:
+                self._send(P.TaskSpilled(spill, reason="overload"))
+            except (OSError, EOFError):
+                pass
+
+    def _try_dispatch_local(self, lease: P.LeaseTask) -> bool:
+        """Pop an idle compatible worker or start one (call under
+        _lease_lock). Returns True when the task went to a worker."""
+        fp = self._lease_fp(lease)
+        idle = self._fp_idle.get(fp)
+        while idle:
+            wid = idle.pop()
+            if wid not in self._wid_fp:
+                continue  # retired
+            if self._send_to_worker(wid, P.ExecuteTask(lease.spec, lease.resolved_args)):
+                self._busy.setdefault(wid, set()).add(lease.spec.task_id.binary())
+                return True
+            self._retire_local_worker(wid)
+        n = len(self._wid_fp) + self._spawning
+        # grow: under cap freely; past cap only while the pool is blocked
+        # (nothing completed locally — e.g. every worker waits on a nested
+        # task), mirroring the head's churn-aware growth rule
+        blocked = (time.monotonic() - self._last_local_done) > self._growth_idle_s
+        if self.shutting_down:
+            return False
+        if n < self._pool_cap or (blocked and self._spawning == 0):
+            self._spawning += 1
+            wid = WorkerID.from_random()
+            self._agent_owned[wid] = fp
+            threading.Thread(
+                target=self._spawn_worker,
+                args=(
+                    P.SpawnWorker(
+                        wid, dict(lease.env_vars), lease.needs_tpu, fp, packages=[]
+                    ),
+                ),
+                daemon=True,
+            ).start()
+        return False
+
+    def _send_to_worker(self, wid: WorkerID, msg) -> bool:
+        with self.workers_lock:
+            w = self.workers.get(wid)
+        if w is None or w.get("conn") is None:
+            return False
+        try:
+            with w["lock"]:
+                w["conn"].send(msg)
+            return True
+        except (OSError, EOFError):
+            return False
+
+    def _retire_local_worker(self, wid: WorkerID):
+        """Drop a worker from the local pool maps (under _lease_lock)."""
+        fp = self._wid_fp.pop(wid, None)
+        if fp is not None:
+            idle = self._fp_idle.get(fp)
+            if idle and wid in idle:
+                idle.remove(wid)
+        self._busy.pop(wid, None)
+
+    def _on_local_worker_ready(self, wid: WorkerID, fp: tuple):
+        """An agent-owned worker finished handshaking: join the pool and
+        drain the local queue."""
+        with self._lease_lock:
+            self._spawning = max(0, self._spawning - 1)
+            self._wid_fp[wid] = fp
+            self._fp_idle.setdefault(fp, []).append(wid)
+            self._pump_local_locked()
+
+    def _pump_local_locked(self):
+        i = 0
+        while i < len(self._local_queue):
+            if self._try_dispatch_local(self._local_queue[i]):
+                self._local_queue.pop(i)
+            else:
+                i += 1
+
+    def _pump_loop(self):
+        """Periodic local pump: retries queued leases (covers the blocked-
+        pool growth window where no completion/handshake event fires)."""
+        while not self.shutting_down:
+            time.sleep(0.25)
+            with self._lease_lock:
+                if self._local_queue:
+                    self._pump_local_locked()
+
+    def _on_leased_task_done(self, wid: WorkerID, msg: P.TaskDone) -> bool:
+        """Intercept TaskDone for tasks THIS agent dispatched: report
+        AgentTaskDone to the head and reuse the worker immediately. Returns
+        False when the task wasn't agent-leased (head-managed path)."""
+        tid = msg.task_id.binary()
+        with self._lease_lock:
+            lease = self._leased.pop(tid, None)
+            if lease is None:
+                return False
+            self._last_local_done = time.monotonic()
+            running = self._busy.get(wid)
+            if running is not None:
+                running.discard(tid)
+            fp = self._wid_fp.get(wid)
+            if fp is not None:
+                self._fp_idle.setdefault(fp, []).append(wid)
+                self._pump_local_locked()
+        try:
+            self._send(P.AgentTaskDone(msg.task_id, msg.results, msg.exec_ms))
+        except (OSError, EOFError):
+            pass
+        return True
+
+    def _on_local_worker_death(self, wid: WorkerID):
+        """Spill this worker's in-flight leased tasks back to the head."""
+        with self._lease_lock:
+            was_spawning = self._agent_owned.pop(wid, None) is not None and wid not in self._wid_fp
+            if was_spawning:
+                self._spawning = max(0, self._spawning - 1)
+            running = self._busy.pop(wid, set())
+            self._retire_local_worker(wid)
+            ids = []
+            for tid in running:
+                if self._leased.pop(tid, None) is not None:
+                    ids.append(tid)
+            self._pump_local_locked()
+        if ids:
+            try:
+                self._send(P.TaskSpilled(ids, reason="worker_died"))
+            except (OSError, EOFError):
+                pass
+
     # --------------------------------------------------------- worker plane
 
     def _spawn_worker(self, msg: P.SpawnWorker):
@@ -385,6 +583,7 @@ class NodeAgent:
                 cwd=cwd,
             )
         except OSError as e:
+            self._on_local_worker_death(msg.worker_id)
             self._send(P.WorkerDied(msg.worker_id, f"spawn failed: {e}"))
             return
         with self.workers_lock:
@@ -400,6 +599,33 @@ class NodeAgent:
                 proc.terminate()
             except OSError:
                 pass
+            return
+        if msg.worker_id in self._agent_owned:
+            self._watch_agent_spawn(msg.worker_id, proc)
+
+    def _watch_agent_spawn(self, wid: WorkerID, proc):
+        """Reap an agent-owned worker that dies (or hangs) before its
+        handshake — without this, _spawning leaks and the blocked-growth
+        clause can never fire again (the head path has
+        worker_register_timeout_s; this is the agent-side equivalent)."""
+        deadline = time.monotonic() + self._register_timeout_s
+        while time.monotonic() < deadline and not self.shutting_down:
+            with self._lease_lock:
+                if wid in self._wid_fp:
+                    return  # joined the pool
+            if proc.poll() is not None:
+                break  # died before handshake
+            time.sleep(0.5)
+        with self.workers_lock:
+            w = self.workers.get(wid)
+            if w is not None and w.get("conn") is not None:
+                return  # handshake raced in; the reader owns lifecycle now
+            self.workers.pop(wid, None)
+        try:
+            proc.terminate()
+        except OSError:
+            pass
+        self._on_local_worker_death(wid)
 
     def _stage_package(self, name: str, blob: bytes) -> str:
         """Unpack a shipped runtime-env zip into the agent's staging area,
@@ -444,7 +670,13 @@ class NodeAgent:
                 conn.close()
                 return
             w["conn"] = conn
+        # register with the head either way: the head tracks identity (for
+        # the worker's own control-plane ops) even when the AGENT schedules
+        # onto it (agent-owned pool workers)
         self._send(P.FromWorker(msg.worker_id, msg))
+        fp = self._agent_owned.get(msg.worker_id)
+        if fp is not None:
+            self._on_local_worker_ready(msg.worker_id, fp)
         self._worker_reader(msg.worker_id, conn)
 
     def _worker_reader(self, worker_id: WorkerID, conn):
@@ -462,6 +694,7 @@ class NodeAgent:
                 )
         with self.workers_lock:
             w = self.workers.pop(worker_id, None)
+        self._on_local_worker_death(worker_id)
         reason = "connection closed"
         if w is not None and w.get("proc") is not None:
             rc = w["proc"].poll()
@@ -499,6 +732,8 @@ class NodeAgent:
                 if kind == "plasma":
                     self.store.seal(oid, payload[0], payload[1])
                     self._track_seal(oid, payload[0], payload[1])
+            if self._on_leased_task_done(worker_id, msg):
+                return  # reported as AgentTaskDone; head never saw a dispatch
         self._send(P.FromWorker(worker_id, msg))
 
     def _track_seal(self, object_id: ObjectID, name: str, size: int):

@@ -52,6 +52,12 @@ class Config:
     # the WorkerPool soft limit keyed to num_cpus, worker_pool.h:283).
     worker_pool_soft_limit: int = 0
     worker_pool_growth_idle_s: float = 0.25
+    # Task-pipelining depth per leased worker: when every worker of a shape
+    # is busy and the pool can't grow, up to this many same-shape normal
+    # tasks are dispatched to one worker's FIFO queue, amortizing the
+    # per-dispatch round trip (reference: max_tasks_in_flight_per_worker in
+    # the direct task submitter, normal_task_submitter.h:79). 1 disables.
+    max_tasks_in_flight_per_worker: int = 4
     # --- object store ---
     # Objects <= this many bytes are returned inline through the control plane
     # (reference: max_direct_call_object_size, ray_config_def.h).

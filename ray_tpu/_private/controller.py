@@ -75,6 +75,10 @@ class NodeState:
         self.task_workers = 0
         self.starting_workers = 0
         self.last_task_done_t = 0.0
+        # Normal tasks leased to this node's agent for LOCAL dispatch
+        # (two-level scheduling): task_id binary -> PendingTask. The head
+        # holds the resource charge; the agent owns worker pop/queueing.
+        self.leased: dict[bytes, "PendingTask"] = {}
 
     def fits(self, demand: dict[str, float]) -> bool:
         return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
@@ -115,6 +119,17 @@ class WorkerHandle:
         # gauge — flipped exactly once each way so retirement paths can't
         # double- or miss-decrement (pool-cap accounting).
         self.pooled_counted = False
+        # Active worker lease: (shape_key, NodeState, pg_bundle, demand).
+        # The lease — not each task — holds the node/bundle resource charge;
+        # same-shape normal tasks pipeline behind the running one up to
+        # Config.max_tasks_in_flight_per_worker (reference: the per-
+        # SchedulingKey leased-worker pipeline, normal_task_submitter.h:79).
+        self.lease = None
+        # one outstanding StealTasks request at a time per worker
+        self.steal_pending = False
+        # spawned and scheduled by a node agent's local dispatcher — the
+        # head tracks identity only (never pools or dispatches onto it)
+        self.agent_owned = False
         self.is_driver = False  # client drivers are never scheduling targets
         # refs this client driver holds — released if it detaches uncleanly
         self.held_refs: set = set()
@@ -312,6 +327,9 @@ class Controller:
         # shapes competing for the same slots (nested submits!) interleave
         # by arrival instead of starving each other.
         self.ready_queues: dict[tuple, deque] = {}
+        # shape -> leased workers currently running that shape (pipelining
+        # candidates for saturated shapes; see _try_pipeline)
+        self.lease_index: dict[tuple, set] = defaultdict(set)
         self._enqueue_seq = itertools.count()
         self.waiting_on_deps: dict[ObjectID, list[PendingTask]] = defaultdict(list)
         self.pending_by_id: dict[TaskID, PendingTask] = {}
@@ -857,6 +875,23 @@ class Controller:
                     pass
         for w in victims:
             self._on_worker_death(w, reason=f"node {node_id.hex()[:8]} removed")
+        # tasks leased to the dead node's agent: retry elsewhere or fail
+        failed_leased: list = []
+        with self.lock:
+            for pt in node.leased.values():
+                self._release_task_resources(pt)
+                if pt.retries_left > 0:
+                    pt.retries_left -= 1
+                    pt._avoid_node = node_id  # type: ignore[attr-defined]
+                    self._enqueue_ready(pt)
+                else:
+                    failed_leased.append(pt)
+            node.leased.clear()
+            self.sched_cv.notify_all()
+        for pt in failed_leased:
+            self._fail_task(
+                pt, WorkerCrashedError(f"node {node_id.hex()[:8]} removed")
+            )
         if lost:
             logger.warning(
                 "node %s removed: %d resident object(s) lost",
@@ -1411,8 +1446,11 @@ class Controller:
                     logger.error("scheduler iteration failed:\n%s", traceback.format_exc())
                     progressed = False
                 if not progressed:
-                    # Nothing dispatchable right now: sleep until a task is
-                    # submitted, a worker frees up/registers, or a node joins.
+                    # Nothing dispatchable: pipelined work may be stuck
+                    # behind a blocked task — rebalance before sleeping.
+                    self._maybe_steal_locked()
+                    # Sleep until a task is submitted, a worker frees
+                    # up/registers, or a node joins.
                     self.sched_cv.wait(timeout=0.5)
 
     def _try_dispatch_locked(self) -> bool:
@@ -1505,6 +1543,14 @@ class Controller:
         candidates = [n for n in alive if n.fits(demand)]
         if not candidates:
             return None
+        avoid = getattr(pt, "_avoid_node", None)
+        if avoid is not None:
+            # one-shot spillback hint: prefer any other node, but a saturated
+            # single-node cluster may still retry the spiller
+            pt._avoid_node = None  # type: ignore[attr-defined]
+            others = [n for n in candidates if n.node_id != avoid]
+            if others:
+                candidates = others
         if strat.kind == "spread":
             # Round-robin by lowest utilization (reference: spread policy).
             return min(candidates, key=lambda n: n.utilization())
@@ -1520,30 +1566,224 @@ class Controller:
             return head
         return min(candidates, key=lambda n: n.utilization())
 
-    def _try_place(self, pt: PendingTask) -> bool:
-        node = self._pick_node(pt)
-        if node is None:
-            self._maybe_autoscale_hint(pt)
+    def _leasable(self, spec: TaskSpec) -> bool:
+        """Normal tasks without shipped packages or streaming returns go to
+        the agent's local dispatcher; the rest use head-managed workers."""
+        if spec.task_type != TaskType.NORMAL_TASK or spec.num_returns == "streaming":
             return False
-        worker = self._acquire_worker(node, pt)
-        if worker is None:
+        rt = spec.runtime_env or {}
+        return not rt.get("working_dir") and not rt.get("py_modules")
+
+    def _lease_backlog_cap(self, node: NodeState) -> int:
+        """Max outstanding leases per node — matches the agent's own spill
+        threshold so zero-demand floods queue HERE instead of ping-ponging
+        lease→overload-spill→re-lease over the wire."""
+        return max(4 * (int(node.total.get("CPU", 0)) + 4), 64)
+
+    def _lease_to_agent(self, node: NodeState, pt: PendingTask) -> bool:
+        """First-level placement decided: hand the task to the node's agent
+        (LocalTaskManager analog) and charge the node. The agent reports
+        AgentTaskDone or spills the task back."""
+        if len(node.leased) >= self._lease_backlog_cap(node):
             return False
-        demand = pt.spec.resources
+        spec = pt.spec
+        resolved_args, _lost = self._resolve_args(pt)
+        if resolved_args is None:
+            from ray_tpu.exceptions import ObjectLostError
+
+            self._fail_task(pt, ObjectLostError(_lost.hex()))
+            return True  # consumed (failed), not requeued
+        demand = spec.resources
         pg_bundle = getattr(pt, "_pg_bundle", None)
+        try:
+            node.agent.send(
+                P.LeaseTask(
+                    spec,
+                    resolved_args,
+                    bool(spec.resources.get("TPU")),
+                    dict((spec.runtime_env or {}).get("env_vars") or {}),
+                )
+            )
+        except (OSError, EOFError):
+            return False  # agent gone; heartbeat monitor will remove the node
         if pg_bundle is not None:
-            # bundle resources were debited from the node when the placement
-            # group committed; charging the node again would double-count
             pg, i = pg_bundle
             for k, v in demand.items():
                 pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) - v
         else:
             node.allocate(demand)
-        pt._node = node  # type: ignore[attr-defined]
-        # demand satisfied: stop advertising this shape to the autoscaler
-        # (otherwise a scaled-down group relaunches for stale demand)
+            pt._node = node  # type: ignore[attr-defined]
+        node.leased[spec.task_id.binary()] = pt
+        pt.dispatch_t = time.time()
         self.pending_demand.pop(tuple(sorted(demand.items())), None)
-        self._dispatch_to_worker(worker, pt)
+        self.task_events.append(
+            {"task_id": spec.task_id.hex(), "name": spec.name,
+             "event": "LEASED", "node": node.node_id.hex(), "t": pt.dispatch_t}
+        )
         return True
+
+    def _try_place(self, pt: PendingTask) -> bool:
+        spec = pt.spec
+        node = self._pick_node(pt)
+        if node is not None:
+            if node.agent is not None and self._leasable(spec):
+                # terminal: backlog-full/send-failure leaves the task queued
+                # for the next round (no fallback to head-managed dispatch —
+                # the agent owns this node's normal-task workers)
+                return self._lease_to_agent(node, pt)
+            worker = self._acquire_worker(node, pt)
+            if worker is not None:
+                demand = spec.resources
+                pg_bundle = getattr(pt, "_pg_bundle", None)
+                if pg_bundle is not None:
+                    # bundle resources were debited from the node when the
+                    # placement group committed; charging the node again
+                    # would double-count
+                    pg, i = pg_bundle
+                    for k, v in demand.items():
+                        pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) - v
+                else:
+                    node.allocate(demand)
+                # demand satisfied: stop advertising this shape to the
+                # autoscaler (otherwise a scaled-down group relaunches for
+                # stale demand)
+                self.pending_demand.pop(tuple(sorted(demand.items())), None)
+                if spec.task_type == TaskType.NORMAL_TASK:
+                    # the LEASE holds the charge; the task carries none, so
+                    # same-shape followers can pipeline behind it
+                    worker.lease = (self._shape_key(spec), node, pg_bundle, dict(demand))
+                    self.lease_index[worker.lease[0]].add(worker)
+                    pt._pg_bundle = None  # type: ignore[attr-defined]
+                else:
+                    # actor creation: per-task charge, held for the actor's
+                    # lifetime via actor.held
+                    pt._node = node  # type: ignore[attr-defined]
+                self._dispatch_to_worker(worker, pt)
+                return True
+            # no worker free (spawn in flight / pool capped): fall through
+            # to pipelining instead of blocking the shape
+        else:
+            self._maybe_autoscale_hint(pt)
+        if spec.task_type == TaskType.NORMAL_TASK:
+            return self._try_pipeline(pt)
+        return False
+
+    def _try_pipeline(self, pt: PendingTask) -> bool:
+        """Dispatch onto the least-loaded leased worker already running this
+        shape (FIFO on the worker's task pool), bounded by
+        ``max_tasks_in_flight_per_worker``."""
+        depth = self.config.max_tasks_in_flight_per_worker
+        if depth <= 1:
+            return False
+        cands = self.lease_index.get(self._shape_key(pt.spec))
+        if not cands:
+            return False
+        best, best_n = None, depth
+        for w in cands:
+            if w.dead:
+                continue
+            n = len(w.running)
+            if n < best_n:
+                best, best_n = w, n
+        if best is None:
+            return False
+        # the LEASE on `best` holds the node/bundle charge; this task must
+        # not carry one (a bundle hint left by _pick_node would be credited
+        # on completion without ever being debited)
+        pt._pg_bundle = None  # type: ignore[attr-defined]
+        self._dispatch_to_worker(best, pt)
+        return True
+
+    def _maybe_steal_locked(self):
+        """Rebalance pipelined dispatches (call under self.lock). For every
+        shape whose ready queue is empty but whose leased workers still hold
+        queued tasks behind a (possibly blocked) head task: move queued tasks
+        to an idle same-env worker, or grow the pool if none exists — without
+        this, two interdependent tasks pipelined onto one worker deadlock
+        (reference: work stealing alongside the in-flight task pipeline)."""
+        if self.config.max_tasks_in_flight_per_worker <= 1:
+            return
+        for shape, workers in list(self.lease_index.items()):
+            if self.ready_queues.get(shape):
+                continue  # undispatched work exists; idle workers take that
+            victim = None
+            for w in workers:
+                if not w.dead and len(w.running) > 1 and not w.steal_pending:
+                    if victim is None or len(w.running) > len(victim.running):
+                        victim = w
+            if victim is None:
+                continue
+            env_fp = shape[-1]
+            thief = None
+            for idle in self.idle_workers.values():
+                for w in idle:
+                    if not w.dead and w.fingerprint == env_fp:
+                        thief = w
+                        break
+                if thief is not None:
+                    break
+            if thief is None:
+                # nowhere to move the work: grow the pool; the steal fires
+                # once the new worker registers idle (growth is allowed
+                # because a blocked pipeline stops completing tasks)
+                node = self.nodes.get(victim.node_id)
+                sample = next(iter(victim.running.values()), None)
+                if node is not None and sample is not None:
+                    self._acquire_worker(node, sample)
+                continue
+            victim.steal_pending = True
+            try:
+                victim.send(P.StealTasks(len(victim.running) - 1))
+            except (OSError, EOFError):
+                victim.steal_pending = False
+
+    def _on_tasks_stolen(self, worker: WorkerHandle, msg: P.TasksStolen):
+        with self.lock:
+            worker.steal_pending = False
+            for tid_b in msg.task_ids:
+                pt = worker.running.pop(TaskID(tid_b), None)
+                if pt is None:
+                    continue
+                pt.worker = None
+                self._enqueue_ready(pt)
+            # the steal may have emptied the pipeline (its TaskDone raced
+            # ahead): release the lease or the worker leaks out of the pool
+            self._maybe_end_lease_and_idle(worker)
+            self.sched_cv.notify_all()
+
+    def _end_lease(self, worker: WorkerHandle):
+        """Release the worker's lease charge (call under self.lock)."""
+        lease = worker.lease
+        if lease is None:
+            return
+        worker.lease = None
+        shape, node, pg_bundle, demand = lease
+        s = self.lease_index.get(shape)
+        if s is not None:
+            s.discard(worker)
+            if not s:
+                del self.lease_index[shape]
+        if pg_bundle is not None:
+            pg, i = pg_bundle
+            if not pg.removed:
+                for k, v in demand.items():
+                    pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) + v
+        elif node is not None:
+            node.release(demand)
+
+    def _maybe_end_lease_and_idle(self, worker: WorkerHandle):
+        """After a normal task left ``worker.running``: if the pipeline
+        drained, release the lease and return the worker to the idle pool
+        (call under self.lock)."""
+        if worker.running:
+            return
+        self._end_lease(worker)
+        if not worker.dead and worker.actor_id is None:
+            pool = self.idle_workers[worker.node_id]
+            if worker not in pool:  # e.g. an empty steal reply after TaskDone
+                worker.last_idle_t = time.monotonic()
+                pool.append(worker)
+                self._pool_worker_freed(worker)
 
     def _maybe_autoscale_hint(self, pt: PendingTask):
         """Record unfulfilled demand for the autoscaler (reference:
@@ -1980,8 +2220,25 @@ class Controller:
             if isinstance(msg, P.FromWorker):
                 with self.lock:
                     handle = self.workers.get(msg.worker_id)
+                    if handle is None and isinstance(msg.msg, P.RegisterWorker):
+                        # agent-owned pool worker (spawned by the agent's
+                        # local dispatcher): track identity for its own
+                        # control-plane ops, but never schedule onto it —
+                        # the agent owns its queue
+                        handle = WorkerHandle(
+                            msg.worker_id, agent.node_id,
+                            conn=_RelayConn(agent, msg.worker_id),
+                        )
+                        handle.agent = agent
+                        handle.agent_owned = True
+                        handle.registered.set()
+                        self.workers[msg.worker_id] = handle
                 if handle is not None:
                     self._route_worker_msg(handle, msg.msg)
+            elif isinstance(msg, P.AgentTaskDone):
+                self._on_agent_task_done(agent, msg)
+            elif isinstance(msg, P.TaskSpilled):
+                self._on_task_spilled(agent, msg)
             elif isinstance(msg, P.Heartbeat):
                 with self.lock:
                     node = self.nodes.get(agent.node_id)
@@ -2081,6 +2338,8 @@ class Controller:
             for oid in msg.object_ids:
                 handle.held_refs.discard(oid)
                 self.remove_ref(oid)
+        elif isinstance(msg, P.TasksStolen):
+            self._on_tasks_stolen(handle, msg)
         elif isinstance(msg, P.StacksReply):
             waiter = self._stack_waiters.get(msg.req_id)
             if waiter is not None:
@@ -2600,24 +2859,16 @@ class Controller:
 
     # ------------------------------------------------------------ dispatching
 
-    def _dispatch_to_worker(self, worker: WorkerHandle, pt: PendingTask):
-        spec = pt.spec
+    def _resolve_args(self, pt: PendingTask):
+        """Resolve ref args to transportable payloads. Returns
+        (resolved_args, None) or (None, lost_object_id) when a dep is gone
+        (the caller must fail the task — resources must NOT be held)."""
         resolved_args = []
-        for a in spec.args:
+        for a in pt.spec.args:
             if a[0] == "ref":
                 entry = self.memory_store.get([a[1]], timeout=0)[0]
                 if entry is None:
-                    # Dependency vanished (e.g. freed between restarts and no
-                    # lineage to rebuild it) — fail rather than crash dispatch.
-                    from ray_tpu.exceptions import ObjectLostError
-
-                    with self.lock:
-                        self._release_task_resources(pt)
-                        if not worker.dead and worker.actor_id is None:
-                            self.idle_workers[worker.node_id].append(worker)
-                            self._pool_worker_freed(worker)
-                    self._fail_task(pt, ObjectLostError(a[1].hex()))
-                    return
+                    return None, a[1]
                 kind, payload = entry
                 if kind in ("inline", "error"):
                     resolved_args.append((kind, payload.to_bytes()))
@@ -2625,6 +2876,21 @@ class Controller:
                     resolved_args.append((kind, payload))  # plasma | spilled
             else:
                 resolved_args.append(a)
+        return resolved_args, None
+
+    def _dispatch_to_worker(self, worker: WorkerHandle, pt: PendingTask):
+        spec = pt.spec
+        resolved_args, lost = self._resolve_args(pt)
+        if resolved_args is None:
+            # Dependency vanished (e.g. freed between restarts and no
+            # lineage to rebuild it) — fail rather than crash dispatch.
+            from ray_tpu.exceptions import ObjectLostError
+
+            with self.lock:
+                self._release_task_resources(pt)
+                self._maybe_end_lease_and_idle(worker)
+            self._fail_task(pt, ObjectLostError(lost.hex()))
+            return
         pt.worker = worker
         pt.dispatch_t = time.time()
         worker.running[spec.task_id] = pt
@@ -2635,6 +2901,74 @@ class Controller:
             worker.send(P.ExecuteTask(spec, resolved_args))
         except (OSError, EOFError):
             self._on_worker_death(worker, reason="send failed")
+
+    def _on_agent_task_done(self, agent: AgentHandle, msg: P.AgentTaskDone):
+        """Completion of a task the node's agent dispatched locally (the
+        head only did placement — two-level scheduling)."""
+        with self.lock:
+            node = self.nodes.get(agent.node_id)
+            pt = node.leased.pop(msg.task_id.binary(), None) if node else None
+        if pt is None:
+            return
+        spec = pt.spec
+        failed = any(kind == "error" for _, kind, _ in msg.results)
+        if failed and spec.retry_exceptions and pt.retries_left > 0:
+            self.task_events.append(
+                {"task_id": spec.task_id.hex(), "name": spec.name,
+                 "event": "RETRY", "exec_ms": msg.exec_ms, "t": time.time()}
+            )
+            with self.lock:
+                pt.retries_left -= 1
+                self._release_task_resources(pt)
+                self._enqueue_ready(pt)
+                self.sched_cv.notify_all()
+            return
+        for oid, kind, payload in msg.results:
+            if kind == "plasma":
+                self._seal_plasma(oid, payload[0], payload[1])
+            else:
+                self.memory_store.put(oid, (kind, SerializedObject.from_buffer(payload)))
+            self._on_object_sealed(oid)
+        self.task_events.append(
+            {"task_id": spec.task_id.hex(), "name": spec.name,
+             "event": "FAILED" if failed else "FINISHED",
+             "exec_ms": msg.exec_ms, "t": time.time()}
+        )
+        with self.lock:
+            if node is not None:
+                node.last_task_done_t = time.monotonic()
+            self._release_task_resources(pt)
+            self.pending_by_id.pop(spec.task_id, None)
+            self._unpin_task_deps(pt)
+            self.sched_cv.notify_all()
+        self._persist_state()
+
+    def _on_task_spilled(self, agent: AgentHandle, msg: P.TaskSpilled):
+        """The agent handed leased tasks back (overload or worker death):
+        re-place them, preferring other nodes (spillback, the reference's
+        hybrid-policy SPILLBACK lease reply)."""
+        failed: list = []
+        with self.lock:
+            node = self.nodes.get(agent.node_id)
+            if node is None:
+                return
+            for tid_b in msg.task_ids:
+                pt = node.leased.pop(tid_b, None)
+                if pt is None:
+                    continue
+                self._release_task_resources(pt)
+                if msg.reason == "worker_died":
+                    if pt.retries_left <= 0:
+                        failed.append(pt)
+                        continue
+                    pt.retries_left -= 1
+                pt._avoid_node = agent.node_id  # type: ignore[attr-defined]
+                self._enqueue_ready(pt)
+            self.sched_cv.notify_all()
+        for pt in failed:
+            self._fail_task(
+                pt, WorkerCrashedError("worker died (leased task, no retries left)")
+            )
 
     def _on_task_done(self, worker: WorkerHandle, msg: P.TaskDone):
         with self.lock:
@@ -2707,11 +3041,9 @@ class Controller:
                     actor.inflight -= 1
                     self._pump_actor(actor)
             else:
-                # Normal task worker returns to the idle pool.
-                if not worker.dead and worker.actor_id is None:
-                    worker.last_idle_t = time.monotonic()
-                    self.idle_workers[worker.node_id].append(worker)
-                    self._pool_worker_freed(worker)
+                # Normal task: worker returns to the idle pool once its
+                # pipelined queue drains (the lease holds until then).
+                self._maybe_end_lease_and_idle(worker)
             self.sched_cv.notify_all()
         self._persist_state()
 
@@ -2736,10 +3068,7 @@ class Controller:
                     actor.queue.appendleft(pt)  # preserve ordering
                     self._pump_actor(actor)
             else:
-                if not worker.dead and worker.actor_id is None:
-                    worker.last_idle_t = time.monotonic()
-                    self.idle_workers[worker.node_id].append(worker)
-                    self._pool_worker_freed(worker)
+                self._maybe_end_lease_and_idle(worker)
                 self._enqueue_ready(pt)
             self.sched_cv.notify_all()
         logger.warning(
@@ -2776,6 +3105,7 @@ class Controller:
             worker.dead = True
             self.workers.pop(worker.worker_id, None)
             self._uncount_pooled(worker)
+            self._end_lease(worker)
             pool = self.idle_workers.get(worker.node_id)
             if pool and worker in pool:
                 pool.remove(worker)

@@ -254,6 +254,24 @@ class KillActor:
 
 
 @dataclasses.dataclass
+class StealTasks:
+    """Controller → worker: return up to ``count`` not-yet-started pipelined
+    tasks so they can be re-dispatched to an idle worker (reference: the
+    work-stealing companion of max_tasks_in_flight_per_worker pipelining in
+    the direct task submitter)."""
+
+    count: int
+
+
+@dataclasses.dataclass
+class TasksStolen:
+    """Worker → controller: task ids whose queued futures were successfully
+    cancelled (never started); the controller re-enqueues them."""
+
+    task_ids: list  # of bytes (TaskID.binary())
+
+
+@dataclasses.dataclass
 class Shutdown:
     pass
 
@@ -306,6 +324,41 @@ class KillWorker:
     """Controller → agent: hard-kill a worker process (ray.kill path)."""
 
     worker_id: WorkerID
+
+
+@dataclasses.dataclass
+class LeaseTask:
+    """Controller → agent: run this normal task on YOUR worker pool — the
+    second level of two-level scheduling. The head picked the node and holds
+    the resource charge; the agent owns worker pop/spawn/queueing locally
+    (reference: ClusterTaskManager assigns a node, the raylet's
+    LocalTaskManager dispatches, cluster_task_manager.h:44,
+    local_task_manager.h:60)."""
+
+    spec: Any  # TaskSpec
+    resolved_args: list
+    needs_tpu: bool
+    env_vars: dict
+
+
+@dataclasses.dataclass
+class AgentTaskDone:
+    """Agent → controller: a leased task finished (results already sealed
+    into the agent's arena where plasma-sized)."""
+
+    task_id: Any  # TaskID
+    results: list  # [(object_id, kind, payload)]
+    exec_ms: float = 0.0
+
+
+@dataclasses.dataclass
+class TaskSpilled:
+    """Agent → controller: leased tasks this agent is handing back — local
+    overload or a dead worker. The head re-places them elsewhere (reference:
+    scheduler spillback, hybrid_scheduling_policy.h:50)."""
+
+    task_ids: list  # of bytes (TaskID.binary())
+    reason: str = "overload"  # or "worker_died"
 
 
 @dataclasses.dataclass

@@ -108,6 +108,13 @@ class WorkerRuntime:
         # The reader loop must never block on task execution (tasks make
         # controller calls — get/submit — whose replies arrive on the reader).
         self._task_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+        # Queued-but-unstarted normal tasks (pipelined dispatches): task_id
+        # binary -> Future. The controller may steal these back for idle
+        # workers (StealTasks); a Future that cancels cleanly never started.
+        # _pf_lock serializes reader inserts against the executor's pop at
+        # execution start (a lost race would pin an entry forever).
+        self._pending_futures: dict = {}
+        self._pf_lock = threading.Lock()
         # worker-side rpc chaos (lazily parsed from env)
         self._chaos_table: Optional[dict] = None
         import random as _random
@@ -216,6 +223,8 @@ class WorkerRuntime:
                 self._route_task(msg)
             elif isinstance(msg, (P.GetReply, P.PutAck, P.Reply)):
                 self._handle_reply(msg)
+            elif isinstance(msg, P.StealTasks):
+                self._handle_steal(msg)
             elif isinstance(msg, P.DumpStacks):
                 try:
                     self._send(P.StacksReply(msg.req_id, self._dump_stacks()))
@@ -322,10 +331,45 @@ class WorkerRuntime:
                 if loop is not None:
                     asyncio.run_coroutine_threadsafe(self._execute_async(msg), loop)
                     return
-            self._task_pool.submit(self._execute_task, msg)
+            if spec.task_type == TaskType.NORMAL_TASK:
+                tid = spec.task_id.binary()
+                with self._pf_lock:
+                    self._pending_futures[tid] = None  # placeholder pre-submit
+                try:
+                    fut = self._task_pool.submit(self._execute_task, msg)
+                except RuntimeError:
+                    with self._pf_lock:
+                        self._pending_futures.pop(tid, None)
+                    raise
+                with self._pf_lock:
+                    # skip if the executor already started (and popped) it
+                    if tid in self._pending_futures:
+                        self._pending_futures[tid] = fut
+            else:
+                self._task_pool.submit(self._execute_task, msg)
         except RuntimeError:
             # pool shut down: this worker is going away; the controller
             # reschedules the task when the death is observed
+            pass
+
+    def _handle_steal(self, msg: "P.StealTasks"):
+        """Give back up to ``count`` queued tasks, newest first (they would
+        run last anyway). Runs on the reader thread — the same thread that
+        populates _pending_futures — so iteration is race-free; only the
+        executor thread's pop (at execution start) can interleave, and
+        Future.cancel() arbitrates that atomically."""
+        stolen = []
+        with self._pf_lock:
+            for tid in list(reversed(self._pending_futures.keys())):
+                if len(stolen) >= msg.count:
+                    break
+                fut = self._pending_futures.get(tid)
+                if fut is not None and fut.cancel():
+                    self._pending_futures.pop(tid, None)
+                    stolen.append(tid)
+        try:
+            self._send(P.TasksStolen(stolen))
+        except (OSError, EOFError):
             pass
 
     # -------------------------------------------------------- object plane
@@ -585,6 +629,9 @@ class WorkerRuntime:
 
     def _execute_task(self, msg: P.ExecuteTask):
         spec = msg.spec
+        # running now — no longer stealable
+        with self._pf_lock:
+            self._pending_futures.pop(spec.task_id.binary(), None)
         start = time.monotonic()
         results = []
         try:

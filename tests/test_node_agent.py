@@ -272,3 +272,54 @@ def test_lost_object_reconstructed_from_lineage(agent_cluster):
     # node-removal marked the object lost; this get triggers reconstruction
     arr = ray_tpu.get(ref, timeout=180)
     assert float(arr.sum()) == 600_000.0
+
+
+def test_two_level_scheduling_head_places_only(agent_cluster):
+    """Two-level scheduling (reference: ClusterTaskManager assigns the node,
+    the raylet's LocalTaskManager dispatches to workers,
+    cluster_task_manager.h:44 / local_task_manager.h:60): normal tasks on an
+    agent node are LEASED to the agent, which owns worker pop/spawn locally.
+    The head must record placement only — no per-task worker dispatch — and
+    must never pool the agent's workers."""
+    agent_cluster.add_agent("a1", {"CPU": 2, "remote_only": 4})
+
+    @ray_tpu.remote(resources={"remote_only": 0.1})
+    def f(i):
+        return (i, os.getpid())
+
+    out = ray_tpu.get([f.remote(i) for i in range(30)], timeout=180)
+    assert sorted(i for i, _ in out) == list(range(30))
+    assert all(pid != os.getpid() for _, pid in out)
+
+    ctrl = agent_cluster.controller
+    per_task: dict = {}
+    for ev in ctrl.task_events:
+        per_task.setdefault(ev["task_id"], set()).add(ev["event"])
+    leased = [evs for evs in per_task.values() if "LEASED" in evs]
+    assert len(leased) >= 30
+    # placement only: the head never dispatched these to a worker itself
+    assert all("DISPATCHED" not in evs for evs in leased)
+    # the agent's pool workers are identity-tracked but never head-pooled
+    node_id = next(iter(ctrl.agents))
+    assert not ctrl.idle_workers.get(node_id)
+    agent_owned = [w for w in ctrl.workers.values() if w.agent_owned]
+    assert agent_owned, "agent spawned no local pool workers"
+
+
+def test_leased_task_spillback_on_worker_death(agent_cluster):
+    """A leased task whose worker dies is spilled back to the head and
+    re-placed (retry accounting intact)."""
+    agent_cluster.add_agent("a1", {"CPU": 2, "remote_only": 2})
+    marker = str(agent_cluster.tmp_path / "died-once")
+
+    @ray_tpu.remote(resources={"remote_only": 1}, max_retries=2)
+    def die_once(path):
+        import os as _os
+
+        if not _os.path.exists(path):
+            with open(path, "w"):
+                pass
+            _os._exit(1)  # hard kill: the agent must spill the lease back
+        return "recovered"
+
+    assert ray_tpu.get(die_once.remote(marker), timeout=180) == "recovered"
