@@ -59,6 +59,23 @@ class EngineConfig:
     # honored host-side after the fact (over-decoded tokens discarded);
     # admission latency grows by up to K steps.
     decode_steps: int = 1
+    # chunked prefill: prompts are prefilled in chunks of at most this many
+    # tokens, with decode programs interleaved between chunks so a long
+    # admission can't stall in-flight decodes for a whole prompt's worth of
+    # compute (reference: vLLM chunked prefill). Mid-chunks skip the LM
+    # head. 0 = prefill each prompt in one program.
+    prefill_chunk: int = 256
+    # run-ahead depth: decode programs launched before the previous
+    # program's sampled tokens have been fetched to the host. 1 hides the
+    # device->host round trip (~100ms on tunneled chips) behind the next
+    # program's compute; finished slots may over-decode up to
+    # decode_steps * runahead discarded tokens.
+    decode_runahead: int = 1
+    # concurrent chunked admissions per pool: each holds a stripe-sized
+    # scratch KV until its final chunk lands, so this bounds transient HBM
+    # (admissions * stripe KV) and per-pass prefill work; too low serializes
+    # admission waves and lets slot occupancy decay before the batch fills.
+    max_concurrent_admissions: int = 4
 
 
 @dataclasses.dataclass

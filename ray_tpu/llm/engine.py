@@ -70,12 +70,27 @@ class _Request:
         self.prefix_hit_tokens = 0
 
 
+class _Admission:
+    """Chunked-prefill state for one slot being filled (reference: vLLM
+    chunked prefill — bounded prompt work interleaved with decode steps)."""
+
+    def __init__(self, req: _Request, slot: int, one, chunks: list, prefix_m: int):
+        self.req = req
+        self.slot = slot
+        self.one = one  # scratch [L, 1, K, stripe, D] KV being extended
+        self.chunks = chunks  # [(tokens_np [1, C], eff_len, start, is_final)]
+        self.idx = 0
+        self.prefix_m = prefix_m
+
+
 class _Pool:
     """One KV stripe class: ``n_slots`` decode slots of ``stripe_len``
     positions each, with its own compiled decode program. Short requests
     route to short pools so they never pin max_seq_len-sized KV memory."""
 
     def __init__(self, stripe_len: int, n_slots: int, model_cfg):
+        from collections import deque
+
         from ray_tpu.models.llama import init_kv_cache
 
         self.stripe_len = stripe_len
@@ -85,9 +100,18 @@ class _Pool:
         self.temps = np.zeros((n_slots,), np.float32)
         self.top_ks = np.full((n_slots,), 50, np.int32)
         self.keys = None  # per-slot PRNG keys, set by the engine loop
-        self.pending_first: dict[int, int] = {}
         self.adapter_ids = np.zeros((n_slots,), np.int32)
         self.adapter_ids_dev = None
+        # device-resident next-token inputs: decode programs chain on these
+        # without a host round trip (run-ahead; tunneled chips pay ~100ms
+        # per device->host sync)
+        self.dev_tokens = None  # [n_slots] int32 on device
+        self.admitting: dict[int, _Admission] = {}
+        # launched decode programs whose sampled tokens are still being
+        # fetched: (out_dev [K, slots], {slot: _Request} binding snapshot)
+        self.inflight: "deque" = deque()
+        # first tokens from final prefill chunks awaiting host arrival
+        self.first_pending: list = []
 
 
 class JaxEngine:
@@ -229,31 +253,32 @@ class JaxEngine:
 
         lora_enabled = self.loras is not None
 
+        def sample_row(logits_row, temp, top_k, key):
+            """Sample one token from [V] fp32 logits: greedy where temp<=0,
+            else top-k/temperature categorical. The ONE sampler — the decode
+            program vmaps it and the prefill first token calls it directly,
+            so seeded runs cannot diverge at token 2."""
+            greedy = jnp.argmax(logits_row, -1)
+            vals, idxs = jax.lax.top_k(logits_row, K)
+            rank_ok = jnp.arange(K) < top_k
+            scaled = jnp.where(rank_ok, vals / jnp.maximum(temp, 1e-6), -jnp.inf)
+            key, sub = jax.random.split(key)
+            sampled = idxs[jax.random.categorical(sub, scaled)]
+            tok = jnp.where(temp <= 0.0, greedy, sampled).astype(jnp.int32)
+            return tok, key
+
         def decode_fn(params, cache, tokens, temps, top_ks, keys,
                       loras=None, adapter_ids=None):
-            """Decode + in-program sampling: greedy where temp<=0, else
-            per-row top-k/temperature categorical with per-slot PRNG keys
+            """Decode + in-program sampling with per-slot PRNG keys
             (per-request seeds stay reproducible across batch compositions)."""
             logits, cache = decode_step(
                 params, cache, tokens, cfg,
                 loras=loras, adapter_ids=adapter_ids,
             )
-            greedy = jnp.argmax(logits, axis=-1)
-            vals, idxs = jax.lax.top_k(logits, K)
-            # per-row k: mask ranks >= k to -inf before the categorical
-            rank_ok = jnp.arange(K)[None, :] < top_ks[:, None]
-            scaled = jnp.where(
-                rank_ok, vals / jnp.maximum(temps, 1e-6)[:, None], -jnp.inf
+            next_tokens, new_keys = jax.vmap(sample_row)(
+                logits, temps, top_ks, keys
             )
-            new_keys, sample_keys = jnp.split(
-                jax.vmap(lambda k: jax.random.split(k, 2))(keys), 2, axis=1
-            )
-            choice = jax.vmap(
-                lambda k, s: jax.random.categorical(k, s)
-            )(sample_keys[:, 0], scaled)
-            sampled = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
-            next_tokens = jnp.where(temps <= 0.0, greedy, sampled)
-            return next_tokens, cache, new_keys[:, 0]
+            return next_tokens, cache, new_keys
 
         self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
 
@@ -279,57 +304,57 @@ class JaxEngine:
         self._decode_multi_jit = jax.jit(decode_multi, donate_argnums=(1,))
         self._decode_n_steps = n_steps
 
-        def prefill_one(params, cache, tokens, length, slot,
-                        loras=None, adapter_id=None):
-            """Prefill a single sequence (B=1) and scatter into `slot`.
-            The scratch cache takes the POOL's stripe length (static from
-            the cache operand's shape)."""
-            from ray_tpu.models.llama import init_kv_cache
-
-            stripe = cache["k"].shape[2]
-            one = init_kv_cache(cfg, 1, stripe)
-            last_logits, one = prefill(
-                params, one, tokens, cfg, lengths=length,
-                loras=loras, adapter_ids=adapter_id,
+        def chunk_mid(params, one, tokens, length, start,
+                      loras=None, adapter_id=None):
+            """Extend the scratch stripe with one prompt chunk — no LM head
+            (mid-chunks of chunked prefill never need logits)."""
+            _, one = prefill(
+                params, one, tokens, cfg, lengths=length, start_pos=start,
+                loras=loras, adapter_ids=adapter_id, with_logits=False,
             )
-            cache = {
-                "k": cache["k"].at[:, slot].set(one["k"][:, 0]),
-                "v": cache["v"].at[:, slot].set(one["v"][:, 0]),
-                "length": cache["length"].at[slot].set(length[0]),
-            }
-            return last_logits[0], cache
+            return one
 
-        self._prefill_jit = jax.jit(prefill_one, donate_argnums=(1,))
+        self._chunk_mid_jit = jax.jit(chunk_mid, donate_argnums=(1,))
 
-        def prefill_suffix(params, cache, pk, pv, tokens, length, slot,
-                           loras=None, adapter_id=None):
-            """Prefix-cache hit: copy the cached prefix KV (length m =
-            pk.shape[1], static per bucket) into the scratch stripe, then
-            prefill only the SUFFIX at absolute positions m.. — the
-            attention inside sees the prefix through the cache."""
-            from ray_tpu.models.llama import init_kv_cache
-
-            stripe = cache["k"].shape[2]
-            m = pk.shape[1]
-            one = init_kv_cache(cfg, 1, stripe)
-            one = {
-                "k": one["k"].at[:, 0, :m].set(pk),
-                "v": one["v"].at[:, 0, :m].set(pv),
-                "length": one["length"],
-            }
-            start = jnp.full((1,), m, jnp.int32)
+        def chunk_final(params, cache, one, tokens, length, start, slot,
+                        temp, top_k, key, loras=None, adapter_id=None):
+            """Last prompt chunk: prefill it, sample the first generated
+            token IN-PROGRAM (no host sync on the admission path), and
+            scatter the finished stripe into the pool slot."""
             last_logits, one = prefill(
                 params, one, tokens, cfg, lengths=length, start_pos=start,
                 loras=loras, adapter_ids=adapter_id,
             )
+            total = start[0] + length[0]
             cache = {
                 "k": cache["k"].at[:, slot].set(one["k"][:, 0]),
                 "v": cache["v"].at[:, slot].set(one["v"][:, 0]),
-                "length": cache["length"].at[slot].set(m + length[0]),
+                "length": cache["length"].at[slot].set(total),
             }
-            return last_logits[0], cache
+            tok, new_key = sample_row(last_logits[0], temp, top_k, key)
+            return tok, new_key, cache
 
-        self._prefill_suffix_jit = jax.jit(prefill_suffix, donate_argnums=(1,))
+        # donate only the pool cache: the scratch stripe's shape matches no
+        # output, so donating it just triggers unusable-buffer warnings
+        self._chunk_final_jit = jax.jit(chunk_final, donate_argnums=(1,))
+
+        def seed_prefix(one, pk, pv):
+            """Copy a cached prefix KV [L, K, m, D] into the scratch stripe."""
+            m = pk.shape[2]
+            return {
+                "k": one["k"].at[:, 0, :, :m].set(pk),
+                "v": one["v"].at[:, 0, :, :m].set(pv),
+                "length": one["length"],
+            }
+
+        self._seed_prefix_jit = jax.jit(seed_prefix, donate_argnums=(0,))
+        # tiny device-side updates that keep the decode chain host-free
+        self._set_tok_jit = jax.jit(
+            lambda toks, slot, tok: toks.at[slot].set(tok), donate_argnums=(0,)
+        )
+        self._set_key_jit = jax.jit(
+            lambda keys, slot, key: keys.at[slot].set(key), donate_argnums=(0,)
+        )
         self._rng_key = jax.random.PRNGKey(self.config.model.seed)
 
     def _decode(self, pool: _Pool, tokens, temps, top_ks, keys):
@@ -353,23 +378,14 @@ class JaxEngine:
             out = out[None]  # unify to [K, slots]
         return out, cache, keys
 
-    def _prefill(self, pool: _Pool, tokens, length, slot, adapter_id=0,
-                 prefix=None):
+    def _lora_kw(self, adapter_id: int) -> dict:
         import jax.numpy as jnp
 
-        lora_kw = {}
-        if self.loras is not None:
-            lora_kw = dict(
-                loras=self.loras,
-                adapter_id=jnp.asarray([adapter_id], jnp.int32),
-            )
-        if prefix is None:
-            return self._prefill_jit(
-                self.params, pool.cache, tokens, length, slot, **lora_kw
-            )
-        return self._prefill_suffix_jit(
-            self.params, pool.cache, prefix["k"], prefix["v"],
-            tokens, length, slot, **lora_kw
+        if self.loras is None:
+            return {}
+        return dict(
+            loras=self.loras,
+            adapter_id=jnp.asarray([adapter_id], jnp.int32),
         )
 
     def _sync_adapter_ids(self, pool: _Pool):
@@ -419,8 +435,8 @@ class JaxEngine:
             if key in self._prefix_cache:
                 self._prefix_cache.move_to_end(key)
                 continue
-            k = pool.cache["k"][:, slot, :b]
-            v = pool.cache["v"][:, slot, :b]
+            k = pool.cache["k"][:, slot, :, :b]  # [L, K, b, D]
+            v = pool.cache["v"][:, slot, :, :b]
             nbytes = int(k.nbytes + v.nbytes)
             self._prefix_cache[key] = {"k": k, "v": v, "nbytes": nbytes}
             self._prefix_bytes += nbytes
@@ -573,6 +589,7 @@ class JaxEngine:
             "active_slots": sum(
                 s is not None for p in self._pools for s in p.slots
             ),
+            "admitting": sum(len(p.admitting) for p in self._pools),
             "waiting": self._waiting.qsize() + len(self._backlog),
             "max_num_seqs": sum(p.n_slots for p in self._pools),
             "pools": [
@@ -602,9 +619,10 @@ class JaxEngine:
                 return pool
         return self._pools[-1]
 
-    def _admit(self, pool: "_Pool", slot: int, req: _Request) -> None:
-        import jax
-        import jax.numpy as jnp
+    def _start_admission(self, pool: "_Pool", slot: int, req: _Request) -> None:
+        """Build the chunked-prefill plan for a slot (device work starts on
+        the next _advance_admissions pass)."""
+        from ray_tpu.models.llama import init_kv_cache
 
         ids = req.prompt_token_ids
         if len(ids) > pool.stripe_len - 1:
@@ -617,157 +635,256 @@ class JaxEngine:
         else:
             prefix, m = None, 0
         suffix = ids[m:]
-        bucket = self._bucket(len(suffix))
-        bucket = min(bucket, pool.stripe_len)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, : len(suffix)] = suffix
-        pool.adapter_ids[slot] = req.lora_idx
-        self._sync_adapter_ids(pool)
-        last_logits, pool.cache = self._prefill(
-            pool,
-            jnp.asarray(toks),
-            jnp.asarray([len(suffix)], jnp.int32),
-            slot,
-            adapter_id=req.lora_idx,
-            prefix=prefix,
-        )
         req.prefix_hit_tokens = m
-        if prefix is None and req.lora_idx == 0:
-            # LoRA'd prefixes are adapter-specific: never shared
-            self._prefix_store(pool, slot, ids)
-        # sample the first generated token from prefill logits (same top-K
-        # truncation as the decode program, and the request's own PRNG
-        # chain when seeded, so seeded generations reproduce regardless of
-        # batch composition)
-        first = int(np.argmax(np.asarray(last_logits)))
+        chunk = self.config.engine.prefill_chunk or len(suffix)
+        pieces = [suffix[i : i + chunk] for i in range(0, len(suffix), chunk)]
+        chunks = []
+        start = m
+        for j, piece in enumerate(pieces):
+            is_final = j == len(pieces) - 1
+            width = (
+                min(self._bucket(len(piece)), pool.stripe_len)
+                if is_final
+                else len(piece)
+            )
+            toks = np.zeros((1, width), np.int32)
+            toks[0, : len(piece)] = piece
+            chunks.append((toks, len(piece), start, is_final))
+            start += len(piece)
+        one = init_kv_cache(self.model_cfg, 1, pool.stripe_len)
+        if prefix is not None:
+            one = self._seed_prefix_jit(one, prefix["k"], prefix["v"])
+        pool.admitting[slot] = _Admission(req, slot, one, chunks, m)
+
+    def _advance_admission(self, pool: "_Pool", adm: _Admission) -> None:
+        """Dispatch ONE prompt chunk (device-async). The final chunk
+        samples the first token in-program and activates the slot."""
+        import jax
+        import jax.numpy as jnp
+
+        toks, eff_len, start, is_final = adm.chunks[adm.idx]
+        adm.idx += 1
+        req = adm.req
+        lora_kw = self._lora_kw(req.lora_idx)
+        t = jnp.asarray(toks)
+        l = jnp.asarray([eff_len], jnp.int32)
+        s = jnp.asarray([start], jnp.int32)
+        if not is_final:
+            adm.one = self._chunk_mid_jit(
+                self.params, adm.one, t, l, s, **lora_kw
+            )
+            return
         K = self._top_k_static
         if req.params.seed is not None:
             req_key = jax.random.PRNGKey(req.params.seed)
         else:
             self._rng_key, req_key = jax.random.split(self._rng_key)
-        req_key, sub = jax.random.split(req_key)
-        if req.params.temperature > 0:
-            l = jnp.asarray(last_logits)
-            k = min(max(1, req.params.top_k), K)
-            v, ix = jax.lax.top_k(l, k)
-            c = jax.random.categorical(
-                sub, v / max(req.params.temperature, 1e-6)
-            )
-            first = int(ix[c])
+        temp = jnp.float32(req.params.temperature)
+        topk = jnp.int32(min(max(1, req.params.top_k), K))
+        slot = adm.slot
+        pool.adapter_ids[slot] = req.lora_idx
+        self._sync_adapter_ids(pool)
+        first_tok, new_key, pool.cache = self._chunk_final_jit(
+            self.params, pool.cache, adm.one, t, l, s,
+            jnp.int32(slot), temp, topk, req_key, **lora_kw
+        )
+        pool.keys = self._set_key_jit(pool.keys, jnp.int32(slot), new_key)
+        pool.dev_tokens = self._set_tok_jit(
+            pool.dev_tokens, jnp.int32(slot), first_tok
+        )
         pool.slots[slot] = req
         pool.temps[slot] = req.params.temperature
         # decode truncates to the program's static top-K; clamp here so
         # first token and all later tokens agree
         pool.top_ks[slot] = min(max(1, req.params.top_k), K)
-        pool.keys = pool.keys.at[slot].set(req_key)
-        pool.pending_first[slot] = first
-        req.first_token_t = time.time()
-        self._emit(pool, slot, first)
+        del pool.admitting[slot]
+        if req.prefix_hit_tokens == 0 and req.lora_idx == 0:
+            # LoRA'd prefixes are adapter-specific: never shared
+            self._prefix_store(pool, slot, req.prompt_token_ids)
+        try:
+            first_tok.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — platform without async copy
+            pass
+        pool.first_pending.append((slot, req, first_tok))
+
+    def _fail_admission(self, pool: "_Pool", adm: _Admission, e: BaseException):
+        pool.admitting.pop(adm.slot, None)
+        adm.req.error = e
+        adm.req.done.set()
+        adm.req.stream_queue.put(None)
+
+    def _pull_waiting(self) -> bool:
+        """Route waiting requests to free slots and build admission plans.
+        The backlog is engine-thread-owned and order-preserving: a head
+        request whose stripe class is full must NOT starve shorter
+        requests that fit other pools' free slots."""
+        try:
+            while True:
+                self._backlog.append(self._waiting.get_nowait())
+        except queue.Empty:
+            pass
+        if not self._backlog:
+            return False
+        progressed = False
+        still_waiting = []
+        for req in self._backlog:
+            preferred = self._pool_for(req)
+            budget = len(req.prompt_token_ids) + req.params.max_tokens + 1
+            target = None
+            candidates = [preferred] + [
+                p for p in self._pools
+                if p is not preferred and p.stripe_len >= min(
+                    budget, preferred.stripe_len
+                )
+            ]
+            for pool in candidates:
+                # cap concurrent admissions: each holds a live stripe-sized
+                # scratch KV (unbounded, 16 free slots would transiently
+                # DOUBLE the pool's HBM footprint), and per-pass prefill
+                # work must stay bounded for chunking to protect decode
+                if len(pool.admitting) >= self.config.engine.max_concurrent_admissions:
+                    continue
+                for slot in range(pool.n_slots):
+                    if pool.slots[slot] is None and slot not in pool.admitting:
+                        target = (pool, slot)
+                        break
+                if target:
+                    break
+            if target is None:
+                still_waiting.append(req)
+                continue
+            try:
+                self._start_admission(target[0], target[1], req)
+                progressed = True
+            except BaseException as e:  # noqa: BLE001
+                req.error = e
+                req.done.set()
+                req.stream_queue.put(None)
+        self._backlog = still_waiting
+        return progressed
+
+    def _advance_admissions(self) -> bool:
+        progressed = False
+        for pool in self._pools:
+            for adm in list(pool.admitting.values()):
+                try:
+                    self._advance_admission(pool, adm)
+                    progressed = True
+                except BaseException as e:  # noqa: BLE001
+                    self._fail_admission(pool, adm, e)
+        return progressed
+
+    def _launch_decodes(self) -> bool:
+        """One decode program per pool with active slots, chained on
+        device-resident tokens (no host sync on the launch path)."""
+        import jax.numpy as jnp
+
+        launched = False
+        runahead = max(0, self.config.engine.decode_runahead)
+        for pool in self._pools:
+            active = {s: r for s, r in enumerate(pool.slots) if r is not None}
+            if not active or len(pool.inflight) > runahead:
+                continue
+            try:
+                out, pool.cache, pool.keys = self._decode(
+                    pool,
+                    pool.dev_tokens,
+                    jnp.asarray(pool.temps),
+                    jnp.asarray(pool.top_ks),
+                    pool.keys,
+                )
+                pool.dev_tokens = out[-1]
+                try:
+                    out.copy_to_host_async()
+                except Exception:  # noqa: BLE001
+                    pass
+                pool.inflight.append((out, active))
+                launched = True
+            except BaseException as e:  # noqa: BLE001 — device failure
+                self._fail_pool(pool, e)
+        return launched
+
+    def _fail_pool(self, pool: "_Pool", e: BaseException):
+        """Device failure: fail every in-flight request of THIS pool
+        (callers must never hang on a dead engine loop) and reset it."""
+        import jax
+
+        logger.error("decode step failed: %r", e)
+        from ray_tpu.models.llama import init_kv_cache
+
+        for slot, req in enumerate(pool.slots):
+            if req is not None:
+                pool.slots[slot] = None
+                req.error = e
+                req.stream_queue.put(None)
+                req.done.set()
+        for adm in list(pool.admitting.values()):
+            self._fail_admission(pool, adm, e)
+        pool.inflight.clear()
+        pool.first_pending.clear()
+        pool.cache = init_kv_cache(self.model_cfg, pool.n_slots, pool.stripe_len)
+        pool.dev_tokens = jax.numpy.zeros((pool.n_slots,), jax.numpy.int32)
+        # keys may already point at the failed program's poisoned output
+        # (reassigned in _launch_decodes before the error surfaced at
+        # fetch): without fresh keys every future admission fails too
+        pool.keys = jax.random.split(
+            jax.random.PRNGKey(self.config.model.seed ^ int(time.time())),
+            pool.n_slots,
+        )
+
+    def _drain(self) -> bool:
+        """Fetch arrived tokens (first tokens + completed decode programs)
+        and run finish bookkeeping. Keeps up to ``decode_runahead`` decode
+        programs in flight; over-decoded tokens of finished or re-admitted
+        slots are discarded via the per-program binding snapshot."""
+        progressed = False
+        runahead = max(0, self.config.engine.decode_runahead)
+        for pool in self._pools:
+            if pool.first_pending:
+                pending, pool.first_pending = pool.first_pending, []
+                for slot, req, tok in pending:
+                    try:
+                        t = int(np.asarray(tok))
+                    except BaseException as e:  # noqa: BLE001
+                        self._fail_pool(pool, e)
+                        break
+                    if pool.slots[slot] is req:
+                        req.first_token_t = time.time()
+                        self._emit(pool, slot, t)
+                        progressed = True
+            has_active = any(r is not None for r in pool.slots)
+            keep = runahead if has_active else 0
+            while len(pool.inflight) > keep:
+                out, binding = pool.inflight.popleft()
+                try:
+                    arr = np.asarray(out)  # [K, slots]
+                except BaseException as e:  # noqa: BLE001
+                    self._fail_pool(pool, e)
+                    break
+                for k in range(arr.shape[0]):
+                    for slot, req in binding.items():
+                        if pool.slots[slot] is req:
+                            self._emit(pool, slot, int(arr[k, slot]))
+                progressed = True
+        return progressed
 
     def _engine_loop(self):
         import jax
-        import jax.numpy as jnp
 
         for i, pool in enumerate(self._pools):
             pool.keys = jax.random.split(
                 jax.random.PRNGKey(self.config.model.seed ^ (0x5EED + i)),
                 pool.n_slots,
             )
+            pool.dev_tokens = jax.numpy.zeros((pool.n_slots,), jax.numpy.int32)
 
         while not self._stop.is_set():
-            # 1) admit waiting requests into free slots (prefill). The
-            # backlog is engine-thread-owned and order-preserving: a head
-            # request whose stripe class is full must NOT starve shorter
-            # requests that fit other pools' free slots.
-            admitted = False
-            try:
-                while True:
-                    self._backlog.append(self._waiting.get_nowait())
-            except queue.Empty:
-                pass
-            still_waiting = []
-            for req in self._backlog:
-                preferred = self._pool_for(req)
-                budget = len(req.prompt_token_ids) + req.params.max_tokens + 1
-                target = None
-                candidates = [preferred] + [
-                    p for p in self._pools
-                    if p is not preferred and p.stripe_len >= min(
-                        budget, preferred.stripe_len
-                    )
-                ]
-                for pool in candidates:
-                    for slot in range(pool.n_slots):
-                        if pool.slots[slot] is None:
-                            target = (pool, slot)
-                            break
-                    if target:
-                        break
-                if target is None:
-                    still_waiting.append(req)
-                    continue
-                try:
-                    self._admit(target[0], target[1], req)
-                    admitted = True
-                except BaseException as e:  # noqa: BLE001
-                    req.error = e
-                    req.done.set()
-                    req.stream_queue.put(None)
-            self._backlog = still_waiting
-
-            any_active = False
-            # 2) one decode step per pool with active slots (each pool is
-            # its own compiled program; static shapes per pool)
-            for pool in self._pools:
-                active = [s for s, r in enumerate(pool.slots) if r is not None]
-                if not active:
-                    continue
-                any_active = True
-                tokens = np.zeros((pool.n_slots,), np.int32)
-                for slot in active:
-                    req = pool.slots[slot]
-                    tokens[slot] = (
-                        pool.pending_first.pop(slot)
-                        if slot in pool.pending_first
-                        else req.out_tokens[-1]
-                    )
-                try:
-                    step_tokens, pool.cache, pool.keys = self._decode(
-                        pool,
-                        jnp.asarray(tokens),
-                        jnp.asarray(pool.temps),
-                        jnp.asarray(pool.top_ks),
-                        pool.keys,
-                    )
-                    next_np = np.asarray(step_tokens)  # [K, slots]
-                except BaseException as e:  # noqa: BLE001 — device failure
-                    # fail every in-flight request of THIS pool (callers
-                    # must never hang on a dead engine loop) and keep going
-                    logger.error("decode step failed: %r", e)
-                    from ray_tpu.models.llama import init_kv_cache
-
-                    for slot in active:
-                        req = pool.slots[slot]
-                        pool.slots[slot] = None
-                        pool.pending_first.pop(slot, None)
-                        req.error = e
-                        req.stream_queue.put(None)
-                        req.done.set()
-                    pool.cache = init_kv_cache(
-                        self.model_cfg, pool.n_slots, pool.stripe_len
-                    )
-                    continue
-
-                # 3) bookkeeping: emit tokens, finish slots. With
-                # multi-step decode, a slot that finishes mid-scan simply
-                # ignores its remaining over-decoded tokens.
-                for k in range(next_np.shape[0]):
-                    for slot in active:
-                        if pool.slots[slot] is None:
-                            continue
-                        self._emit(pool, slot, int(next_np[k, slot]))
-            if not any_active:
-                time.sleep(0.002 if admitted else 0.005)
+            progressed = self._pull_waiting()
+            progressed |= self._advance_admissions()
+            progressed |= self._launch_decodes()
+            progressed |= self._drain()
+            if not progressed:
+                time.sleep(0.002)
 
     def _emit(self, pool: "_Pool", slot: int, token: int):
         """Record a generated token for the request in `slot`; finish on
@@ -798,8 +915,5 @@ class JaxEngine:
             if pool.adapter_ids[slot]:
                 pool.adapter_ids[slot] = 0
                 self._sync_adapter_ids(pool)
-            # a request can finish at admission (max_tokens=1): its queued
-            # first token must not leak into the slot's next occupant
-            pool.pending_first.pop(slot, None)
             req.stream_queue.put(None)
             req.done.set()

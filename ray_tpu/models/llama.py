@@ -638,8 +638,12 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
 
 
 def init_kv_cache(cfg: LlamaConfig, batch_size: int, max_len: Optional[int] = None):
+    """KV cache [L, B, KV_HEADS, S, D] — head-major so each (batch, head)
+    attention read streams a contiguous S×D block from HBM (position-major
+    put the head axis inside, making every read a 256-byte stride: decode
+    measured ~5x off the bandwidth roofline on v5e because of it)."""
     max_len = max_len or cfg.max_seq_len
-    shape = (cfg.n_layers, batch_size, max_len, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch_size, cfg.n_kv_heads, max_len, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -668,7 +672,7 @@ def init_lora_stack(cfg: LlamaConfig, n_adapters: int, rank: int):
 
 def _decode_forward(
     params, cache, tokens, positions, cfg: LlamaConfig, valid=None,
-    loras=None, adapter_ids=None,
+    loras=None, adapter_ids=None, with_logits: bool = True,
 ):
     """Shared prefill/decode body. tokens: [B, T]; positions: [B, T].
     New k/v are scattered into the cache before attention so new tokens
@@ -679,7 +683,7 @@ def _decode_forward(
     if cfg.moe_experts:
         raise NotImplementedError("MoE decode path is not supported yet")
     B, T = tokens.shape
-    S = cache["k"].shape[2]
+    S = cache["k"].shape[3]  # [L, B, K, S, D]
     x = params["embed"][tokens].astype(cfg.dtype)
 
     new_len = cache["length"] + T
@@ -687,28 +691,32 @@ def _decode_forward(
     qpos = positions[:, :, None]  # [B, T, 1]
     seq_mask = slot <= qpos  # causal over absolute positions
 
-    batch_idx = jnp.arange(B)[:, None]
     if valid is not None:
         # out-of-range index -> dropped by scatter mode='drop'
         write_pos = jnp.where(valid, positions, S)
     else:
         write_pos = positions
     stacked = {k: params[k] for k in _LAYER_KEYS}
-    scan_xs = (stacked, cache["k"], cache["v"])
-    if loras is not None:
-        scan_xs = scan_xs + (loras,)
+    bi = jnp.arange(B)[:, None, None]
+    ki = jnp.arange(cfg.n_kv_heads)[None, :, None]
+    pi = write_pos[:, None, :]  # [B, 1, T]
+    groups = cfg.n_heads // cfg.n_kv_heads
+    scale = cfg.head_dim**-0.5
 
-    def scan_body(x, inp):
-        if loras is not None:
-            p, ck, cv, lp = inp
-        else:
-            p, ck, cv = inp
+    # fori_loop with the FULL cache as carry — the per-layer scatter updates
+    # alias in place (donated buffers), where a lax.scan carrying per-layer
+    # cache slices as ys re-materializes the whole cache every step (decode
+    # measured 1.6x slower from those copies alone at 3B/B=16 on v5e).
+    def body(l, carry):
+        x, ck_all, cv_all = carry
+        p = {k: stacked[k][l] for k in _LAYER_KEYS}
         h = _rmsnorm(x, p["attn_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
         q = jnp.einsum("bte,ehd->bthd", h, p["wq"])
         k = jnp.einsum("bte,ehd->bthd", h, p["wk"])
         v = jnp.einsum("bte,ehd->bthd", h, p["wv"])
         if loras is not None:
             # per-sequence adapter gather + low-rank delta: W x + B(A x)
+            lp = {n: loras[n][l] for n in ("wq_a", "wq_b", "wv_a", "wv_b")}
             q = q + jnp.einsum(
                 "btr,brhd->bthd",
                 jnp.einsum("bte,ber->btr", h, lp["wq_a"][adapter_ids]),
@@ -721,17 +729,31 @@ def _decode_forward(
             )
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        ck = ck.at[batch_idx, write_pos].set(k, mode="drop")
-        cv = cv.at[batch_idx, write_pos].set(v, mode="drop")
+        # cache is [B, K, S, D]: write the new [B, T, K, D] rows head-major
+        kh = k.transpose(0, 2, 1, 3)  # [B, K, T, D]
+        vh = v.transpose(0, 2, 1, 3)
+        ck_all = ck_all.at[l, bi, ki, pi].set(kh, mode="drop")
+        cv_all = cv_all.at[l, bi, ki, pi].set(vh, mode="drop")
+        ck = ck_all[l]
+        cv = cv_all[l]
 
-        groups = cfg.n_heads // cfg.n_kv_heads
-        fk = jnp.repeat(ck, groups, axis=2) if groups > 1 else ck
-        fv = jnp.repeat(cv, groups, axis=2) if groups > 1 else cv
-        scale = cfg.head_dim**-0.5
-        s = jnp.einsum("bthd,bshd->bhts", q, fk) * scale
-        s = jnp.where(seq_mask[:, None, :, :], s, -1e30)
-        w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhts,bshd->bthd", w, fv)
+        if groups > 1:
+            # GQA without materializing repeated K/V: fold the group axis
+            # into the query instead (a jnp.repeat here would write+reread
+            # the whole cache ×groups per layer per step — at 3B/B=16 that
+            # alone is ~11 GB of HBM traffic per decode step)
+            qg = q.reshape(B, T, cfg.n_kv_heads, groups, cfg.head_dim)
+            s = jnp.einsum("btkgd,bksd->bktgs", qg, ck) * scale
+            s = jnp.where(seq_mask[:, None, :, None, :], s, -1e30)
+            w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bktgs,bksd->btkgd", w, cv).reshape(
+                B, T, cfg.n_heads, cfg.head_dim
+            )
+        else:
+            s = jnp.einsum("bthd,bhsd->bhts", q, ck) * scale
+            s = jnp.where(seq_mask[:, None, :, :], s, -1e30)
+            w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bhts,bhsd->bthd", w, cv)
         x = x + jnp.einsum("bthd,hde->bte", attn, p["wo"])
 
         h = _rmsnorm(x, p["mlp_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
@@ -739,29 +761,37 @@ def _decode_forward(
             "bte,ef->btf", h, p["w_up"]
         )
         x = x + jnp.einsum("btf,fe->bte", ff, p["w_down"])
-        return x, (ck, cv)
+        return (x, ck_all, cv_all)
 
-    x, (new_k, new_v) = jax.lax.scan(scan_body, x, scan_xs)
+    x, new_k, new_v = jax.lax.fori_loop(
+        0, cfg.n_layers, body, (x, cache["k"], cache["v"])
+    )
+    new_cache = {"k": new_k, "v": new_v, "length": new_len}
+    if not with_logits:
+        # mid-chunk prefill: the caller only extends the KV cache — skip the
+        # LM head (the vocab projection reads ~0.8 GB of weights at 128k
+        # vocab; chunked admission would pay it once per chunk otherwise)
+        return None, new_cache
     x = _rmsnorm(x, params["final_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.einsum(
         "bte,ev->btv", x, unembed.astype(x.dtype),
         preferred_element_type=jnp.float32,
     )
-    new_cache = {"k": new_k, "v": new_v, "length": new_len}
     return logits, new_cache
 
 
 def prefill(
     params, cache, tokens, cfg: LlamaConfig, lengths=None,
-    loras=None, adapter_ids=None, start_pos=None,
+    loras=None, adapter_ids=None, start_pos=None, with_logits: bool = True,
 ):
     """Process a prompt batch. tokens: [B, T] (right-padded); lengths: [B].
-    Returns (last-token logits [B, vocab], cache).
+    Returns (last-token logits [B, vocab] or None, cache).
 
     ``start_pos`` [B]: absolute position of tokens[:, 0] — the SUFFIX
-    prefill used by prefix caching (the cache already holds positions
-    0..start_pos-1 copied from a cached prefix; this call extends it)."""
+    prefill used by prefix caching and chunked admission (the cache already
+    holds positions 0..start_pos-1; this call extends it). ``with_logits=
+    False`` skips the LM head for mid-chunk prefills."""
     B, T = tokens.shape
     if lengths is None:
         lengths = jnp.full((B,), T, jnp.int32)
@@ -772,9 +802,11 @@ def prefill(
     valid = rel < lengths[:, None]
     logits, cache = _decode_forward(
         params, cache, tokens, positions, cfg, valid,
-        loras=loras, adapter_ids=adapter_ids,
+        loras=loras, adapter_ids=adapter_ids, with_logits=with_logits,
     )
     cache["length"] = start_pos + lengths
+    if not with_logits:
+        return None, cache
     last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
     return last, cache
 
