@@ -644,11 +644,18 @@ class NodeAgent:
         return root
 
     def _worker_accept_loop(self):
+        import errno
+
         while not self.shutting_down:
             try:
                 conn = self._worker_listener.accept()
-            except (OSError, EOFError):
-                return
+            except OSError as e:
+                # per-connection handshake failures must NOT kill the loop
+                # (see Controller._accept_loop); only a closed listener ends it
+                if self.shutting_down or e.errno in (errno.EBADF, errno.EINVAL):
+                    return
+                time.sleep(0.05)  # persistent errors (EMFILE) must not spin
+                continue
             except Exception:  # noqa: BLE001 — failed authkey handshake
                 continue
             threading.Thread(
@@ -897,11 +904,16 @@ class NodeAgent:
         return (size, chunk)
 
     def _data_accept_loop(self):
+        import errno
+
         while not self.shutting_down:
             try:
                 conn = self._data_listener.accept()
-            except (OSError, EOFError):
-                return
+            except OSError as e:
+                if self.shutting_down or e.errno in (errno.EBADF, errno.EINVAL):
+                    return
+                time.sleep(0.05)  # persistent errors (EMFILE) must not spin
+                continue
             except Exception:  # noqa: BLE001
                 continue
             threading.Thread(

@@ -16,6 +16,23 @@ import os
 from typing import Any
 
 
+def _field_type(f: "dataclasses.Field") -> type:
+    """Resolve a dataclass field's scalar type. With ``from __future__
+    import annotations`` the annotation is a STRING (e.g. "Optional[str]"),
+    so fields like cluster_token would otherwise fall through to the JSON
+    coercion and reject plain strings."""
+    t = f.type
+    if isinstance(t, type):
+        return t
+    s = str(t)
+    for name, typ in (("bool", bool), ("float", float), ("int", int), ("str", str)):
+        if name in s:
+            return typ
+    if f.default is not None and type(f.default) in (bool, int, float, str):
+        return type(f.default)
+    return object  # JSON-coerced
+
+
 def _coerce(value: str, typ: type) -> Any:
     if typ is bool:
         return value.lower() in ("1", "true", "yes", "on")
@@ -124,7 +141,7 @@ class Config:
         for f in dataclasses.fields(cls):
             env_key = "RAY_TPU_" + f.name.upper()
             if env_key in os.environ:
-                kwargs[f.name] = _coerce(os.environ[env_key], f.type if isinstance(f.type, type) else type(f.default))
+                kwargs[f.name] = _coerce(os.environ[env_key], _field_type(f))
         if overrides:
             for k, v in overrides.items():
                 if k not in {f.name for f in dataclasses.fields(cls)}:

@@ -2112,12 +2112,23 @@ class Controller:
     # ------------------------------------------------------- worker transport
 
     def _accept_loop(self, listener):
+        import errno
+
         while not self.shutting_down:
             try:
                 conn = listener.accept()
-            except (OSError, EOFError):
-                return  # listener closed (shutdown)
-            except Exception:  # noqa: BLE001 — e.g. failed authkey handshake
+            except OSError as e:
+                # EBADF/EINVAL = the listener itself was closed (shutdown).
+                # Anything else (ECONNRESET from a peer that dropped mid
+                # authkey-challenge — e.g. a bare TCP health probe) is
+                # per-connection: exiting here would silently kill the
+                # accept loop and strand every later connect in the backlog
+                # until SYN timeout.
+                if self.shutting_down or e.errno in (errno.EBADF, errno.EINVAL):
+                    return
+                time.sleep(0.05)  # persistent errors (EMFILE) must not spin
+                continue
+            except Exception:  # noqa: BLE001 — failed/aborted handshake
                 continue  # keep serving other clients
             threading.Thread(target=self._handshake, args=(conn,), daemon=True).start()
 
@@ -2838,7 +2849,8 @@ class Controller:
                         "node_id": n.node_id.hex(),
                         "total": dict(n.total),
                         "available": dict(n.available),
-                        "idle": all(
+                        "labels": dict(n.labels),
+                        "idle": not n.leased and all(
                             abs(n.available.get(k, 0) - v) < 1e-9
                             for k, v in n.total.items()
                         ),

@@ -134,6 +134,33 @@ class Autoscaler:
 
     # -- one reconcile pass ---------------------------------------------------
 
+    def _nodes_for_launch(self, launch: list[str], state: dict) -> list[dict]:
+        """Controller nodes belonging to one provider launch. Direct id
+        match covers FakeNodeProvider (provider id == controller id); real
+        providers correlate through the ``provider_node_id`` label their
+        launched agents register with."""
+        nodes_by_id = {n["node_id"]: n for n in state["nodes"]}
+        direct = [nodes_by_id[nid] for nid in launch if nid in nodes_by_id]
+        if direct:
+            return direct
+        wanted = set(launch)
+        return [
+            n for n in state["nodes"]
+            if (n.get("labels") or {}).get("provider_node_id") in wanted
+        ]
+
+    def _launch_pending(self, g: NodeGroup, state: dict) -> bool:
+        """True when a launch of this group hasn't fully registered yet —
+        real agents take seconds to boot, and re-launching for the same
+        demand every reconcile tick would stack slices. A launch registers
+        ``nodes_per_group`` controller nodes regardless of how many
+        provider ids it returned (a TPU slice is ONE provider node but
+        hosts_per_slice agents)."""
+        for launch in self.launched[g.name]:
+            if len(self._nodes_for_launch(launch, state)) < g.nodes_per_group:
+                return True
+        return False
+
     def update(self) -> dict:
         state = self._call("autoscaler_state")
         actions: dict[str, Any] = {"scaled_up": [], "scaled_down": []}
@@ -150,7 +177,11 @@ class Autoscaler:
             if self._satisfiable(shape, nodes_by_id):
                 continue
             for g in self.config.node_groups:
-                if g.can_satisfy(shape) and len(self.launched[g.name]) < g.max_groups:
+                if not g.can_satisfy(shape):
+                    continue
+                if self._launch_pending(g, state):
+                    break  # boot in progress covers this demand
+                if len(self.launched[g.name]) < g.max_groups:
                     self.launched[g.name].append(self.provider.create_node_group(g))
                     actions["scaled_up"].append(g.name)
                     break
@@ -162,8 +193,10 @@ class Autoscaler:
                 if len(self.launched[g.name]) <= g.min_groups:
                     break
                 key = ",".join(launch)
-                infos = [nodes_by_id.get(nid) for nid in launch]
-                if all(i and i["idle"] and i["alive"] for i in infos):
+                infos = self._nodes_for_launch(launch, state)
+                if len(infos) >= g.nodes_per_group and all(
+                    i["idle"] and i["alive"] for i in infos
+                ):
                     since = self._idle_since.setdefault(key, now)
                     if now - since >= self.config.idle_timeout_s:
                         self.provider.terminate_nodes(launch)

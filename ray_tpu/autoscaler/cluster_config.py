@@ -1,0 +1,139 @@
+"""Cluster YAML schema + validation.
+
+Reference: the autoscaler cluster YAML validated by
+``python/ray/autoscaler/ray-schema.json`` and loaded by
+``python/ray/autoscaler/_private/commands.py`` (``ray up/down``). TPU-first
+delta: worker pools are SLICE groups — ``hosts_per_slice`` hosts launched and
+terminated atomically (a partial slice cannot run an SPMD program), mirroring
+the pod-slice gang resources of ``python/ray/_private/accelerators/tpu.py``.
+
+Example::
+
+    cluster_name: demo
+    cluster_token: s3cret
+    provider:
+      type: local_process            # or: tpu_vm
+      # tpu_vm only:
+      # project_id: my-proj
+      # zone: us-central2-b
+      # runtime_version: tpu-ubuntu2204-base
+    head:
+      port: 6380
+      num_cpus: 4
+      resources: {}
+    node_groups:
+      - name: workers
+        hosts_per_slice: 2
+        resources_per_node: {CPU: 2}
+        min_slices: 1
+        max_slices: 4
+        # tpu_vm only:
+        # accelerator_type: v5litepod-16
+    setup_commands: []
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class HeadConfig:
+    port: int = 6380
+    num_cpus: int = 4
+    resources: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class NodeGroupConfig:
+    name: str
+    resources_per_node: dict = dataclasses.field(default_factory=dict)
+    hosts_per_slice: int = 1
+    min_slices: int = 0
+    max_slices: int = 10
+    accelerator_type: Optional[str] = None  # tpu_vm: e.g. "v5litepod-16"
+    num_cpus: int = 2
+    object_store_memory: int = 256 * 1024**2
+
+
+@dataclasses.dataclass
+class ProviderConfig:
+    type: str = "local_process"
+    # tpu_vm provider fields (gcloud):
+    project_id: Optional[str] = None
+    zone: Optional[str] = None
+    runtime_version: str = "tpu-ubuntu2204-base"
+    # extra provider-specific knobs pass through untouched
+    extra: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    cluster_name: str
+    provider: ProviderConfig
+    head: HeadConfig
+    node_groups: list[NodeGroupConfig]
+    cluster_token: str = ""
+    setup_commands: list = dataclasses.field(default_factory=list)
+    idle_timeout_s: float = 60.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ClusterConfig":
+        known_provider = {
+            f.name for f in dataclasses.fields(ProviderConfig) if f.name != "extra"
+        }
+        prov_raw = dict(d.get("provider") or {})
+        prov = {k: v for k, v in prov_raw.items() if k in known_provider}
+        extra = {k: v for k, v in prov_raw.items() if k not in known_provider}
+        groups = [NodeGroupConfig(**g) for g in d.get("node_groups") or []]
+        cfg = cls(
+            cluster_name=_require(d, "cluster_name", str),
+            provider=ProviderConfig(extra=extra, **prov),
+            head=HeadConfig(**(d.get("head") or {})),
+            node_groups=groups,
+            cluster_token=d.get("cluster_token", ""),
+            setup_commands=list(d.get("setup_commands") or []),
+            idle_timeout_s=float(d.get("idle_timeout_s", 60.0)),
+        )
+        cfg.validate()
+        return cfg
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ClusterConfig":
+        import yaml
+
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f) or {})
+
+    def validate(self) -> None:
+        if not self.cluster_name:
+            raise ValueError("cluster_name is required")
+        names = [g.name for g in self.node_groups]
+        if len(names) != len(set(names)):
+            raise ValueError(f"duplicate node group names: {names}")
+        for g in self.node_groups:
+            if g.hosts_per_slice < 1:
+                raise ValueError(f"{g.name}: hosts_per_slice must be >= 1")
+            if g.min_slices > g.max_slices:
+                raise ValueError(f"{g.name}: min_slices > max_slices")
+            if self.provider.type == "tpu_vm" and not g.accelerator_type:
+                raise ValueError(
+                    f"{g.name}: tpu_vm groups need accelerator_type "
+                    "(e.g. v5litepod-16)"
+                )
+        if self.provider.type == "tpu_vm":
+            if not self.provider.project_id or not self.provider.zone:
+                raise ValueError("tpu_vm provider needs project_id and zone")
+        if not self.cluster_token:
+            raise ValueError(
+                "cluster_token is required (agents on other hosts derive "
+                "the control-plane authkey from it)"
+            )
+
+
+def _require(d: dict, key: str, typ: type) -> Any:
+    v = d.get(key)
+    if not isinstance(v, typ):
+        raise ValueError(f"cluster config: {key!r} ({typ.__name__}) is required")
+    return v

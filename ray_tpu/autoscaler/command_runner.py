@@ -1,0 +1,127 @@
+"""Command runners: execute setup/start commands on provisioned hosts.
+
+Reference: ``python/ray/autoscaler/command_runner.py`` (the
+``CommandRunnerInterface``) with ``_private/command_runner.py``'s
+``SSHCommandRunner`` and the TPU pod-slice fan-out of
+``_private/gcp/tpu_command_runner.py`` (one logical node = N slice workers;
+every command runs on all of them).
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class CommandRunner:
+    def run(self, cmd: str, timeout: float = 300.0, background: bool = False) -> str:
+        """Run a shell command on the target host; returns stdout."""
+        raise NotImplementedError
+
+    def run_many(self, cmds: list[str], **kw) -> None:
+        for c in cmds:
+            self.run(c, **kw)
+
+
+class LocalCommandRunner(CommandRunner):
+    """Runs on THIS host — the local_process provider's runner and the
+    degenerate case of `up` from the head node itself."""
+
+    def __init__(self, env: Optional[dict] = None):
+        self.env = env
+
+    def run(self, cmd: str, timeout: float = 300.0, background: bool = False) -> str:
+        import os
+
+        env = dict(os.environ)
+        if self.env:
+            env.update(self.env)
+        if background:
+            subprocess.Popen(
+                cmd, shell=True, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            return ""
+        out = subprocess.run(
+            cmd, shell=True, env=env, capture_output=True, text=True,
+            timeout=timeout,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"command failed ({out.returncode}): {cmd}\n{out.stderr[-2000:]}"
+            )
+        return out.stdout
+
+
+class SSHCommandRunner(CommandRunner):
+    """Plain ssh. Reference: ``_private/command_runner.py`` SSHCommandRunner
+    (simplified: no rsync/docker legs)."""
+
+    def __init__(self, host: str, user: str = "", key_path: str = ""):
+        self.host = host
+        self.user = user
+        self.key_path = key_path
+
+    def _ssh_base(self) -> list[str]:
+        target = f"{self.user}@{self.host}" if self.user else self.host
+        base = [
+            "ssh", "-o", "StrictHostKeyChecking=no",
+            "-o", "ConnectTimeout=10",
+        ]
+        if self.key_path:
+            base += ["-i", self.key_path]
+        return base + [target]
+
+    def run(self, cmd: str, timeout: float = 300.0, background: bool = False) -> str:
+        full = self._ssh_base() + [cmd]
+        if background:
+            subprocess.Popen(
+                full, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+            )
+            return ""
+        out = subprocess.run(full, capture_output=True, text=True, timeout=timeout)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"ssh {self.host} failed ({out.returncode}): {cmd}\n"
+                f"{out.stderr[-2000:]}"
+            )
+        return out.stdout
+
+
+class TPUCommandRunner(CommandRunner):
+    """One TPU slice = N VM workers; every command fans out to all of them
+    via ``gcloud compute tpus tpu-vm ssh --worker=all`` (reference:
+    ``_private/gcp/tpu_command_runner.py`` — a TPU 'node' is a pod of
+    workers and each command targets every worker)."""
+
+    def __init__(self, tpu_name: str, project_id: str, zone: str,
+                 worker: str = "all"):
+        self.tpu_name = tpu_name
+        self.project_id = project_id
+        self.zone = zone
+        self.worker = worker
+
+    def gcloud_args(self, cmd: str) -> list[str]:
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", "ssh", self.tpu_name,
+            f"--project={self.project_id}", f"--zone={self.zone}",
+            f"--worker={self.worker}", "--command", cmd,
+        ]
+
+    def run(self, cmd: str, timeout: float = 600.0, background: bool = False) -> str:
+        full = self.gcloud_args(cmd)
+        if background:
+            subprocess.Popen(
+                full, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+            )
+            return ""
+        out = subprocess.run(full, capture_output=True, text=True, timeout=timeout)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"tpu-vm ssh {self.tpu_name} failed ({out.returncode}): "
+                f"{cmd}\n{out.stderr[-2000:]}"
+            )
+        return out.stdout

@@ -1,0 +1,217 @@
+"""Cluster launcher commands: ``ray-tpu up / down / exec / attach``.
+
+Reference: ``python/ray/autoscaler/_private/commands.py`` (1.6k LoC
+``create_or_update_cluster``/``teardown_cluster``/``attach``/``exec``),
+cut to the TPU-first shape: the head starts first, worker SLICES join it
+atomically, and the demand autoscaler drives the same provider through
+``SliceGroupAdapter`` for scale-up/down of whole slices.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import time
+from typing import Optional
+
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    NodeGroup,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.cluster_config import ClusterConfig, NodeGroupConfig
+from ray_tpu.autoscaler.providers import ClusterNodeProvider, make_provider
+
+logger = logging.getLogger(__name__)
+
+
+def client_address(
+    config: ClusterConfig, provider: ClusterNodeProvider
+) -> str:
+    """ray://-style attach address for this cluster (authkey derived from
+    the shared cluster token)."""
+    from ray_tpu._private.protocol import token_to_authkey
+
+    key = token_to_authkey(config.cluster_token).hex()
+    return f"tcp://{provider.head_address()}?authkey={key}"
+
+
+def _wait_port(address: str, timeout_s: float = 60.0) -> None:
+    host, _, port = address.rpartition(":")
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, int(port)), timeout=2):
+                return
+        except OSError:
+            time.sleep(0.3)
+    raise TimeoutError(f"head at {address} did not become reachable")
+
+
+def create_or_update_cluster(
+    config: ClusterConfig,
+    provider: Optional[ClusterNodeProvider] = None,
+    wait_nodes_s: float = 60.0,
+) -> ClusterNodeProvider:
+    """``ray-tpu up``: boot the head, wait for its control plane, launch
+    every group's ``min_slices``, and wait for the agents to register."""
+    provider = provider or make_provider(config)
+    if provider.head_exists():
+        # idempotent re-up: a second head would orphan the first and its
+        # workers (the state file only tracks one)
+        logger.info("head for %s already running; updating", config.cluster_name)
+    else:
+        logger.info("launching head for cluster %s", config.cluster_name)
+        provider.launch_head()
+    _wait_port(provider.head_address(), wait_nodes_s)
+    # top up each group to min_slices (existing worker nodes counted by
+    # provider; slices are atomic units)
+    existing_ids = len([n for n in provider.non_terminated() if n != "head"])
+    expected = 0
+    for group in config.node_groups:
+        per = max(provider.ids_per_slice(group), 1)
+        have = existing_ids // per
+        expected += have * group.hosts_per_slice
+        for _ in range(max(0, group.min_slices - have)):
+            provider.launch_slice(group)
+            expected += group.hosts_per_slice
+        existing_ids = 0  # naive single-group attribution
+    if expected:
+        _wait_agents(config, provider, expected, wait_nodes_s)
+    logger.info(
+        "cluster %s up: head at %s, %d worker node(s)",
+        config.cluster_name, provider.head_address(), expected,
+    )
+    return provider
+
+
+def _wait_agents(
+    config: ClusterConfig,
+    provider: ClusterNodeProvider,
+    expected: int,
+    timeout_s: float,
+) -> None:
+    """Wait until ``expected`` agent nodes registered with the head (via a
+    throwaway client-driver attach)."""
+    import ray_tpu
+
+    deadline = time.monotonic() + timeout_s
+    last = -1
+    with _attached(config, provider):
+        while time.monotonic() < deadline:
+            agents = [
+                n for n in ray_tpu.nodes()
+                if n["Alive"] and n["Labels"].get("provider_node_id")
+            ]
+            if len(agents) != last:
+                last = len(agents)
+                logger.info("%d/%d agent nodes registered", len(agents), expected)
+            if len(agents) >= expected:
+                return
+            time.sleep(0.5)
+    raise TimeoutError(
+        f"only {last}/{expected} agent nodes registered within {timeout_s}s"
+    )
+
+
+class _attached:
+    """Attach to the cluster as a client driver for the scope of a with."""
+
+    def __init__(self, config: ClusterConfig, provider: ClusterNodeProvider):
+        self.config = config
+        self.provider = provider
+
+    def __enter__(self):
+        import ray_tpu
+
+        self._was_initialized = ray_tpu.is_initialized()
+        if not self._was_initialized:
+            ray_tpu.init(address=client_address(self.config, self.provider))
+        return self
+
+    def __exit__(self, *exc):
+        import ray_tpu
+
+        if not self._was_initialized:
+            ray_tpu.shutdown()
+        return False
+
+
+def teardown_cluster(
+    config: ClusterConfig, provider: ClusterNodeProvider
+) -> None:
+    """``ray-tpu down``: terminate every provider node (head last)."""
+    nodes = [n for n in provider.non_terminated() if n != "head"]
+    if nodes:
+        provider.terminate(nodes)
+    provider.terminate([n for n in provider.non_terminated()])
+    provider.shutdown()
+    logger.info("cluster %s torn down", config.cluster_name)
+
+
+def exec_on_head(
+    config: ClusterConfig, provider: ClusterNodeProvider, cmd: str
+) -> str:
+    """``ray-tpu exec``: run a shell command on the head host."""
+    return provider.get_command_runner("head").run(cmd)
+
+
+class SliceGroupAdapter(NodeProvider):
+    """Bridges the demand ``Autoscaler`` (group-level API) to a REAL
+    ``ClusterNodeProvider``: scale-up launches provider slices whose agents
+    register with the head; scale-down terminates the provider nodes and
+    lets heartbeat loss remove the controller nodes. Controller nodes map
+    back to provider nodes through the ``provider_node_id`` label each
+    launched agent carries."""
+
+    def __init__(self, provider: ClusterNodeProvider, config: ClusterConfig):
+        self.provider = provider
+        self._groups = {g.name: g for g in config.node_groups}
+        self._launched: list[str] = []
+
+    def create_node_group(self, group: NodeGroup) -> list[str]:
+        cfg = self._groups.get(group.name)
+        if cfg is None:
+            cfg = NodeGroupConfig(
+                name=group.name,
+                resources_per_node=dict(group.resources_per_node),
+                hosts_per_slice=group.nodes_per_group,
+            )
+        ids = self.provider.launch_slice(cfg)
+        self._launched.extend(ids)
+        return ids
+
+    def terminate_nodes(self, node_ids: list[str]) -> None:
+        self.provider.terminate(node_ids)
+        for nid in node_ids:
+            if nid in self._launched:
+                self._launched.remove(nid)
+
+    def non_terminated_nodes(self) -> list[str]:
+        return [
+            n for n in self.provider.non_terminated() if n in self._launched
+        ]
+
+
+def autoscaler_for(
+    config: ClusterConfig, provider: ClusterNodeProvider
+) -> Autoscaler:
+    """Demand autoscaler wired to the real provider (must run attached to
+    the cluster — e.g. on the head, reference: monitor.py)."""
+    groups = [
+        NodeGroup(
+            name=g.name,
+            resources_per_node=dict(g.resources_per_node),
+            nodes_per_group=g.hosts_per_slice,
+            min_groups=g.min_slices,
+            max_groups=g.max_slices,
+        )
+        for g in config.node_groups
+    ]
+    return Autoscaler(
+        AutoscalerConfig(
+            node_groups=groups, idle_timeout_s=config.idle_timeout_s
+        ),
+        provider=SliceGroupAdapter(provider, config),
+    )

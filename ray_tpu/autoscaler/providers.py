@@ -1,0 +1,376 @@
+"""Cluster node providers: provision hosts and start head/agent processes.
+
+Reference: the ``NodeProvider`` plugin API
+(``python/ray/autoscaler/node_provider.py``) with the GCP TPU-VM backend
+(``python/ray/autoscaler/_private/gcp/node.py`` +
+``gcp/tpu_command_runner.py``) and the fake multi-node provider used by
+tests (``autoscaler/_private/fake_multi_node``). TPU-first delta: the
+provisioning unit is a SLICE (all hosts created/terminated together).
+
+Provider contract (launcher-level, used by ``commands.up/down`` and the
+demand autoscaler through ``SliceGroupAdapter``):
+
+- ``launch_head()`` boots the head host and starts the head process;
+- ``launch_slice(group)`` boots ``hosts_per_slice`` hosts and starts a node
+  agent on each, pointed at the head;
+- every started agent carries a ``provider_node_id`` label so controller
+  nodes can be correlated back to provider nodes for scale-down.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shlex
+import subprocess
+import sys
+import time
+import uuid
+from typing import Optional
+
+from ray_tpu.autoscaler.cluster_config import ClusterConfig, NodeGroupConfig
+from ray_tpu.autoscaler.command_runner import (
+    CommandRunner,
+    LocalCommandRunner,
+    SSHCommandRunner,
+    TPUCommandRunner,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class ClusterNodeProvider:
+    """Launcher-level provider API (one per cluster config)."""
+
+    def __init__(self, config: ClusterConfig):
+        self.config = config
+
+    def launch_head(self) -> str:
+        raise NotImplementedError
+
+    def head_exists(self) -> bool:
+        """True when this cluster's head is already provisioned and alive
+        (makes ``up`` idempotent)."""
+        return False
+
+    def head_address(self) -> str:
+        raise NotImplementedError
+
+    def launch_slice(self, group: NodeGroupConfig) -> list[str]:
+        raise NotImplementedError
+
+    def ids_per_slice(self, group: NodeGroupConfig) -> int:
+        """How many provider node ids one launch_slice returns (hosts for
+        per-host providers; 1 for providers whose unit IS the slice)."""
+        return group.hosts_per_slice
+
+    def terminate(self, node_ids: list[str]) -> None:
+        raise NotImplementedError
+
+    def non_terminated(self) -> list[str]:
+        raise NotImplementedError
+
+    def get_command_runner(self, node_id: str) -> CommandRunner:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class LocalProcessProvider(ClusterNodeProvider):
+    """Hosts are subprocesses on this machine — the e2e test backend
+    (reference: ``fake_multi_node``, where nodes are local processes). The
+    head is a real ``ray-tpu start --head`` process and every worker a real
+    ``ray-tpu start --address`` agent: the full launch path minus SSH."""
+
+    def __init__(self, config: ClusterConfig, state_dir: Optional[str] = None):
+        super().__init__(config)
+        self.state_dir = state_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"),
+            f"rtpu-cluster-{config.cluster_name}",
+        )
+        os.makedirs(self.state_dir, exist_ok=True)
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._head_port: Optional[int] = None
+        # pid table persisted so a later `ray-tpu down` invocation (a fresh
+        # process) can find and terminate the cluster (reference: the
+        # cluster state files under ~/.ray in commands.py)
+        self._state_path = os.path.join(self.state_dir, "state.json")
+        self._pids: dict[str, int] = {}
+        if os.path.exists(self._state_path):
+            try:
+                with open(self._state_path) as f:
+                    st = json.load(f)
+                self._pids = {k: int(v) for k, v in st.get("pids", {}).items()}
+                self._head_port = st.get("head_port")
+            except (OSError, ValueError):
+                pass
+
+    def _save_state(self) -> None:
+        with open(self._state_path, "w") as f:
+            json.dump({"pids": self._pids, "head_port": self._head_port}, f)
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
+
+    # -- head ---------------------------------------------------------------
+
+    def launch_head(self) -> str:
+        import socket
+
+        # pick a free port for the head's TCP control plane
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        self._head_port = s.getsockname()[1]
+        s.close()
+        node_id = "head"
+        env = dict(os.environ)
+        env.pop("RAY_TPU_ARENA", None)
+        env.pop("RAY_TPU_WORKER", None)
+        env["PYTHONUNBUFFERED"] = "1"  # live logs in the state dir
+        with open(os.path.join(self.state_dir, "head.log"), "w") as log:
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+                    "--head", "--port", str(self._head_port),
+                    "--token", self.config.cluster_token,
+                    "--num-cpus", str(self.config.head.num_cpus),
+                ],
+                env=env,
+                stdout=log,  # child holds its own duplicate fd
+                stderr=subprocess.STDOUT,
+            )
+        self._procs[node_id] = proc
+        self._pids[node_id] = proc.pid
+        self._save_state()
+        return node_id
+
+    def head_exists(self) -> bool:
+        return "head" in self.non_terminated()
+
+    def head_address(self) -> str:
+        return f"127.0.0.1:{self._head_port}"
+
+    # -- workers ------------------------------------------------------------
+
+    def launch_slice(self, group: NodeGroupConfig) -> list[str]:
+        created = []
+        for i in range(group.hosts_per_slice):
+            node_id = f"{group.name}-{uuid.uuid4().hex[:8]}"
+            env = dict(os.environ)
+            env.pop("RAY_TPU_ARENA", None)
+            env.pop("RAY_TPU_WORKER", None)
+            env["RAY_TPU_CLUSTER_TOKEN"] = self.config.cluster_token
+            env["PYTHONUNBUFFERED"] = "1"  # live logs in the state dir
+            with open(
+                os.path.join(self.state_dir, f"{node_id}.log"), "w"
+            ) as log:
+                proc = subprocess.Popen(
+                    [
+                        sys.executable, "-m", "ray_tpu._private.agent",
+                        "--address", self.head_address(),
+                        "--resources", json.dumps(group.resources_per_node),
+                        "--labels", json.dumps(
+                            {"group": group.name, "provider_node_id": node_id}
+                        ),
+                        "--base-dir", os.path.join(self.state_dir, node_id),
+                        "--object-store-memory", str(group.object_store_memory),
+                    ],
+                    env=env,
+                    stdout=log,  # child holds its own duplicate fd
+                    stderr=subprocess.STDOUT,
+                )
+            self._procs[node_id] = proc
+            self._pids[node_id] = proc.pid
+            created.append(node_id)
+        self._save_state()
+        return created
+
+    def terminate(self, node_ids: list[str]) -> None:
+        import signal
+
+        for nid in node_ids:
+            proc = self._procs.pop(nid, None)
+            pid = self._pids.pop(nid, None)
+            if proc is not None:
+                if proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            elif pid is not None and self._pid_alive(pid):
+                # reattached from the state file: no Popen handle
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    pass
+        self._save_state()
+
+    def non_terminated(self) -> list[str]:
+        out = []
+        for nid, pid in self._pids.items():
+            proc = self._procs.get(nid)
+            alive = proc.poll() is None if proc is not None else self._pid_alive(pid)
+            if alive:
+                out.append(nid)
+        return out
+
+    def get_command_runner(self, node_id: str) -> CommandRunner:
+        return LocalCommandRunner()
+
+    def shutdown(self) -> None:
+        self.terminate(list(self._pids.keys()))
+
+
+class TPUVMProvider(ClusterNodeProvider):
+    """GCP TPU-VM provisioning through ``gcloud`` (reference:
+    ``autoscaler/_private/gcp/node.py`` TPU support +
+    ``gcp/tpu_command_runner.py``). One provider node = one TPU slice; the
+    agent start command fans out to every VM worker of the slice."""
+
+    AGENT_START = (
+        "nohup python -m ray_tpu._private.agent --address {head} "
+        "--labels {labels} >/tmp/rtpu-agent.log 2>&1 &"
+    )
+
+    def __init__(self, config: ClusterConfig):
+        super().__init__(config)
+        p = config.provider
+        self.project_id, self.zone = p.project_id, p.zone
+        self.runtime_version = p.runtime_version
+        self._head_name = f"{config.cluster_name}-head"
+        self._head_ip: Optional[str] = None
+
+    def _gcloud(self, args: list[str], timeout: float = 600.0) -> str:
+        out = subprocess.run(
+            ["gcloud"] + args, capture_output=True, text=True, timeout=timeout
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"gcloud {' '.join(args[:4])}... failed: {out.stderr[-2000:]}"
+            )
+        return out.stdout
+
+    def _resolve_head_ip(self) -> Optional[str]:
+        if self._head_ip:
+            return self._head_ip
+        try:
+            self._head_ip = self._gcloud([
+                "compute", "instances", "describe", self._head_name,
+                f"--project={self.project_id}", f"--zone={self.zone}",
+                "--format=value(networkInterfaces[0].networkIP)",
+            ]).strip() or None
+        except RuntimeError:
+            self._head_ip = None
+        return self._head_ip
+
+    def head_exists(self) -> bool:
+        return self._resolve_head_ip() is not None
+
+    def launch_head(self) -> str:
+        """Head = a plain GCE instance running ``ray-tpu start --head``."""
+        self._gcloud([
+            "compute", "instances", "create", self._head_name,
+            f"--project={self.project_id}", f"--zone={self.zone}",
+            "--machine-type=n2-standard-8",
+        ])
+        self._resolve_head_ip()
+        runner = self.get_command_runner(self._head_name)
+        for cmd in self.config.setup_commands:
+            runner.run(cmd)
+        runner.run(
+            f"nohup python -m ray_tpu.scripts.cli start --head "
+            f"--port {self.config.head.port} "
+            f"--token {shlex.quote(self.config.cluster_token)} "
+            f">/tmp/rtpu-head.log 2>&1 &",
+            background=False,
+        )
+        return self._head_name
+
+    def head_address(self) -> str:
+        return f"{self._resolve_head_ip()}:{self.config.head.port}"
+
+    def launch_slice(self, group: NodeGroupConfig) -> list[str]:
+        name = f"{self.config.cluster_name}-{group.name}-{uuid.uuid4().hex[:6]}"
+        self._gcloud([
+            "compute", "tpus", "tpu-vm", "create", name,
+            f"--project={self.project_id}", f"--zone={self.zone}",
+            f"--accelerator-type={group.accelerator_type}",
+            f"--version={self.runtime_version}",
+        ], timeout=1800.0)
+        runner = TPUCommandRunner(name, self.project_id, self.zone)
+        for cmd in self.config.setup_commands:
+            runner.run(cmd)
+        labels = json.dumps({"group": group.name, "provider_node_id": name})
+        runner.run(
+            "export RAY_TPU_CLUSTER_TOKEN="
+            + shlex.quote(self.config.cluster_token) + "; "
+            + self.AGENT_START.format(
+                head=self.head_address(), labels=shlex.quote(labels)
+            )
+        )
+        return [name]  # one provider node = the whole slice
+
+    def ids_per_slice(self, group: NodeGroupConfig) -> int:
+        return 1
+
+    def terminate(self, node_ids: list[str]) -> None:
+        for nid in node_ids:
+            if nid == self._head_name:
+                self._gcloud([
+                    "compute", "instances", "delete", nid, "--quiet",
+                    f"--project={self.project_id}", f"--zone={self.zone}",
+                ])
+            else:
+                self._gcloud([
+                    "compute", "tpus", "tpu-vm", "delete", nid, "--quiet",
+                    f"--project={self.project_id}", f"--zone={self.zone}",
+                ], timeout=1800.0)
+
+    def non_terminated(self) -> list[str]:
+        out = self._gcloud([
+            "compute", "tpus", "tpu-vm", "list",
+            f"--project={self.project_id}", f"--zone={self.zone}",
+            "--format=value(name)",
+            f"--filter=name~^{self.config.cluster_name}-",
+        ])
+        nodes = [l.strip() for l in out.splitlines() if l.strip()]
+        # the head is a GCE instance, not a TPU — without this, teardown
+        # would leak one billing n2-standard-8 per up/down cycle
+        if self.head_exists():
+            nodes.append(self._head_name)
+        return nodes
+
+    def get_command_runner(self, node_id: str) -> CommandRunner:
+        if node_id in ("head", self._head_name):
+            return SSHCommandRunner(self._resolve_head_ip() or self._head_name)
+        return TPUCommandRunner(node_id, self.project_id, self.zone)
+
+
+_PROVIDERS = {
+    "local_process": LocalProcessProvider,
+    "tpu_vm": TPUVMProvider,
+}
+
+
+def make_provider(config: ClusterConfig) -> ClusterNodeProvider:
+    try:
+        cls = _PROVIDERS[config.provider.type]
+    except KeyError:
+        raise ValueError(
+            f"unknown provider type {config.provider.type!r} "
+            f"(have: {sorted(_PROVIDERS)})"
+        ) from None
+    return cls(config)
+
+
+def register_provider(name: str, cls) -> None:
+    """Plugin hook (reference: external node providers via module path)."""
+    _PROVIDERS[name] = cls

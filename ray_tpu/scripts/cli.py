@@ -74,6 +74,12 @@ def cmd_start(args):
     import time
 
     if args.head:
+        # stack dumps on demand (kill -USR1): same debugging affordance the
+        # node agent registers — a wedged head must be inspectable
+        import faulthandler
+        import signal as _signal
+
+        faulthandler.register(_signal.SIGUSR1)
         import ray_tpu
 
         config = {"tcp_port": args.port}
@@ -91,9 +97,11 @@ def cmd_start(args):
         from ray_tpu._private.worker import global_worker
 
         controller = global_worker().controller
-        print(f"head started: tcp={controller.tcp_address}")
+        # flush: `ray-tpu start --head > log` must show liveness immediately
+        # (block-buffered stdout would sit unflushed for the process's life)
+        print(f"head started: tcp={controller.tcp_address}", flush=True)
         if not args.token:
-            print(f"authkey={controller._authkey.hex()}")
+            print(f"authkey={controller._authkey.hex()}", flush=True)
         print(
             "join with: ray-tpu start --address "
             f"{controller.tcp_address}"
@@ -185,6 +193,61 @@ def cmd_job(args):
         print("stopped" if ok else "not running")
 
 
+def cmd_up(args):
+    """``ray-tpu up cluster.yaml`` (reference: ``ray up``,
+    ``autoscaler/_private/commands.py`` create_or_update_cluster)."""
+    from ray_tpu.autoscaler.cluster_config import ClusterConfig
+    from ray_tpu.autoscaler.commands import (
+        client_address,
+        create_or_update_cluster,
+    )
+
+    cfg = ClusterConfig.from_yaml(args.config_file)
+    provider = create_or_update_cluster(cfg, wait_nodes_s=args.timeout)
+    print(f"cluster {cfg.cluster_name} is up")
+    print(f"head: {provider.head_address()}")
+    print(f"attach: ray_tpu.init(address={client_address(cfg, provider)!r})")
+
+
+def cmd_down(args):
+    """``ray-tpu down cluster.yaml``."""
+    from ray_tpu.autoscaler.cluster_config import ClusterConfig
+    from ray_tpu.autoscaler.commands import teardown_cluster
+    from ray_tpu.autoscaler.providers import make_provider
+
+    cfg = ClusterConfig.from_yaml(args.config_file)
+    teardown_cluster(cfg, make_provider(cfg))
+    print(f"cluster {cfg.cluster_name} torn down")
+
+
+def cmd_exec(args):
+    """``ray-tpu exec cluster.yaml -- <cmd>``: run a command on the head."""
+    from ray_tpu.autoscaler.cluster_config import ClusterConfig
+    from ray_tpu.autoscaler.commands import exec_on_head
+    from ray_tpu.autoscaler.providers import make_provider
+
+    cfg = ClusterConfig.from_yaml(args.config_file)
+    parts = args.cmd[1:] if args.cmd[:1] == ["--"] else list(args.cmd)
+    cmd = " ".join(parts)
+    if not cmd:
+        print("error: pass a command after --", file=sys.stderr)
+        sys.exit(2)
+    print(exec_on_head(cfg, make_provider(cfg), cmd), end="")
+
+
+def cmd_attach(args):
+    """``ray-tpu attach cluster.yaml``: print the client attach address
+    (local provider) or open an interactive shell on the head (ssh)."""
+    from ray_tpu.autoscaler.cluster_config import ClusterConfig
+    from ray_tpu.autoscaler.commands import client_address
+    from ray_tpu.autoscaler.providers import make_provider
+
+    cfg = ClusterConfig.from_yaml(args.config_file)
+    provider = make_provider(cfg)
+    print(f"head: {provider.head_address()}")
+    print(f"attach: ray_tpu.init(address={client_address(cfg, provider)!r})")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -202,6 +265,24 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--node-ip", default=None)
     s.add_argument("--gcs-snapshot", default=None, help="head state snapshot path")
     s.set_defaults(fn=cmd_start)
+
+    s = sub.add_parser("up", help="launch a cluster from a YAML config")
+    s.add_argument("config_file")
+    s.add_argument("--timeout", type=float, default=120.0)
+    s.set_defaults(fn=cmd_up)
+
+    s = sub.add_parser("down", help="tear down a launched cluster")
+    s.add_argument("config_file")
+    s.set_defaults(fn=cmd_down)
+
+    s = sub.add_parser("exec", help="run a command on the cluster head")
+    s.add_argument("config_file")
+    s.add_argument("cmd", nargs=argparse.REMAINDER)
+    s.set_defaults(fn=cmd_exec)
+
+    s = sub.add_parser("attach", help="print attach info for a cluster")
+    s.add_argument("config_file")
+    s.set_defaults(fn=cmd_attach)
 
     s = sub.add_parser("status", help="cluster resources + nodes")
     s.add_argument("--num-cpus", type=int, default=4)
