@@ -40,6 +40,10 @@ class AutoscalerConfig:
     node_groups: list[NodeGroup] = dataclasses.field(default_factory=list)
     idle_timeout_s: float = 60.0
     poll_interval_s: float = 1.0
+    # A launch gets this long for all its agents to register; past it, a
+    # partial/dead launch stops blocking new scale-ups and — if NO node of
+    # it ever registered (or all died) — is terminated and replaced.
+    launch_grace_s: float = 180.0
 
 
 class NodeProvider:
@@ -100,6 +104,7 @@ class Autoscaler:
             g.name: [] for g in config.node_groups
         }
         self._idle_since: dict[str, float] = {}  # launch key -> first idle t
+        self._launch_t: dict[str, float] = {}  # launch key -> create time
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -155,21 +160,53 @@ class Autoscaler:
         demand every reconcile tick would stack slices. A launch registers
         ``nodes_per_group`` controller nodes regardless of how many
         provider ids it returned (a TPU slice is ONE provider node but
-        hosts_per_slice agents)."""
+        hosts_per_slice agents). Launches older than ``launch_grace_s``
+        stop counting as pending: a boot-crashed slice must not block
+        scale-up forever (it gets reaped in _reap_failed_launches)."""
+        now = time.time()
         for launch in self.launched[g.name]:
+            key = ",".join(launch)
+            age = now - self._launch_t.get(key, now)
+            if age > self.config.launch_grace_s:
+                continue
             if len(self._nodes_for_launch(launch, state)) < g.nodes_per_group:
                 return True
         return False
+
+    def _record_launch(self, g: NodeGroup, ids: list[str]) -> None:
+        self.launched[g.name].append(ids)
+        self._launch_t[",".join(ids)] = time.time()
+
+    def _reap_failed_launches(self, state: dict, actions: dict) -> None:
+        """Terminate launches past the boot grace with ZERO alive registered
+        nodes — a crashed-on-boot slice would otherwise leak (billing!) and
+        its pending demand would never be re-served."""
+        now = time.time()
+        for g in self.config.node_groups:
+            for launch in list(self.launched[g.name]):
+                key = ",".join(launch)
+                age = now - self._launch_t.get(key, now)
+                if age <= self.config.launch_grace_s:
+                    continue
+                infos = self._nodes_for_launch(launch, state)
+                if not any(i["alive"] for i in infos):
+                    self.provider.terminate_nodes(launch)
+                    self.launched[g.name].remove(launch)
+                    self._launch_t.pop(key, None)
+                    self._idle_since.pop(key, None)
+                    actions["scaled_down"].append(g.name)
 
     def update(self) -> dict:
         state = self._call("autoscaler_state")
         actions: dict[str, Any] = {"scaled_up": [], "scaled_down": []}
         nodes_by_id = {n["node_id"]: n for n in state["nodes"]}
 
+        self._reap_failed_launches(state, actions)
+
         # ensure minimums
         for g in self.config.node_groups:
             while len(self.launched[g.name]) < g.min_groups:
-                self.launched[g.name].append(self.provider.create_node_group(g))
+                self._record_launch(g, self.provider.create_node_group(g))
                 actions["scaled_up"].append(g.name)
 
         # scale up for unfulfilled demand
@@ -182,7 +219,7 @@ class Autoscaler:
                 if self._launch_pending(g, state):
                     break  # boot in progress covers this demand
                 if len(self.launched[g.name]) < g.max_groups:
-                    self.launched[g.name].append(self.provider.create_node_group(g))
+                    self._record_launch(g, self.provider.create_node_group(g))
                     actions["scaled_up"].append(g.name)
                     break
 
@@ -202,6 +239,7 @@ class Autoscaler:
                         self.provider.terminate_nodes(launch)
                         self.launched[g.name].remove(launch)
                         self._idle_since.pop(key, None)
+                        self._launch_t.pop(key, None)
                         actions["scaled_down"].append(g.name)
                 else:
                     self._idle_since.pop(key, None)

@@ -66,8 +66,13 @@ def create_or_update_cluster(
         provider.launch_head()
     _wait_port(provider.head_address(), wait_nodes_s)
     # top up each group to min_slices (existing worker nodes counted by
-    # provider; slices are atomic units)
-    existing_ids = len([n for n in provider.non_terminated() if n != "head"])
+    # provider; slices are atomic units). Head naming differs per provider
+    # ("head" locally, "<cluster>-head" on tpu_vm) — counting it as a
+    # worker would skip a slice launch and then time out waiting for it.
+    existing_ids = len([
+        n for n in provider.non_terminated()
+        if n != "head" and not n.endswith("-head")
+    ])
     expected = 0
     for group in config.node_groups:
         per = max(provider.ids_per_slice(group), 1)
@@ -142,7 +147,10 @@ def teardown_cluster(
     config: ClusterConfig, provider: ClusterNodeProvider
 ) -> None:
     """``ray-tpu down``: terminate every provider node (head last)."""
-    nodes = [n for n in provider.non_terminated() if n != "head"]
+    nodes = [
+        n for n in provider.non_terminated()
+        if n != "head" and not n.endswith("-head")
+    ]
     if nodes:
         provider.terminate(nodes)
     provider.terminate([n for n in provider.non_terminated()])

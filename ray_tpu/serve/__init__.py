@@ -14,6 +14,7 @@ from ray_tpu.serve.api import (
     shutdown,
     status,
 )
+from ray_tpu.serve.asgi import ingress
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
@@ -36,6 +37,7 @@ __all__ = [
     "get_app_handle",
     "get_deployment_handle",
     "get_multiplexed_model_id",
+    "ingress",
     "multiplexed",
     "run",
     "shutdown",

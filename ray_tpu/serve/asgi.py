@@ -1,0 +1,230 @@
+"""ASGI ingress: mount an unmodified FastAPI/Starlette app as a deployment.
+
+Reference: ``serve.ingress(fastapi_app)`` (``python/ray/serve/api.py:174``)
+and the uvicorn/ASGI proxy (``python/ray/serve/_private/proxy.py:697``).
+TPU-first delta: the proxy's data plane stays the asyncio chunked-transfer
+server; the ASGI protocol runs INSIDE the replica on a private event loop,
+and the response streams back through the core streaming-generator
+machinery — one code path for SSE, FastAPI ``StreamingResponse``, and plain
+JSON endpoints.
+
+Usage::
+
+    app = FastAPI()
+
+    @app.get("/items/{item_id}")
+    def get_item(item_id: int): ...
+
+    @serve.deployment
+    @serve.ingress(app)
+    class Api:
+        pass
+
+    serve.run(Api.bind(), route_prefix="/api")
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+from typing import Any
+
+from ray_tpu.serve.streaming import StreamStart
+
+_DONE = object()
+
+
+def _build_scope(request) -> dict:
+    headers = [
+        (k.lower().encode(), str(v).encode())
+        for k, v in (request.headers or {}).items()
+    ]
+    path = request.path or "/"
+    if not path.startswith("/"):
+        path = "/" + path
+    return {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.method,
+        "scheme": "http",
+        "path": path,
+        "raw_path": path.encode(),
+        "query_string": (getattr(request, "raw_query", "") or "").encode(),
+        "root_path": "",
+        "headers": headers,
+        "client": ("127.0.0.1", 0),
+        "server": ("127.0.0.1", 80),
+    }
+
+
+async def _run_asgi(app, request, out: "queue.Queue") -> None:
+    """Drive one request through the ASGI app; response frames go to
+    ``out`` (thread-safe: the consumer is a sync generator streaming back
+    through the replica)."""
+    body_sent = False
+
+    async def receive():
+        nonlocal body_sent
+        if body_sent:
+            return {"type": "http.disconnect"}
+        body_sent = True
+        return {
+            "type": "http.request",
+            "body": request.body or b"",
+            "more_body": False,
+        }
+
+    started = False
+
+    async def put(item):
+        # bounded handoff: a fast producer streaming to a slow client must
+        # not buffer the whole response in replica memory (the consumer is
+        # a sync generator on another thread, so block with a poll rather
+        # than stalling the shared event loop). The deadline frees this
+        # task if the consumer abandoned the stream entirely.
+        deadline = asyncio.get_running_loop().time() + 300
+        while True:
+            try:
+                out.put_nowait(item)
+                return
+            except queue.Full:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise RuntimeError("response consumer stalled/abandoned")
+                await asyncio.sleep(0.02)
+
+    async def send(message):
+        nonlocal started
+        if message["type"] == "http.response.start":
+            started = True
+            ctype = "application/octet-stream"
+            extra = []
+            for name, value in message.get("headers") or []:
+                n = name.decode().lower()
+                v = value.decode()
+                if n == "content-type":
+                    ctype = v
+                elif n not in ("content-length", "transfer-encoding"):
+                    extra.append((n, v))
+            await put(
+                StreamStart(
+                    content_type=ctype,
+                    status=int(message["status"]),
+                    headers=extra,
+                )
+            )
+        elif message["type"] == "http.response.body":
+            body = message.get("body") or b""
+            if body:
+                await put(body)
+
+    try:
+        await app(_build_scope(request), receive, send)
+        if not started:
+            await put(StreamStart(content_type="text/plain", status=500))
+            await put(b"ASGI app returned without a response")
+    except BaseException as e:  # noqa: BLE001 — surface as 500, don't hang
+        try:
+            if not started:
+                await put(StreamStart(content_type="text/plain", status=500))
+            await put(f"ASGI app error: {e!r}".encode())
+        except RuntimeError:
+            pass  # consumer gone — nothing to tell
+    finally:
+        try:
+            await put(_DONE)
+        except RuntimeError:
+            pass  # consumer gone; its get() timeout ends the generator
+
+
+class _ASGIRunner:
+    """Private event loop hosting the app (created lazily in the replica
+    process — it must not be pickled with the deployment)."""
+
+    def __init__(self, app):
+        self.app = app
+        self.loop = asyncio.new_event_loop()
+        t = threading.Thread(target=self._run, daemon=True, name="asgi-loop")
+        t.start()
+        self._lifespan("startup")
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def _lifespan(self, phase: str) -> None:
+        """Run the app's lifespan STARTUP and then keep the lifespan open
+        for the replica's lifetime: a Starlette/FastAPI lifespan context
+        (DB pools etc.) tears down when it receives shutdown — receive()
+        must therefore BLOCK after startup, not return fresh events, or the
+        app would run its shutdown hooks before the first request
+        (reference: serve's ASGI lifespan handling). Apps without lifespan
+        support are fine."""
+        started = threading.Event()
+
+        async def drive():
+            scope = {"type": "lifespan", "asgi": {"version": "3.0"}}
+            sent_startup = False
+            forever = asyncio.Event()
+
+            async def receive():
+                nonlocal sent_startup
+                if not sent_startup:
+                    sent_startup = True
+                    return {"type": "lifespan.startup"}
+                # shutdown arrives only at replica teardown (daemon loop
+                # dies with the process) — park here meanwhile
+                await forever.wait()
+                return {"type": "lifespan.shutdown"}
+
+            async def send(message):
+                if message["type"].startswith("lifespan.startup"):
+                    started.set()
+
+            try:
+                await self.app(scope, receive, send)
+            except BaseException:  # noqa: BLE001 — lifespan unsupported
+                pass
+            finally:
+                started.set()
+
+        asyncio.run_coroutine_threadsafe(drive(), self.loop)
+        started.wait(timeout=15)
+
+    def stream(self, request):
+        """Sync generator of response frames (StreamStart, then bytes)."""
+        out: "queue.Queue" = queue.Queue(maxsize=64)
+        asyncio.run_coroutine_threadsafe(
+            _run_asgi(self.app, request, out), self.loop
+        )
+        while True:
+            try:
+                item = out.get(timeout=600)
+            except queue.Empty:
+                return  # producer died without a terminator
+            if item is _DONE:
+                return
+            yield item
+
+
+def ingress(app) -> Any:
+    """Class decorator mounting ``app`` (any ASGI callable) as the
+    deployment's HTTP handler. The decorated class's own ``__init__`` still
+    runs (replica state, model loading, ...); HTTP requests go to the app."""
+
+    def decorator(cls):
+        class ASGIIngress(cls):
+            def __init__(self, *args, **kwargs):
+                super().__init__(*args, **kwargs)
+                self.__asgi = _ASGIRunner(app)
+
+            def __call__(self, request):
+                return self.__asgi.stream(request)
+
+        ASGIIngress.__name__ = cls.__name__
+        ASGIIngress.__qualname__ = getattr(cls, "__qualname__", cls.__name__)
+        ASGIIngress.__module__ = cls.__module__
+        return ASGIIngress
+
+    return decorator

@@ -24,12 +24,16 @@ import ray_tpu
 
 
 class Request:
-    def __init__(self, method: str, path: str, query: dict, headers: dict, body: bytes):
+    def __init__(self, method: str, path: str, query: dict, headers: dict,
+                 body: bytes, raw_query: str = ""):
         self.method = method
         self.path = path
         self.query_params = query
         self.headers = headers
         self.body = body
+        # unparsed query string — ASGI ingress needs the raw form (repeated
+        # keys, encoding) that the parsed dict can't reconstruct
+        self.raw_query = raw_query
 
     def json(self) -> Any:
         return json.loads(self.body or b"null")
@@ -37,7 +41,8 @@ class Request:
     def __reduce__(self):
         return (
             Request,
-            (self.method, self.path, self.query_params, self.headers, self.body),
+            (self.method, self.path, self.query_params, self.headers,
+             self.body, self.raw_query),
         )
 
 
@@ -154,6 +159,7 @@ class AsyncHTTPServer:
             {k: v[-1] for k, v in parse_qs(parsed.query).items()},
             headers,
             body,
+            raw_query=parsed.query,
         )
         loop = asyncio.get_running_loop()
         try:
@@ -172,7 +178,7 @@ class AsyncHTTPServer:
             )
             if chunks.stream_start is not None:
                 return await self._stream_body(
-                    writer, chunks.stream_start.content_type, first, done,
+                    writer, chunks.stream_start, first, done,
                     chunks, loop,
                 )
             if isinstance(first, bytes):
@@ -188,9 +194,9 @@ class AsyncHTTPServer:
             )
 
     async def _respond(self, writer, code, body, ctype):
-        reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}.get(
-            code, "OK"
-        )
+        import http.client as _hc
+
+        reason = _hc.responses.get(code, "")
         writer.write(
             (
                 f"HTTP/1.1 {code} {reason}\r\n"
@@ -202,20 +208,26 @@ class AsyncHTTPServer:
         )
         await writer.drain()
 
-    async def _stream_body(self, writer, ctype, first, done, chunks, loop):
+    async def _stream_body(self, writer, start, first, done, chunks, loop):
         """Chunked transfer-encoding on the event loop; each deployment
         chunk is written as it seals (SSE end to end). A mid-stream error
         truncates the chunked body (no terminator) — an unambiguous
-        client-side error that keeps headers sane."""
-        writer.write(
-            (
-                f"HTTP/1.1 200 OK\r\n"
-                f"Content-Type: {ctype}\r\n"
-                f"Transfer-Encoding: chunked\r\n"
-                f"Cache-Control: no-cache\r\n"
-                f"\r\n"
-            ).encode()
-        )
+        client-side error that keeps headers sane. ``start`` (StreamStart)
+        carries the full response head — status + app headers for ASGI
+        ingress responses."""
+        import http.client as _hc
+
+        status = getattr(start, "status", 200)
+        reason = _hc.responses.get(status, "")
+        head = [
+            f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {start.content_type}",
+            "Transfer-Encoding: chunked",
+            "Cache-Control: no-cache",
+        ]
+        for name, value in getattr(start, "headers", None) or []:
+            head.append(f"{name}: {value}")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
         await writer.drain()
 
         def next_chunk():
@@ -305,6 +317,7 @@ class ProxyActor:
                         {k: v[-1] for k, v in parse_qs(parsed.query).items()},
                         dict(self.headers.items()),
                         body,
+                        raw_query=parsed.query,
                     )
                     # All proxy requests ride the streaming path; unary
                     # handlers arrive as a single non-StreamStart chunk and
@@ -318,7 +331,7 @@ class ProxyActor:
                         first = None
                     if chunks.stream_start is not None:
                         return self._stream_body(
-                            chunks.stream_start.content_type, first, chunks
+                            chunks.stream_start, first, chunks
                         )
                     if isinstance(first, bytes):
                         return self._respond(200, first, "application/octet-stream")
@@ -337,17 +350,19 @@ class ProxyActor:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _stream_body(self, ctype: str, first, chunks):
+            def _stream_body(self, start, first, chunks):
                 """Chunked transfer-encoding: each deployment chunk hits the
                 socket as it seals — SSE works end to end. A mid-stream
                 handler error TRUNCATES the chunked body (no terminator) and
                 drops the connection: headers are already on the wire, so a
                 trailing 500 would corrupt keep-alive framing, while a
                 missing terminator is an unambiguous client-side error."""
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
+                self.send_response(getattr(start, "status", 200))
+                self.send_header("Content-Type", start.content_type)
                 self.send_header("Transfer-Encoding", "chunked")
                 self.send_header("Cache-Control", "no-cache")
+                for name, value in getattr(start, "headers", None) or []:
+                    self.send_header(name, value)
                 self.end_headers()
                 try:
                     item = first

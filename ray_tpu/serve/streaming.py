@@ -20,9 +20,14 @@ class StreamStart:
     """First item of a streamed deployment response: tells the proxy to
     switch to chunked/SSE output with this content type instead of buffering
     a single JSON body. User handlers may yield one explicitly as the first
-    item to control the content type; otherwise the replica infers one."""
+    item to control the content type; otherwise the replica infers one.
+    ``status``/``headers`` carry the full response head for ASGI ingress
+    (the proxy writes them verbatim; content-type/length excluded from
+    ``headers``)."""
 
     content_type: str = "text/event-stream"
+    status: int = 200
+    headers: Optional[list] = None  # [(name, value)] strings
 
 
 class DeploymentResponseGenerator:
