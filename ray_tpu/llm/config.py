@@ -84,6 +84,10 @@ class ModelConfig:
     tokenizer: str = "byte"  # "byte" | transformers tokenizer path
     checkpoint_path: Optional[str] = None  # ray_tpu.train pytree checkpoint
     seed: int = 0
+    # extra LlamaConfig overrides applied on top of the preset — e.g.
+    # {"moe_experts": 8, "moe_top_k": 2} serves a MoE variant (the engine
+    # decode path is dropless, models/llama.py:_moe_decode_ffn)
+    model_kwargs: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

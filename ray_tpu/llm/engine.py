@@ -203,6 +203,7 @@ class JaxEngine:
             max_seq_len=ec.max_seq_len,
             dtype=jnp.bfloat16 if ec.dtype == "bfloat16" else jnp.float32,
         )
+        kw.update(mc.model_kwargs)
         if mc.model_id in presets:
             self.model_cfg = presets[mc.model_id](**kw)
         else:

@@ -447,13 +447,6 @@ def _moe_ffn(p, h, cfg: LlamaConfig, mesh: Optional[Mesh]):
     return y.reshape(B, T, e).astype(h.dtype), aux
 
 
-# Dense (non-MoE) stacked block params; use _layer_keys(cfg) for the
-# config-dependent set.
-_LAYER_KEYS = (
-    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm",
-)
-
-
 def _embed_lookup(table, tokens, cfg: LlamaConfig, mesh: Optional[Mesh]):
     """Token embedding. On a sharded mesh the row-gather is replaced by a
     one-hot matmul: SPMD cannot partition a gather from a table sharded on
@@ -637,6 +630,51 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
 # ---------------------------------------------------------------------------
 
 
+def _moe_decode_ffn(p, h, cfg: LlamaConfig):
+    """Dropless routed expert FFN for the serving path. h: [B, T, e].
+
+    Inference must never drop tokens (a capacity overflow at prefill would
+    silently corrupt the prompt — the reference's serving engine is likewise
+    dropless), so instead of the training path's capacity buffers
+    (``parallel/moe.py``) this computes every expert on the decode batch and
+    mixes with renormalized top-k gate weights. For decode steps this is also
+    the HBM-optimal shape: all expert weights stream from HBM once regardless
+    of routing, and B*T is tiny. Prefill chunks pay E/top_k extra FFN FLOPs
+    for dropless-ness (attention + the dense projections dominate prefill;
+    a grouped-GEMM Pallas kernel is the known upgrade path). Numerically
+    identical to ``moe_dense`` whenever its capacity does not overflow, which
+    is what the decode-vs-forward exactness test pins."""
+    from ray_tpu.parallel.moe import topk_gates
+
+    B, T, e = h.shape
+    E = cfg.moe_experts
+    g = h.reshape(B * T, e)
+    G = g.shape[0]
+    _, gate_vals, gate_idx = topk_gates({"router": p["moe_router"]}, g, cfg.moe_top_k)
+    # w[g, e] = sum_k gate_vals[g, k] * [gate_idx[g, k] == e]
+    wge = (
+        jax.nn.one_hot(gate_idx, E, dtype=jnp.float32) * gate_vals[..., None]
+    ).sum(axis=1).astype(g.dtype)
+    if G <= 64:
+        # decode steps (G = batch): one batched einsum over all experts —
+        # better MXU shapes than E sequential skinny matmuls
+        gate = jnp.einsum("gd,edf->egf", g, p["moe_w_gate"])
+        up = jnp.einsum("gd,edf->egf", g, p["moe_w_up"])
+        out = jnp.einsum("egf,efd->egd", jax.nn.silu(gate) * up, p["moe_w_down"])
+        y = jnp.einsum("egd,ge->gd", out, wge)
+    else:
+        # prefill chunks (G = B*chunk tokens): accumulate expert-by-expert so
+        # peak transient memory is [G, d_ff], not [E, G, d_ff]
+        def body(ei, y):
+            gate = g @ p["moe_w_gate"][ei]
+            up = g @ p["moe_w_up"][ei]
+            out = (jax.nn.silu(gate) * up) @ p["moe_w_down"][ei]
+            return y + out * wge[:, ei][:, None]
+
+        y = jax.lax.fori_loop(0, E, body, jnp.zeros_like(g))
+    return y.reshape(B, T, e)
+
+
 def init_kv_cache(cfg: LlamaConfig, batch_size: int, max_len: Optional[int] = None):
     """KV cache [L, B, KV_HEADS, S, D] — head-major so each (batch, head)
     attention read streams a contiguous S×D block from HBM (position-major
@@ -680,8 +718,6 @@ def _decode_forward(
     marks real (non-padding) tokens; padding writes are dropped so later
     decode steps never attend to stale slots. ``loras``/``adapter_ids``:
     stacked LoRA adapters + per-sequence adapter index (0 = base)."""
-    if cfg.moe_experts:
-        raise NotImplementedError("MoE decode path is not supported yet")
     B, T = tokens.shape
     S = cache["k"].shape[3]  # [L, B, K, S, D]
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -696,7 +732,8 @@ def _decode_forward(
         write_pos = jnp.where(valid, positions, S)
     else:
         write_pos = positions
-    stacked = {k: params[k] for k in _LAYER_KEYS}
+    layer_keys = _layer_keys(cfg)
+    stacked = {k: params[k] for k in layer_keys}
     bi = jnp.arange(B)[:, None, None]
     ki = jnp.arange(cfg.n_kv_heads)[None, :, None]
     pi = write_pos[:, None, :]  # [B, 1, T]
@@ -709,7 +746,7 @@ def _decode_forward(
     # measured 1.6x slower from those copies alone at 3B/B=16 on v5e).
     def body(l, carry):
         x, ck_all, cv_all = carry
-        p = {k: stacked[k][l] for k in _LAYER_KEYS}
+        p = {k: stacked[k][l] for k in layer_keys}
         h = _rmsnorm(x, p["attn_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
         q = jnp.einsum("bte,ehd->bthd", h, p["wq"])
         k = jnp.einsum("bte,ehd->bthd", h, p["wk"])
@@ -757,10 +794,13 @@ def _decode_forward(
         x = x + jnp.einsum("bthd,hde->bte", attn, p["wo"])
 
         h = _rmsnorm(x, p["mlp_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
-        ff = jax.nn.silu(jnp.einsum("bte,ef->btf", h, p["w_gate"])) * jnp.einsum(
-            "bte,ef->btf", h, p["w_up"]
-        )
-        x = x + jnp.einsum("btf,fe->bte", ff, p["w_down"])
+        if cfg.moe_experts:
+            x = x + _moe_decode_ffn(p, h, cfg)
+        else:
+            ff = jax.nn.silu(
+                jnp.einsum("bte,ef->btf", h, p["w_gate"])
+            ) * jnp.einsum("bte,ef->btf", h, p["w_up"])
+            x = x + jnp.einsum("btf,fe->bte", ff, p["w_down"])
         return (x, ck_all, cv_all)
 
     x, new_k, new_v = jax.lax.fori_loop(

@@ -38,6 +38,22 @@ def _expert_ffn(params, x):
     return jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, params["w_down"])
 
 
+def topk_gates(params, x, top_k: int):
+    """Router probabilities + renormalized top-k gate values.
+
+    The single source of truth for the gate math — shared by the capacity
+    path below and the dropless serving path
+    (``models/llama._moe_decode_ffn``); the decode-vs-forward exactness test
+    pins the two staying numerically identical.
+
+    Returns (probs [G, E] f32, gate_vals [G, k] f32, gate_idx [G, k])."""
+    logits = x @ params["router"]  # [G, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # [G, k]
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    return probs, gate_vals, gate_idx
+
+
 def _route(params, x, num_experts: int, top_k: int, capacity: int):
     """Shared top-k routing: dispatch/combine one-hot tensors + aux loss
     inputs. Single source of truth for the routing math — ``_moe_local``
@@ -48,10 +64,7 @@ def _route(params, x, num_experts: int, top_k: int, capacity: int):
     G, d = x.shape
     E, C = num_experts, capacity
 
-    logits = x @ params["router"]  # [G, E]
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # [G, k]
-    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    probs, gate_vals, gate_idx = topk_gates(params, x, top_k)
 
     # Position of each (token, choice) within its expert's capacity buffer.
     onehot_e = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [G, k, E]
