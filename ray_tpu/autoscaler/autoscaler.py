@@ -44,6 +44,11 @@ class AutoscalerConfig:
     # partial/dead launch stops blocking new scale-ups and — if NO node of
     # it ever registered (or all died) — is terminated and replaced.
     launch_grace_s: float = 180.0
+    # A previously-registered launch is only reaped after its nodes have
+    # been observed dead for this long (sustained across reconcile ticks):
+    # one controller restart or heartbeat blip must not terminate healthy
+    # long-running slices.
+    dead_reap_s: float = 30.0
 
 
 class NodeProvider:
@@ -105,6 +110,8 @@ class Autoscaler:
         }
         self._idle_since: dict[str, float] = {}  # launch key -> first idle t
         self._launch_t: dict[str, float] = {}  # launch key -> create time
+        self._dead_since: dict[str, float] = {}  # launch key -> first dead t
+        self._registered: set = set()  # launch keys that ever had a node
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -180,21 +187,39 @@ class Autoscaler:
     def _reap_failed_launches(self, state: dict, actions: dict) -> None:
         """Terminate launches past the boot grace with ZERO alive registered
         nodes — a crashed-on-boot slice would otherwise leak (billing!) and
-        its pending demand would never be re-served."""
+        its pending demand would never be re-served.
+
+        A launch that never registered any node is reaped as soon as the
+        boot grace lapses. A launch whose nodes DID register only goes when
+        the all-dead observation has been sustained for ``dead_reap_s``:
+        a single reconcile tick during a controller restart (empty node
+        table) or a heartbeat blip must not mass-terminate healthy
+        slices."""
         now = time.time()
         for g in self.config.node_groups:
             for launch in list(self.launched[g.name]):
                 key = ",".join(launch)
+                infos = self._nodes_for_launch(launch, state)
+                if infos:
+                    self._registered.add(key)
                 age = now - self._launch_t.get(key, now)
                 if age <= self.config.launch_grace_s:
                     continue
-                infos = self._nodes_for_launch(launch, state)
-                if not any(i["alive"] for i in infos):
-                    self.provider.terminate_nodes(launch)
-                    self.launched[g.name].remove(launch)
-                    self._launch_t.pop(key, None)
-                    self._idle_since.pop(key, None)
-                    actions["scaled_down"].append(g.name)
+                if any(i["alive"] for i in infos):
+                    self._dead_since.pop(key, None)
+                    continue
+                if key in self._registered:
+                    # registered once, now unseen/dead -> need sustained dwell
+                    dead_t = self._dead_since.setdefault(key, now)
+                    if now - dead_t < self.config.dead_reap_s:
+                        continue
+                self.provider.terminate_nodes(launch)
+                self.launched[g.name].remove(launch)
+                self._launch_t.pop(key, None)
+                self._idle_since.pop(key, None)
+                self._dead_since.pop(key, None)
+                self._registered.discard(key)
+                actions["scaled_down"].append(g.name)
 
     def update(self) -> dict:
         state = self._call("autoscaler_state")
@@ -240,6 +265,8 @@ class Autoscaler:
                         self.launched[g.name].remove(launch)
                         self._idle_since.pop(key, None)
                         self._launch_t.pop(key, None)
+                        self._dead_since.pop(key, None)
+                        self._registered.discard(key)
                         actions["scaled_down"].append(g.name)
                 else:
                     self._idle_since.pop(key, None)

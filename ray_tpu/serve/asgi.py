@@ -35,7 +35,7 @@ from ray_tpu.serve.streaming import StreamStart
 _DONE = object()
 
 
-def _build_scope(request) -> dict:
+def _build_scope(request, state=None) -> dict:
     headers = [
         (k.lower().encode(), str(v).encode())
         for k, v in (request.headers or {}).items()
@@ -44,6 +44,9 @@ def _build_scope(request) -> dict:
     if not path.startswith("/"):
         path = "/" + path
     return {
+        # per the ASGI lifespan-state extension: each request sees a shallow
+        # copy of the state dict the lifespan startup populated
+        "state": dict(state) if state is not None else {},
         "type": "http",
         "asgi": {"version": "3.0", "spec_version": "2.3"},
         "http_version": "1.1",
@@ -59,15 +62,21 @@ def _build_scope(request) -> dict:
     }
 
 
-async def _run_asgi(app, request, out: "queue.Queue") -> None:
+async def _run_asgi(app, request, out: "queue.Queue", state=None) -> None:
     """Drive one request through the ASGI app; response frames go to
     ``out`` (thread-safe: the consumer is a sync generator streaming back
     through the replica)."""
     body_sent = False
+    disconnected = asyncio.Event()
 
     async def receive():
         nonlocal body_sent
         if body_sent:
+            # BLOCK until the client is actually gone: Starlette's
+            # listen_for_disconnect loops on receive() while a
+            # StreamingResponse is in flight — a fabricated immediate
+            # http.disconnect here cancels the stream at its first chunk
+            await disconnected.wait()
             return {"type": "http.disconnect"}
         body_sent = True
         return {
@@ -91,6 +100,7 @@ async def _run_asgi(app, request, out: "queue.Queue") -> None:
                 return
             except queue.Full:
                 if asyncio.get_running_loop().time() > deadline:
+                    disconnected.set()  # unblock listen_for_disconnect
                     raise RuntimeError("response consumer stalled/abandoned")
                 await asyncio.sleep(0.02)
 
@@ -120,7 +130,7 @@ async def _run_asgi(app, request, out: "queue.Queue") -> None:
                 await put(body)
 
     try:
-        await app(_build_scope(request), receive, send)
+        await app(_build_scope(request, state), receive, send)
         if not started:
             await put(StreamStart(content_type="text/plain", status=500))
             await put(b"ASGI app returned without a response")
@@ -132,6 +142,7 @@ async def _run_asgi(app, request, out: "queue.Queue") -> None:
         except RuntimeError:
             pass  # consumer gone — nothing to tell
     finally:
+        disconnected.set()  # release a parked listen_for_disconnect task
         try:
             await put(_DONE)
         except RuntimeError:
@@ -145,6 +156,9 @@ class _ASGIRunner:
     def __init__(self, app):
         self.app = app
         self.loop = asyncio.new_event_loop()
+        # populated by the app's lifespan startup (ASGI lifespan-state
+        # extension); each request scope gets a shallow copy
+        self.state: dict = {}
         t = threading.Thread(target=self._run, daemon=True, name="asgi-loop")
         t.start()
         self._lifespan("startup")
@@ -161,10 +175,18 @@ class _ASGIRunner:
         app would run its shutdown hooks before the first request
         (reference: serve's ASGI lifespan handling). Apps without lifespan
         support are fine."""
+        import logging
+
+        logger = logging.getLogger(__name__)
         started = threading.Event()
+        failure: list[str] = []
 
         async def drive():
-            scope = {"type": "lifespan", "asgi": {"version": "3.0"}}
+            scope = {
+                "type": "lifespan",
+                "asgi": {"version": "3.0"},
+                "state": self.state,
+            }
             sent_startup = False
             forever = asyncio.Event()
 
@@ -179,24 +201,38 @@ class _ASGIRunner:
                 return {"type": "lifespan.shutdown"}
 
             async def send(message):
+                if message["type"] == "lifespan.startup.failed":
+                    failure.append(message.get("message", ""))
                 if message["type"].startswith("lifespan.startup"):
                     started.set()
 
             try:
                 await self.app(scope, receive, send)
-            except BaseException:  # noqa: BLE001 — lifespan unsupported
-                pass
+            except BaseException:  # noqa: BLE001
+                # apps without lifespan support raise on the unknown scope
+                # type (fine); a real startup crash must not be silent
+                logger.warning(
+                    "ASGI lifespan exited with an exception (harmless for "
+                    "apps without lifespan support)", exc_info=True,
+                )
             finally:
                 started.set()
 
         asyncio.run_coroutine_threadsafe(drive(), self.loop)
         started.wait(timeout=15)
+        if failure:
+            # ASGI spec: the server must not serve after startup.failed —
+            # raising here fails replica construction so the serve
+            # controller surfaces/retries it instead of per-request 500s
+            raise RuntimeError(
+                f"ASGI lifespan startup failed: {failure[0]}"
+            )
 
     def stream(self, request):
         """Sync generator of response frames (StreamStart, then bytes)."""
         out: "queue.Queue" = queue.Queue(maxsize=64)
         asyncio.run_coroutine_threadsafe(
-            _run_asgi(self.app, request, out), self.loop
+            _run_asgi(self.app, request, out, self.state), self.loop
         )
         while True:
             try:

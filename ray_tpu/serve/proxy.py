@@ -55,6 +55,22 @@ def _encode_chunk(item) -> bytes:
     return json.dumps(item).encode() + b"\n"
 
 
+def _clean_header(name, value) -> tuple[str, str]:
+    """Strip CR/LF (and the NUL h11 also rejects) from app-supplied header
+    names/values before they reach the wire — an app echoing request input
+    into e.g. a Location header must not be able to split the response or
+    inject headers on the keep-alive connection."""
+    tr = {ord("\r"): None, ord("\n"): None, ord("\x00"): None}
+    return str(name).translate(tr), str(value).translate(tr)
+
+
+# RFC 9112: these responses never carry a body — writing Transfer-Encoding
+# or chunk framing for them desyncs keep-alive clients (http.client leaves
+# the '0\r\n\r\n' unread and parses it as the next response's status line).
+def _bodiless(status: int) -> bool:
+    return status in (204, 304) or 100 <= status < 200
+
+
 def _hget(headers: dict, name: str, default: str = "") -> str:
     """Case-insensitive header lookup on a case-preserving dict (HTTP
     header names are case-insensitive, RFC 7230)."""
@@ -219,14 +235,16 @@ class AsyncHTTPServer:
 
         status = getattr(start, "status", 200)
         reason = _hc.responses.get(status, "")
-        head = [
-            f"HTTP/1.1 {status} {reason}",
-            f"Content-Type: {start.content_type}",
-            "Transfer-Encoding: chunked",
-            "Cache-Control: no-cache",
-        ]
+        head = [f"HTTP/1.1 {status} {reason}"]
+        if not _bodiless(status):
+            head += [
+                f"Content-Type: {start.content_type}",
+                "Transfer-Encoding: chunked",
+                "Cache-Control: no-cache",
+            ]
         for name, value in getattr(start, "headers", None) or []:
-            head.append(f"{name}: {value}")
+            n, v = _clean_header(name, value)
+            head.append(f"{n}: {v}")
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
         await writer.drain()
 
@@ -235,6 +253,24 @@ class AsyncHTTPServer:
                 return chunks.next(timeout_s=120), False
             except StopIteration:
                 return None, True
+
+        if _bodiless(status):
+            # no body and no chunk framing on the wire; still drain the
+            # replica's stream so its resources release. The head is already
+            # out — a drain error must NOT bubble to the outer 500 handler
+            # (a second status line would desync the keep-alive client).
+            try:
+                done_ = done
+                while not done_:
+                    _, done_ = await loop.run_in_executor(
+                        self._pool, next_chunk
+                    )
+            except Exception:  # noqa: BLE001
+                try:
+                    writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            return
 
         try:
             item = first
@@ -357,13 +393,28 @@ class ProxyActor:
                 drops the connection: headers are already on the wire, so a
                 trailing 500 would corrupt keep-alive framing, while a
                 missing terminator is an unambiguous client-side error."""
-                self.send_response(getattr(start, "status", 200))
-                self.send_header("Content-Type", start.content_type)
-                self.send_header("Transfer-Encoding", "chunked")
-                self.send_header("Cache-Control", "no-cache")
+                status = getattr(start, "status", 200)
+                self.send_response(status)
+                if not _bodiless(status):
+                    self.send_header("Content-Type", start.content_type)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.send_header("Cache-Control", "no-cache")
                 for name, value in getattr(start, "headers", None) or []:
-                    self.send_header(name, value)
+                    n, v = _clean_header(name, value)
+                    self.send_header(n, v)
                 self.end_headers()
+                if _bodiless(status):
+                    # drain the stream, write no body/framing; the head is
+                    # on the wire, so swallow drain errors (a trailing 500
+                    # would corrupt keep-alive framing) and drop the conn
+                    try:
+                        while True:
+                            chunks.next(timeout_s=120)
+                    except StopIteration:
+                        pass
+                    except Exception:  # noqa: BLE001
+                        self.close_connection = True
+                    return
                 try:
                     item = first
                     while True:

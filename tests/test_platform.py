@@ -172,6 +172,63 @@ def test_autoscaler_scales_up_and_down():
     ray_tpu.shutdown()
 
 
+def test_autoscaler_reap_requires_sustained_death():
+    """A previously-registered launch is only terminated after the all-dead
+    observation persists for dead_reap_s; one blip tick (controller restart,
+    heartbeat hiccup) must not kill healthy slices. A launch that never
+    registered is reaped as soon as the boot grace lapses."""
+    from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, NodeGroup
+    from ray_tpu.autoscaler.autoscaler import NodeProvider
+
+    class RecordingProvider(NodeProvider):
+        def __init__(self):
+            self.terminated = []
+
+        def create_node_group(self, group):
+            return ["n1"]
+
+        def terminate_nodes(self, node_ids):
+            self.terminated.append(list(node_ids))
+
+        def non_terminated_nodes(self):
+            return []
+
+    cfg = AutoscalerConfig(
+        node_groups=[NodeGroup(name="g", resources_per_node={"CPU": 1})],
+        launch_grace_s=0.05,
+        dead_reap_s=0.4,
+    )
+    provider = RecordingProvider()
+    scaler = Autoscaler(cfg, provider=provider)
+    scaler.launched["g"].append(["n1"])
+    scaler._launch_t["n1"] = time.time()
+
+    alive = {"nodes": [{"node_id": "n1", "alive": True, "labels": {}}]}
+    dead = {"nodes": [{"node_id": "n1", "alive": False, "labels": {}}]}
+    gone = {"nodes": []}
+    actions = {"scaled_up": [], "scaled_down": []}
+
+    scaler._reap_failed_launches(alive, actions)  # registers the launch
+    time.sleep(0.1)  # past boot grace
+    scaler._reap_failed_launches(dead, actions)  # blip tick 1: dwell starts
+    scaler._reap_failed_launches(gone, actions)  # blip tick 2 (empty table)
+    assert provider.terminated == []
+    scaler._reap_failed_launches(alive, actions)  # recovered: dwell resets
+    scaler._reap_failed_launches(dead, actions)
+    time.sleep(0.45)
+    assert provider.terminated == []  # dwell restarted after recovery
+    scaler._reap_failed_launches(dead, actions)  # sustained past dead_reap_s
+    assert provider.terminated == [["n1"]]
+    assert scaler.launched["g"] == []
+
+    # never-registered launch: immediate reap once grace lapses
+    provider.terminated.clear()
+    scaler.launched["g"].append(["n2"])
+    scaler._launch_t["n2"] = time.time() - 1.0
+    scaler._reap_failed_launches(gone, actions)
+    assert provider.terminated == [["n2"]]
+
+
 def test_runtime_env_working_dir(tmp_path):
     """Tasks with runtime_env working_dir run with cwd + import path there."""
     mod = tmp_path / "my_wd_module.py"

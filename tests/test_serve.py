@@ -544,11 +544,65 @@ def _make_asgi_app():
 
     async def app(scope, receive, send):
         if scope["type"] == "lifespan":
-            msg = await receive()
-            await send({"type": f"{msg['type']}.complete"})
-            return
+            while True:
+                msg = await receive()
+                if msg["type"] == "lifespan.startup":
+                    scope.get("state", {})["from_lifespan"] = "db-pool"
+                await send({"type": f"{msg['type']}.complete"})
+                if msg["type"] == "lifespan.shutdown":
+                    return
         assert scope["type"] == "http"
         path = scope["path"]
+        if path == "/state":
+            await _json_resp(
+                send, 200,
+                {"state": scope.get("state", {}).get("from_lifespan")},
+            )
+            return
+        if path == "/nobody":
+            # 204 must go out WITHOUT chunk framing or the next request on
+            # this keep-alive connection desyncs
+            await send({
+                "type": "http.response.start", "status": 204,
+                "headers": [(b"x-deleted", b"yes")],
+            })
+            await send({"type": "http.response.body", "body": b""})
+            return
+        if path == "/redirect":
+            # echoes attacker-controlled input into a header value; real
+            # frameworks decode the query first, so unquote to put actual
+            # CR/LF bytes through the proxy's sanitizer
+            from urllib.parse import unquote
+
+            target = unquote(scope["query_string"].decode())
+            await send({
+                "type": "http.response.start", "status": 302,
+                "headers": [(b"location", target.encode())],
+            })
+            await send({"type": "http.response.body", "body": b""})
+            return
+        if path == "/guarded-stream":
+            # Starlette StreamingResponse shape: a listen_for_disconnect
+            # task races the stream — a fabricated early http.disconnect
+            # from the server cancels the response mid-flight
+            disconnect = asyncio.ensure_future(_wait_disconnect(receive))
+            try:
+                await send({
+                    "type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type", b"text/plain")],
+                })
+                for i in range(4):
+                    if disconnect.done():
+                        return  # client gone -> truncated stream
+                    await send({
+                        "type": "http.response.body",
+                        "body": f"g{i};".encode(), "more_body": True,
+                    })
+                    await asyncio.sleep(0.01)
+                await send({"type": "http.response.body", "body": b"gend"})
+            finally:
+                disconnect.cancel()
+            return
         if path.startswith("/items/"):
             item_id = path.split("/")[2]
             if not item_id.isdigit():
@@ -591,6 +645,12 @@ def _make_asgi_app():
             "headers": [(b"content-type", b"application/json")],
         })
         await send({"type": "http.response.body", "body": body})
+
+    async def _wait_disconnect(receive):
+        while True:
+            msg = await receive()
+            if msg["type"] == "http.disconnect":
+                return
 
     def middleware(inner):
         """Header-stamping middleware — proves the full ASGI chain runs."""
@@ -664,6 +724,40 @@ def test_asgi_ingress_e2e(ray_start_thread):
         resp = conn.getresponse()
         assert resp.status == 200
         assert resp.read() == b"part0;part1;part2;part3;end"
+
+        # a disconnect-guarded stream (Starlette StreamingResponse shape)
+        # must NOT be cancelled by a fabricated early http.disconnect
+        conn.request("GET", "/api/guarded-stream")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.read() == b"g0;g1;g2;g3;gend"
+
+        # lifespan startup state is visible to request scopes
+        conn.request("GET", "/api/state")
+        resp = conn.getresponse()
+        assert _json.loads(resp.read()) == {"state": "db-pool"}
+
+        # 204: no chunk framing; the SAME keep-alive connection must stay
+        # usable for the next request
+        conn.request("DELETE", "/api/nobody")
+        resp = conn.getresponse()
+        assert resp.status == 204
+        assert resp.getheader("x-deleted") == "yes"
+        assert resp.getheader("transfer-encoding") is None
+        assert resp.read() == b""
+        conn.request("GET", "/api/items/9?q=y")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert _json.loads(resp.read())["item_id"] == 9
+
+        # CRLF in an app-supplied header value cannot split the response
+        conn.request("GET", "/api/redirect?/evil%0d%0aX-Injected:%20owned")
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 302
+        assert resp.getheader("x-injected") is None
+        loc = resp.getheader("location") or ""
+        assert "\r" not in loc and "\n" not in loc
 
         conn.close()
     finally:
