@@ -17,13 +17,17 @@ from ray_tpu.llm.config import (
     SamplingParams,
 )
 from ray_tpu.llm.engine import JaxEngine, RequestOutput
+from ray_tpu.llm.gang import GangLLMServer
 from ray_tpu.llm.server import LLMServer
+from ray_tpu.llm.spmd import SPMDGenerator
 
 __all__ = [
     "EngineConfig",
+    "GangLLMServer",
     "JaxEngine",
     "LLMConfig",
     "LLMServer",
+    "SPMDGenerator",
     "ModelConfig",
     "ProcessorConfig",
     "RequestOutput",

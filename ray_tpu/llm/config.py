@@ -90,6 +90,37 @@ class ModelConfig:
     model_kwargs: dict = dataclasses.field(default_factory=dict)
 
 
+def resolve_llama_config(model: "ModelConfig", engine: "EngineConfig", min_vocab: int = 0):
+    """ModelConfig + EngineConfig -> concrete LlamaConfig (preset + kwargs,
+    vocab widened to cover the tokenizer). Shared by the continuous-batching
+    engine and the gang (multi-process SPMD) generator so both resolve a
+    model id identically."""
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig
+
+    presets = {
+        "tiny": LlamaConfig.tiny,
+        "llama2-7b": LlamaConfig.llama2_7b,
+        "llama3-8b": LlamaConfig.llama3_8b,
+        "llama3.2-3b": LlamaConfig.llama32_3b,
+        "llama3-70b": LlamaConfig.llama3_70b,
+    }
+    kw = dict(
+        max_seq_len=engine.max_seq_len,
+        dtype=jnp.bfloat16 if engine.dtype == "bfloat16" else jnp.float32,
+    )
+    kw.update(model.model_kwargs)
+    if model.model_id not in presets:
+        raise ValueError(f"unknown model_id: {model.model_id}")
+    cfg = presets[model.model_id](**kw)
+    if cfg.vocab_size < min_vocab:
+        cfg = _dc.replace(cfg, vocab_size=min_vocab)
+    return cfg
+
+
 @dataclasses.dataclass
 class LLMConfig:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)

@@ -182,36 +182,16 @@ class JaxEngine:
 
     def _build_model(self):
         import jax
-        import jax.numpy as jnp
 
-        from ray_tpu.models.llama import (
-            LlamaConfig,
-            init_kv_cache,
-            init_params,
-        )
+        from ray_tpu.models.llama import init_params
         from ray_tpu.train.checkpoint import restore_pytree
 
+        from ray_tpu.llm.config import resolve_llama_config
+
         mc, ec = self.config.model, self.config.engine
-        presets = {
-            "tiny": LlamaConfig.tiny,
-            "llama2-7b": LlamaConfig.llama2_7b,
-            "llama3-8b": LlamaConfig.llama3_8b,
-            "llama3.2-3b": LlamaConfig.llama32_3b,
-            "llama3-70b": LlamaConfig.llama3_70b,
-        }
-        kw = dict(
-            max_seq_len=ec.max_seq_len,
-            dtype=jnp.bfloat16 if ec.dtype == "bfloat16" else jnp.float32,
+        self.model_cfg = resolve_llama_config(
+            mc, ec, min_vocab=self.tokenizer.vocab_size
         )
-        kw.update(mc.model_kwargs)
-        if mc.model_id in presets:
-            self.model_cfg = presets[mc.model_id](**kw)
-        else:
-            raise ValueError(f"unknown model_id: {mc.model_id}")
-        if self.model_cfg.vocab_size < self.tokenizer.vocab_size:
-            self.model_cfg = dataclasses.replace(
-                self.model_cfg, vocab_size=self.tokenizer.vocab_size
-            )
         if ec.tensor_parallel_degree > 1 or ec.sequence_parallel_degree > 1:
             from ray_tpu.parallel.mesh import MeshSpec, build_mesh
 

@@ -1,0 +1,250 @@
+"""Gang-scheduled multi-process LLM serving: replicas that span hosts.
+
+Reference: ``llm/_internal/serve/deployments/llm/vllm/vllm_models.py:176-190``
+— the reference's LLMServer asks serve for a placement group sized
+``tensor_parallel_degree * pipeline_parallel_degree`` and scatters vLLM
+engine workers over it. Here the replica owns a STRICT_PACK placement group
+of ``EngineWorker`` actors; workers rendezvous into one ``jax.distributed``
+world (coordinator address brokered through the control plane, the same
+pattern as ``train/_internal/worker_group.py``) and each hosts the SAME
+lockstep SPMD generator (``llm/spmd.py``) over the global mesh. A model
+bigger than one host's chips shards over the gang's ICI/DCN domain; the
+serve router still load-balances across replicas (each replica = one gang).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.server import _sampling_from_dict
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+class EngineWorker:
+    """One process of the gang: joins the jax.distributed world, hosts the
+    sharded params + compiled programs, answers lockstep generate calls."""
+
+    def reserve_coordinator(self) -> str:
+        import socket
+
+        from ray_tpu._private.protocol import routable_host
+
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return f"{routable_host()}:{port}"
+
+    def setup(self, config: LLMConfig, rank: int, world: int, coordinator: str):
+        import jax
+
+        if world > 1:
+            # must precede this process's first backend use; afterwards
+            # jax.devices() is the GLOBAL device set across the gang
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world,
+                process_id=rank,
+            )
+        from ray_tpu.llm.spmd import SPMDGenerator
+
+        self.rank = rank
+        self.gen = SPMDGenerator(config)
+        return {
+            "rank": rank,
+            "global_devices": jax.device_count(),
+            "local_devices": jax.local_device_count(),
+            "mesh": {k: int(v) for k, v in self.gen.mesh.shape.items()},
+        }
+
+    def generate_batch(self, token_lists, params_dict: Optional[dict]):
+        sp = SamplingParams(**params_dict) if params_dict else None
+        out = self.gen.generate_batch(token_lists, sampling_params=sp)
+        # every process computed the same replicated tokens; only rank 0's
+        # payload travels back through the object store
+        return out if self.rank == 0 else True
+
+    def ping(self) -> bool:
+        return True
+
+
+class GangLLMServer:
+    """Serve deployment whose ONE replica is a gang of N engine-worker
+    processes (tp/sp sharded). API mirrors ``LLMServer``'s OpenAI-shaped
+    methods so the OpenAI router and proxy work unchanged."""
+
+    def __init__(
+        self,
+        llm_config: LLMConfig,
+        num_workers: int = 2,
+        resources_per_worker: Optional[dict] = None,
+        worker_env: Optional[dict] = None,
+        pg_timeout: float = 120.0,
+    ):
+        from ray_tpu.llm.tokenizer import get_tokenizer
+
+        self.llm_config = llm_config
+        self.tokenizer = get_tokenizer(llm_config.model.tokenizer)
+        self.num_workers = num_workers
+        bundles = [dict(resources_per_worker or {"CPU": 1}) for _ in range(num_workers)]
+        # STRICT_PACK: the gang must land in one ICI domain (one slice)
+        self.pg = placement_group(bundles, strategy="STRICT_PACK")
+        if not self.pg.wait(timeout_seconds=pg_timeout):
+            remove_placement_group(self.pg)
+            raise TimeoutError(
+                f"placement group for {num_workers} engine workers not ready"
+            )
+        cls = ray_tpu.remote(EngineWorker)
+        opts = {}
+        if worker_env:
+            opts["runtime_env"] = {"env_vars": dict(worker_env)}
+        self.workers = []
+        try:
+            self.workers = [
+                cls.options(
+                    num_cpus=bundles[i].get("CPU", 1),
+                    resources={k: v for k, v in bundles[i].items() if k != "CPU"},
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=self.pg, placement_group_bundle_index=i
+                    ),
+                    name=f"llm-gang-{llm_config.served_name}-{i}-{time.time_ns()}",
+                    **opts,
+                ).remote()
+                for i in range(num_workers)
+            ]
+            coordinator = ray_tpu.get(
+                self.workers[0].reserve_coordinator.remote(), timeout=60
+            )
+            # all setups in flight together: jax.distributed.initialize
+            # blocks until the whole world has connected
+            infos = ray_tpu.get(
+                [
+                    w.setup.remote(llm_config, rank, num_workers, coordinator)
+                    for rank, w in enumerate(self.workers)
+                ],
+                timeout=300,
+            )
+        except BaseException:
+            # a failed replica construction must not pin a slice's worth of
+            # reserved resources (actors + STRICT_PACK pg) across retries
+            self.shutdown()
+            raise
+        self.gang_info = infos[0]
+
+    # -- generation (lockstep broadcast) ------------------------------------
+
+    def _generate(self, prompts: list[str], params: SamplingParams):
+        token_lists = [self.tokenizer.encode(p) for p in prompts]
+        pd = {
+            f: getattr(params, f) for f in SamplingParams.__dataclass_fields__
+        }
+        refs = [
+            w.generate_batch.remote(token_lists, pd) for w in self.workers
+        ]
+        outs = ray_tpu.get(refs, timeout=600)
+        return token_lists, outs[0]
+
+    def completions(self, body: dict) -> dict:
+        prompt = body.get("prompt", "")
+        params = _sampling_from_dict(
+            {
+                "max_tokens": body.get("max_tokens", 64),
+                "temperature": body.get("temperature", 0.0),
+                "top_k": body.get("top_k", 50),
+                "seed": body.get("seed"),
+            }
+        )
+        try:
+            prompt_ids, outs = self._generate([prompt], params)
+        except ValueError as e:
+            # prompt-too-long (spmd.generate_batch's contract) -> OpenAI 400
+            return {"error": {"message": str(e), "code": 400}}
+        text = self.tokenizer.decode(outs[0])
+        return {
+            "id": f"cmpl-gang-{time.time_ns()}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.llm_config.served_name,
+            "choices": [
+                {
+                    "index": 0,
+                    "text": text,
+                    "finish_reason": "length"
+                    if len(outs[0]) >= params.max_tokens
+                    else "stop",
+                }
+            ],
+            "usage": {
+                "prompt_tokens": len(prompt_ids[0]),
+                "completion_tokens": len(outs[0]),
+                "total_tokens": len(prompt_ids[0]) + len(outs[0]),
+            },
+        }
+
+    def chat(self, body: dict) -> dict:
+        from ray_tpu.llm.server import LLMServer
+
+        prompt = LLMServer._render_chat(body.get("messages", []))
+        res = self.completions({**body, "prompt": prompt})
+        res["object"] = "chat.completion"
+        res["choices"] = [
+            {
+                "index": 0,
+                "message": {
+                    "role": "assistant",
+                    "content": res["choices"][0]["text"],
+                },
+                "finish_reason": res["choices"][0]["finish_reason"],
+            }
+        ]
+        return res
+
+    def __call__(self, request) -> dict:
+        """Direct-proxy entrypoint (a gang deployment can also sit behind
+        the OpenAI router, which calls completions/chat explicitly)."""
+        path = request.path or ""
+        if path.endswith("/models") or path.endswith("/model_info"):
+            return self.model_info()
+        try:
+            body = request.json() or {}
+        except Exception:  # noqa: BLE001
+            return {"error": {"message": "invalid JSON body", "code": 400}}
+        if path.endswith("/chat/completions") or path.endswith("/chat"):
+            return self.chat(body)
+        if path.endswith("/completions"):
+            return self.completions(body)
+        return {"error": {"message": f"unknown route {path}", "code": 404}}
+
+    # -- ops -----------------------------------------------------------------
+
+    def model_info(self) -> dict:
+        return {
+            "id": self.llm_config.served_name,
+            "object": "model",
+            "owned_by": "ray_tpu",
+            "gang": self.gang_info,
+        }
+
+    def stats(self) -> dict:
+        return {"gang": self.gang_info, "num_workers": self.num_workers}
+
+    def check_health(self):
+        ray_tpu.get([w.ping.remote() for w in self.workers], timeout=30)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []
+        if self.pg is not None:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:  # noqa: BLE001
+                pass
+            self.pg = None

@@ -1,0 +1,241 @@
+"""Lockstep SPMD batch generation for gang (multi-process) LLM replicas.
+
+Reference: the reference serves models larger than one host by
+gang-scheduling vLLM engine workers TPxPP via placement groups
+(``llm/_internal/serve/deployments/llm/vllm/vllm_models.py:176-190``) with
+Ray compiled-graph control flow between them. The TPU-first shape is
+different: every process in the gang runs ONE AND THE SAME jitted SPMD
+program over a global mesh (``jax.distributed`` world), so there is no
+driver/worker RPC inside a decode step — the "coordination" is XLA
+collectives over ICI/DCN.
+
+The consequence is the lockstep rule: every process must issue identical
+programs in identical order with identical host-side control flow. This
+module therefore does deterministic synchronous *batch* generation (the
+per-call analog of one continuous-batching wave): tokenize → bucket-pad →
+prefill → decode loop, with sampling in-program from a seeded key so every
+process observes the same tokens without any cross-process chatter. The
+dynamic continuous-batching engine (``llm/engine.py``) stays the
+single-process serving path; ``GangLLMServer`` (``llm/gang.py``) broadcasts
+each batch to all gang workers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+from ray_tpu.llm.config import LLMConfig, SamplingParams, resolve_llama_config
+
+
+def _pad_bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class SPMDGenerator:
+    """Deterministic batched prefill+decode over a (possibly multi-process)
+    mesh. All array programs are jitted with explicit shardings; host logic
+    is pure function of the inputs, so N processes stay in lockstep."""
+
+    def __init__(self, config: LLMConfig, mesh=None):
+        import jax
+        import numpy as np
+
+        from ray_tpu.llm.tokenizer import get_tokenizer
+        from ray_tpu.models.llama import init_params, param_shardings
+        from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+        from ray_tpu.train.checkpoint import restore_pytree
+
+        mc, ec = config.model, config.engine
+        self.config = config
+        self.tokenizer = get_tokenizer(mc.tokenizer)
+        self.model_cfg = resolve_llama_config(
+            mc, ec, min_vocab=self.tokenizer.vocab_size
+        )
+        if mesh is None:
+            # all GLOBAL devices (jax.devices() spans the jax.distributed
+            # world): tp*sp must cover them; -1 infers tp
+            spec = MeshSpec(
+                tp=ec.tensor_parallel_degree or -1,
+                sp=ec.sequence_parallel_degree,
+            )
+            try:
+                spec = spec.resolve(len(jax.devices()))
+            except ValueError:
+                spec = MeshSpec(tp=-1).resolve(len(jax.devices()))
+            mesh = build_mesh(spec)
+        self.mesh = mesh
+        self.max_seq_len = ec.max_seq_len
+        self.prefill_buckets = tuple(ec.prefill_buckets)
+        if mc.checkpoint_path:
+            params = restore_pytree(mc.checkpoint_path)
+            shardings = param_shardings(self.model_cfg, mesh)
+            self.params = jax.tree.map(
+                lambda x, s: jax.make_array_from_callback(
+                    np.shape(x), s, lambda idx: np.asarray(x)[idx]
+                ),
+                params,
+                shardings,
+            )
+        else:
+            self.params = init_params(
+                jax.random.PRNGKey(mc.seed), self.model_cfg, mesh=mesh
+            )
+        self._programs()
+
+    # -- compiled programs ---------------------------------------------------
+
+    def _programs(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.models.llama import decode_step, init_kv_cache, prefill
+
+        cfg = self.model_cfg
+        mesh = self.mesh
+        rep = NamedSharding(mesh, P())
+        # KV cache [L, B, K, S, D]: kv heads ride the tp axis (same layout
+        # the tp rules give the wk/wv params), everything else replicated;
+        # replicate when tp doesn't divide the kv heads (GQA with small kv)
+        tp = mesh.shape.get("tp", 1)
+        kv_spec = (
+            P(None, None, "tp", None, None)
+            if tp > 1 and cfg.n_kv_heads % tp == 0
+            else P()
+        )
+        kv = NamedSharding(mesh, kv_spec)
+        self._cache_shardings = {"k": kv, "v": kv, "length": rep}
+
+        def make_cache(batch: int, max_len: int):
+            return init_kv_cache(cfg, batch, max_len)
+
+        self._make_cache = jax.jit(
+            make_cache,
+            static_argnums=(0, 1),
+            out_shardings=self._cache_shardings,
+        )
+
+        def run_prefill(params, cache, tokens, lengths):
+            return prefill(params, cache, tokens, cfg, lengths=lengths)
+
+        self._prefill = jax.jit(
+            run_prefill,
+            donate_argnums=(1,),
+            out_shardings=(rep, self._cache_shardings),
+        )
+
+        K = min(64, cfg.vocab_size)
+        self._top_k_static = K
+
+        def sample(logits, temp, key, top_k):
+            """[B, V] fp32 -> [B] int32; greedy at temp<=0, else
+            top-K/temperature categorical. In-program: every gang process
+            computes the same replicated tokens from the same seeded key."""
+            greedy = jnp.argmax(logits, axis=-1)
+            vals, idx = jax.lax.top_k(logits, K)  # [B, K]
+            rank_ok = jnp.arange(K)[None, :] < top_k
+            scaled = jnp.where(
+                rank_ok, vals / jnp.maximum(temp, 1e-6), -jnp.inf
+            )
+            cat = jax.random.categorical(key, scaled, axis=-1)  # [B]
+            sampled = jnp.take_along_axis(idx, cat[:, None], axis=1)[:, 0]
+            return jnp.where(temp <= 0.0, greedy, sampled).astype(jnp.int32)
+
+        def run_decode(params, cache, tokens, temp, key, top_k):
+            logits, cache = decode_step(params, cache, tokens, cfg)
+            return sample(logits, temp, key, top_k), cache
+
+        self._decode = jax.jit(
+            run_decode,
+            donate_argnums=(1,),
+            out_shardings=(rep, self._cache_shardings),
+        )
+        self._sample = jax.jit(sample, out_shardings=rep)
+
+    # -- generation ----------------------------------------------------------
+
+    @staticmethod
+    def _host(arr):
+        """Fetch a replicated global array's value on this process (a
+        multi-process replicated Array is not fully addressable, so
+        np.asarray would throw — every local shard holds the full value)."""
+        import numpy as np
+
+        return np.asarray(arr.addressable_shards[0].data)
+
+    def generate_batch(
+        self,
+        token_lists: list[list[int]],
+        sampling_params: Optional[SamplingParams] = None,
+    ) -> list[list[int]]:
+        """Generate completions for a batch of prompts, lockstep across the
+        gang. Returns per-prompt generated token ids (prompt excluded)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        p = sampling_params or SamplingParams()
+        B = len(token_lists)
+        lengths = [len(t) for t in token_lists]
+        limit = min(self.prefill_buckets[-1], self.max_seq_len - 1)
+        if max(lengths) > limit:
+            # reject, don't crash the lockstep batch: the caller surfaces
+            # this as a 400 (vLLM's prompt-too-long contract)
+            raise ValueError(
+                f"prompt length {max(lengths)} exceeds the maximum "
+                f"{limit} (largest prefill bucket / max_seq_len)"
+            )
+        T = _pad_bucket(max(lengths), self.prefill_buckets)
+        # KV length from a fixed bucket ladder, NOT T + max_tokens directly:
+        # program shapes must be user-independent or every distinct
+        # max_tokens value forces a fresh XLA compile on every gang process
+        max_len = self.max_seq_len
+        for b in self.prefill_buckets:
+            if T + p.max_tokens <= b:
+                max_len = min(b, self.max_seq_len)
+                break
+        toks = np.zeros((B, T), np.int32)
+        for i, t in enumerate(token_lists):
+            toks[i, : len(t)] = t
+
+        cache = self._make_cache(B, max_len)
+        logits, cache = self._prefill(
+            self.params,
+            cache,
+            jnp.asarray(toks),
+            jnp.asarray(lengths, jnp.int32),
+        )
+        key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
+        temp = jnp.asarray(p.temperature, jnp.float32)
+        top_k = jnp.asarray(min(p.top_k, self._top_k_static), jnp.int32)
+        key, sub = jax.random.split(key)
+        nxt = self._sample(logits, temp, sub, top_k)
+
+        eos = self.tokenizer.eos_id
+        stop = set(p.stop_token_ids or ())
+        out: list[list[int]] = [[] for _ in range(B)]
+        finished = [False] * B
+        steps = min(p.max_tokens, max_len - max(lengths))
+        for step in range(steps):
+            host_tok = self._host(nxt)
+            for i in range(B):
+                if finished[i]:
+                    continue
+                t = int(host_tok[i])
+                if not p.ignore_eos and (t == eos or t in stop):
+                    finished[i] = True
+                    continue
+                out[i].append(t)
+                if len(out[i]) >= p.max_tokens:
+                    finished[i] = True
+            if all(finished) or step == steps - 1:
+                break
+            key, sub = jax.random.split(key)
+            nxt, cache = self._decode(
+                self.params, cache, nxt, temp, sub, top_k
+            )
+        return out

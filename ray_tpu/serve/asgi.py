@@ -62,12 +62,16 @@ def _build_scope(request, state=None) -> dict:
     }
 
 
-async def _run_asgi(app, request, out: "queue.Queue", state=None) -> None:
+async def _run_asgi(app, request, out: "queue.Queue", state=None, box=None) -> None:
     """Drive one request through the ASGI app; response frames go to
     ``out`` (thread-safe: the consumer is a sync generator streaming back
-    through the replica)."""
+    through the replica). ``box`` (dict) exposes the per-request
+    ``disconnected`` event to the consumer thread so stream abandonment
+    propagates back into the app promptly."""
     body_sent = False
     disconnected = asyncio.Event()
+    if box is not None:
+        box["disconnected"] = disconnected
 
     async def receive():
         nonlocal body_sent
@@ -231,17 +235,28 @@ class _ASGIRunner:
     def stream(self, request):
         """Sync generator of response frames (StreamStart, then bytes)."""
         out: "queue.Queue" = queue.Queue(maxsize=64)
+        box: dict = {}
         asyncio.run_coroutine_threadsafe(
-            _run_asgi(self.app, request, out, self.state), self.loop
+            _run_asgi(self.app, request, out, self.state, box), self.loop
         )
-        while True:
-            try:
-                item = out.get(timeout=600)
-            except queue.Empty:
-                return  # producer died without a terminator
-            if item is _DONE:
-                return
-            yield item
+        try:
+            while True:
+                try:
+                    item = out.get(timeout=600)
+                except queue.Empty:
+                    return  # producer died without a terminator
+                if item is _DONE:
+                    return
+                yield item
+        finally:
+            # generator closed (client disconnected and the streaming
+            # machinery abandoned the stream) OR completed: flip the
+            # request's disconnect event so a listen_for_disconnect-style
+            # task — and any still-streaming app loop — ends promptly
+            # instead of waiting out the 300s producer backstop
+            ev = box.get("disconnected")
+            if ev is not None:
+                self.loop.call_soon_threadsafe(ev.set)
 
 
 def ingress(app) -> Any:

@@ -237,8 +237,9 @@ class AsyncHTTPServer:
         reason = _hc.responses.get(status, "")
         head = [f"HTTP/1.1 {status} {reason}"]
         if not _bodiless(status):
+            _, ctype = _clean_header("", start.content_type)
             head += [
-                f"Content-Type: {start.content_type}",
+                f"Content-Type: {ctype}",
                 "Transfer-Encoding: chunked",
                 "Cache-Control: no-cache",
             ]
@@ -396,7 +397,8 @@ class ProxyActor:
                 status = getattr(start, "status", 200)
                 self.send_response(status)
                 if not _bodiless(status):
-                    self.send_header("Content-Type", start.content_type)
+                    _, ctype = _clean_header("", start.content_type)
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Transfer-Encoding", "chunked")
                     self.send_header("Cache-Control", "no-cache")
                 for name, value in getattr(start, "headers", None) or []:
