@@ -85,11 +85,19 @@ class GangLLMServer:
         worker_env: Optional[dict] = None,
         pg_timeout: float = 120.0,
     ):
+        import threading
+
         from ray_tpu.llm.tokenizer import get_tokenizer
 
         self.llm_config = llm_config
         self.tokenizer = get_tokenizer(llm_config.model.tokenizer)
         self.num_workers = num_workers
+        # serve replicas are threaded (max_concurrency follows
+        # max_ongoing_requests): two in-flight broadcasts could reach the
+        # workers in different per-actor orders and pair mismatched SPMD
+        # programs in one jax.distributed world — collective deadlock. One
+        # broadcast at a time; queued requests wait here on the replica.
+        self._lockstep = threading.Lock()
         bundles = [dict(resources_per_worker or {"CPU": 1}) for _ in range(num_workers)]
         # STRICT_PACK: the gang must land in one ICI domain (one slice)
         self.pg = placement_group(bundles, strategy="STRICT_PACK")
@@ -142,10 +150,11 @@ class GangLLMServer:
         pd = {
             f: getattr(params, f) for f in SamplingParams.__dataclass_fields__
         }
-        refs = [
-            w.generate_batch.remote(token_lists, pd) for w in self.workers
-        ]
-        outs = ray_tpu.get(refs, timeout=600)
+        with self._lockstep:
+            refs = [
+                w.generate_batch.remote(token_lists, pd) for w in self.workers
+            ]
+            outs = ray_tpu.get(refs, timeout=600)
         return token_lists, outs[0]
 
     def completions(self, body: dict) -> dict:

@@ -226,7 +226,9 @@ class SPMDGenerator:
                 if finished[i]:
                     continue
                 t = int(host_tok[i])
-                if not p.ignore_eos and (t == eos or t in stop):
+                # ignore_eos exempts only EOS, never user stop tokens
+                # (the JaxEngine contract, engine.py stop handling)
+                if (t == eos and not p.ignore_eos) or t in stop:
                     finished[i] = True
                     continue
                 out[i].append(t)
