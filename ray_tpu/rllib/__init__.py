@@ -6,6 +6,7 @@ LearnerGroup / EnvRunnerGroup, with PPO as the first algorithm
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig, record_experience
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
@@ -19,6 +20,8 @@ from ray_tpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "APPO",
+    "APPOConfig",
     "BC",
     "BCConfig",
     "MARWIL",

@@ -1,0 +1,178 @@
+"""APPO: asynchronous PPO — IMPALA's pipeline + PPO's clipped surrogate.
+
+Reference: ``rllib/algorithms/appo/appo.py`` (APPO = IMPALA architecture,
+PPO objective) and ``appo/default_appo_rl_module.py`` (the target-network
+half). The async machinery (one in-flight sample per runner, immediate
+resubmit, fault-tolerant consume) is inherited from ``impala.py`` verbatim;
+what changes is the update:
+
+- V-trace targets are computed under the TARGET network (a periodic
+  snapshot of the learner), with importance ratios pi_target/pi_behavior —
+  decoupling the regression target from the fast-moving learner the way the
+  reference's old-policy head does.
+- The policy gradient is PPO's clipped surrogate on ratio
+  pi_current/pi_behavior against those V-trace advantages, instead of
+  IMPALA's plain rho-weighted policy gradient.
+- Optionally a KL(target || current) penalty (``use_kl_loss``) replaces
+  hard clipping's role of keeping the learner near the data-generating
+  policy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.core.rl_module import RLModule
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        # reference defaults: appo.py (clip 0.4; target net refreshed on a
+        # cadence of learner updates)
+        self.clip_param = 0.4
+        self.target_network_update_freq = 4  # in learner updates
+        self.use_kl_loss = False
+        self.kl_coeff = 0.2
+        self.lr = 5e-4
+
+
+class APPO(IMPALA):
+    _target_params = None
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        n_hidden = len(self.module_spec.hidden)
+        gamma = self.config.gamma
+        rho_clip = self.config.vtrace_clip_rho_threshold
+        pg_rho_clip = self.config.vtrace_clip_pg_rho_threshold
+        clip = self.config.clip_param
+        ent_c = self.config.entropy_coeff
+        vf_c = self.config.vf_loss_coeff
+        use_kl = self.config.use_kl_loss
+        kl_c = self.config.kl_coeff
+        optimizer = self.optimizer
+
+        def loss_fn(params, target_params, seq):
+            T, N, D = seq["obs"].shape
+            obs = seq["obs"].reshape(T * N, D)
+            next_obs = seq["next_obs"].reshape(T * N, D)
+            logits, values = RLModule.forward(params, obs, n_hidden)
+            logits = logits.reshape(T, N, -1)
+            values = values.reshape(T, N)
+            # target network: V-trace targets + IS ratios live under the
+            # snapshot, so the regression target doesn't chase the learner
+            t_logits, t_values = RLModule.forward(target_params, obs, n_hidden)
+            t_logits = t_logits.reshape(T, N, -1)
+            t_values = t_values.reshape(T, N)
+            _, t_next_values = RLModule.forward(
+                target_params, next_obs, n_hidden
+            )
+            t_next_values = t_next_values.reshape(T, N) * (
+                1.0 - seq["terminals"]
+            )
+
+            acts = seq["actions"][:, :, None].astype(jnp.int32)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, acts, axis=2)[:, :, 0]
+            t_logp_all = jax.nn.log_softmax(t_logits)
+            t_logp = jnp.take_along_axis(t_logp_all, acts, axis=2)[:, :, 0]
+
+            # v-trace under the target policy (Espeholt et al. eq. 1)
+            rho_t = jnp.exp(t_logp - seq["logp_behavior"])
+            rho_bar = jnp.minimum(rho_t, rho_clip)
+            c_bar = jnp.minimum(rho_t, 1.0)
+            not_end = 1.0 - seq["ends"]
+            delta = rho_bar * (
+                seq["rewards"] + gamma * t_next_values - t_values
+            )
+
+            def scan_fn(acc, xs):
+                d, c, ne = xs
+                acc = d + gamma * c * ne * acc
+                return acc, acc
+
+            _, acc_rev = jax.lax.scan(
+                scan_fn,
+                jnp.zeros((N,), jnp.float32),
+                (delta[::-1], c_bar[::-1], not_end[::-1]),
+            )
+            acc = acc_rev[::-1]
+            vs = t_values + acc
+            vs_tp1 = jnp.concatenate([vs[1:], t_next_values[-1:]], axis=0)
+            vs_tp1 = jnp.where(seq["ends"] > 0, t_next_values, vs_tp1)
+            adv = jnp.minimum(rho_t, pg_rho_clip) * (
+                seq["rewards"] + gamma * vs_tp1 - t_values
+            )
+            adv = jax.lax.stop_gradient(adv)
+
+            # PPO clipped surrogate on the current/behavior ratio
+            ratio = jnp.exp(logp - seq["logp_behavior"])
+            surrogate = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv,
+            )
+            pg_loss = -jnp.mean(surrogate)
+            vf_loss = 0.5 * jnp.mean(
+                (jax.lax.stop_gradient(vs) - values) ** 2
+            )
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+            )
+            total = pg_loss + vf_c * vf_loss - ent_c * entropy
+            if use_kl:
+                kl = jnp.mean(
+                    jnp.sum(
+                        jnp.exp(t_logp_all) * (t_logp_all - logp_all), axis=-1
+                    )
+                )
+                total = total + kl_c * kl
+            return total, (pg_loss, vf_loss, entropy, jnp.mean(ratio))
+
+        def update(params, target_params, opt_state, seq):
+            import optax
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, seq
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        jitted = jax.jit(update, donate_argnums=(0, 2))
+
+        def wrapped(params, opt_state, seq):
+            if self._target_params is None:
+                self._target_params = jax.tree.map(jnp.array, params)
+            params, opt_state, loss, aux = jitted(
+                params, self._target_params, opt_state, seq
+            )
+            self._updates_since_target = (
+                getattr(self, "_updates_since_target", 0) + 1
+            )
+            if (
+                self._updates_since_target
+                >= self.config.target_network_update_freq
+            ):
+                self._target_params = jax.tree.map(jnp.array, params)
+                self._updates_since_target = 0
+            return params, opt_state, loss, aux
+
+        return wrapped
+
+    def set_state(self, state: dict):
+        super().set_state(state)
+        # re-snapshot: a restored learner must not chase a stale target
+        self._target_params = None
+        self._updates_since_target = 0
+
+    def _result(self, losses, metrics_list) -> dict:
+        out = super()._result(losses, metrics_list)
+        out["learner"]["target_updates_pending"] = getattr(
+            self, "_updates_since_target", 0
+        )
+        return out

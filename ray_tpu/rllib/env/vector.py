@@ -19,11 +19,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
-
-class _Space:
-    def __init__(self, shape=None, n=None):
-        self.shape = shape
-        self.n = n
+from ray_tpu.rllib.env.cartpole import _Space
 
 
 class VectorEnv:
@@ -254,21 +250,31 @@ class VecMiniBreakout(VectorEnv):
 
         final = self._obs()
         done = term | trunc
-        for i in np.nonzero(done)[0]:
+        done_idx = np.nonzero(done)[0]
+        for i in done_idx:
             self._reset_index(i)
-        obs = self._obs() if done.any() else final.copy()
+        obs = final
+        if done_idx.size:
+            # rendering dominates step cost: patch only the reset rows
+            # instead of re-rendering all N frames
+            obs = final.copy()
+            obs[done_idx] = self._obs(done_idx)
         return obs, rew, term, trunc, final
 
-    def _obs(self) -> np.ndarray:
-        N = self.num_envs
-        img = np.zeros((N, self.h, self.w, 1), np.float32)
-        img[:, : self.brick_rows, :, 0] = self.bricks.astype(np.float32) * 0.5
-        idx = np.arange(N)
-        img[idx, self.ball_y, self.ball_x, 0] = 1.0
+    def _obs(self, idx: Optional[np.ndarray] = None) -> np.ndarray:
+        """Render frames for env indices ``idx`` (all envs when None)."""
+        if idx is None:
+            idx = np.arange(self.num_envs)
+        n = len(idx)
+        img = np.zeros((n, self.h, self.w, 1), np.float32)
+        img[:, : self.brick_rows, :, 0] = (
+            self.bricks[idx].astype(np.float32) * 0.5
+        )
+        img[np.arange(n), self.ball_y[idx], self.ball_x[idx], 0] = 1.0
         half = self.paddle_width // 2
         # paddle row: vectorized range mask
         cols = np.arange(self.w)[None, :]
-        pmask = np.abs(cols - self.paddle_x[:, None]) <= half
+        pmask = np.abs(cols - self.paddle_x[idx, None]) <= half
         img[:, self.h - 1, :, 0] = np.where(
             pmask, 0.8, img[:, self.h - 1, :, 0]
         )

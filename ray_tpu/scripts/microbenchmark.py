@@ -333,10 +333,13 @@ def serve_proxy_bench(n_requests: int = 300) -> dict:
 
 def env_stepping_bench(num_envs: int = 64, seconds: float = 2.0) -> dict:
     """Env-steps/sec: numpy-batched vector envs vs the per-env Python loop
-    (VERDICT r3 missing #6 — Atari-scale sampling needs batched stepping)."""
+    over the SAME in-repo scalar envs (like-for-like: gym.make's wrapper
+    stack would inflate the loop baseline). VERDICT r3 missing #6 —
+    Atari-scale sampling needs batched stepping."""
     import numpy as np
 
-    from ray_tpu.rllib.env.env_runner import _make_env
+    from ray_tpu.rllib.env.breakout import MiniBreakout
+    from ray_tpu.rllib.env.cartpole import CartPole
     from ray_tpu.rllib.env.vector import (
         LoopVectorEnv,
         VecCartPole,
@@ -345,10 +348,10 @@ def env_stepping_bench(num_envs: int = 64, seconds: float = 2.0) -> dict:
 
     out = {}
     cases = [
-        ("minibreakout_pixel", VecMiniBreakout(num_envs), "MiniBreakout-v0", 3),
-        ("cartpole_vector", VecCartPole(num_envs), "CartPole-v1", 2),
+        ("minibreakout_pixel", VecMiniBreakout(num_envs), MiniBreakout, 3),
+        ("cartpole_vector", VecCartPole(num_envs), CartPole, 2),
     ]
-    for name, vec, env_id, n_act in cases:
+    for name, vec, scalar_cls, n_act in cases:
         rng = np.random.default_rng(0)
 
         def rate(env):
@@ -361,9 +364,7 @@ def env_stepping_bench(num_envs: int = 64, seconds: float = 2.0) -> dict:
             return steps / (time.perf_counter() - t0)
 
         v = rate(vec)
-        l = rate(
-            LoopVectorEnv([lambda e=env_id: _make_env(e)] * num_envs)
-        )
+        l = rate(LoopVectorEnv([scalar_cls] * num_envs))
         out[name] = {
             "vectorized_steps_per_s": round(v),
             "loop_steps_per_s": round(l),
