@@ -369,7 +369,9 @@ def test_appo_beats_sync_ppo_wallclock(ray_start_thread):
         .training(lr=5e-4)
         .debugging(seed=0)
     )
-    assert appo_t < ppo_t, (appo_t, ppo_t)
+    # measured 2-3x headroom across seeds; 0.85 leaves room for host noise
+    # while still failing if the async pipeline stops paying for itself
+    assert appo_t < 0.85 * ppo_t, (appo_t, ppo_t)
 
 
 def test_impala_vtrace_offpolicy_correction():
