@@ -558,11 +558,14 @@ class NodeAgent:
         )
         paths = [pkg_root]
         cwd = None
+        wheels_dir = None
         for kind, name, blob in msg.packages:
             root = self._stage_package(name, blob)
             if kind == "working_dir":
                 cwd = os.path.join(root, name)
                 paths.insert(0, cwd)
+            elif kind == "pip_wheels":
+                wheels_dir = os.path.join(root, name)
             else:
                 paths.append(root)
         existing = env.get("PYTHONPATH", "")
@@ -570,10 +573,36 @@ class NodeAgent:
         if not msg.needs_tpu:
             env.setdefault("JAX_PLATFORMS", "cpu")
         env.update({k: str(v) for k, v in msg.env_vars.items()})
+        # runtime_env pip: build (or reuse) the offline venv against the
+        # staged wheel cache shipped from the driver host; the worker's
+        # interpreter is the venv's python (controller local path mirror)
+        python_exe = sys.executable
+        pip_json = msg.env_vars.get("RAY_TPU_PIP_SPEC")
+        if pip_json:
+            import json as _json
+
+            from ray_tpu._private.runtime_env_pip import (
+                build_spec,
+                ensure_pip_env,
+            )
+
+            spec = build_spec(_json.loads(pip_json)["packages"], wheels_dir)
+            try:
+                python_exe = ensure_pip_env(
+                    spec, base_dir=os.path.join(self.base_dir, "pip_envs")
+                )
+            except Exception as e:  # noqa: BLE001 — surface, don't wedge
+                with self.workers_lock:
+                    self._pending_kills.discard(msg.worker_id)
+                self._on_local_worker_death(msg.worker_id)
+                self._send(
+                    P.WorkerDied(msg.worker_id, f"pip env failed: {e}")
+                )
+                return
         try:
             proc = subprocess.Popen(
                 [
-                    sys.executable,
+                    python_exe,
                     "-m",
                     "ray_tpu._private.worker_main",
                     self.worker_sock,

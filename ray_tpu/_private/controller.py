@@ -20,6 +20,7 @@ split the reference makes between raylet control RPCs and plasma.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
@@ -1287,6 +1288,20 @@ class Controller:
                     f"runtime_env py_modules path does not exist on the "
                     f"cluster host: {p}"
                 )
+        from ray_tpu._private.runtime_env_pip import (
+            normalize_pip_spec,
+            validate_pip_spec,
+        )
+
+        pip_spec = normalize_pip_spec(rt)
+        if pip_spec:
+            validate_pip_spec(pip_spec)
+            if self.mode == "thread":
+                raise ValueError(
+                    "runtime_env pip requires process mode (thread-mode "
+                    "workers share the driver interpreter and cannot enter "
+                    "a venv); ray_tpu.init(mode='process')"
+                )
 
     def submit_task(self, spec: TaskSpec):
         self._validate_runtime_env(spec)
@@ -1572,7 +1587,14 @@ class Controller:
         if spec.task_type != TaskType.NORMAL_TASK or spec.num_returns == "streaming":
             return False
         rt = spec.runtime_env or {}
-        return not rt.get("working_dir") and not rt.get("py_modules")
+        # pip rides the package-shipping SpawnWorker path (the wheel cache
+        # must travel to the agent host), so it is head-managed like
+        # working_dir/py_modules
+        return (
+            not rt.get("working_dir")
+            and not rt.get("py_modules")
+            and not rt.get("pip")
+        )
 
     def _lease_backlog_cap(self, node: NodeState) -> int:
         """Max outstanding leases per node — matches the agent's own spill
@@ -1795,13 +1817,17 @@ class Controller:
     def _env_fingerprint(spec: TaskSpec):
         """Workers are only reusable by tasks with the same environment needs
         (TPU visibility is baked in at spawn; runtime_env vars likewise)."""
+        from ray_tpu._private.runtime_env_pip import normalize_pip_spec
+
         rt = spec.runtime_env or {}
         env_vars = rt.get("env_vars") or {}
+        pip_spec = normalize_pip_spec(rt)
         return (
             bool(spec.resources.get("TPU")),
             tuple(sorted(env_vars.items())),
             rt.get("working_dir"),
             tuple(str(m) for m in (rt.get("py_modules") or ())),
+            json.dumps(pip_spec, sort_keys=True) if pip_spec else None,
         )
 
     def _worker_pool_cap(self, node: NodeState) -> int:
@@ -1898,13 +1924,18 @@ class Controller:
                     worker.dead = True
                     logger.error("worker failed to register in time")
                 self.sched_cv.notify_all()
-        except Exception:
+        except Exception as e:
             with self.lock:
                 self.starting_workers -= 1
                 node = self.nodes.get(node_id)
                 if node is not None and node.starting_workers > 0:
                     node.starting_workers -= 1
             logger.error("worker spawn failed:\n%s", traceback.format_exc())
+            from ray_tpu.exceptions import RuntimeEnvSetupError
+
+            if isinstance(e, RuntimeEnvSetupError):
+                # a doomed env must fail its tasks, not respawn forever
+                self._fail_pending_for_env(self._env_fingerprint(spec_hint), e)
 
     def _spawn_worker_process(self, node_id: NodeID, spec_hint: TaskSpec) -> WorkerHandle:
         if self.mode == "thread":
@@ -1968,8 +1999,17 @@ class Controller:
             env["PYTHONPATH"] = os.pathsep.join(
                 staged + [env.get("PYTHONPATH", "")]
             )
+        # runtime_env pip: the worker interpreter is the spec's offline
+        # venv (created once, content-addressed) — reference pip.py/uv.py
+        from ray_tpu._private.runtime_env_pip import (
+            ensure_pip_env,
+            normalize_pip_spec,
+        )
+
+        pip_spec = normalize_pip_spec(spec_hint.runtime_env or {})
+        python_exe = ensure_pip_env(pip_spec) if pip_spec else sys.executable
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main", self.address, worker_id.hex()],
+            [python_exe, "-m", "ray_tpu._private.worker_main", self.address, worker_id.hex()],
             env=env,
             cwd=working_dir or None,
             stdout=None,
@@ -2000,6 +2040,20 @@ class Controller:
             path = os.path.abspath(os.path.expanduser(str(mod)))
             packages.append(("py_module", *self._package_cached(path)))
         env_vars = {k: str(v) for k, v in (rt.get("env_vars") or {}).items()}
+        # runtime_env pip across hosts: ship the wheel cache by value
+        # (content-cached zip) and carry the spec in the env; the agent
+        # builds the venv against its own staged copy
+        from ray_tpu._private.runtime_env_pip import normalize_pip_spec
+
+        pip_spec = normalize_pip_spec(rt)
+        if pip_spec:
+            if pip_spec["find_links"]:
+                packages.append(
+                    ("pip_wheels", *self._package_cached(pip_spec["find_links"]))
+                )
+            env_vars["RAY_TPU_PIP_SPEC"] = json.dumps(
+                {"packages": pip_spec["packages"]}
+            )
         handle = WorkerHandle(
             worker_id, node_id, proc=None, conn=_RelayConn(agent, worker_id)
         )
@@ -2261,6 +2315,15 @@ class Controller:
                     handle = self.workers.get(msg.worker_id)
                 if handle is not None:
                     self._on_worker_death(handle, reason=msg.reason)
+                    if msg.reason.startswith("pip env failed"):
+                        # the agent could not build this env: every queued
+                        # task needing it is doomed — fail, don't respawn
+                        from ray_tpu.exceptions import RuntimeEnvSetupError
+
+                        self._fail_pending_for_env(
+                            handle.fingerprint,
+                            RuntimeEnvSetupError(msg.reason),
+                        )
             elif isinstance(msg, P.Request):
                 # the agent's own control RPCs. object_owner/pull can block
                 # on a not-yet-sealed entry whose seal arrives on THIS
@@ -3200,6 +3263,28 @@ class Controller:
         while actor.queue:
             pt = actor.queue.popleft()
             self._fail_task(pt, ActorDiedError(actor.actor_id.hex(), actor.death_cause or "actor died"))
+
+    def _fail_pending_for_env(self, fingerprint: tuple, error: Exception):
+        """Fail every still-queued task whose runtime env resolves to the
+        fingerprint whose worker environment could not be built — the
+        RuntimeEnvSetupError-surfaces-on-the-task contract (reference:
+        runtime-env agent setup failure handling)."""
+        from ray_tpu.exceptions import RuntimeEnvSetupError
+
+        if not isinstance(error, RuntimeEnvSetupError):
+            error = RuntimeEnvSetupError(str(error))
+        with self.lock:
+            doomed = [
+                pt
+                for pt in self.pending_by_id.values()
+                if getattr(pt, "worker", None) is None
+                and self._env_fingerprint(pt.spec) == fingerprint
+            ]
+        for pt in doomed:
+            self._fail_task(pt, error)
+        if doomed:
+            with self.lock:
+                self.sched_cv.notify_all()
 
     def _fail_task(self, pt: PendingTask, error: Exception):
         sobj = self.serialization.serialize(
