@@ -58,6 +58,10 @@ class Config:
     scheduler_top_k_fraction: float = 0.2
     # --- workers ---
     worker_register_timeout_s: float = 120.0
+    # Extra registration budget for workers whose spawn builds an offline
+    # pip venv first (heavy wheel sets take minutes; concurrent spawns of
+    # the same env serialize on the build flock).
+    pip_env_build_timeout_s: float = 600.0
     worker_pool_prestart: bool = True
     idle_worker_kill_s: float = 300.0
     maximum_startup_concurrency: int = 2

@@ -1302,6 +1302,12 @@ class Controller:
                     "workers share the driver interpreter and cannot enter "
                     "a venv); ray_tpu.init(mode='process')"
                 )
+            # resolve ONCE at submission: the fingerprint is recomputed in
+            # the scheduler hot path (shape keys, worker matching), which
+            # must never re-read a requirements file or the env var — a
+            # deleted/edited file would otherwise stall dispatch or strand
+            # spawned workers with mismatched fingerprints
+            spec.runtime_env = {**rt, "pip": pip_spec}
 
     def submit_task(self, spec: TaskSpec):
         self._validate_runtime_env(spec)
@@ -1906,7 +1912,13 @@ class Controller:
     def _start_worker(self, node_id: NodeID, spec_hint: TaskSpec):
         try:
             worker = self._spawn_worker_process(node_id, spec_hint)
-            ok = worker.registered.wait(self.config.worker_register_timeout_s)
+            timeout = self.config.worker_register_timeout_s
+            if (spec_hint.runtime_env or {}).get("pip"):
+                # the spawn may be building the offline venv (agent-side it
+                # happens after SpawnWorker is sent, inside this window) —
+                # don't declare the worker dead mid-install
+                timeout += self.config.pip_env_build_timeout_s
+            ok = worker.registered.wait(timeout)
             with self.lock:
                 self.starting_workers -= 1
                 node = self.nodes.get(node_id)
@@ -3277,9 +3289,15 @@ class Controller:
             doomed = [
                 pt
                 for pt in self.pending_by_id.values()
-                if getattr(pt, "worker", None) is None
+                if pt.worker is None
                 and self._env_fingerprint(pt.spec) == fingerprint
             ]
+            for pt in doomed:
+                # cancelled gates the ready queues + dep-wakeup dispatch —
+                # without it the queue entry survives _fail_task's
+                # pending_by_id pop and the scheduler respawns the doomed
+                # env (full venv build) every round, forever
+                pt.cancelled = True
         for pt in doomed:
             self._fail_task(pt, error)
         if doomed:
