@@ -12,6 +12,14 @@ from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.connectors import (
+    ConnectorV2,
+    EnvToModulePipeline,
+    FlattenObservations,
+    FrameStack,
+    MeanStdFilter,
+    PrevActionsPrevRewards,
+)
 from ray_tpu.rllib.core.learner import JaxLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
@@ -27,8 +35,14 @@ __all__ = [
     "MARWIL",
     "MARWILConfig",
     "record_experience",
+    "ConnectorV2",
     "DQN",
     "DQNConfig",
+    "EnvToModulePipeline",
+    "FlattenObservations",
+    "FrameStack",
+    "MeanStdFilter",
+    "PrevActionsPrevRewards",
     "ReplayBuffer",
     "EnvRunnerGroup",
     "IMPALA",

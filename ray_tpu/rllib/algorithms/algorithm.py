@@ -30,6 +30,7 @@ class AlgorithmConfig:
         self.num_env_runners = 0
         self.num_envs_per_env_runner = 1
         self.rollout_fragment_length = 200
+        self.env_to_module_connector = None  # factory -> ConnectorV2 piece(s)
         # training
         self.gamma = 0.99
         self.lr = 3e-4
@@ -73,6 +74,7 @@ class AlgorithmConfig:
         num_env_runners: Optional[int] = None,
         num_envs_per_env_runner: Optional[int] = None,
         rollout_fragment_length: Optional[int] = None,
+        env_to_module_connector=None,
         **_,
     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
@@ -81,6 +83,11 @@ class AlgorithmConfig:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            # zero-arg factory returning ConnectorV2 piece(s) — built fresh
+            # per runner (pieces are stateful); reference:
+            # AlgorithmConfig.env_runners(env_to_module_connector=...)
+            self.env_to_module_connector = env_to_module_connector
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -133,6 +140,12 @@ class Algorithm:
         self.config = config
         self.is_multi_agent = config.policies is not None
         if self.is_multi_agent:
+            if config.env_to_module_connector is not None:
+                # fail loudly rather than silently training on raw obs
+                raise NotImplementedError(
+                    "env_to_module_connector is not supported for "
+                    "multi-agent configs yet"
+                )
             self._setup_multi_agent()
             self.iteration = 0
             self._total_env_steps = 0
@@ -140,6 +153,14 @@ class Algorithm:
         from ray_tpu.rllib.env.env_runner import env_spec
 
         obs_shape, act_dim = env_spec(config.env)
+        if config.env_to_module_connector is not None:
+            # the module sees post-connector observations: size the spec
+            # from a probe pipeline (reference: connector pipelines adapt
+            # observation_space before RLModule build)
+            from ray_tpu.rllib.connectors import as_pipeline
+
+            probe = as_pipeline(config.env_to_module_connector())
+            obs_shape = tuple(probe.transform_obs_shape(tuple(obs_shape)))
         if len(obs_shape) == 3 and self.supports_pixel_obs:
             # pixel env: conv torso (Atari-CNN-style defaults scaled down)
             self.module_spec = RLModuleSpec(
@@ -175,6 +196,7 @@ class Algorithm:
             lambda_=getattr(config, "lambda_", 0.95),
             seed=config.seed,
             emit_sequences=getattr(config, "_emit_sequences", False),
+            env_to_module_connector=config.env_to_module_connector,
         )
         self.iteration = 0
         self._total_env_steps = 0
@@ -270,11 +292,19 @@ class Algorithm:
             }
         else:
             learner = self.learner_group.get_state()
-        return {
+        out = {
             "learner": learner,
             "iteration": self.iteration,
             "total_env_steps": self._total_env_steps,
         }
+        if not self.is_multi_agent:
+            conn = self.env_runner_group.get_connector_state()
+            if conn is not None:
+                # stacks/filters survive checkpoints (a MeanStdFilter
+                # restarted at count=0 would re-normalize with fresh
+                # small-sample stats against a converged policy)
+                out["connectors"] = conn
+        return out
 
     def set_state(self, state: dict):
         if self.is_multi_agent:
@@ -282,6 +312,8 @@ class Algorithm:
                 self.learner_groups[pid].set_state(s)
         else:
             self.learner_group.set_state(state["learner"])
+            if state.get("connectors") is not None:
+                self.env_runner_group.set_connector_state(state["connectors"])
         self.iteration = state.get("iteration", 0)
         self._total_env_steps = state.get("total_env_steps", 0)
 

@@ -67,6 +67,7 @@ class SingleAgentEnvRunner:
         lambda_: float = 0.95,
         seed: int = 0,
         emit_sequences: bool = False,
+        connector_payload: Optional[bytes] = None,
     ):
         import cloudpickle
 
@@ -91,8 +92,21 @@ class SingleAgentEnvRunner:
         # (IMPALA's V-trace needs per-step behavior logp in trajectory order)
         self.emit_sequences = emit_sequences
         self._rng = np.random.default_rng(seed)
-        # make_vector_env already seeded+reset; take its initial obs
-        self._obs = self._to_obs(self._initial_obs)
+        # env-to-module connector pipeline (reference: ConnectorV2) — built
+        # fresh per runner from the config's factory; numpy-batched pieces
+        # transform the whole env gang's [N, ...] obs per step
+        self.connectors = None
+        if connector_payload is not None:
+            from ray_tpu.rllib.connectors import as_pipeline
+
+            factory = cloudpickle.loads(connector_payload)
+            self.connectors = as_pipeline(factory())
+        # make_vector_env already seeded+reset; take its initial obs.
+        # connectors see RAW env shapes (FrameStack needs [N, H, W, C]);
+        # the MLP flatten happens after
+        self._obs = self._to_obs(
+            self._apply_connectors(self._initial_obs, update=True, initial=True)
+        )
         from collections import deque
 
         self._ep_return = np.zeros(num_envs)
@@ -107,8 +121,23 @@ class SingleAgentEnvRunner:
         a = np.asarray(o, np.float32)
         return a.reshape(a.shape[0], -1) if self._flatten else a
 
+    def _apply_connectors(self, obs, update=False, dones=None, initial=False):
+        if self.connectors is None:
+            return obs
+        return self.connectors.transform(
+            obs, update=update, dones=dones, initial=initial
+        )
+
     def set_weights(self, weights: dict) -> bool:
         self.module.set_state(weights)
+        return True
+
+    def get_connector_state(self):
+        return self.connectors.get_state() if self.connectors else None
+
+    def set_connector_state(self, state) -> bool:
+        if self.connectors is not None and state is not None:
+            self.connectors.set_state(state)
         return True
 
     def sample(self) -> dict:
@@ -143,14 +172,22 @@ class SingleAgentEnvRunner:
             val_buf[t] = values
 
             o2, r, term, trunc, final = self.venv.step(actions)
-            o2 = self._to_obs(o2)
+            done = term | trunc
+            # stage this step's context FIRST: the bootstrap peek below
+            # must see the action/reward just taken (as-if-continuing)
+            if self.connectors is not None:
+                self.connectors.note_step(actions, r, done)
             # pre-reset successor: value-based learners (DQN) need the
-            # true transition even at episode boundaries
-            next_obs_buf[t] = self._to_obs(final)
+            # true transition even at episode boundaries. The connector
+            # PEEKS (no state advance): the bootstrap obs must see the
+            # stack/filter as-if-continuing, not post-reset
+            next_obs_buf[t] = self._to_obs(self._apply_connectors(final))
+            o2 = self._to_obs(
+                self._apply_connectors(o2, update=True, dones=done)
+            )
             rew_buf[t] = r
             self._ep_return += r
             self._ep_len += 1
-            done = term | trunc
             term_buf[t] = term.astype(np.float32)
             end_buf[t] = done.astype(np.float32)
             trunc_only[t] = trunc & ~term
@@ -262,6 +299,7 @@ class EnvRunnerGroup:
         lambda_: float = 0.95,
         seed: int = 0,
         emit_sequences: bool = False,
+        env_to_module_connector=None,
     ):
         import cloudpickle
 
@@ -273,6 +311,11 @@ class EnvRunnerGroup:
             gamma=gamma,
             lambda_=lambda_,
             emit_sequences=emit_sequences,
+            connector_payload=(
+                cloudpickle.dumps(env_to_module_connector)
+                if env_to_module_connector is not None
+                else None
+            ),
         )
         self._seed = seed
         self.num_env_runners = num_env_runners
@@ -364,6 +407,30 @@ class EnvRunnerGroup:
             "num_healthy_runners": len(good),
         }
         return batch, metrics
+
+    def get_connector_state(self):
+        """Connector pipeline state for checkpoints (local runner's, or the
+        first healthy remote's — runners converge on the same stream)."""
+        if self._local is not None:
+            return self._local.get_connector_state()
+        for r in self._remote:
+            try:
+                return ray_tpu.get(r.get_connector_state.remote(), timeout=60)
+            except Exception:
+                continue
+        return None
+
+    def set_connector_state(self, state):
+        if state is None:
+            return
+        if self._local is not None:
+            self._local.set_connector_state(state)
+            return
+        for r in self._remote:
+            try:
+                ray_tpu.get(r.set_connector_state.remote(state), timeout=60)
+            except Exception:
+                pass
 
     def shutdown(self):
         for r in self._remote:
