@@ -32,6 +32,13 @@ from ray_tpu.data.dataset import (
 )
 from ray_tpu.data.datasource import Datasource, ReadTask
 from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.logical import (
+    EliminateRedundantOps,
+    LimitPushdown,
+    ProjectionPushdown,
+    Rule,
+)
+from ray_tpu.data.partitioning import Partitioning, PathPartitionFilter
 
 __all__ = [
     "Block",
@@ -43,7 +50,13 @@ __all__ = [
     "Datasource",
     "GroupedData",
     "MaterializedDataset",
+    "Partitioning",
+    "PathPartitionFilter",
     "ReadTask",
+    "Rule",
+    "EliminateRedundantOps",
+    "LimitPushdown",
+    "ProjectionPushdown",
     "from_arrow",
     "from_items",
     "from_numpy",

@@ -24,6 +24,11 @@ class DataContext:
     preserve_order: bool = True
     # resources attached to each block task
     task_resources: Optional[dict] = None
+    # logical optimizer rules applied before physical planning, in order
+    # (reference: _internal/logical/rules; append custom Rule instances)
+    optimizer_rules: tuple = dataclasses.field(
+        default_factory=lambda: _default_rules()
+    )
 
     _instance = None
     _lock = threading.Lock()
@@ -34,3 +39,9 @@ class DataContext:
             if cls._instance is None:
                 cls._instance = cls()
             return cls._instance
+
+
+def _default_rules() -> tuple:
+    from ray_tpu.data.logical import DEFAULT_RULES
+
+    return DEFAULT_RULES

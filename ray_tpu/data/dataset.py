@@ -72,9 +72,9 @@ class Dataset:
         )
 
     def select_columns(self, cols: list[str]) -> "Dataset":
-        return self.map_batches(
-            lambda b: {k: b[k] for k in cols}, batch_format="dict"
-        )
+        # a first-class Project op: the optimizer pushes it into columnar
+        # reads (ProjectionPushdown) where a lambda could not be inspected
+        return self._with(L.Project(list(cols)))
 
     def rename_columns(self, mapping: dict[str, str]) -> "Dataset":
         return self.map_batches(
@@ -255,13 +255,48 @@ class Dataset:
             if BlockAccessor(block).num_rows():
                 writer(block, os.path.join(path, f"part-{i:05d}.{ext}"))
 
-    def write_parquet(self, path: str):
+    def write_parquet(self, path: str, partition_cols: Optional[list] = None):
+        """``partition_cols``: hive-style partitioned output — rows land in
+        ``col=value/`` subdirectories readable back with
+        ``read_parquet(path, partitioning=Partitioning('hive'))``
+        (reference: ``Dataset.write_parquet(partition_cols=...)``)."""
+        import os as _os
+
         def w(block, p):
             import pyarrow.parquet as pq
 
             pq.write_table(BlockAccessor(block).to_arrow(), p)
 
-        self._write(path, w, "parquet")
+        if not partition_cols:
+            self._write(path, w, "parquet")
+            return
+        _os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            block = BlockAccessor.normalize(ray_tpu.get(ref))
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            if not n:
+                continue
+            keys = np.stack(
+                [np.asarray(block[c]).astype(str) for c in partition_cols],
+                axis=1,
+            )
+            uniq, inverse = np.unique(keys, axis=0, return_inverse=True)
+            for g, vals in enumerate(uniq):
+                idx = np.nonzero(inverse == g)[0]
+                sub = acc.take_indices(idx)
+                # partition values live in the path, not the file
+                sub = {
+                    k: v
+                    for k, v in BlockAccessor.normalize(sub).items()
+                    if k not in partition_cols
+                }
+                d = _os.path.join(
+                    path,
+                    *(f"{c}={v}" for c, v in zip(partition_cols, vals)),
+                )
+                _os.makedirs(d, exist_ok=True)
+                w(sub, _os.path.join(d, f"part-{i:05d}.parquet"))
 
     def write_csv(self, path: str):
         self._write(

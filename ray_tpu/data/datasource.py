@@ -118,20 +118,32 @@ class BlocksDatasource(Datasource):
         ]
 
 
-def _expand_paths(paths) -> list[str]:
+def _expand_paths(paths, recursive: bool = False) -> list[str]:
     if isinstance(paths, str):
         paths = [paths]
     out: list[str] = []
     for p in paths:
         p = os.path.expanduser(p)
         if os.path.isdir(p):
-            out.extend(
-                sorted(
-                    os.path.join(p, f)
-                    for f in os.listdir(p)
-                    if not f.startswith(".")
+            if recursive:
+                # partitioned layouts nest files under key dirs
+                for root, dirs, files in os.walk(p):
+                    dirs.sort()
+                    out.extend(
+                        sorted(
+                            os.path.join(root, f)
+                            for f in files
+                            if not f.startswith(".")
+                        )
+                    )
+            else:
+                out.extend(
+                    sorted(
+                        os.path.join(p, f)
+                        for f in os.listdir(p)
+                        if not f.startswith(".")
+                    )
                 )
-            )
         elif any(c in p for c in "*?["):
             out.extend(sorted(_glob.glob(p)))
         else:
@@ -142,18 +154,66 @@ def _expand_paths(paths) -> list[str]:
 
 
 class FileBasedDatasource(Datasource):
-    """One read task per file (reference: ``file_based_datasource.py``)."""
+    """One read task per file (reference: ``file_based_datasource.py``).
 
-    def __init__(self, paths, **reader_kwargs):
-        self.paths = _expand_paths(paths)
+    ``partitioning`` (``data/partitioning.py``): partition fields parsed
+    from each file's path are appended to its block as constant columns.
+    ``partition_filter``: a ``PathPartitionFilter`` (or plain path
+    predicate) pruning files BEFORE read tasks exist — partition pruning
+    costs zero reads."""
+
+    def __init__(self, paths, partitioning=None, partition_filter=None,
+                 **reader_kwargs):
+        from ray_tpu.data.partitioning import PathPartitionFilter
+
+        self.partitioning = partitioning
+        if partition_filter is not None and not isinstance(
+            partition_filter, PathPartitionFilter
+        ):
+            if partitioning is None:
+                raise ValueError(
+                    "a plain partition_filter callable needs partitioning= "
+                    "to parse fields; pass a PathPartitionFilter otherwise"
+                )
+            partition_filter = PathPartitionFilter(
+                partitioning, partition_filter
+            )
+        # partitioned layouts nest files under key dirs: recurse whenever
+        # partition semantics are in play (a filter without partitioning=
+        # still implies a partitioned tree)
+        recursive = partitioning is not None or partition_filter is not None
+        self.paths = _expand_paths(paths, recursive=recursive)
+        if partition_filter is not None:
+            self.paths = [p for p in self.paths if partition_filter(p)]
+            if not self.paths:
+                raise FileNotFoundError(
+                    "partition_filter pruned every input file"
+                )
         self.reader_kwargs = reader_kwargs
 
     def _read_file(self, path: str) -> Block:
         raise NotImplementedError
 
+    def _read_with_partitions(self, path: str) -> Block:
+        block = self._read_file(path)
+        if self.partitioning is None:
+            return block
+        fields = self.partitioning.parse(path)
+        if not fields:
+            return block
+        from ray_tpu.data.block import BlockAccessor
+
+        block = BlockAccessor.normalize(block)
+        n = BlockAccessor(block).num_rows()
+        for k, v in fields.items():
+            if k not in block:
+                block[k] = np.full(n, v)
+        return block
+
     def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
         return [
-            ReadTask(lambda p=p: self._read_file(p), {"path": p}) for p in self.paths
+            ReadTask(lambda p=p: self._read_with_partitions(p), {"path": p})
+            for p in self.paths
         ]
 
 
@@ -226,8 +286,25 @@ class ParquetDatasource(FileBasedDatasource):
     def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
         if not self.stream_row_groups:
             return super().get_read_tasks(parallelism)
+
+        def stream(p):
+            fields = (
+                self.partitioning.parse(p)
+                if self.partitioning is not None
+                else {}
+            )
+            for rg in self._read_row_groups(p):
+                if fields:
+                    from ray_tpu.data.block import BlockAccessor
+
+                    rg = BlockAccessor.normalize(rg)
+                    n = BlockAccessor(rg).num_rows()
+                    for k, v in fields.items():
+                        rg.setdefault(k, np.full(n, v))
+                yield rg
+
         return [
-            StreamingReadTask(lambda p=p: self._read_row_groups(p), {"path": p})
+            StreamingReadTask(lambda p=p: stream(p), {"path": p})
             for p in self.paths
         ]
 

@@ -45,6 +45,11 @@ def _apply_transforms(block: Block, transforms: list) -> Block:
                 out = op.fn(batch, **op.fn_kwargs)
                 outs.append(BlockAccessor.normalize(out))
             block = BlockAccessor.concat(outs) if outs else {}
+        elif isinstance(op, L.Project):
+            nb = BlockAccessor.normalize(block)
+            # KeyError on a missing column — a typo must fail loudly, not
+            # silently drop the column downstream
+            block = {k: nb[k] for k in op.cols}
         elif isinstance(op, L.MapRows):
             block = BlockAccessor.from_rows([op.fn(r) for r in acc.iter_rows()])
         elif isinstance(op, L.Filter):
@@ -491,6 +496,7 @@ class StreamingExecutor:
 
     def execute(self, plan: L.LogicalPlan) -> Iterator[Any]:
         """Returns an iterator of block refs."""
+        plan = L.optimize(plan)  # DataContext.optimizer_rules
         stream: Optional[Iterator[Any]] = None
         ops = plan.ops
         i = 0

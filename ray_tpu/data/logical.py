@@ -39,6 +39,20 @@ class InputBlocks(LogicalOp):
         self.refs = refs
 
 
+class Project(LogicalOp):
+    """Column selection (``Dataset.select_columns``) — its own operator so
+    the optimizer can push it into columnar reads (reference:
+    ``logical/operators/map_operator.py`` Project + projection pushdown)."""
+
+    name = "Project"
+
+    def __init__(self, cols: list):
+        self.cols = list(cols)
+
+    def is_per_block(self) -> bool:
+        return True
+
+
 class MapBatches(LogicalOp):
     name = "MapBatches"
 
@@ -156,3 +170,123 @@ class LogicalPlan:
 
     def __repr__(self):
         return " -> ".join(op.name for op in self.ops)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer rules (reference: python/ray/data/_internal/logical/rules/ —
+# a pluggable list of plan→plan rewrites applied before physical planning;
+# users add custom rules via DataContext.optimizer_rules).
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    """One logical-plan rewrite. Must be pure: return a NEW plan."""
+
+    name = "rule"
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        return plan
+
+
+class EliminateRedundantOps(Rule):
+    """Merge consecutive Limits (min wins), collapse consecutive
+    Repartitions (last wins), drop a RandomShuffle directly before a Sort
+    (the sort re-orders everything anyway)."""
+
+    name = "EliminateRedundantOps"
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        ops: list[LogicalOp] = []
+        for op in plan.ops:
+            prev = ops[-1] if ops else None
+            if isinstance(op, Limit) and isinstance(prev, Limit):
+                ops[-1] = Limit(min(prev.n, op.n))
+            elif isinstance(op, Repartition) and isinstance(prev, Repartition):
+                ops[-1] = op
+            elif isinstance(op, Sort) and isinstance(prev, RandomShuffle):
+                ops[-1] = op
+            else:
+                ops.append(op)
+        return LogicalPlan(ops)
+
+
+class LimitPushdown(Rule):
+    """Move a Limit upstream past row-count-preserving 1:1 operators
+    (Map, Project) — the streaming executor then transforms only rows that
+    survive the limit (reference: ``rules/limit_pushdown.py``). Filter,
+    FlatMap, and MapBatches may change row counts: the limit stops there."""
+
+    name = "LimitPushdown"
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        ops = list(plan.ops)
+        moved = True
+        while moved:
+            moved = False
+            for i in range(1, len(ops)):
+                if isinstance(ops[i], Limit) and isinstance(
+                    ops[i - 1], (MapRows, Project)
+                ):
+                    ops[i - 1], ops[i] = ops[i], ops[i - 1]
+                    moved = True
+        return LogicalPlan(ops)
+
+
+class ProjectionPushdown(Rule):
+    """A Project directly after a columnar Read becomes the reader's column
+    list — parquet then never decodes pruned columns (reference:
+    parquet projection pushdown via ``columns=``)."""
+
+    name = "ProjectionPushdown"
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        from ray_tpu.data.datasource import ParquetDatasource
+
+        ops = list(plan.ops)
+        for i in range(len(ops) - 1):
+            op, nxt = ops[i], ops[i + 1]
+            if (
+                isinstance(op, Read)
+                and isinstance(nxt, Project)
+                and isinstance(op.datasource, ParquetDatasource)
+                and "columns" not in op.datasource.reader_kwargs
+            ):
+                import copy as _copy
+
+                src = _copy.copy(op.datasource)
+                src.reader_kwargs = dict(src.reader_kwargs)
+                # partition fields come from paths, not parquet columns
+                part_fields = set()
+                if src.partitioning is not None and src.paths:
+                    part_fields = set(src.partitioning.parse(src.paths[0]))
+                file_cols = [c for c in nxt.cols if c not in part_fields]
+                if not file_cols:
+                    # projecting ONLY partition columns: a zero-column
+                    # parquet read normalizes to an empty block and would
+                    # silently drop every row — keep the full read
+                    continue
+                src.reader_kwargs["columns"] = file_cols
+                new_read = Read(src, op.parallelism)
+                # keep the Project: it orders/filters partition columns and
+                # is nearly free post-pushdown
+                ops[i] = new_read
+        return LogicalPlan(ops)
+
+
+# projection pushdown MUST run before limit pushdown: LimitPushdown swaps a
+# Limit in front of Project, which would break the Read->Project adjacency
+# the parquet column pruning matches on
+DEFAULT_RULES = (EliminateRedundantOps, ProjectionPushdown, LimitPushdown)
+
+
+def optimize(plan: LogicalPlan, rules=None) -> LogicalPlan:
+    """Apply optimizer rules (DataContext.optimizer_rules by default)."""
+    if rules is None:
+        from ray_tpu.data.context import DataContext
+
+        rules = DataContext.get_current().optimizer_rules
+    for rule in rules:
+        if isinstance(rule, type):
+            rule = rule()
+        plan = rule.apply(plan)
+    return plan

@@ -122,7 +122,10 @@ class FrameStack(ConnectorV2):
             raise ValueError(f"FrameStack expects [N, H, W, C], got {obs.shape}")
         self._c = obs.shape[-1]
         if self._stack is None or initial:
-            seeded = np.repeat(obs, self.k, axis=-1)
+            # frame-BLOCKED layout [f1|f2|..|fk] (np.tile), matching
+            # _shifted's drop-first-C/append-C — np.repeat would interleave
+            # per channel and scramble multi-channel stacks
+            seeded = np.tile(obs, (1, 1, 1, self.k))
             if update:  # a peek NEVER seeds state (pure by contract)
                 self._stack = seeded
             return seeded
@@ -130,7 +133,7 @@ class FrameStack(ConnectorV2):
         if update:
             if dones is not None and dones.any():
                 # ended envs: obs is the post-reset frame — re-seed
-                reseed = np.repeat(obs, self.k, axis=-1)
+                reseed = np.tile(obs, (1, 1, 1, self.k))
                 out = np.where(
                     dones.reshape(-1, *([1] * (obs.ndim - 1))), reseed, out
                 )

@@ -480,3 +480,148 @@ def test_actor_pool_chains_with_task_stage(ray_start_thread):
     assert sorted(r["x"] for r in ds.take_all()) == [
         (i + 1) * 2 - 1 for i in range(32)
     ]
+
+
+# ---------------------------------------------------------------------------
+# Path partitioning (hive/dir styles, planning-time pruning, partitioned
+# writes) + the pluggable logical-optimizer rule framework (reference:
+# datasource/partitioning.py, _internal/logical/rules/).
+# ---------------------------------------------------------------------------
+
+
+def test_hive_partitioned_write_read_roundtrip(ray_start_thread, tmp_path):
+    """write_parquet(partition_cols=...) lays out col=value/ dirs; reading
+    with Partitioning('hive') restores the partition columns from paths."""
+    ds = rd.from_items(
+        [{"year": 2023 + (i % 2), "v": i} for i in range(10)]
+    )
+    out = str(tmp_path / "pq")
+    ds.write_parquet(out, partition_cols=["year"])
+    assert sorted(os.listdir(out)) == ["year=2023", "year=2024"]
+
+    back = rd.read_parquet(out, partitioning=rd.Partitioning("hive"))
+    rows = back.take_all()
+    assert len(rows) == 10
+    assert {r["year"] for r in rows} == {"2023", "2024"}  # from the path
+    assert sorted(r["v"] for r in rows) == list(range(10))
+
+
+def test_partition_filter_prunes_before_read(ray_start_thread, tmp_path):
+    """partition_filter drops files at PLANNING time: only matching
+    partitions produce read tasks (pruning costs zero reads)."""
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(12)])
+    out = str(tmp_path / "pq")
+    ds.write_parquet(out, partition_cols=["k"])
+
+    from ray_tpu.data.datasource import ParquetDatasource
+
+    part = rd.Partitioning("hive")
+    src = ParquetDatasource(
+        out, partitioning=part, partition_filter=lambda f: f.get("k") == "1"
+    )
+    assert all("k=1" in p for p in src.paths)  # pruned at planning
+    back = rd.read_datasource(src)
+    rows = back.take_all()
+    assert sorted(r["v"] for r in rows) == [1, 4, 7, 10]
+
+
+def test_dir_partitioning_parse():
+    p = rd.Partitioning("dir", base_dir="/data", field_names=["year", "month"])
+    assert p.parse("/data/2024/07/f.csv") == {"year": "2024", "month": "07"}
+    assert rd.Partitioning("hive").parse("/x/a=1/b=two/f.pq") == {
+        "a": "1", "b": "two"
+    }
+
+
+def test_optimizer_rules_rewrite_plans(ray_start_thread):
+    """Rule framework: redundant-op elimination and limit pushdown rewrite
+    the logical plan; execution results are unchanged."""
+    from ray_tpu.data import logical as L
+
+    ds = (
+        rd.range(100)
+        .map(lambda r: {"id": r["id"] * 2})
+        .limit(30)
+        .limit(10)
+    )
+    plan = L.optimize(ds._plan)
+    names = [op.name for op in plan.ops]
+    # limits merged, then pushed before the 1:1 map
+    assert names.count("Limit") == 1
+    assert names.index("Limit") < names.index("Map")
+    assert next(op.n for op in plan.ops if isinstance(op, L.Limit)) == 10
+    assert sorted(r["id"] for r in ds.take_all()) == [i * 2 for i in range(10)]
+
+    # custom rules are pluggable via DataContext
+    class CountRule(rd.Rule):
+        calls = 0
+
+        def apply(self, plan):
+            CountRule.calls += 1
+            return plan
+
+    ctx = rd.DataContext.get_current()
+    old = ctx.optimizer_rules
+    try:
+        ctx.optimizer_rules = tuple(old) + (CountRule(),)
+        rd.range(5).take_all()
+        assert CountRule.calls == 1
+    finally:
+        ctx.optimizer_rules = old
+
+
+def test_projection_pushdown_into_parquet(ray_start_thread, tmp_path):
+    """select_columns directly after read_parquet becomes the reader's
+    column list — pruned columns are never decoded."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(
+        pa.table({"a": list(range(8)), "b": [1.5] * 8, "c": ["x"] * 8}), path
+    )
+    from ray_tpu.data import logical as L
+
+    ds = rd.read_parquet(path).select_columns(["a"])
+    plan = L.optimize(ds._plan)
+    read = plan.ops[0]
+    assert read.datasource.reader_kwargs.get("columns") == ["a"]
+    rows = ds.take_all()
+    assert sorted(rows[0].keys()) == ["a"]
+    assert [r["a"] for r in rows] == list(range(8))
+
+
+def test_projection_of_partition_columns_only(ray_start_thread, tmp_path):
+    """Selecting ONLY partition columns must not push an empty column list
+    into the reader (a zero-column parquet read would drop every row)."""
+    ds = rd.from_items([{"k": i % 2, "v": i} for i in range(6)])
+    out = str(tmp_path / "pq")
+    ds.write_parquet(out, partition_cols=["k"])
+    back = (
+        rd.read_parquet(out, partitioning=rd.Partitioning("hive"))
+        .select_columns(["k"])
+    )
+    rows = back.take_all()
+    assert len(rows) == 6
+    assert {r["k"] for r in rows} == {"0", "1"}
+
+
+def test_projection_pushdown_survives_limit(ray_start_thread, tmp_path):
+    """select_columns(...).limit(...) must still prune parquet columns —
+    rule ordering (projection before limit pushdown)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"a": list(range(8)), "b": ["x"] * 8}), path)
+    from ray_tpu.data import logical as L
+
+    ds = rd.read_parquet(path).select_columns(["a"]).limit(3)
+    plan = L.optimize(ds._plan)
+    assert plan.ops[0].datasource.reader_kwargs.get("columns") == ["a"]
+    assert [r["a"] for r in ds.take_all()] == [0, 1, 2]
+
+
+def test_select_missing_column_raises(ray_start_thread):
+    with pytest.raises(Exception, match="vlue|KeyError"):
+        rd.from_items([{"value": 1}]).select_columns(["vlue"]).take_all()
