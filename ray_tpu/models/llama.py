@@ -711,13 +711,19 @@ def init_lora_stack(cfg: LlamaConfig, n_adapters: int, rank: int):
 def _decode_forward(
     params, cache, tokens, positions, cfg: LlamaConfig, valid=None,
     loras=None, adapter_ids=None, with_logits: bool = True,
+    logits_at=None,
 ):
     """Shared prefill/decode body. tokens: [B, T]; positions: [B, T].
     New k/v are scattered into the cache before attention so new tokens
     attend to themselves and to all prior cache slots. ``valid`` [B, T]
     marks real (non-padding) tokens; padding writes are dropped so later
     decode steps never attend to stale slots. ``loras``/``adapter_ids``:
-    stacked LoRA adapters + per-sequence adapter index (0 = base)."""
+    stacked LoRA adapters + per-sequence adapter index (0 = base).
+    ``logits_at`` [B]: project the LM head at ONLY this position per
+    sequence (returns [B, 1, V]) — prefill needs one next-token
+    distribution, and the full [B, T, V] projection is the single biggest
+    prefill allocation (0.5 GB/seq at 7B/128k-vocab scale: the allocation
+    that kept 7B from fitting one v5e chip)."""
     B, T = tokens.shape
     S = cache["k"].shape[3]  # [L, B, K, S, D]
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -812,6 +818,10 @@ def _decode_forward(
         # LM head (the vocab projection reads ~0.8 GB of weights at 128k
         # vocab; chunked admission would pay it once per chunk otherwise)
         return None, new_cache
+    if logits_at is not None:
+        # gather the single requested hidden state per sequence BEFORE the
+        # vocab projection: [B, T, e] -> [B, 1, e]
+        x = jnp.take_along_axis(x, logits_at[:, None, None], axis=1)
     x = _rmsnorm(x, params["final_norm"], cfg.rms_eps, cfg.fused_rmsnorm)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.einsum(
@@ -843,12 +853,12 @@ def prefill(
     logits, cache = _decode_forward(
         params, cache, tokens, positions, cfg, valid,
         loras=loras, adapter_ids=adapter_ids, with_logits=with_logits,
+        logits_at=None if not with_logits else lengths - 1,
     )
     cache["length"] = start_pos + lengths
     if not with_logits:
         return None, cache
-    last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-    return last, cache
+    return logits[:, 0], cache
 
 
 def decode_step(
