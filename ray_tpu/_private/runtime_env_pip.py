@@ -133,6 +133,8 @@ def ensure_pip_env(spec: dict, base_dir: Optional[str] = None) -> str:
     is torn down so the next attempt starts clean."""
     import fcntl
 
+    from ray_tpu.exceptions import RuntimeEnvSetupError
+
     base = base_dir or _base_dir()
     key = env_key(spec)
     env_dir = os.path.join(base, key)
@@ -140,8 +142,16 @@ def ensure_pip_env(spec: dict, base_dir: Optional[str] = None) -> str:
     marker = os.path.join(env_dir, ".ready")
     if os.path.exists(marker):
         return python
-    os.makedirs(base, exist_ok=True)
-    with open(os.path.join(base, key + ".lock"), "w") as lockf:
+    try:
+        os.makedirs(base, exist_ok=True)
+        lockf = open(os.path.join(base, key + ".lock"), "w")
+    except OSError as e:
+        # unwritable env dir is a DETERMINISTIC setup failure — it must
+        # doom the pending tasks, not respawn the env forever
+        raise RuntimeEnvSetupError(
+            f"pip env base dir {base!r} is unusable: {e}"
+        ) from e
+    with lockf:
         fcntl.flock(lockf, fcntl.LOCK_EX)
         try:
             if os.path.exists(marker):
@@ -154,31 +164,49 @@ def ensure_pip_env(spec: dict, base_dir: Optional[str] = None) -> str:
             # is itself a venv/conda env (sys.prefix != base_prefix, true
             # in this image), it chains to the REAL system python — so also
             # bridge the parent's site dirs with a .pth file.
-            subprocess.run(
-                [sys.executable, "-m", "venv", "--system-site-packages", env_dir],
-                check=True,
-                capture_output=True,
-            )
-            import site
+            # deterministic venv/probe failures (unwritable env dir, broken
+            # venv module) must surface as RuntimeEnvSetupError: the
+            # controller only dooms pending tasks for that type — a raw
+            # CalledProcessError would make the scheduler respawn the
+            # doomed env forever.
+            try:
+                subprocess.run(
+                    [sys.executable, "-m", "venv", "--system-site-packages", env_dir],
+                    check=True,
+                    capture_output=True,
+                )
+                import site
 
-            parent_sites = [
-                p for p in site.getsitepackages() if os.path.isdir(p)
-            ]
-            r = subprocess.run(
-                [
-                    python, "-c",
-                    "import site, json;"
-                    "print(json.dumps(site.getsitepackages()))",
-                ],
-                capture_output=True,
-                text=True,
-                check=True,
-            )
-            venv_site = json.loads(r.stdout)[0]
-            with open(
-                os.path.join(venv_site, "_ray_tpu_parent_env.pth"), "w"
-            ) as f:
-                f.write("\n".join(parent_sites) + "\n")
+                parent_sites = [
+                    p for p in site.getsitepackages() if os.path.isdir(p)
+                ]
+                r = subprocess.run(
+                    [
+                        python, "-c",
+                        "import site, json;"
+                        "print(json.dumps(site.getsitepackages()))",
+                    ],
+                    capture_output=True,
+                    text=True,
+                    check=True,
+                )
+                venv_site = json.loads(r.stdout)[0]
+                with open(
+                    os.path.join(venv_site, "_ray_tpu_parent_env.pth"), "w"
+                ) as f:
+                    f.write("\n".join(parent_sites) + "\n")
+            except (
+                subprocess.CalledProcessError,
+                OSError,
+                json.JSONDecodeError,
+                IndexError,
+            ) as e:
+                stderr = getattr(e, "stderr", None)
+                shutil.rmtree(env_dir, ignore_errors=True)
+                raise RuntimeEnvSetupError(
+                    f"venv creation failed for {spec['packages']}: "
+                    f"{e}\n{(stderr or b'')!r}"
+                ) from e
             cmd = [
                 python, "-m", "pip", "install",
                 "--no-index",  # fully offline, always

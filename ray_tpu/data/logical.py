@@ -255,10 +255,22 @@ class ProjectionPushdown(Rule):
 
                 src = _copy.copy(op.datasource)
                 src.reader_kwargs = dict(src.reader_kwargs)
-                # partition fields come from paths, not parquet columns
+                # partition fields come from paths, not parquet columns.
+                # Union across ALL paths: in a heterogeneous layout a column
+                # can be a partition field for one file but a real parquet
+                # column in another — pruning from the first path alone
+                # would wrongly drop (or keep) it; if the layouts disagree,
+                # skip the pushdown entirely.
                 part_fields = set()
                 if src.partitioning is not None and src.paths:
-                    part_fields = set(src.partitioning.parse(src.paths[0]))
+                    per_path = [
+                        set(src.partitioning.parse(p)) for p in src.paths
+                    ]
+                    part_fields = set().union(*per_path)
+                    if any(s != per_path[0] for s in per_path[1:]):
+                        inconsistent = part_fields - set.intersection(*per_path)
+                        if inconsistent & set(nxt.cols):
+                            continue
                 file_cols = [c for c in nxt.cols if c not in part_fields]
                 if not file_cols:
                     # projecting ONLY partition columns: a zero-column

@@ -112,18 +112,22 @@ class GangLLMServer:
             opts["runtime_env"] = {"env_vars": dict(worker_env)}
         self.workers = []
         try:
-            self.workers = [
-                cls.options(
-                    num_cpus=bundles[i].get("CPU", 1),
-                    resources={k: v for k, v in bundles[i].items() if k != "CPU"},
-                    scheduling_strategy=PlacementGroupSchedulingStrategy(
-                        placement_group=self.pg, placement_group_bundle_index=i
-                    ),
-                    name=f"llm-gang-{llm_config.served_name}-{i}-{time.time_ns()}",
-                    **opts,
-                ).remote()
-                for i in range(num_workers)
-            ]
+            # append as each handle is created: if creation fails partway,
+            # the except-BaseException shutdown() below must see (and kill)
+            # every actor actually spawned — remove_placement_group only
+            # releases bundle resources, it does not reap actors on the pg.
+            for i in range(num_workers):
+                self.workers.append(
+                    cls.options(
+                        num_cpus=bundles[i].get("CPU", 1),
+                        resources={k: v for k, v in bundles[i].items() if k != "CPU"},
+                        scheduling_strategy=PlacementGroupSchedulingStrategy(
+                            placement_group=self.pg, placement_group_bundle_index=i
+                        ),
+                        name=f"llm-gang-{llm_config.served_name}-{i}-{time.time_ns()}",
+                        **opts,
+                    ).remote()
+                )
             coordinator = ray_tpu.get(
                 self.workers[0].reserve_coordinator.remote(), timeout=60
             )
