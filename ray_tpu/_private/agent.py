@@ -196,6 +196,49 @@ class NodeAgent:
         threading.Thread(
             target=self._pump_loop, daemon=True, name="agent-pump"
         ).start()
+        # Worker log capture: spawned workers write per-worker files under
+        # logs/; this monitor tails them and streams new lines to the head,
+        # which prefixes them onto the driver's console (the remote half of
+        # the reference's log_monitor.py).
+        self.log_dir = os.path.join(self.base_dir, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._log_offsets: dict[str, int] = {}
+        threading.Thread(
+            target=self._log_monitor_loop, daemon=True, name="agent-logmon"
+        ).start()
+
+    # ------------------------------------------------------------- log plane
+
+    def _log_monitor_loop(self):
+        while not self.shutting_down:
+            try:
+                self._log_monitor_scan()
+            except Exception:  # noqa: BLE001 — the monitor must never die
+                pass
+            time.sleep(0.2)
+
+    def _log_monitor_scan(self):
+        from ray_tpu._private.log_tail import scan_log_dir
+
+        def forward(wid_hex, source, lines):
+            try:
+                self._send(P.WorkerLogLines(wid_hex, source, lines))
+            except (OSError, EOFError):
+                pass
+
+        scan_log_dir(self.log_dir, self._log_offsets, forward)
+
+    def _handle_fetch_logs(self, msg: "P.FetchLogs"):
+        from ray_tpu._private.log_tail import tail_file
+
+        text = tail_file(
+            os.path.join(self.log_dir, f"worker-{msg.worker_id_hex}.{msg.source}"),
+            msg.tail_bytes,
+        )
+        try:
+            self._send(P.LogsReply(msg.req_id, text))
+        except (OSError, EOFError):
+            pass
 
     # ------------------------------------------------------------- transport
 
@@ -339,6 +382,10 @@ class NodeAgent:
             ).start()
         elif isinstance(msg, P.LeaseTask):
             self._on_lease_task(msg)
+        elif isinstance(msg, P.FetchLogs):
+            threading.Thread(
+                target=self._handle_fetch_logs, args=(msg,), daemon=True
+            ).start()
         elif isinstance(msg, P.KillWorker):
             with self.workers_lock:
                 w = self.workers.get(msg.worker_id)
@@ -553,6 +600,9 @@ class NodeAgent:
         env["RAY_TPU_WORKER"] = "1"
         env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
         env["RAY_TPU_ARENA"] = self.arena_name
+        # workers advertise direct actor-call listeners at this host's
+        # routable IP so cross-host callers can push calls peer-to-peer
+        env["RAY_TPU_NODE_IP"] = self.node_ip
         pkg_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
@@ -599,6 +649,18 @@ class NodeAgent:
                     P.WorkerDied(msg.worker_id, f"pip env failed: {e}")
                 )
                 return
+        # per-worker log capture (tailed to the head by the log monitor)
+        env["PYTHONUNBUFFERED"] = "1"
+        out_path = os.path.join(self.log_dir, f"worker-{msg.worker_id.hex()}.out")
+        err_path = os.path.join(self.log_dir, f"worker-{msg.worker_id.hex()}.err")
+        stdout = stderr = None
+        try:
+            stdout = open(out_path, "ab", buffering=0)
+            stderr = open(err_path, "ab", buffering=0)
+        except OSError:
+            if stdout is not None:
+                stdout.close()
+            stdout = stderr = None
         try:
             proc = subprocess.Popen(
                 [
@@ -610,11 +672,17 @@ class NodeAgent:
                 ],
                 env=env,
                 cwd=cwd,
+                stdout=stdout,
+                stderr=stderr,
             )
         except OSError as e:
             self._on_local_worker_death(msg.worker_id)
             self._send(P.WorkerDied(msg.worker_id, f"spawn failed: {e}"))
             return
+        finally:
+            for fh in (stdout, stderr):
+                if fh is not None:
+                    fh.close()
         with self.workers_lock:
             self.workers[msg.worker_id] = {
                 "conn": None,

@@ -132,6 +132,9 @@ class WorkerHandle:
         # head tracks identity only (never pools or dispatches onto it)
         self.agent_owned = False
         self.is_driver = False  # client drivers are never scheduling targets
+        # "host:port" of the worker's direct actor-call listener (callers
+        # push actor calls here, bypassing the head entirely)
+        self.direct_address: Optional[str] = None
         # refs this client driver holds — released if it detaches uncleanly
         self.held_refs: set = set()
         # set for workers on agent-backed remote nodes
@@ -448,6 +451,39 @@ class Controller:
 
         # Observability: task events ring buffer.
         self.task_events: deque[dict] = deque(maxlen=config.event_buffer_size)
+        # Worker log capture (reference: the per-session log dir layout in
+        # _private/node.py + log_monitor.py tailing worker files to the
+        # driver). Every spawned worker's stdout/stderr is redirected to
+        # per-worker files here; a monitor thread tails new lines to the
+        # driver console, a ring buffer feeds the state API, and the files
+        # outlive their workers (dead-worker log fetch).
+        self.session_log_dir = os.path.join(
+            os.path.dirname(self._session_file_path()),
+            f"session_{os.getpid()}",
+            "logs",
+        )
+        self._log_buffer: deque[dict] = deque(maxlen=20000)
+        self._log_offsets: dict[str, int] = {}
+        # worker_hex -> {"pid", "ip", "label"} — survives worker death
+        self._log_meta: dict[str, dict] = {}
+        self._log_waiters: dict[int, tuple] = {}
+        self._log_req_counter = itertools.count(1)
+        self._log_to_driver = (
+            os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0"
+        )
+        if mode == "process":
+            try:
+                os.makedirs(self.session_log_dir, exist_ok=True)
+            except OSError:
+                self.session_log_dir = None
+            t = threading.Thread(
+                target=self._log_monitor_loop, daemon=True, name="ctrl-logmon"
+            )
+            t.start()
+        # messages received from worker/driver/agent connections — the
+        # direct actor transport's "head sees nothing" property is asserted
+        # against this in tests
+        self.worker_msg_count = 0
         # spilling: plasma-resident objects in seal order (LRU-ish) + the
         # on-disk spill directory (reference: external_storage.py
         # FileSystemStorage at :271)
@@ -574,6 +610,148 @@ class Controller:
                     os.unlink(path)
         except (OSError, ValueError):
             pass
+
+    # ------------------------------------------------------ worker log plane
+
+    def _log_monitor_loop(self):
+        """Tail every per-worker log file in the session dir; stream new
+        lines to the driver console + the state-API ring buffer (reference:
+        ``python/ray/_private/log_monitor.py``)."""
+        while not self.shutting_down:
+            try:
+                self._log_monitor_scan()
+            except Exception:  # noqa: BLE001 — the monitor must never die
+                pass
+            time.sleep(0.2)
+
+    def _log_monitor_scan(self):
+        if not self.session_log_dir:
+            return
+        from ray_tpu._private.log_tail import scan_log_dir
+
+        scan_log_dir(self.session_log_dir, self._log_offsets, self._emit_worker_lines)
+
+    def _emit_worker_lines(self, wid_hex: str, source: str, lines: list):
+        """One captured batch: ring-buffer it, prefix-print it to the driver
+        (reference: the ``(pid=..., ip=...)`` line prefixes the driver sees)."""
+        meta = self._log_meta.get(wid_hex, {})
+        label = meta.get("label") or f"worker={wid_hex[:8]}"
+        pid = meta.get("pid", "?")
+        ip = meta.get("ip", "local")
+        now = time.time()
+        for line in lines:
+            self._log_buffer.append(
+                {
+                    "worker_id": wid_hex,
+                    "source": source,
+                    "line": line,
+                    "t": now,
+                }
+            )
+        if self._log_to_driver:
+            stream = sys.stderr if source == "err" else sys.stdout
+            prefix = f"({label} pid={pid}, ip={ip})"
+            try:
+                for line in lines:
+                    stream.write(f"{prefix} {line}\n")
+                stream.flush()
+            except (OSError, ValueError):
+                pass
+        # client drivers attached over ray:// see the same stream by
+        # subscribing to this channel (reference: the GCS log pubsub the
+        # client's log streamer rides)
+        try:
+            self.publish(
+                "worker_logs",
+                {"worker_id": wid_hex, "source": source, "lines": list(lines),
+                 "pid": pid, "ip": ip, "label": label},
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _worker_log_paths(self, worker_id: WorkerID):
+        """(out, err) file paths for a worker spawned on the head node, or
+        None when capture is disabled."""
+        if not self.session_log_dir:
+            return None
+        hexid = worker_id.hex()
+        return (
+            os.path.join(self.session_log_dir, f"worker-{hexid}.out"),
+            os.path.join(self.session_log_dir, f"worker-{hexid}.err"),
+        )
+
+    def _register_log_meta(
+        self, worker_id: WorkerID, pid=None, ip="local", label=None, agent_node=None
+    ):
+        entry = self._log_meta.setdefault(worker_id.hex(), {})
+        if pid is not None:
+            entry["pid"] = pid
+        entry["ip"] = ip
+        if label:
+            entry["label"] = label
+        if agent_node is not None:
+            entry["agent_node"] = agent_node
+
+    def _log_fetch(self, prefix: str, source: str = "out", tail_bytes: int = 65536):
+        """Read a worker's captured output by worker-id hex prefix — works
+        for DEAD workers too (files outlive processes). Agent-hosted workers
+        are fetched over the agent control channel."""
+        matches = [h for h in self._log_meta if h.startswith(prefix)]
+        if not matches:
+            raise ValueError(f"no worker with id prefix {prefix!r}")
+        if len(matches) > 1:
+            raise ValueError(f"ambiguous worker prefix {prefix!r}: {matches}")
+        wid_hex = matches[0]
+        meta = self._log_meta[wid_hex]
+        agent_node = meta.get("agent_node")
+        if agent_node is not None:
+            with self.lock:
+                agent = self.agents.get(agent_node)
+            if agent is None:
+                raise ValueError(f"worker {wid_hex[:8]}'s node has left the cluster")
+            req_id = next(self._log_req_counter)
+            ev = threading.Event()
+            out: list = []
+            self._log_waiters[req_id] = (ev, out)
+            agent.send(P.FetchLogs(req_id, wid_hex, source, tail_bytes))
+            try:
+                if not ev.wait(timeout=10.0):
+                    raise TimeoutError("agent log fetch timed out")
+            finally:
+                self._log_waiters.pop(req_id, None)
+            return out[0]
+        if not self.session_log_dir:
+            return ""
+        from ray_tpu._private.log_tail import tail_file
+
+        return tail_file(
+            os.path.join(self.session_log_dir, f"worker-{wid_hex}.{source}"),
+            tail_bytes,
+        )
+
+    def _log_list(self):
+        out = []
+        for wid_hex, meta in self._log_meta.items():
+            sizes = {}
+            if meta.get("agent_node") is None and self.session_log_dir:
+                for source in ("out", "err"):
+                    p = os.path.join(
+                        self.session_log_dir, f"worker-{wid_hex}.{source}"
+                    )
+                    try:
+                        sizes[source] = os.path.getsize(p)
+                    except OSError:
+                        sizes[source] = 0
+            out.append(
+                {
+                    "worker_id": wid_hex,
+                    "pid": meta.get("pid"),
+                    "ip": meta.get("ip", "local"),
+                    "label": meta.get("label"),
+                    **{f"{k}_bytes": v for k, v in sizes.items()},
+                }
+            )
+        return out
 
     def _persist_kv(self):
         """Mark controller state dirty; a background flusher writes the
@@ -2020,13 +2198,36 @@ class Controller:
 
         pip_spec = normalize_pip_spec(spec_hint.runtime_env or {})
         python_exe = ensure_pip_env(pip_spec) if pip_spec else sys.executable
-        proc = subprocess.Popen(
-            [python_exe, "-m", "ray_tpu._private.worker_main", self.address, worker_id.hex()],
-            env=env,
-            cwd=working_dir or None,
-            stdout=None,
-            stderr=None,
-        )
+        # capture stdout/stderr to per-worker session files; a `print`
+        # inside a task streams to the driver via the log monitor and stays
+        # fetchable after the worker dies (reference: log_monitor.py)
+        stdout = stderr = None
+        log_paths = self._worker_log_paths(worker_id)
+        if log_paths is not None:
+            env["PYTHONUNBUFFERED"] = "1"  # lines must reach the file promptly
+            try:
+                stdout = open(log_paths[0], "ab", buffering=0)
+                stderr = open(log_paths[1], "ab", buffering=0)
+            except OSError:
+                # degrade to no-capture (deleted session dir, fd limit) —
+                # the worker must still spawn
+                if stdout is not None:
+                    stdout.close()
+                stdout = stderr = None
+        try:
+            proc = subprocess.Popen(
+                [python_exe, "-m", "ray_tpu._private.worker_main", self.address, worker_id.hex()],
+                env=env,
+                cwd=working_dir or None,
+                stdout=stdout,
+                stderr=stderr,
+            )
+        finally:
+            # the child holds the fds now; ours would leak one pair per worker
+            for fh in (stdout, stderr):
+                if fh is not None:
+                    fh.close()
+        self._register_log_meta(worker_id, pid=proc.pid, label=None)
         handle = WorkerHandle(worker_id, node_id, proc=proc)
         handle.fingerprint = self._env_fingerprint(spec_hint)
         with self.lock:
@@ -2071,6 +2272,8 @@ class Controller:
         )
         handle.agent = agent
         handle.fingerprint = self._env_fingerprint(spec_hint)
+        ip = (agent.data_address or "remote").rpartition(":")[0] or "remote"
+        self._register_log_meta(worker_id, ip=ip, agent_node=node_id)
         with self.lock:
             self.workers[worker_id] = handle
         agent.send(
@@ -2227,6 +2430,7 @@ class Controller:
                 conn.close()
                 return
             handle.conn = conn
+            handle.direct_address = getattr(msg, "direct_address", None)
             handle.registered.set()
         self._worker_reader(handle)
 
@@ -2294,6 +2498,7 @@ class Controller:
                 msg = conn.recv()
             except (EOFError, OSError):
                 break
+            self.worker_msg_count += 1
             if isinstance(msg, P.FromWorker):
                 with self.lock:
                     handle = self.workers.get(msg.worker_id)
@@ -2336,6 +2541,23 @@ class Controller:
                             handle.fingerprint,
                             RuntimeEnvSetupError(msg.reason),
                         )
+            elif isinstance(msg, P.WorkerLogLines):
+                # agent-owned pool workers are spawned without head
+                # involvement — their first captured lines register them in
+                # the log table so list/fetch can find them
+                meta = self._log_meta.setdefault(msg.worker_id_hex, {})
+                meta.setdefault(
+                    "ip",
+                    (agent.data_address or "remote").rpartition(":")[0]
+                    or "remote",
+                )
+                meta.setdefault("agent_node", agent.node_id)
+                self._emit_worker_lines(msg.worker_id_hex, msg.source, msg.lines)
+            elif isinstance(msg, P.LogsReply):
+                waiter = self._log_waiters.get(msg.req_id)
+                if waiter is not None:
+                    waiter[1].append(msg.text)
+                    waiter[0].set()
             elif isinstance(msg, P.Request):
                 # the agent's own control RPCs. object_owner/pull can block
                 # on a not-yet-sealed entry whose seal arrives on THIS
@@ -2379,6 +2601,7 @@ class Controller:
                 msg = conn.recv()
             except (EOFError, OSError):
                 break
+            self.worker_msg_count += 1
             self._route_worker_msg(handle, msg)
         if handle.is_driver:
             with self.lock:
@@ -2400,6 +2623,7 @@ class Controller:
         """Dispatch one worker-originated message (shared between direct
         connections and agent-relayed envelopes)."""
         if isinstance(msg, P.RegisterWorker):
+            handle.direct_address = getattr(msg, "direct_address", None)
             handle.registered.set()
         elif isinstance(msg, P.TaskDone):
             self._on_task_done(handle, msg)
@@ -2497,6 +2721,40 @@ class Controller:
             for oid in payload:
                 self.add_ref(oid)
             return None
+        if op == "actor_direct_endpoint":
+            # direct actor-call transport: resolve the actor's worker
+            # endpoint ONCE per caller (cached caller-side; invalidated when
+            # the connection breaks). Reference: ActorTaskSubmitter resolves
+            # the actor's rpc address from the GCS actor table, then pushes
+            # calls peer-to-peer (actor_task_submitter.h).
+            with self.lock:
+                actor = self.actors.get(payload)
+                if (
+                    actor is not None
+                    and actor.state == "ALIVE"
+                    and actor.worker is not None
+                    and not actor.worker.dead
+                    and actor.worker.direct_address
+                ):
+                    return ("ALIVE", actor.worker.direct_address)
+                return (actor.state if actor is not None else "UNKNOWN", None)
+        if op == "debug_worker_msg_count":
+            return self.worker_msg_count
+        if op == "tasks_pending":
+            # liveness of specific task ids (direct transport's head-queue
+            # drain check — cross-path per-caller ordering)
+            with self.lock:
+                return [tid in self.pending_by_id for tid in payload]
+        if op == "log_get":
+            prefix, source, tail_bytes = payload
+            return self._log_fetch(prefix, source, tail_bytes)
+        if op == "log_list":
+            return self._log_list()
+        if op == "log_tail_buffer":
+            # most recent captured lines across all workers (state API /
+            # dashboard "logs" source)
+            n = int(payload or 1000)
+            return list(self._log_buffer)[-n:]
         if op == "wait":
             object_ids, num_returns, timeout = payload
             return self.memory_store.wait(object_ids, num_returns, timeout)
@@ -3119,6 +3377,11 @@ class Controller:
                         self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "ALIVE"})
                         actor.held = (getattr(pt, "_node", None), getattr(pt, "_pg_bundle", None), dict(spec.resources))
                         worker.actor_id = actor.actor_id
+                        # actor workers' log lines carry the class label
+                        self._register_log_meta(
+                            worker.worker_id,
+                            label=(spec.name or "").rsplit(".", 1)[0] or None,
+                        )
                         # dedicated to the actor now — no longer a pooled worker
                         self._uncount_pooled(worker)
                         self._pump_actor(actor)
@@ -3198,6 +3461,7 @@ class Controller:
                 pool.remove(worker)
             running = list(worker.running.values())
             worker.running.clear()
+        requeue: list[PendingTask] = []
         for pt in running:
             with self.lock:
                 self._release_task_resources(pt)
@@ -3206,7 +3470,22 @@ class Controller:
                     actor = self.actors.get(pt.spec.actor_id)
                     if actor is not None:
                         actor.inflight = max(0, actor.inflight - 1)
-                self._fail_task(pt, ActorDiedError(pt.spec.actor_id.hex(), reason))
+                    retriable = (
+                        pt.retries_left > 0
+                        and actor is not None
+                        and actor.state != "DEAD"
+                        and actor.restarts_left != 0
+                    )
+                if retriable:
+                    # max_retries on an actor method survives the worker's
+                    # death: re-queue ahead of everything and run after the
+                    # actor restarts (reference: max_task_retries,
+                    # task_manager.cc actor-task resubmit)
+                    pt.retries_left -= 1
+                    pt.worker = None
+                    requeue.append(pt)
+                else:
+                    self._fail_task(pt, ActorDiedError(pt.spec.actor_id.hex(), reason))
             elif pt.retries_left > 0:
                 pt.retries_left -= 1
                 pt.worker = None
@@ -3220,6 +3499,14 @@ class Controller:
                     self.sched_cv.notify_all()
             else:
                 self._fail_task(pt, WorkerCrashedError(f"worker died: {reason}"))
+        if requeue:
+            with self.lock:
+                # reversed appendleft restores dispatch order at the front
+                for pt in reversed(requeue):
+                    actor = self.actors.get(pt.spec.actor_id)
+                    if actor is not None:
+                        actor.queue.appendleft(pt)
+                self.sched_cv.notify_all()
         if worker.actor_id is not None:
             self._on_actor_worker_death(worker.actor_id, reason)
 

@@ -1,0 +1,483 @@
+"""Caller-side direct actor-call transport.
+
+The head is NOT on the actor data path: the caller resolves the actor's
+worker endpoint once (one controller query, cached; invalidated when the
+connection to that worker breaks), then pushes calls straight to the actor's
+worker over an authenticated socket and receives results on the same
+connection. Reference: ``ActorTaskSubmitter`` pushing tasks worker-to-worker
+over gRPC with no raylet/GCS hop
+(``src/ray/core_worker/transport/actor_task_submitter.h``; direct ``PushTask``
+at ``normal_task_submitter.cc:554``).
+
+Ownership: direct-call results are CALLER-owned — they live in this process's
+result table, never in the head's store. When such a ref escapes (passed as a
+task arg or serialized), it is *promoted*: sealed into the head's store so any
+process can resolve it; until then, ``get``/``wait`` on it are local and free.
+
+Fallback ladder (every rung preserves exactly the head-mediated semantics):
+- endpoint unknown / actor restarting / dial fails  → submit via the head
+- spec not direct-eligible (streaming, multi-return,
+  retry_exceptions)                                 → submit via the head
+- connection breaks with calls in flight            → max_retries != 0:
+  resubmit via the head (it queues across the restart window);
+  max_retries == 0: the call fails with ActorDiedError (reference actor
+  task-loss semantics).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Optional
+
+from ray_tpu._private import protocol as P
+from ray_tpu._private.serialization import SerializedObject
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu.exceptions import ActorDiedError, GetTimeoutError, TaskError
+
+_NEG_TTL = 0.25  # s between endpoint re-queries while an actor has no address
+
+
+class _DirectConn:
+    """One pooled connection to an actor worker's direct listener."""
+
+    def __init__(self, address: str, conn, transport: "DirectActorTransport"):
+        self.address = address
+        self.conn = conn
+        self.transport = transport
+        self.send_lock = threading.Lock()
+        # req_id -> (spec, oid_binary) for conn-failure handling
+        self.inflight: dict[int, tuple] = {}
+        self.alive = True
+        self.reader = threading.Thread(
+            target=self._read_loop, daemon=True, name=f"direct-client-{address}"
+        )
+        self.reader.start()
+
+    def send_call(self, req_id: int, spec: TaskSpec, resolved_args: list):
+        with self.send_lock:
+            if not self.alive:
+                raise OSError("direct connection closed")
+            self.conn.send(P.DirectActorCall(req_id, spec, resolved_args))
+
+    def _read_loop(self):
+        t = self.transport
+        while True:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                break
+            except (TypeError, ValueError):
+                # Connection.recv on a handle another thread just close()d
+                # dies with TypeError (handle is None) — a normal shutdown
+                # race, same as EOF
+                break
+            if isinstance(msg, P.DirectCallReply):
+                entry = self.inflight.pop(msg.req_id, None)
+                if entry is None:
+                    continue
+                spec, oid_bin = entry
+                if msg.results == "stale":
+                    # callee no longer hosts the actor: re-resolve + reroute
+                    t._reroute(spec, oid_bin, stale_address=self.address)
+                    continue
+                t._complete(oid_bin, msg.results)
+        self.alive = False
+        t._on_conn_lost(self)
+
+
+class DirectActorTransport:
+    """Per-process transport shared by every actor handle of one WorkerAPI."""
+
+    def __init__(self, api, authkey: bytes):
+        self.api = api
+        self.authkey = authkey
+        self.cv = threading.Condition()
+        # oid binary -> ("pending",) | ("done", kind, payload_bytes)
+        #             | ("fallback",) | ("promoted", kind, payload_bytes)
+        self.table: dict[bytes, tuple] = {}
+        self._conns: dict[str, _DirectConn] = {}
+        self._conn_lock = threading.Lock()
+        # actor_id binary -> (address | None, recheck_after_monotonic)
+        self._endpoints: dict[bytes, tuple] = {}
+        # actor_id binary -> set of head-submitted TaskIDs still possibly
+        # queued there. While non-empty, this caller's calls to that actor
+        # stay on the head path — a direct call must not overtake a
+        # head-queued one (per-caller submission order, reference:
+        # sequence_number ordering in actor_task_submitter.h)
+        self._head_pending: dict[bytes, set] = {}
+        self._req = itertools.count(1)
+        # fast-path flag: get()/wait() skip the table entirely until the
+        # first direct submission happens
+        self.active = False
+
+    # --------------------------------------------------------------- submit
+
+    def try_submit(self, spec: TaskSpec) -> bool:
+        """Push ``spec`` directly to its actor's worker. False = caller must
+        use the head-mediated path (this method has then done nothing)."""
+        if (
+            spec.num_returns != 1
+            or spec.generator_backpressure
+            or spec.retry_exceptions
+        ):
+            return False
+        if not self._head_queue_drained(spec.actor_id.binary()):
+            return False  # stay ordered behind earlier head-path calls
+        resolved = self._resolve_args(spec)
+        if resolved is None:
+            return False
+        address = self._endpoint(spec.actor_id.binary())
+        if address is None:
+            return False
+        conn = self._get_conn(address)
+        if conn is None:
+            return False
+        oid_bin = spec.return_ids()[0].binary()
+        req_id = next(self._req)
+        with self.cv:
+            # ("pending", actor_bin, promote_on_done)
+            self.table[oid_bin] = ("pending", spec.actor_id.binary(), False)
+            self.active = True
+        conn.inflight[req_id] = (spec, oid_bin)
+        try:
+            conn.send_call(req_id, spec, resolved)
+        except (OSError, EOFError, ValueError):
+            self._drop_conn(conn)
+            self._invalidate_address(address)
+            # ownership of the in-flight entry is the atomic pop: if the
+            # reader's conn-lost handler popped it first, it has already
+            # rerouted/failed this call — returning False here would make
+            # the caller submit the SAME spec a second time
+            if conn.inflight.pop(req_id, None) is None:
+                return True
+            with self.cv:
+                self.table.pop(oid_bin, None)
+            return False
+        return True
+
+    def _resolve_args(self, spec: TaskSpec) -> Optional[list]:
+        """Caller-side dependency resolution. Returns ExecuteTask-shaped
+        resolved_args, or None when a ref arg lives in the head's store (the
+        head then does the dep-waiting it already knows how to do)."""
+        resolved = [("value", spec.args[0][1])]
+        for kind, entry in spec.args[1:]:
+            if kind != "ref":
+                continue
+            st = self.table.get(entry.binary())
+            if st is None:
+                return None  # head-owned dep — fall back
+            if st[0] == "fallback":
+                return None
+            if st[0] == "pending":
+                # an earlier direct call's result, still in flight — wait
+                # briefly (chained fast calls resolve in ms); a slow
+                # producer falls back to the head, whose dep-waiting is
+                # asynchronous (the dep is promoted when it lands — see
+                # promote's deferred path), so .remote() never blocks long
+                try:
+                    st = self.wait_local(entry.binary(), timeout=5.0)
+                except GetTimeoutError:
+                    return None
+                if st[0] in ("fallback", "pending"):
+                    return None
+            resolved.append((st[1], st[2]))
+        return resolved
+
+    def wait_direct_drained(self, actor_bin: bytes, timeout: float = 300.0) -> bool:
+        """Block until no direct call to ``actor_bin`` is in flight — a
+        head-mediated submission must not overtake direct calls already on
+        the wire (the direct→head half of cross-path per-caller ordering;
+        the head→direct half is _head_queue_drained). Best effort: returns
+        False on timeout and the caller proceeds."""
+        deadline = time.monotonic() + timeout
+        with self.cv:
+            while self._direct_inflight_for(actor_bin) > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self.cv.wait(timeout=min(remaining, 1.0))
+        return True
+
+    def _direct_inflight_for(self, actor_bin: bytes) -> int:
+        with self._conn_lock:
+            conns = list(self._conns.values())
+        n = 0
+        for c in conns:
+            for spec, _ in list(c.inflight.values()):
+                if (
+                    spec.actor_id is not None
+                    and spec.actor_id.binary() == actor_bin
+                ):
+                    n += 1
+        return n
+
+    def note_head_submit(self, spec: TaskSpec):
+        """Record a head-mediated submission to an actor: later direct
+        calls must wait for the head's queue to drain (cross-path order)."""
+        if spec.actor_id is None:
+            return
+        self._head_pending.setdefault(spec.actor_id.binary(), set()).add(
+            spec.task_id
+        )
+
+    def _head_queue_drained(self, actor_bin: bytes) -> bool:
+        pending = self._head_pending.get(actor_bin)
+        if not pending:
+            return True
+        snapshot = list(pending)
+        try:
+            alive = self.api.controller_call("tasks_pending", snapshot)
+        except Exception:  # noqa: BLE001 — control-plane hiccup: stay on head
+            return False
+        for tid, is_pending in zip(snapshot, alive):
+            if not is_pending:
+                pending.discard(tid)
+        if pending:
+            return False
+        self._head_pending.pop(actor_bin, None)
+        return True
+
+    # ------------------------------------------------------------ endpoints
+
+    def _endpoint(self, actor_bin: bytes) -> Optional[str]:
+        now = time.monotonic()
+        cached = self._endpoints.get(actor_bin)
+        if cached is not None:
+            address, recheck = cached
+            if address is not None or now < recheck:
+                return address
+        try:
+            from ray_tpu._private.ids import ActorID
+
+            state, address = self.api.controller_call(
+                "actor_direct_endpoint", ActorID(actor_bin)
+            )
+        except Exception:  # noqa: BLE001 — any control-plane hiccup → fallback
+            state, address = "UNKNOWN", None
+        self._endpoints[actor_bin] = (address, now + _NEG_TTL)
+        return address
+
+    def _invalidate_address(self, address: str):
+        for actor_bin, (addr, _) in list(self._endpoints.items()):
+            if addr == address:
+                self._endpoints[actor_bin] = (None, 0.0)  # re-query next call
+
+    def _get_conn(self, address: str) -> Optional[_DirectConn]:
+        with self._conn_lock:
+            conn = self._conns.get(address)
+            if conn is not None and conn.alive:
+                return conn
+            try:
+                from multiprocessing.connection import Client
+
+                host, _, port = address.rpartition(":")
+                raw = Client((host, int(port)), authkey=self.authkey)
+            except (OSError, EOFError, ConnectionError, ValueError):
+                self._invalidate_address(address)
+                return None
+            conn = _DirectConn(address, raw, self)
+            self._conns[address] = conn
+            return conn
+
+    def _drop_conn(self, conn: _DirectConn):
+        with self._conn_lock:
+            if self._conns.get(conn.address) is conn:
+                del self._conns[conn.address]
+        try:
+            conn.conn.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- completion
+
+    def _complete(self, oid_bin: bytes, results: list):
+        _, kind, payload = results[0]
+        self._settle(oid_bin, kind, payload)
+
+    def _settle(self, oid_bin: bytes, kind: str, payload):
+        """Transition pending → done, honoring a deferred promotion: if the
+        ref escaped while the call was in flight, seal the result into the
+        head store now (head-side dependents are blocked on it)."""
+        promote_after = False
+        with self.cv:
+            old = self.table.get(oid_bin)
+            if old is not None:  # may have been released already
+                promote_after = old[0] == "pending" and len(old) > 2 and old[2]
+                self.table[oid_bin] = ("done", kind, payload)
+            self.cv.notify_all()
+        if promote_after:
+            from ray_tpu._private.ids import ObjectID
+
+            try:
+                self.api._put_entry(ObjectID(oid_bin), kind, payload)
+                with self.cv:
+                    if self.table.get(oid_bin, ("?",))[0] == "done":
+                        self.table[oid_bin] = ("promoted", kind, payload)
+            except Exception:  # noqa: BLE001 — head gone; local copy stands
+                pass
+
+    def _reroute(self, spec: TaskSpec, oid_bin: bytes, stale_address: str):
+        """Resubmit through the head (restart window / stale endpoint)."""
+        self._invalidate_address(stale_address)
+        with self.cv:
+            self.table[oid_bin] = ("fallback",)
+            self.cv.notify_all()
+        try:
+            # the head must be able to resolve the spec's ref args — any
+            # caller-owned ones have to be sealed into its store first
+            for kind, entry in spec.args[1:]:
+                if kind == "ref":
+                    self.promote(entry.binary())
+            self.api.add_refs(spec.return_ids())
+            self.note_head_submit(spec)
+            self.api._submit(spec)
+        except Exception as e:  # noqa: BLE001 — surface as the call's result
+            self._fail_local(spec, oid_bin, e)
+
+    def _fail_local(self, spec: TaskSpec, oid_bin: bytes, cause: Exception):
+        err = cause if isinstance(cause, TaskError) else TaskError(spec.name, cause)
+        payload = self.api.serialization.serialize(err).to_bytes()
+        self._settle(oid_bin, "error", payload)
+
+    def _on_conn_lost(self, conn: _DirectConn):
+        """The actor's worker (or the path to it) died. In-flight calls:
+        retriable ones reroute through the head — it holds them across the
+        restart window; non-retriable ones fail with ActorDiedError
+        (reference: actor task failure on worker death, task_manager.cc)."""
+        self._drop_conn(conn)
+        self._invalidate_address(conn.address)
+        # atomic per-entry pops: entries claimed by try_submit's send-failure
+        # path are skipped (exactly one side handles each call)
+        inflight = []
+        for req_id in list(conn.inflight.keys()):
+            entry = conn.inflight.pop(req_id, None)
+            if entry is not None:
+                inflight.append(entry)
+        for spec, oid_bin in inflight:
+            if spec.max_retries != 0:
+                self._reroute(spec, oid_bin, stale_address=conn.address)
+            else:
+                self._fail_local(
+                    spec,
+                    oid_bin,
+                    ActorDiedError(
+                        spec.actor_id.hex(),
+                        "worker connection lost during direct call",
+                    ),
+                )
+
+    # ----------------------------------------------------------- caller API
+
+    def manages(self, oid_bin: bytes) -> bool:
+        return oid_bin in self.table
+
+    def state(self, oid_bin: bytes) -> Optional[str]:
+        st = self.table.get(oid_bin)
+        return None if st is None else st[0]
+
+    def wait_local(self, oid_bin: bytes, timeout: Optional[float]) -> tuple:
+        """Block until the entry is terminal; returns the table entry.
+        ("fallback",) means the caller must resolve through the head."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.cv:
+            while True:
+                st = self.table.get(oid_bin)
+                if st is None:
+                    return ("fallback",)  # released/promoted-and-dropped
+                if st[0] != "pending":
+                    return st
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError("direct actor call timed out")
+                self.cv.wait(timeout=remaining if remaining is not None else 1.0)
+
+    def ready_now(self, oid_bins: list[bytes]) -> set[bytes]:
+        with self.cv:
+            return {
+                o
+                for o in oid_bins
+                if self.table.get(o, ("?",))[0] in ("done", "promoted")
+            }
+
+    def wait_ready(
+        self, oid_bins: list[bytes], count: int, timeout: Optional[float]
+    ) -> set[bytes]:
+        """ray.wait over direct-managed ids: ready = done/promoted. Also
+        returns (possibly short) when enough entries reach ANY terminal
+        state — a "fallback" transition means the id is now head-resident
+        and the caller must re-partition, not sleep here forever."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.cv:
+            while True:
+                ready, terminal = set(), 0
+                for o in oid_bins:
+                    st = self.table.get(o, ("?",))[0]
+                    if st in ("done", "promoted"):
+                        ready.add(o)
+                        terminal += 1
+                    elif st == "fallback":
+                        terminal += 1
+                if len(ready) >= count or terminal >= count:
+                    return ready
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return ready
+                self.cv.wait(timeout=remaining if remaining is not None else 1.0)
+
+    def promote(self, oid_bin: bytes) -> bool:
+        """Seal a caller-owned result into the head's store so other
+        processes can resolve it (the ref is escaping this process). A
+        still-pending result is promoted ASYNCHRONOUSLY — the head pin is
+        taken now and the seal happens when the reply lands (_settle), so
+        escaping an in-flight ref never blocks the escaping submit. Safe to
+        call for non-managed ids (returns False). Idempotent."""
+        from ray_tpu._private.ids import ObjectID
+
+        with self.cv:
+            st = self.table.get(oid_bin)
+            if st is None:
+                return False
+            if st[0] == "fallback":
+                return False  # already head-owned
+            if st[0] == "promoted":
+                return True
+            if st[0] == "pending":
+                if not st[2]:
+                    self.table[oid_bin] = ("pending", st[1], True)
+                    pin_now = True
+                else:
+                    pin_now = False
+            else:
+                pin_now = True
+        if st[0] == "pending":
+            if pin_now:
+                self.api.add_refs([ObjectID(oid_bin)])
+            return True
+        _, kind, payload = st
+        oid = ObjectID(oid_bin)
+        self.api.add_refs([oid])  # the head-side pin for the escaped ref
+        self.api._put_entry(oid, kind, payload)
+        with self.cv:
+            self.table[oid_bin] = ("promoted", kind, payload)
+        return True
+
+    def release_local(self, oid_bin: bytes) -> str:
+        """ObjectRef.__del__ path — dict ops only (GC-safe, no locks).
+        Returns "local" (fully handled here), "promoted" (caller must also
+        release the head-side pin), or "absent"."""
+        st = self.table.pop(oid_bin, None)
+        if st is None:
+            return "absent"
+        return "promoted" if st[0] in ("promoted", "fallback") else "local"
+
+    def shutdown(self):
+        with self._conn_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.conn.close()
+            except OSError:
+                pass

@@ -1,0 +1,64 @@
+"""Shared worker-log tailing (used by the head's and every agent's log
+monitor; reference: the tail loop in ``python/ray/_private/log_monitor.py``).
+
+One scan algorithm in one place: per-file byte offsets, a 1 MiB read cap,
+newline-bounded consumption — with a flush-anyway escape so a single giant
+line (or a ``\\r``-only progress bar) cannot stall the offset forever.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+_READ_CAP = 1 << 20  # bytes per file per scan
+
+
+def scan_log_dir(
+    log_dir: str,
+    offsets: dict[str, int],
+    emit: Callable[[str, str, list[str]], None],
+) -> None:
+    """One pass over ``log_dir``'s ``worker-<hex>.{out,err}`` files: read
+    newly appended bytes past ``offsets`` and hand complete lines to
+    ``emit(worker_hex, source, lines)``. Mutates ``offsets``."""
+    try:
+        names = sorted(os.listdir(log_dir))
+    except OSError:
+        return
+    for name in names:
+        if not (name.endswith(".out") or name.endswith(".err")):
+            continue
+        path = os.path.join(log_dir, name)
+        off = offsets.get(name, 0)
+        try:
+            size = os.path.getsize(path)
+            if size <= off:
+                continue
+            with open(path, "rb") as f:
+                f.seek(off)
+                data = f.read(min(size - off, _READ_CAP))
+        except OSError:
+            continue
+        nl = data.rfind(b"\n")
+        if nl >= 0:
+            data = data[: nl + 1]
+        elif len(data) < _READ_CAP:
+            continue  # incomplete line — wait for the newline
+        # else: a single line larger than the cap (or newline-free output):
+        # flush the chunk as-is — re-reading it every scan forever would
+        # livelock the monitor and silence the worker's later output
+        offsets[name] = off + len(data)
+        stem, _, source = name.rpartition(".")
+        wid_hex = stem[len("worker-"):] if stem.startswith("worker-") else stem
+        emit(wid_hex, source, data.decode(errors="replace").splitlines())
+
+
+def tail_file(path: str, tail_bytes: int) -> str:
+    """Last ``tail_bytes`` of a log file ("" when unreadable)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(max(os.path.getsize(path) - tail_bytes, 0))
+            return f.read().decode(errors="replace")
+    except OSError:
+        return ""

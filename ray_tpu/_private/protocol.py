@@ -142,6 +142,11 @@ def routable_host() -> str:
 class RegisterWorker:
     worker_id: WorkerID
     pid: int
+    # "host:port" of this worker's direct actor-call listener (None for
+    # thread-mode/in-process workers). Callers push actor calls straight to
+    # this address, bypassing the head (reference: the direct PushTask
+    # transport, src/ray/core_worker/transport/actor_task_submitter.h).
+    direct_address: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -276,6 +281,32 @@ class Shutdown:
     pass
 
 
+# ---- caller <-> actor worker (direct transport; the head is NOT on this
+# path — reference: ActorTaskSubmitter pushes calls worker-to-worker over
+# gRPC without a raylet/GCS hop, actor_task_submitter.h) ----
+
+@dataclasses.dataclass
+class DirectActorCall:
+    """Caller → actor worker: execute this actor task and reply on THIS
+    connection. ``resolved_args`` carries the template plus caller-resolved
+    ref payloads (same shape as ExecuteTask.resolved_args); ordering is the
+    connection's FIFO order (caller-side sequencing)."""
+
+    req_id: int
+    spec: TaskSpec
+    resolved_args: list
+
+
+@dataclasses.dataclass
+class DirectCallReply:
+    """Actor worker → caller: results of a DirectActorCall. Always inline
+    or error payloads — the result rides the direct connection, never the
+    head's store (kind in {"inline", "error"})."""
+
+    req_id: int
+    results: list  # [(object_id, kind, payload_bytes)]
+
+
 # ---- node agent <-> controller (real multi-host worker plane; reference:
 # the raylet's NodeManager gRPC surface, src/ray/raylet/node_manager.h:124,
 # and `ray start --address=<head>`, python/ray/scripts/scripts.py:226) ----
@@ -392,6 +423,36 @@ class Heartbeat:
 
     node_id: Any  # NodeID
     load: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class WorkerLogLines:
+    """Agent → controller: new stdout/stderr lines captured from a local
+    worker's log files (the remote half of the log monitor; reference:
+    ``log_monitor.py`` publishing tailed lines to the driver)."""
+
+    worker_id_hex: str
+    source: str  # "out" | "err"
+    lines: list
+
+
+@dataclasses.dataclass
+class FetchLogs:
+    """Controller → agent: read the tail of a (possibly dead) worker's
+    captured log file."""
+
+    req_id: int
+    worker_id_hex: str
+    source: str
+    tail_bytes: int
+
+
+@dataclasses.dataclass
+class LogsReply:
+    """Agent → controller: FetchLogs response."""
+
+    req_id: int
+    text: str
 
 
 @dataclasses.dataclass

@@ -57,6 +57,10 @@ class WorkerAPI:
         self._submit_counter = 0
         self._put_counter = 0
         self._counter_lock = threading.Lock()
+        # direct worker-to-worker actor-call transport (lazily built by
+        # _ensure_direct; None until the first actor call, or always None
+        # for transports that can't dial workers)
+        self._direct = None
         self.serialization = SerializationContext(
             ref_serializer=self._on_ref_serialized,
             ref_deserializer=self._on_ref_deserialized,
@@ -91,11 +95,35 @@ class WorkerAPI:
     def remove_ref(self, object_id: ObjectID):
         raise NotImplementedError
 
+    def _put_entry(self, object_id: ObjectID, kind: str, payload: bytes):
+        """Seal a pre-serialized (kind, payload) entry into the head store —
+        the promotion path for caller-owned direct-call results."""
+        raise NotImplementedError
+
+    def _direct_authkey(self) -> Optional[bytes]:
+        """Cluster authkey for dialing worker direct listeners (None =
+        this transport cannot do direct calls)."""
+        return None
+
+    def _ensure_direct(self):
+        if self._direct is None:
+            authkey = self._direct_authkey()
+            if authkey is None:
+                return None
+            from ray_tpu._private.direct_call import DirectActorTransport
+
+            self._direct = DirectActorTransport(self, authkey)
+        return self._direct
+
     # ref tracking ----------------------------------------------------------
     def _on_ref_serialized(self, ref: ObjectRef):
         # Nested refs crossing a process boundary: pin on the owner so the
         # payload outlives the sender's handle. (Round-1 simplification of the
-        # reference's borrower protocol, reference_count.h:73.)
+        # reference's borrower protocol, reference_count.h:73.) A caller-owned
+        # direct-call result must first be sealed into the head store — the
+        # receiving process resolves nested refs there.
+        if self._direct is not None and self._direct.active:
+            self._direct.promote(ref.id().binary())
         self.add_refs([ref.id()])
 
     def _on_ref_deserialized(self, id_binary: bytes) -> ObjectRef:
@@ -144,8 +172,20 @@ class WorkerAPI:
         return_ids = spec.return_ids()
         self.add_refs(return_ids)
         refs = [ObjectRef(oid) for oid in return_ids]
+        self._promote_ref_args(spec)
         self._submit(spec)
         return refs
+
+    def _promote_ref_args(self, spec: TaskSpec):
+        """A head-mediated submission whose ref args are caller-owned
+        direct-call results: seal them into the head store first, or the
+        head could never resolve the dependencies."""
+        d = self._direct
+        if d is None or not d.active:
+            return
+        for kind, entry in spec.args[1:]:
+            if kind == "ref":
+                d.promote(entry.binary())
 
     def create_actor(
         self,
@@ -182,6 +222,7 @@ class WorkerAPI:
             runtime_env=runtime_env,
         )
         self.add_refs(spec.return_ids())
+        self._promote_ref_args(spec)
         self._submit(spec, actor_name=name)
         return actor_id
 
@@ -218,8 +259,23 @@ class WorkerAPI:
             generator_backpressure=generator_backpressure,
         )
         return_ids = spec.return_ids()
-        self.add_refs(return_ids)
         refs = [ObjectRef(oid) for oid in return_ids]
+        # direct worker-to-worker path first: the head never sees the call
+        # (reference: ActorTaskSubmitter's direct PushTask). Falls back to
+        # head mediation for streaming/multi-return/retry_exceptions specs,
+        # unknown endpoints, and restart windows.
+        direct = self._ensure_direct()
+        if direct is not None and direct.try_submit(spec):
+            return refs
+        self.add_refs(return_ids)
+        self._promote_ref_args(spec)
+        if direct is not None:
+            # cross-path per-caller ordering, both directions: this head
+            # submission must not overtake direct calls already on the wire,
+            # and later direct calls must queue behind this one
+            if direct.active:
+                direct.wait_direct_drained(actor_id.binary())
+            direct.note_head_submit(spec)
         self._submit(spec)
         return refs
 
@@ -272,7 +328,12 @@ class WorkerAPI:
         for r in ref_list:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"ray_tpu.get takes ObjectRefs, got {type(r)}")
-        sobjs = self._get_serialized([r.id() for r in ref_list], timeout)
+        ids = [r.id() for r in ref_list]
+        d = self._direct
+        if d is not None and d.active:
+            sobjs = self._get_with_direct(ids, timeout, d)
+        else:
+            sobjs = self._get_serialized(ids, timeout)
         values = []
         for r, item in zip(ref_list, sobjs):
             if item is None:
@@ -286,13 +347,89 @@ class WorkerAPI:
             values.append(value)
         return values[0] if single else values
 
+    def _get_with_direct(self, ids, timeout, d):
+        """``get`` when some ids may be caller-owned direct-call results:
+        those resolve from the local table (no head round-trip); the rest —
+        including direct calls rerouted through the head — go through the
+        normal transport."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: list = [None] * len(ids)
+        rest_ids, rest_pos = [], []
+        for i, oid in enumerate(ids):
+            ob = oid.binary()
+            if not d.manages(ob):
+                rest_ids.append(oid)
+                rest_pos.append(i)
+                continue
+            remaining = None if deadline is None else max(deadline - time.monotonic(), 0.0)
+            st = d.wait_local(ob, remaining)
+            if st[0] in ("done", "promoted"):
+                out[i] = (st[1], SerializedObject.from_buffer(st[2]))
+            else:  # fallback — the head owns it now
+                rest_ids.append(oid)
+                rest_pos.append(i)
+        if rest_ids:
+            remaining = None if deadline is None else max(deadline - time.monotonic(), 0.0)
+            fetched = self._get_serialized(rest_ids, remaining)
+            for p, item in zip(rest_pos, fetched):
+                out[p] = item
+        return out
+
     def wait(self, refs: Sequence[ObjectRef], num_returns=1, timeout=None):
         if not refs:
             return [], []
         ids = [r.id() for r in refs]
         by_id = {r.id(): r for r in refs}
+        d = self._direct
+        if d is not None and d.active and any(d.manages(i.binary()) for i in ids):
+            ready_set = self._wait_with_direct(ids, num_returns, timeout, d)
+            return (
+                [by_id[i] for i in ids if i in ready_set],
+                [by_id[i] for i in ids if i not in ready_set],
+            )
         ready_ids, not_ready_ids = self.controller_call("wait", (ids, num_returns, timeout))
         return [by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids]
+
+    def _wait_with_direct(self, ids, num_returns, timeout, d) -> set:
+        """``wait`` over a mix of caller-owned (direct) and head-owned ids.
+        Pure-direct sets block on the local table; mixed sets poll the head
+        in short slices between local checks (wait is not the storm hot
+        path — correctness over elegance here)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            # re-partition EVERY round: an in-flight direct call whose
+            # connection drops transitions to "fallback" (head-resident)
+            # mid-wait — a one-shot snapshot would poll it nowhere and hang
+            direct_ids = [
+                i for i in ids
+                if d.manages(i.binary()) and d.state(i.binary()) != "fallback"
+            ]
+            rest = [i for i in ids if i not in set(direct_ids)]
+            direct_bins = [i.binary() for i in direct_ids]
+            ready = {
+                i for i in direct_ids if i.binary() in d.ready_now(direct_bins)
+            }
+            if rest and len(ready) < num_returns:
+                need = min(num_returns - len(ready), len(rest))
+                slice_t = 0.05
+                if deadline is not None:
+                    slice_t = min(slice_t, max(deadline - time.monotonic(), 0.0))
+                r2, _ = self.controller_call("wait", (rest, need, slice_t))
+                ready.update(r2)
+            elif not rest and len(ready) < num_returns:
+                remaining = None if deadline is None else max(deadline - time.monotonic(), 0.0)
+                bins = d.wait_ready(direct_bins, num_returns, remaining)
+                ready = {i for i in direct_ids if i.binary() in bins}
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+        # cap at num_returns preserving input order (memory-store contract)
+        capped = set()
+        for i in ids:
+            if i in ready and len(capped) < num_returns:
+                capped.add(i)
+        return capped
 
 
 class DriverAPI(WorkerAPI):
@@ -321,6 +458,19 @@ class DriverAPI(WorkerAPI):
     def _put_serialized(self, object_id, sobj):
         self.controller.put_serialized(object_id, sobj)
 
+    def _put_entry(self, object_id, kind, payload):
+        self.controller.memory_store.put(
+            object_id, (kind, SerializedObject.from_buffer(payload))
+        )
+        self.controller._on_object_sealed(object_id)
+
+    def _direct_authkey(self):
+        # thread mode runs actors in-process: the direct transport would be
+        # pure overhead (and a second ordering domain) with nothing to dial
+        if self.controller.mode == "thread":
+            return None
+        return self.controller._authkey
+
     def controller_call(self, op, payload=None):
         return self.controller._dispatch_request(op, payload)
 
@@ -329,6 +479,10 @@ class DriverAPI(WorkerAPI):
             self.controller.add_ref(oid)
 
     def remove_ref(self, object_id):
+        if self._direct is not None:
+            st = self._direct.release_local(object_id.binary())
+            if st == "local":
+                return  # caller-owned, never head-registered
         self.controller.remove_ref(object_id)
 
 
@@ -359,6 +513,12 @@ class WorkerProcAPI(WorkerAPI):
     def _put_serialized(self, object_id, sobj):
         self.runtime.put_serialized(object_id, sobj)
 
+    def _put_entry(self, object_id, kind, payload):
+        self.runtime.put_entry(object_id, kind, payload)
+
+    def _direct_authkey(self):
+        return self.runtime.authkey
+
     def controller_call(self, op, payload=None):
         return self.runtime.call_controller(op, payload)
 
@@ -370,6 +530,11 @@ class WorkerProcAPI(WorkerAPI):
         # which GC can fire on a thread that is ALREADY inside _send
         # holding the (non-reentrant) send lock mid-pickle — a direct send
         # would self-deadlock. Queue the free; a flusher thread batches.
+        # (release_local is dict-pop only — equally GC-safe.)
+        if self._direct is not None:
+            st = self._direct.release_local(object_id.binary())
+            if st == "local":
+                return
         self.runtime.queue_free(object_id)
 
 
@@ -534,7 +699,7 @@ def _connect_client(address: str) -> "WorkerAPI":
         raise RayTpuError(
             f"no running cluster at {sock!r} (stale session file?): {e}"
         ) from e
-    runtime = WorkerRuntime(WorkerID.from_random(), conn, in_process=False)
+    runtime = WorkerRuntime(WorkerID.from_random(), conn, in_process=False, authkey=authkey)
     runtime.client_mode = True
     # reconnect-after-head-restart support (reference: the ray client's
     # reconnect grace): the reply pump re-dials this target on EOF
@@ -564,7 +729,49 @@ def _connect_client(address: str) -> "WorkerAPI":
             pass
     api = WorkerProcAPI(runtime)
     api.is_client = True
+    if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+        # stream worker stdout/stderr to THIS console too (the head prints
+        # locally; clients ride the worker_logs pubsub channel — reference:
+        # the ray client's log streamer over GCS pubsub)
+        threading.Thread(
+            target=_client_log_pump, args=(runtime,), daemon=True,
+            name="client-log-pump",
+        ).start()
     return api
+
+
+def _client_log_pump(runtime):
+    import sys
+
+    # start from "now": only lines captured after attach. Keep probing until
+    # the latest seq is known — falling back to 0 would replay the entire
+    # buffered log history onto the client's console.
+    seq = None
+    while seq is None and not runtime._shutdown:
+        try:
+            seq, _ = runtime.call_controller(
+                "pubsub_poll", ("worker_logs", 1 << 62, 0.0)
+            )
+        except Exception:  # noqa: BLE001 — head busy/reconnecting
+            time.sleep(1.0)
+    while not runtime._shutdown:
+        try:
+            seq, events = runtime.call_controller(
+                "pubsub_poll", ("worker_logs", seq, 10.0)
+            )
+        except Exception:  # noqa: BLE001 — reconnect windows
+            time.sleep(1.0)
+            continue
+        for e in events:
+            label = e.get("label") or f"worker={e.get('worker_id', '')[:8]}"
+            prefix = f"({label} pid={e.get('pid')}, ip={e.get('ip')})"
+            stream = sys.stderr if e.get("source") == "err" else sys.stdout
+            try:
+                for line in e.get("lines", ()):
+                    stream.write(f"{prefix} {line}\n")
+                stream.flush()
+            except (OSError, ValueError):
+                pass
 
 
 def cluster_address(tcp: bool = False) -> Optional[str]:
@@ -590,6 +797,8 @@ def shutdown():
             return
         _global_api = None
         ObjectRef._on_delete = None
+        if api._direct is not None:
+            api._direct.shutdown()
         if getattr(api, "is_client", False):
             runtime = getattr(api, "runtime", None)
             if runtime is not None:

@@ -41,7 +41,12 @@ def main():
     from ray_tpu._private.worker_runtime import WorkerRuntime
 
     conn = Client(address, family="AF_UNIX", authkey=authkey)
-    runtime = WorkerRuntime(WorkerID(bytes.fromhex(worker_id_hex)), conn, in_process=False)
+    runtime = WorkerRuntime(
+        WorkerID(bytes.fromhex(worker_id_hex)),
+        conn,
+        in_process=False,
+        authkey=authkey,
+    )
     runtime.run()
 
 

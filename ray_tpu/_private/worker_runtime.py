@@ -87,15 +87,57 @@ class InProcessChannel:
 _CLOSE = object()
 
 
+class _DirectTask:
+    """A direct actor call routed through the normal execution machinery;
+    the reply goes back on the caller's connection, not to the head."""
+
+    __slots__ = ("spec", "resolved_args", "direct_reply", "req_id")
+
+    def __init__(self, spec, resolved_args, direct_reply, req_id):
+        self.spec = spec
+        self.resolved_args = resolved_args
+        self.direct_reply = direct_reply
+        self.req_id = req_id
+
+
+class _DirectReplyConn:
+    """Send-side of one caller's direct connection (serialized sends)."""
+
+    __slots__ = ("conn", "lock")
+
+    def __init__(self, conn):
+        self.conn = conn
+        self.lock = threading.Lock()
+
+    def send(self, msg):
+        with self.lock:
+            self.conn.send(msg)
+
+
 class WorkerRuntime:
-    def __init__(self, worker_id: WorkerID, conn, in_process: bool = False):
+    def __init__(
+        self,
+        worker_id: WorkerID,
+        conn,
+        in_process: bool = False,
+        authkey: Optional[bytes] = None,
+    ):
         self.worker_id = worker_id
         self.conn = conn
         self.in_process = in_process
+        self.authkey = authkey
+        # direct actor-call listener (started in run() for process workers)
+        self._direct_listener = None
+        self.direct_address: Optional[str] = None
         self.serialization = SerializationContext()
         self.actors: dict[bytes, Any] = {}  # actor_id binary -> instance
         self.actor_pools: dict[bytes, ThreadPoolExecutor] = {}
         self.actor_loops: dict[bytes, asyncio.AbstractEventLoop] = {}
+        # max_concurrency=1 sync actors: every execution path (task pool AND
+        # inline direct calls) serializes on this per-actor lock, so direct
+        # calls can run on the caller-connection reader thread — one fewer
+        # context switch per call — without breaking the concurrency contract
+        self.actor_exec_locks: dict[bytes, threading.Lock] = {}
         self._get_replies: dict[int, Any] = {}
         self._get_cv = threading.Condition()
         self._req_counter = itertools.count(1)
@@ -213,7 +255,12 @@ class WorkerRuntime:
                 self.serialization = worker_mod.global_worker().serialization
         else:
             self._install_worker_api()
-        self._send(P.RegisterWorker(self.worker_id, os.getpid()))
+            self._start_direct_server()
+        self._send(
+            P.RegisterWorker(
+                self.worker_id, os.getpid(), direct_address=self.direct_address
+            )
+        )
         while not self._shutdown:
             try:
                 msg = self.conn.recv()
@@ -237,6 +284,91 @@ class WorkerRuntime:
         self._shutdown = True
         if not self.in_process:
             os._exit(0)
+
+    # ------------------------------------------------- direct actor calls
+
+    def _start_direct_server(self):
+        """Listen for worker-to-worker actor calls (reference: the core
+        worker's gRPC server handling PushTask directly from callers,
+        ``core_worker.cc`` HandlePushTask — no raylet/GCS on the path).
+        Binds 0.0.0.0 when the node advertises an IP (agent hosts, so
+        cross-host callers can reach it); loopback otherwise."""
+        if self.authkey is None:
+            return
+        from multiprocessing.connection import Listener
+
+        host = os.environ.get("RAY_TPU_NODE_IP")
+        try:
+            self._direct_listener = Listener(
+                ("0.0.0.0" if host else "127.0.0.1", 0), authkey=self.authkey
+            )
+        except OSError:
+            return  # no direct transport; calls fall back to the head
+        port = self._direct_listener.address[1]
+        self.direct_address = f"{host or '127.0.0.1'}:{port}"
+        threading.Thread(
+            target=self._direct_accept_loop, daemon=True, name="direct-accept"
+        ).start()
+
+    def _direct_accept_loop(self):
+        while not self._shutdown:
+            try:
+                conn = self._direct_listener.accept()
+            except (OSError, EOFError):
+                if self._shutdown:
+                    return
+                continue
+            except Exception:  # noqa: BLE001 — failed auth handshake
+                continue
+            threading.Thread(
+                target=self._direct_conn_loop,
+                args=(conn,),
+                daemon=True,
+                name="direct-conn",
+            ).start()
+
+    def _direct_conn_loop(self, conn):
+        """One caller's connection: FIFO per caller — messages are routed
+        to the actor's execution queue in arrival order, so a single
+        caller's calls execute in submission order (caller-side seq)."""
+        reply = _DirectReplyConn(conn)
+        while not self._shutdown:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            except (TypeError, ValueError):
+                break  # recv raced a close() — handle already None
+            if isinstance(msg, P.DirectActorCall):
+                task = _DirectTask(msg.spec, msg.resolved_args, reply, msg.req_id)
+                abin = (
+                    msg.spec.actor_id.binary()
+                    if msg.spec.actor_id is not None
+                    else None
+                )
+                if abin is not None and abin not in self.actors:
+                    # stale endpoint (actor restarted elsewhere / not yet
+                    # created here): tell the caller to re-resolve instead
+                    # of raising an opaque KeyError from the task body
+                    try:
+                        reply.send(P.DirectCallReply(msg.req_id, "stale"))
+                    except (OSError, EOFError):
+                        break
+                    continue
+                lock = self.actor_exec_locks.get(abin)
+                if lock is not None:
+                    # sync maxc=1 actor: run inline on this reader thread
+                    # (per-caller FIFO holds — this thread drains the conn in
+                    # order; the lock serializes against other callers and
+                    # the head-dispatch pool)
+                    with lock:
+                        self._execute_task(task)
+                else:
+                    self._route_task(task)
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def _dump_stacks(self) -> str:
         """Every thread's Python stack, annotated with the running task —
@@ -544,6 +676,16 @@ class WorkerRuntime:
             self._send(P.PutObject(req_id, object_id, "plasma", (name, size)))
         self._await_reply(req_id, epoch=epoch)
 
+    def put_entry(self, object_id: ObjectID, kind: str, payload: bytes):
+        """Seal a pre-serialized entry with an explicit kind ("inline" or
+        "error") into the head's store — used when promoting a direct-call
+        result that escapes to another process (kind must survive: an
+        "error" promoted as "inline" would stop propagating)."""
+        req_id = next(self._req_counter)
+        epoch = self._conn_epoch
+        self._send(P.PutObject(req_id, object_id, kind, payload))
+        self._await_reply(req_id, epoch=epoch)
+
     def _push_object(
         self, object_id: ObjectID, data: bytes, chunk_bytes: int = 4 * 1024**2
     ) -> None:
@@ -629,23 +771,46 @@ class WorkerRuntime:
 
     def _execute_task(self, msg: P.ExecuteTask):
         spec = msg.spec
+        direct = getattr(msg, "direct_reply", None)
         # running now — no longer stealable
         with self._pf_lock:
             self._pending_futures.pop(spec.task_id.binary(), None)
         start = time.monotonic()
+        # head-dispatched calls to a sync maxc=1 actor serialize against
+        # inline direct calls (the inline path already holds the lock)
+        lock = None
+        if (
+            direct is None
+            and spec.task_type == TaskType.ACTOR_TASK
+            and spec.actor_id is not None
+        ):
+            lock = self.actor_exec_locks.get(spec.actor_id.binary())
+        if lock is not None:
+            lock.acquire()
         results = []
         try:
             args, kwargs = self._deserialize_args(spec, msg.resolved_args)
             value = self._invoke(spec, args, kwargs)
-            results = self._store_returns(spec, value)
+            results = self._store_returns(spec, value, inline_only=direct is not None)
         except BaseException as e:  # noqa: BLE001 — task errors must not kill the worker
             results = self._store_error(spec, e)
+        finally:
+            if lock is not None:
+                lock.release()
         exec_ms = (time.monotonic() - start) * 1e3
+        if direct is not None:
+            # result rides the caller's connection; the head sees nothing
+            try:
+                direct.send(P.DirectCallReply(msg.req_id, results))
+            except (OSError, EOFError):
+                pass  # caller gone; nothing to deliver to
+            return
         actor_id = spec.actor_id if spec.task_type != TaskType.NORMAL_TASK else None
         self._send(P.TaskDone(spec.task_id, results, actor_id=actor_id, exec_ms=exec_ms))
 
     async def _execute_async(self, msg: P.ExecuteTask):
         spec = msg.spec
+        direct = getattr(msg, "direct_reply", None)
         start = time.monotonic()
         try:
             args, kwargs = self._deserialize_args(spec, msg.resolved_args)
@@ -660,10 +825,16 @@ class WorkerRuntime:
             if spec.num_returns == "streaming" and hasattr(value, "__anext__"):
                 results = await self._stream_returns_async(spec, value)
             else:
-                results = self._store_returns(spec, value)
+                results = self._store_returns(spec, value, inline_only=direct is not None)
         except BaseException as e:  # noqa: BLE001
             results = self._store_error(spec, e)
         exec_ms = (time.monotonic() - start) * 1e3
+        if direct is not None:
+            try:
+                direct.send(P.DirectCallReply(msg.req_id, results))
+            except (OSError, EOFError):
+                pass
+            return
         self._send(P.TaskDone(spec.task_id, results, actor_id=spec.actor_id, exec_ms=exec_ms))
 
     def _invoke(self, spec: TaskSpec, args, kwargs):
@@ -689,6 +860,9 @@ class WorkerRuntime:
                 loop = asyncio.new_event_loop()
                 self.actor_loops[key] = loop
                 threading.Thread(target=loop.run_forever, daemon=True, name="actor-loop").start()
+            elif spec.max_concurrency <= 1:
+                # enables inline direct-call execution (see _direct_conn_loop)
+                self.actor_exec_locks[key] = threading.Lock()
             return None
         # ACTOR_TASK
         instance = self.actors[spec.actor_id.binary()]
@@ -700,7 +874,7 @@ class WorkerRuntime:
         method = getattr(instance, spec.method_name)
         return method(*args, **kwargs)
 
-    def _store_returns(self, spec: TaskSpec, value) -> list:
+    def _store_returns(self, spec: TaskSpec, value, inline_only: bool = False) -> list:
         return_ids = spec.return_ids()
         if spec.num_returns == "streaming":
             return self._stream_returns(spec, value)
@@ -716,7 +890,10 @@ class WorkerRuntime:
         results = []
         for oid, v in zip(return_ids, values):
             sobj = self.serialization.serialize(v)
-            if sobj.total_bytes() <= self.max_inline:
+            if inline_only or sobj.total_bytes() <= self.max_inline:
+                # inline_only: direct-call results ride the caller's
+                # connection whatever their size — the caller owns them and
+                # the head's store never sees them
                 results.append((oid, "inline", sobj.to_bytes()))
             else:
                 name, size = self._write_shm(oid, sobj)

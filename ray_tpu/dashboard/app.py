@@ -17,6 +17,7 @@ Endpoints:
   /api/objects          object-store stats
   /api/stacks[?worker=] on-demand worker stack dump (py-spy analog)
   /api/timeline         chrome://tracing JSON of task events
+  /api/logs[?worker=]   captured worker stdout/stderr (dead workers too)
   /metrics              Prometheus exposition (same registry as util.metrics)
 """
 
@@ -155,6 +156,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(st.get_worker_stacks(target))
             elif path == "/api/timeline":
                 self._json(st.timeline())
+            elif path == "/api/logs":
+                # list log files, or ?worker=<hexprefix>[&source=err] tails
+                # one worker's captured output (dead workers included)
+                q = parse_qs(parsed.query)
+                target = (q.get("worker") or [None])[0]
+                if target:
+                    source = (q.get("source") or ["out"])[0]
+                    self._json({"text": st.get_log(target, source=source)})
+                else:
+                    self._json(st.list_logs())
             elif path == "/metrics":
                 from ray_tpu.util.metrics import export_prometheus
 

@@ -18,8 +18,21 @@ import sys
 def _ensure_init(args):
     import ray_tpu
 
-    if not ray_tpu.is_initialized():
-        ray_tpu.init(num_cpus=getattr(args, "num_cpus", 4), mode="thread")
+    if ray_tpu.is_initialized():
+        return
+    # attach to the running cluster on this host first (ray status/logs
+    # semantics); fall back to a fresh local runtime ONLY when none exists —
+    # any other attach failure (permissions, handshake) must surface, not
+    # silently report an empty brand-new cluster
+    from ray_tpu.exceptions import RayTpuError
+
+    try:
+        ray_tpu.init(address="auto")
+        return
+    except RayTpuError as e:
+        if "no running cluster" not in str(e):
+            raise
+    ray_tpu.init(num_cpus=getattr(args, "num_cpus", 4), mode="thread")
 
 
 def cmd_status(args):
@@ -51,6 +64,28 @@ def cmd_dashboard(args):
             time.sleep(3600)
     except KeyboardInterrupt:
         pass
+
+
+def cmd_logs(args):
+    """Fetch captured worker logs (``ray logs`` analog; works for dead
+    workers — the per-session files outlive their processes)."""
+    from ray_tpu.util.state.api import get_log, list_logs
+
+    _ensure_init(args)
+    if not args.worker:
+        rows = list_logs()
+        if not rows:
+            print("no captured worker logs")
+            return
+        for r in rows:
+            print(
+                f"{r['worker_id'][:16]}  pid={r.get('pid')}  ip={r.get('ip')}"
+                f"  label={r.get('label') or '-'}"
+                f"  out={r.get('out_bytes', '?')}B err={r.get('err_bytes', '?')}B"
+            )
+        return
+    text = get_log(args.worker, source=args.source, tail_bytes=args.tail)
+    print(text, end="" if text.endswith("\n") else "\n")
 
 
 def cmd_microbenchmark(args):
@@ -297,6 +332,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=8265)
     s.set_defaults(fn=cmd_dashboard)
+
+    s = sub.add_parser("logs", help="list / tail captured worker logs")
+    s.add_argument("worker", nargs="?", help="worker id hex prefix (omit to list)")
+    s.add_argument("--source", choices=["out", "err"], default="out")
+    s.add_argument("--tail", type=int, default=65536, help="tail bytes")
+    s.set_defaults(fn=cmd_logs)
 
     s = sub.add_parser("timeline", help="export chrome trace of task events")
     s.add_argument("--output", "-o", default="timeline.json")

@@ -51,6 +51,27 @@ def summarize_tasks() -> dict:
     return {name: dict(c) for name, c in by_name.items()}
 
 
+def list_logs() -> list[dict]:
+    """Captured worker log files across the cluster (reference:
+    ``ray.util.state.list_logs`` backed by the dashboard log agents;
+    here by the per-session log dirs on the head and every agent)."""
+    return _call("log_list")
+
+
+def get_log(
+    worker_id_prefix: str, source: str = "out", tail_bytes: int = 65536
+) -> str:
+    """Tail a worker's captured stdout/stderr by worker-id hex prefix —
+    works for DEAD workers (files outlive processes; reference:
+    ``ray logs worker-*.out``)."""
+    return _call("log_get", (worker_id_prefix, source, tail_bytes))
+
+
+def tail_cluster_logs(n: int = 1000) -> list[dict]:
+    """The most recent captured lines across all workers (ring buffer)."""
+    return _call("log_tail_buffer", n)
+
+
 def get_worker_stacks(worker_id: Optional[str] = None) -> dict:
     """On-demand stack dump of live workers (reference: the dashboard's
     py-spy stack-trace button). ``worker_id``: hex prefix, or None = all."""
