@@ -636,7 +636,12 @@ class NodeAgent:
                 ensure_pip_env,
             )
 
-            spec = build_spec(_json.loads(pip_json)["packages"], wheels_dir)
+            payload = _json.loads(pip_json)
+            spec = build_spec(
+                payload["packages"],
+                wheels_dir,
+                tool=payload.get("tool", "pip"),
+            )
             try:
                 python_exe = ensure_pip_env(
                     spec, base_dir=os.path.join(self.base_dir, "pip_envs")

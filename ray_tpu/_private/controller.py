@@ -1459,6 +1459,15 @@ class Controller:
         discovered at worker-spawn time would otherwise respawn doomed
         workers forever while the task hangs in the ready queue."""
         rt = spec.runtime_env or {}
+        for key in ("container", "image_uri"):
+            if rt.get(key):
+                # explicit refusal, not silence: this image has no container
+                # runtime (reference: runtime_env/container — out of scope)
+                raise ValueError(
+                    f"runtime_env {key!r} is not supported: ray_tpu has no "
+                    "container runtime; use pip/uv (offline wheel cache), "
+                    "py_modules, working_dir, or env_vars instead"
+                )
         for mod in rt.get("py_modules") or ():
             p = os.path.abspath(os.path.expanduser(str(mod)))
             if not os.path.exists(p):
@@ -1484,8 +1493,13 @@ class Controller:
             # the scheduler hot path (shape keys, worker matching), which
             # must never re-read a requirements file or the env var — a
             # deleted/edited file would otherwise stall dispatch or strand
-            # spawned workers with mismatched fingerprints
-            spec.runtime_env = {**rt, "pip": pip_spec}
+            # spawned workers with mismatched fingerprints. The resolved
+            # spec (which carries its "tool") lives under "pip"; a raw "uv"
+            # key would be re-normalized into a conflict.
+            spec.runtime_env = {
+                **{k: v for k, v in rt.items() if k != "uv"},
+                "pip": pip_spec,
+            }
 
     def submit_task(self, spec: TaskSpec):
         self._validate_runtime_env(spec)
@@ -2029,7 +2043,12 @@ class Controller:
             elif w.fingerprint == want:
                 idle.pop(i)
                 return w
-        if self.starting_workers >= self.config.maximum_startup_concurrency:
+        # PER-NODE startup throttle (reference: maximum_startup_concurrency
+        # is per raylet, worker_pool.cc): a global cap would serialize
+        # worker/actor creation cluster-wide — with N agents, spawns must
+        # pipeline N× in parallel (each agent owns its own spawn +
+        # registration handshake; the head only picks the node)
+        if node.starting_workers >= self.config.maximum_startup_concurrency:
             return None
         # Soft pool cap: past it, grow only while the pool is *blocked*
         # (nothing completed recently). Short-task churn keeps completing, so
@@ -2265,7 +2284,10 @@ class Controller:
                     ("pip_wheels", *self._package_cached(pip_spec["find_links"]))
                 )
             env_vars["RAY_TPU_PIP_SPEC"] = json.dumps(
-                {"packages": pip_spec["packages"]}
+                {
+                    "packages": pip_spec["packages"],
+                    "tool": pip_spec.get("tool", "pip"),
+                }
             )
         handle = WorkerHandle(
             worker_id, node_id, proc=None, conn=_RelayConn(agent, worker_id)

@@ -31,28 +31,36 @@ import tempfile
 from typing import Optional
 
 
-def build_spec(packages, find_links) -> dict:
+def build_spec(packages, find_links, tool: str = "pip") -> dict:
     """The one canonical spec shape (head and agent must agree — env_key
-    hashes it)."""
+    hashes it). ``tool`` is the installer: "pip" or "uv" (reference ships
+    both backends, ``runtime_env/pip.py`` and ``runtime_env/uv.py``)."""
     return {
         "packages": sorted(str(p) for p in packages),
         "find_links": find_links,
+        "tool": tool,
     }
 
 
 def normalize_pip_spec(runtime_env: Optional[dict]) -> Optional[dict]:
-    """``runtime_env`` -> {"packages": [...], "find_links": str|None}.
+    """``runtime_env`` -> {"packages": [...], "find_links": str|None,
+    "tool": "pip"|"uv"}.
 
-    Accepted ``pip`` forms (mirrors the reference's pip field):
+    Accepted ``pip`` (or ``uv``) forms (mirrors the reference's fields):
     a list of requirement strings, a requirements-file path (str), or
     {"packages": [...], "find_links": dir}."""
-    pip = (runtime_env or {}).get("pip")
+    rt = runtime_env or {}
+    if rt.get("pip") and rt.get("uv"):
+        raise ValueError("runtime_env accepts 'pip' OR 'uv', not both")
+    tool = "uv" if rt.get("uv") else "pip"
+    pip = rt.get(tool)
     if not pip:
         return None
     find_links = os.environ.get("RAY_TPU_PIP_FIND_LINKS")
     if isinstance(pip, dict):
         packages = list(pip.get("packages") or [])
         find_links = pip.get("find_links") or find_links
+        tool = pip.get("tool") or tool  # already-resolved specs round-trip
     elif isinstance(pip, str):
         # requirements.txt path (reference: pip.py accepts a file path)
         with open(os.path.expanduser(pip)) as f:
@@ -65,14 +73,14 @@ def normalize_pip_spec(runtime_env: Optional[dict]) -> Optional[dict]:
         packages = list(pip)
     else:
         raise TypeError(
-            f"runtime_env pip must be a list of requirements, a "
+            f"runtime_env {tool} must be a list of requirements, a "
             f"requirements-file path, or a dict; got {type(pip).__name__}"
         )
     if not packages:
         return None
     if find_links:
         find_links = os.path.abspath(os.path.expanduser(str(find_links)))
-    return build_spec(packages, find_links)
+    return build_spec(packages, find_links, tool=tool)
 
 
 def validate_pip_spec(spec: dict) -> None:
@@ -114,6 +122,7 @@ def env_key(spec: dict) -> str:
             "packages": spec["packages"],
             "wheels": _dir_fingerprint(spec["find_links"]),
             "python": sys.version_info[:2],
+            "tool": spec.get("tool", "pip"),
         },
         sort_keys=True,
     )
@@ -207,22 +216,39 @@ def ensure_pip_env(spec: dict, base_dir: Optional[str] = None) -> str:
                     f"venv creation failed for {spec['packages']}: "
                     f"{e}\n{(stderr or b'')!r}"
                 ) from e
-            cmd = [
-                python, "-m", "pip", "install",
-                "--no-index",  # fully offline, always
-                "--disable-pip-version-check", "--no-input",
-            ]
+            if spec.get("tool") == "uv":
+                # uv backend (reference: runtime_env/uv.py — the modern
+                # default): same venv + wheel-cache plumbing, uv does the
+                # resolve/install. --offline + --no-index: never touch an
+                # index even if one is configured.
+                cmd = [
+                    "uv", "pip", "install",
+                    "--python", python,
+                    "--offline", "--no-index",
+                ]
+            else:
+                cmd = [
+                    python, "-m", "pip", "install",
+                    "--no-index",  # fully offline, always
+                    "--disable-pip-version-check", "--no-input",
+                ]
             if spec["find_links"]:
                 cmd += ["--find-links", spec["find_links"]]
             cmd += spec["packages"]
-            r = subprocess.run(cmd, capture_output=True, text=True)
-            if r.returncode != 0:
-                from ray_tpu.exceptions import RuntimeEnvSetupError
-
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True)
+            except FileNotFoundError as e:
+                # uv binary absent on this host
                 shutil.rmtree(env_dir, ignore_errors=True)
                 raise RuntimeEnvSetupError(
-                    f"offline pip env creation failed for "
-                    f"{spec['packages']}:\n{r.stdout}\n{r.stderr}"
+                    f"runtime_env tool {spec.get('tool')!r} is not "
+                    f"installed on this host: {e}"
+                ) from e
+            if r.returncode != 0:
+                shutil.rmtree(env_dir, ignore_errors=True)
+                raise RuntimeEnvSetupError(
+                    f"offline {spec.get('tool', 'pip')} env creation failed "
+                    f"for {spec['packages']}:\n{r.stdout}\n{r.stderr}"
                 )
             with open(marker, "w") as f:
                 f.write("ok")

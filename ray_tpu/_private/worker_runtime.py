@@ -240,7 +240,12 @@ class WorkerRuntime:
 
     def run(self):
         # Register with the controller, then serve the task loop.
-        self._ensure_free_flusher()
+        if not self.in_process:
+            # thread-mode workers never send FreeObjects (the driver API is
+            # the global one and frees flow through it) — a flusher thread
+            # per in-process worker is pure thread-count overhead at the
+            # 1000-actor envelope scale
+            self._ensure_free_flusher()
         if self.client_mode:
             # client driver: this loop only pumps replies; no tasks arrive
             # (registration already sent synchronously by _connect_client)
@@ -284,6 +289,14 @@ class WorkerRuntime:
         self._shutdown = True
         if not self.in_process:
             os._exit(0)
+        # thread-mode worker retiring (e.g. KillActor): close the channel so
+        # the controller's reader thread sees EOF and exits — otherwise every
+        # killed actor leaks a blocked reader thread and a 1000-actor
+        # create/kill cycle strangles the host
+        try:
+            self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
 
     # ------------------------------------------------- direct actor calls
 
@@ -477,6 +490,14 @@ class WorkerRuntime:
                     # skip if the executor already started (and popped) it
                     if tid in self._pending_futures:
                         self._pending_futures[tid] = fut
+            elif self.in_process:
+                # thread-mode actor execution runs INLINE on this worker's
+                # own loop thread: ordering is the channel's FIFO, blocking
+                # get()s go straight to the in-process controller (replies
+                # never ride this channel), and the 1000-actor envelope
+                # drops a ThreadPoolExecutor thread per actor. Normal tasks
+                # keep the pool — work stealing needs their queued futures.
+                self._execute_task(msg)
             else:
                 self._task_pool.submit(self._execute_task, msg)
         except RuntimeError:

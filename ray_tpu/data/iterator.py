@@ -80,14 +80,24 @@ class DataIterator:
         sharding_spec=None,
         drop_last: bool = True,
         prefetch: int = 1,
+        ragged_pad_value=0,
+        ragged_buckets: Optional[tuple] = None,
         **kwargs,
     ) -> Iterator[Any]:
         """Batches as jax.Arrays; sharded over `mesh` if given.
 
         drop_last defaults True: a ragged final batch would trigger an XLA
         recompile of the jitted step (static shapes).
+
+        RaggedArray columns (variable-length sequences, e.g. tokenized
+        prompts) are bucket-padded to dense ``[B, T]`` arrays — T from
+        ``ragged_buckets`` (the smallest bucket covering the batch's longest
+        row; a bounded ladder keeps XLA specializations finite) or the max
+        length rounded up to 8 — plus a ``<col>_length`` int32 vector.
         """
         import jax
+
+        from ray_tpu.data.tensor_extension import RaggedArray
 
         sharding = None
         if mesh is not None:
@@ -117,7 +127,14 @@ class DataIterator:
             if isinstance(batch, dict):
                 out = {}
                 for k, v in batch.items():
-                    a = np.asarray(v)
+                    if isinstance(v, RaggedArray):
+                        padded, lens = v.to_padded(
+                            pad_value=ragged_pad_value,
+                            buckets=ragged_buckets,
+                        )
+                        a, extra = padded, lens.astype(np.int32)
+                    else:
+                        a, extra = np.asarray(v), None
                     if dtypes is not None:
                         # per-column dict, or one dtype applied to all columns
                         dt = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
@@ -128,6 +145,12 @@ class DataIterator:
                         if sharding is not None
                         else jax.device_put(a)
                     )
+                    if extra is not None:
+                        out[f"{k}_length"] = (
+                            jax.device_put(extra, sharding)
+                            if sharding is not None
+                            else jax.device_put(extra)
+                        )
                 return out
             return put(batch)
 

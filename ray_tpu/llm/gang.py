@@ -3,18 +3,37 @@
 Reference: ``llm/_internal/serve/deployments/llm/vllm/vllm_models.py:176-190``
 — the reference's LLMServer asks serve for a placement group sized
 ``tensor_parallel_degree * pipeline_parallel_degree`` and scatters vLLM
-engine workers over it. Here the replica owns a STRICT_PACK placement group
-of ``EngineWorker`` actors; workers rendezvous into one ``jax.distributed``
+engine workers over it; its engine does continuous batching at ANY TP×PP
+(``vllm_engine.py``). Here the replica owns a STRICT_PACK placement group of
+``EngineWorker`` actors; workers rendezvous into one ``jax.distributed``
 world (coordinator address brokered through the control plane, the same
 pattern as ``train/_internal/worker_group.py``) and each hosts the SAME
-lockstep SPMD generator (``llm/spmd.py``) over the global mesh. A model
-bigger than one host's chips shards over the gang's ICI/DCN domain; the
-serve router still load-balances across replicas (each replica = one gang).
+lockstep SPMD engine (``llm/spmd.py``) over the global mesh.
+
+Continuous batching under the lockstep rule: the replica runs the ONE
+scheduler (admission, chunked prefill pacing, prefix-cache bookkeeping,
+finish detection) and broadcasts a StepPlan per iteration; every worker
+executes the plan's programs identically and rank 0 reports sampled tokens.
+A request is admitted chunk-by-chunk while other slots keep decoding —
+mid-decode admission, per-token SSE streaming, and prefix-cache TTFT hits
+all work at gang scale, matching the single-host ``JaxEngine`` feature set.
+
+Fault tolerance: sampling keys are derived from ``(request seed, token
+index)``, so after a gang worker dies the replica kills the gang, respawns
+it INTO THE HELD placement group, and replays in-flight requests — the
+regenerated tokens are byte-identical, already-streamed prefixes are
+skipped, and no controller-level replica replacement happens.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import itertools
+import queue
+import threading
 import time
+from collections import OrderedDict, deque
 from typing import Optional
 
 import ray_tpu
@@ -26,7 +45,7 @@ from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
 
 class EngineWorker:
     """One process of the gang: joins the jax.distributed world, hosts the
-    sharded params + compiled programs, answers lockstep generate calls."""
+    sharded params + compiled programs, executes broadcast step plans."""
 
     def reserve_coordinator(self) -> str:
         import socket
@@ -50,10 +69,11 @@ class EngineWorker:
                 num_processes=world,
                 process_id=rank,
             )
-        from ray_tpu.llm.spmd import SPMDGenerator
+        from ray_tpu.llm.spmd import SPMDEngineWorker, SPMDGenerator
 
         self.rank = rank
         self.gen = SPMDGenerator(config)
+        self.eng = SPMDEngineWorker(config, self.gen)
         return {
             "rank": rank,
             "global_devices": jax.device_count(),
@@ -62,20 +82,49 @@ class EngineWorker:
         }
 
     def generate_batch(self, token_lists, params_dict: Optional[dict]):
+        """Legacy lockstep whole-batch generation (offline batch path)."""
         sp = SamplingParams(**params_dict) if params_dict else None
         out = self.gen.generate_batch(token_lists, sampling_params=sp)
         # every process computed the same replicated tokens; only rank 0's
         # payload travels back through the object store
         return out if self.rank == 0 else True
 
+    def engine_step(self, plan: dict):
+        """One continuous-batching lockstep step (see SPMDEngineWorker)."""
+        out = self.eng.step(plan)
+        return out if self.rank == 0 else True
+
     def ping(self) -> bool:
         return True
+
+
+class _GangRequest:
+    _seq = itertools.count()
+
+    def __init__(self, request_id: str, prompt_ids: list, params: SamplingParams):
+        self.seq = next(self._seq)
+        self.request_id = request_id
+        self.prompt_ids = prompt_ids
+        self.params = params  # seed is always concrete (replay determinism)
+        self.out_tokens: list[int] = []  # emitted (streamed) tokens
+        self.gen_count = 0  # tokens generated in the CURRENT run (replay-aware)
+        self.last_token = 0
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+        self.stream_queue: "queue.Queue" = queue.Queue()
+        self.submitted_t = time.time()
+        self.first_token_t: Optional[float] = None
+        self.prefix_hit_tokens = 0
 
 
 class GangLLMServer:
     """Serve deployment whose ONE replica is a gang of N engine-worker
     processes (tp/sp sharded). API mirrors ``LLMServer``'s OpenAI-shaped
-    methods so the OpenAI router and proxy work unchanged."""
+    methods (unary + streaming) so the OpenAI router and proxy work
+    unchanged."""
+
+    _PREFIX_CAP = 8  # cached prompt prefixes per gang (mirrored on workers)
 
     def __init__(
         self,
@@ -85,20 +134,18 @@ class GangLLMServer:
         worker_env: Optional[dict] = None,
         pg_timeout: float = 120.0,
     ):
-        import threading
-
         from ray_tpu.llm.tokenizer import get_tokenizer
 
         self.llm_config = llm_config
         self.tokenizer = get_tokenizer(llm_config.model.tokenizer)
         self.num_workers = num_workers
-        # serve replicas are threaded (max_concurrency follows
-        # max_ongoing_requests): two in-flight broadcasts could reach the
-        # workers in different per-actor orders and pair mismatched SPMD
-        # programs in one jax.distributed world — collective deadlock. One
-        # broadcast at a time; queued requests wait here on the replica.
+        self._resources_per_worker = resources_per_worker
+        self._worker_env = worker_env
+        # one broadcast at a time: two in-flight lockstep programs could
+        # reach workers in different per-actor orders — collective deadlock
         self._lockstep = threading.Lock()
         bundles = [dict(resources_per_worker or {"CPU": 1}) for _ in range(num_workers)]
+        self._bundles = bundles
         # STRICT_PACK: the gang must land in one ICI domain (one slice)
         self.pg = placement_group(bundles, strategy="STRICT_PACK")
         if not self.pg.wait(timeout_seconds=pg_timeout):
@@ -106,60 +153,356 @@ class GangLLMServer:
             raise TimeoutError(
                 f"placement group for {num_workers} engine workers not ready"
             )
-        cls = ray_tpu.remote(EngineWorker)
-        opts = {}
-        if worker_env:
-            opts["runtime_env"] = {"env_vars": dict(worker_env)}
-        self.workers = []
+        self.workers: list = []
         try:
-            # append as each handle is created: if creation fails partway,
-            # the except-BaseException shutdown() below must see (and kill)
-            # every actor actually spawned — remove_placement_group only
-            # releases bundle resources, it does not reap actors on the pg.
-            for i in range(num_workers):
-                self.workers.append(
-                    cls.options(
-                        num_cpus=bundles[i].get("CPU", 1),
-                        resources={k: v for k, v in bundles[i].items() if k != "CPU"},
-                        scheduling_strategy=PlacementGroupSchedulingStrategy(
-                            placement_group=self.pg, placement_group_bundle_index=i
-                        ),
-                        name=f"llm-gang-{llm_config.served_name}-{i}-{time.time_ns()}",
-                        **opts,
-                    ).remote()
-                )
-            coordinator = ray_tpu.get(
-                self.workers[0].reserve_coordinator.remote(), timeout=60
-            )
-            # all setups in flight together: jax.distributed.initialize
-            # blocks until the whole world has connected
-            infos = ray_tpu.get(
-                [
-                    w.setup.remote(llm_config, rank, num_workers, coordinator)
-                    for rank, w in enumerate(self.workers)
-                ],
-                timeout=300,
-            )
+            self._spawn_gang()
         except BaseException:
             # a failed replica construction must not pin a slice's worth of
             # reserved resources (actors + STRICT_PACK pg) across retries
             self.shutdown()
             raise
+        # ---- scheduler state (the gang's single brain) ----
+        ec = llm_config.engine
+        self.n_slots = ec.max_num_seqs
+        self.max_len = ec.max_seq_len
+        self.chunk = min(ec.prefill_buckets)
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._slots: list = [None] * self.n_slots
+        self._adm: Optional[dict] = None
+        self._prefix_index: "OrderedDict[str, int]" = OrderedDict()
+        self._pending_store: Optional[dict] = None
+        self._pending_evict: list = []
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._rebuilds = 0
+        self._need_rebuild = False
+        self._fatal: Optional[BaseException] = None
+        self._stop = False
+        self._loop_thread = threading.Thread(
+            target=self._loop, daemon=True, name="gang-scheduler"
+        )
+        self._loop_thread.start()
+
+    def _spawn_gang(self):
+        """(Re)create the full worker gang inside the held placement group
+        and rendezvous a fresh jax.distributed world."""
+        cls = ray_tpu.remote(EngineWorker)
+        opts = {}
+        if self._worker_env:
+            opts["runtime_env"] = {"env_vars": dict(self._worker_env)}
+        workers = []
+        try:
+            # append as each handle is created: if creation fails partway,
+            # the cleanup must see (and kill) every actor actually spawned —
+            # remove_placement_group only releases bundle resources, it does
+            # not reap actors on the pg.
+            for i in range(self.num_workers):
+                workers.append(
+                    cls.options(
+                        num_cpus=self._bundles[i].get("CPU", 1),
+                        resources={
+                            k: v
+                            for k, v in self._bundles[i].items()
+                            if k != "CPU"
+                        },
+                        scheduling_strategy=PlacementGroupSchedulingStrategy(
+                            placement_group=self.pg,
+                            placement_group_bundle_index=i,
+                        ),
+                        name=f"llm-gang-{self.llm_config.served_name}-{i}-{time.time_ns()}",
+                        **opts,
+                    ).remote()
+                )
+            coordinator = ray_tpu.get(
+                workers[0].reserve_coordinator.remote(), timeout=60
+            )
+            # all setups in flight together: jax.distributed.initialize
+            # blocks until the whole world has connected
+            infos = ray_tpu.get(
+                [
+                    w.setup.remote(self.llm_config, rank, self.num_workers, coordinator)
+                    for rank, w in enumerate(workers)
+                ],
+                timeout=300,
+            )
+        except BaseException:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        self.workers = workers
         self.gang_info = infos[0]
 
-    # -- generation (lockstep broadcast) ------------------------------------
+    # -- scheduler loop ------------------------------------------------------
 
-    def _generate(self, prompts: list[str], params: SamplingParams):
-        token_lists = [self.tokenizer.encode(p) for p in prompts]
-        pd = {
-            f: getattr(params, f) for f in SamplingParams.__dataclass_fields__
+    def submit(self, prompt: str, params: SamplingParams) -> _GangRequest:
+        ids = self.tokenizer.encode(prompt)
+        if len(ids) > self.max_len - 1:
+            raise ValueError(
+                f"prompt length {len(ids)} exceeds the maximum "
+                f"{self.max_len - 1} (max_seq_len)"
+            )
+        if self._fatal is not None:
+            raise RuntimeError(f"gang is down: {self._fatal}")
+        if params.seed is None:
+            import random as _random
+
+            # every request gets a concrete seed: replay after a gang
+            # rebuild must regenerate the exact streamed tokens
+            params = dataclasses.replace(params, seed=_random.getrandbits(31))
+        req = _GangRequest(f"gang-{time.time_ns()}", ids, params)
+        with self._cv:
+            self._queue.append(req)
+            self._cv.notify_all()
+        return req
+
+    def _loop(self):
+        while not self._stop:
+            with self._cv:
+                while (
+                    not self._stop
+                    and not self._need_rebuild
+                    and self._adm is None
+                    and not any(self._slots)
+                    and not self._queue
+                ):
+                    self._cv.wait(timeout=1.0)
+                if self._stop:
+                    return
+            if self._need_rebuild:
+                self._do_rebuild()
+                continue
+            plan = self._build_plan()
+            if plan is None:
+                continue
+            try:
+                with self._lockstep:
+                    refs = [w.engine_step.remote(plan) for w in self.workers]
+                    outs = ray_tpu.get(refs, timeout=600)
+                res = outs[0]
+            except Exception as e:  # noqa: BLE001 — a worker died mid-step
+                self._do_rebuild(cause=e)
+                continue
+            self._apply(plan, res)
+
+    def _build_plan(self) -> Optional[dict]:
+        import numpy as np
+
+        plan: dict = {}
+        if self._pending_evict:
+            plan["evict"] = self._pending_evict
+            self._pending_evict = []
+        if self._pending_store is not None:
+            plan["store"] = self._pending_store
+            self._pending_store = None
+        if self._adm is None:
+            with self._cv:
+                free = next(
+                    (i for i, r in enumerate(self._slots) if r is None), None
+                )
+                req = self._queue.popleft() if (free is not None and self._queue) else None
+            if req is not None:
+                self._start_admission(req, free)
+        a = self._adm
+        if a is not None:
+            ch = a["chunks"][a["idx"]]
+            plan["admit"] = {
+                "slot": a["slot"],
+                "tokens": ch["tokens"],
+                "eff": ch["eff"],
+                "start": ch["start"],
+                "final": ch["final"],
+                "fresh": a["idx"] == 0,
+                "seed_prefix": a["prefix_key"] if a["idx"] == 0 else None,
+                "temp": float(a["req"].params.temperature),
+                "top_k": int(a["req"].params.top_k),
+                "key": np.asarray(
+                    [a["req"].params.seed & 0xFFFFFFFF, 0], np.uint32
+                ),
+            }
+        active = [i for i, r in enumerate(self._slots) if r is not None]
+        if active:
+            S = self.n_slots
+            tokens = np.zeros((S,), np.int32)
+            temps = np.zeros((S,), np.float32)
+            top_ks = np.full((S,), 50, np.int32)
+            keys = np.zeros((S, 2), np.uint32)
+            for i in active:
+                r = self._slots[i]
+                tokens[i] = r.last_token
+                temps[i] = r.params.temperature
+                top_ks[i] = r.params.top_k
+                keys[i] = (r.params.seed & 0xFFFFFFFF, r.gen_count)
+            plan["decode"] = {
+                "tokens": tokens,
+                "temps": temps,
+                "top_ks": top_ks,
+                "keys": keys,
+            }
+            plan["active"] = active
+        return plan or None
+
+    def _start_admission(self, req: _GangRequest, slot: int):
+        import numpy as np
+
+        ids = req.prompt_ids
+        C = self.chunk
+        L = len(ids)
+        m = C * ((L - 1) // C)  # bucket-aligned strict-prefix length
+        prefix_key = None
+        store_key = None
+        if m > 0:
+            key = hashlib.sha1(np.asarray(ids[:m], np.int32).tobytes()).hexdigest()
+            if self._prefix_index.get(key) == m:
+                prefix_key = key
+                self._prefix_index.move_to_end(key)
+                req.prefix_hit_tokens = m
+                self._prefix_hits += 1
+            else:
+                store_key = key
+                self._prefix_misses += 1
+        start = m if prefix_key is not None else 0
+        chunks = []
+        pos = start
+        while pos < L:
+            eff = min(C, L - pos)
+            tok = np.zeros((1, C), np.int32)
+            tok[0, :eff] = ids[pos : pos + eff]
+            chunks.append(
+                {"tokens": tok, "eff": eff, "start": pos, "final": pos + eff >= L}
+            )
+            pos += eff
+        self._adm = {
+            "req": req,
+            "slot": slot,
+            "chunks": chunks,
+            "idx": 0,
+            "prefix_key": prefix_key,
+            "store_key": store_key,
+            "store_m": m,
         }
+
+    def _apply(self, plan: dict, res: dict):
+        adm_plan = plan.get("admit")
+        if adm_plan is not None and self._adm is not None:
+            a = self._adm
+            a["idx"] += 1
+            if adm_plan["final"]:
+                req = a["req"]
+                if a["store_key"]:
+                    # prompt KV is complete in the slot: snapshot it next
+                    # step (before the slot could be reused)
+                    self._pending_store = {
+                        "slot": a["slot"],
+                        "m": a["store_m"],
+                        "key": a["store_key"],
+                    }
+                    self._prefix_index[a["store_key"]] = a["store_m"]
+                    while len(self._prefix_index) > self._PREFIX_CAP:
+                        old_key, _ = self._prefix_index.popitem(last=False)
+                        self._pending_evict.append(old_key)
+                if req.first_token_t is None:
+                    req.first_token_t = time.time()
+                if self._process_token(req, int(res["admit_tok"])):
+                    self._slots[a["slot"]] = req
+                self._adm = None
+        if plan.get("decode") is not None and res.get("toks") is not None:
+            toks = res["toks"]
+            for slot in plan["active"]:
+                r = self._slots[slot]
+                if r is None:
+                    continue
+                if not self._process_token(r, int(toks[slot])):
+                    self._slots[slot] = None
+
+    def _process_token(self, req: _GangRequest, t: int) -> bool:
+        """Account one sampled token; returns False when the request
+        finished (replay-aware: regenerated tokens are not re-streamed)."""
+        p = req.params
+        idx = req.gen_count
+        req.gen_count += 1
+        eos = self.tokenizer.eos_id
+        stop = set(p.stop_token_ids or ())
+        if (t == eos and not p.ignore_eos) or t in stop:
+            self._finish(req, "stop")
+            return False
+        req.last_token = t
+        if idx >= len(req.out_tokens):
+            req.out_tokens.append(t)
+            req.stream_queue.put(t)
+        if req.gen_count >= p.max_tokens:
+            self._finish(req, "length")
+            return False
+        if len(req.prompt_ids) + req.gen_count >= self.max_len:
+            self._finish(req, "length")
+            return False
+        return True
+
+    def _finish(self, req: _GangRequest, reason: str):
+        req.finish_reason = reason
+        req.stream_queue.put(None)
+        req.done.set()
+
+    def _fail_request(self, req: _GangRequest, exc: BaseException):
+        req.error = exc
+        req.finish_reason = "error"
+        req.stream_queue.put(None)
+        req.done.set()
+
+    # -- fault tolerance -----------------------------------------------------
+
+    def _do_rebuild(self, cause: Optional[BaseException] = None):
+        """A gang worker died: the jax.distributed world is broken for every
+        survivor, so kill the whole gang, respawn it into the HELD placement
+        group, and replay in-flight requests (deterministic seeds make the
+        replayed prefix byte-identical; already-streamed tokens are
+        skipped). No controller-level replica replacement happens."""
+        self._need_rebuild = False
+        self._rebuilds += 1
+        live = [r for r in self._slots if r is not None]
+        if self._adm is not None:
+            live.append(self._adm["req"])
+        self._slots = [None] * self.n_slots
+        self._adm = None
+        # worker-side prefix stores died with the gang — reset the mirror
+        self._prefix_index.clear()
+        self._pending_store = None
+        self._pending_evict = []
         with self._lockstep:
-            refs = [
-                w.generate_batch.remote(token_lists, pd) for w in self.workers
-            ]
-            outs = ray_tpu.get(refs, timeout=600)
-        return token_lists, outs[0]
+            old = self.workers
+            self.workers = []
+            for w in old:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                self._spawn_gang()
+            except Exception as e:  # noqa: BLE001 — slice truly gone
+                self._fatal = e
+                with self._cv:
+                    queued = list(self._queue)
+                    self._queue.clear()
+                for r in live + queued:
+                    self._fail_request(r, e)
+                return
+        for r in live:
+            r.gen_count = 0  # replay from the prompt; emitted prefix skipped
+        with self._cv:
+            for r in sorted(live, key=lambda r: r.seq, reverse=True):
+                self._queue.appendleft(r)
+            self._cv.notify_all()
+
+    # -- OpenAI surface ------------------------------------------------------
+
+    def _wait_unary(self, req: _GangRequest) -> None:
+        if not req.done.wait(timeout=600):
+            raise TimeoutError("gang generation timed out")
+        if req.error is not None:
+            raise req.error
 
     def completions(self, body: dict) -> dict:
         prompt = body.get("prompt", "")
@@ -172,29 +515,27 @@ class GangLLMServer:
             }
         )
         try:
-            prompt_ids, outs = self._generate([prompt], params)
-        except ValueError as e:
-            # prompt-too-long (spmd.generate_batch's contract) -> OpenAI 400
+            req = self.submit(prompt, params)
+            self._wait_unary(req)
+        except (ValueError, RuntimeError, TimeoutError) as e:
             return {"error": {"message": str(e), "code": 400}}
-        text = self.tokenizer.decode(outs[0])
+        text = self.tokenizer.decode(req.out_tokens)
         return {
-            "id": f"cmpl-gang-{time.time_ns()}",
+            "id": f"cmpl-{req.request_id}",
             "object": "text_completion",
-            "created": int(time.time()),
+            "created": int(req.submitted_t),
             "model": self.llm_config.served_name,
             "choices": [
                 {
                     "index": 0,
                     "text": text,
-                    "finish_reason": "length"
-                    if len(outs[0]) >= params.max_tokens
-                    else "stop",
+                    "finish_reason": req.finish_reason,
                 }
             ],
             "usage": {
-                "prompt_tokens": len(prompt_ids[0]),
-                "completion_tokens": len(outs[0]),
-                "total_tokens": len(prompt_ids[0]) + len(outs[0]),
+                "prompt_tokens": len(req.prompt_ids),
+                "completion_tokens": len(req.out_tokens),
+                "total_tokens": len(req.prompt_ids) + len(req.out_tokens),
             },
         }
 
@@ -203,6 +544,8 @@ class GangLLMServer:
 
         prompt = LLMServer._render_chat(body.get("messages", []))
         res = self.completions({**body, "prompt": prompt})
+        if "error" in res:
+            return res
         res["object"] = "chat.completion"
         res["choices"] = [
             {
@@ -215,6 +558,89 @@ class GangLLMServer:
             }
         ]
         return res
+
+    def _drain(self, req: _GangRequest):
+        """Incremental text chunks as tokens stream out of the scheduler."""
+        emitted = 0
+        prev = ""
+        while True:
+            tok = req.stream_queue.get()
+            if tok is None:
+                break
+            emitted += 1
+            text = self.tokenizer.decode(req.out_tokens[:emitted])
+            inc = text[len(prev):]
+            prev = text
+            if inc:
+                yield inc
+        if req.error is not None:
+            raise req.error
+
+    def completions_stream(self, body: dict):
+        """Generator of OpenAI ``text_completion`` chunk dicts — one per
+        generated token, pumped by rank 0's scheduler (SSE at gang scale)."""
+        prompt = body.get("prompt", "")
+        params = _sampling_from_dict(
+            {
+                "max_tokens": body.get("max_tokens", 64),
+                "temperature": body.get("temperature", 0.0),
+                "top_k": body.get("top_k", 50),
+                "seed": body.get("seed"),
+            }
+        )
+        try:
+            req = self.submit(prompt, params)
+        except (ValueError, RuntimeError) as e:
+            yield {"error": {"message": str(e), "code": 400}}
+            return
+        created = int(time.time())
+        for inc in self._drain(req):
+            yield {
+                "id": f"cmpl-{req.request_id}",
+                "object": "text_completion",
+                "created": created,
+                "model": self.llm_config.served_name,
+                "choices": [
+                    {"index": 0, "text": inc, "finish_reason": None}
+                ],
+            }
+        yield {
+            "id": f"cmpl-{req.request_id}",
+            "object": "text_completion",
+            "created": created,
+            "model": self.llm_config.served_name,
+            "choices": [
+                {"index": 0, "text": "", "finish_reason": req.finish_reason}
+            ],
+        }
+
+    def chat_stream(self, body: dict):
+        """Generator of OpenAI ``chat.completion.chunk`` dicts."""
+        from ray_tpu.llm.server import LLMServer
+
+        prompt = LLMServer._render_chat(body.get("messages", []))
+        first = True
+        for chunk in self.completions_stream({**body, "prompt": prompt}):
+            if "error" in chunk:
+                yield chunk
+                return
+            delta = {}
+            text = chunk["choices"][0]["text"]
+            finish = chunk["choices"][0]["finish_reason"]
+            if finish is None:
+                delta = {"content": text}
+                if first:
+                    delta["role"] = "assistant"
+                    first = False
+            yield {
+                "id": chunk["id"].replace("cmpl-", "chatcmpl-"),
+                "object": "chat.completion.chunk",
+                "created": chunk["created"],
+                "model": chunk["model"],
+                "choices": [
+                    {"index": 0, "delta": delta, "finish_reason": finish}
+                ],
+            }
 
     def __call__(self, request) -> dict:
         """Direct-proxy entrypoint (a gang deployment can also sit behind
@@ -243,12 +669,34 @@ class GangLLMServer:
         }
 
     def stats(self) -> dict:
-        return {"gang": self.gang_info, "num_workers": self.num_workers}
+        return {
+            "gang": self.gang_info,
+            "num_workers": self.num_workers,
+            "active_slots": sum(1 for r in self._slots if r is not None),
+            "queued": len(self._queue),
+            "prefix_hits": self._prefix_hits,
+            "prefix_misses": self._prefix_misses,
+            "rebuilds": self._rebuilds,
+        }
 
     def check_health(self):
-        ray_tpu.get([w.ping.remote() for w in self.workers], timeout=30)
+        """Serve health probe. A dead worker triggers an IN-PLACE gang
+        rebuild (the replica heals itself); only an unrecoverable gang
+        (respawn failed) reports unhealthy so the controller replaces the
+        replica."""
+        if self._fatal is not None:
+            raise RuntimeError(f"gang is down: {self._fatal}")
+        try:
+            ray_tpu.get([w.ping.remote() for w in self.workers], timeout=30)
+        except Exception:  # noqa: BLE001
+            with self._cv:
+                self._need_rebuild = True
+                self._cv.notify_all()
 
     def shutdown(self):
+        self._stop = True
+        with self._cv:
+            self._cv.notify_all()
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

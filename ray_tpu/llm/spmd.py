@@ -241,3 +241,198 @@ class SPMDGenerator:
                 self.params, cache, nxt, temp, sub, top_k
             )
         return out
+
+
+class SPMDEngineWorker:
+    """Per-process half of the gang's CONTINUOUS-BATCHING engine.
+
+    The single-host ``JaxEngine`` makes admission/chunk/sampling decisions
+    inside its own loop; in a gang that loop must not exist on workers —
+    every process has to issue identical programs in identical order. So
+    the replica (``GangLLMServer``) runs the scheduler and broadcasts one
+    ``StepPlan`` per lockstep iteration; each process executes the plan's
+    programs against its local shard of the slot cache and rank 0 reports
+    the sampled tokens back. Chunked prefill, the prefix cache, and slot
+    state evolve identically on all ranks because they are pure functions
+    of the plan stream. (Reference contract: continuous batching at any
+    TP×PP, ``llm/_internal/serve/.../vllm_engine.py``.)
+
+    Determinism rule: sampling keys arrive IN the plan, derived from
+    ``(request_seed, token_index)`` — replay after a gang rebuild
+    regenerates the exact streamed prefix, and batch composition never
+    affects a request's tokens.
+    """
+
+    def __init__(self, config: LLMConfig, generator: SPMDGenerator):
+        import jax
+        import numpy as np  # noqa: F401
+
+        ec = config.engine
+        self.config = config
+        self.gen = generator
+        self.params = generator.params
+        self.model_cfg = generator.model_cfg
+        self.mesh = generator.mesh
+        self.n_slots = ec.max_num_seqs
+        self.max_len = ec.max_seq_len
+        self.chunk = min(ec.prefill_buckets)
+        self._prefix: dict[str, tuple] = {}  # key -> (k, v) device arrays
+        self._compile()
+        self.cache = self._make_cache(self.n_slots, self.max_len)
+        self._one = None  # scratch stripe for the in-flight admission
+
+    def _compile(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.models.llama import decode_step, init_kv_cache, prefill
+
+        cfg = self.model_cfg
+        mesh = self.mesh
+        rep = NamedSharding(mesh, P())
+        tp = mesh.shape.get("tp", 1)
+        kv_spec = (
+            P(None, None, "tp", None, None)
+            if tp > 1 and cfg.n_kv_heads % tp == 0
+            else P()
+        )
+        kv = NamedSharding(mesh, kv_spec)
+        cache_sh = {"k": kv, "v": kv, "length": rep}
+        self._cache_shardings = cache_sh
+
+        self._make_cache = jax.jit(
+            lambda b, m: init_kv_cache(cfg, b, m),
+            static_argnums=(0, 1),
+            out_shardings=cache_sh,
+        )
+
+        K = min(64, cfg.vocab_size)
+        self._top_k_static = K
+
+        def sample_row(logits_row, temp, top_k, key):
+            greedy = jnp.argmax(logits_row, -1)
+            vals, idxs = jax.lax.top_k(logits_row, K)
+            rank_ok = jnp.arange(K) < top_k
+            scaled = jnp.where(rank_ok, vals / jnp.maximum(temp, 1e-6), -jnp.inf)
+            sampled = idxs[jax.random.categorical(key, scaled)]
+            return jnp.where(temp <= 0.0, greedy, sampled).astype(jnp.int32)
+
+        def chunk_mid(params, one, tokens, eff, start):
+            _, one = prefill(
+                params, one, tokens, cfg, lengths=eff, start_pos=start,
+                with_logits=False,
+            )
+            return one
+
+        self._chunk_mid = jax.jit(
+            chunk_mid, donate_argnums=(1,), out_shardings=cache_sh
+        )
+
+        def chunk_final(params, cache, one, tokens, eff, start, slot,
+                        temp, top_k, key):
+            last_logits, one = prefill(
+                params, one, tokens, cfg, lengths=eff, start_pos=start,
+            )
+            total = start[0] + eff[0]
+            cache = {
+                "k": cache["k"].at[:, slot].set(one["k"][:, 0]),
+                "v": cache["v"].at[:, slot].set(one["v"][:, 0]),
+                "length": cache["length"].at[slot].set(total),
+            }
+            tok = sample_row(last_logits[0], temp, top_k, key)
+            return tok, cache
+
+        self._chunk_final = jax.jit(
+            chunk_final, donate_argnums=(2,), out_shardings=(rep, cache_sh)
+        )
+
+        def decode(params, cache, tokens, temps, top_ks, keys):
+            logits, cache = decode_step(params, cache, tokens, cfg)
+            toks = jax.vmap(sample_row)(logits, temps, top_ks, keys)
+            return toks, cache
+
+        self._decode = jax.jit(
+            decode, donate_argnums=(1,), out_shardings=(rep, cache_sh)
+        )
+
+        def seed_prefix(one, pk, pv):
+            m = pk.shape[2]
+            return {
+                "k": one["k"].at[:, 0, :, :m].set(pk),
+                "v": one["v"].at[:, 0, :, :m].set(pv),
+                "length": one["length"],
+            }
+
+        self._seed_prefix = jax.jit(
+            seed_prefix, donate_argnums=(0,), out_shardings=cache_sh
+        )
+        # prefix extraction specializes per bucket-aligned m (bounded:
+        # max_len / chunk distinct shapes)
+        self._extract_cache: dict[int, object] = {}
+
+    def _extract(self, m: int):
+        import jax
+
+        fn = self._extract_cache.get(m)
+        if fn is None:
+            fn = jax.jit(
+                lambda cache, slot: (
+                    cache["k"][:, slot, :, :m],
+                    cache["v"][:, slot, :, :m],
+                )
+            )
+            self._extract_cache[m] = fn
+        return fn
+
+    def step(self, plan: dict):
+        """Execute one lockstep plan; returns the sampled tokens
+        {"admit_tok": int|-1, "toks": [n_slots]|None} (all ranks compute
+        them, only rank 0's copy is consumed)."""
+        import jax.numpy as jnp
+
+        for key in plan.get("evict", ()):
+            self._prefix.pop(key, None)
+        admit_tok = -1
+        adm = plan.get("admit")
+        if adm is not None:
+            if adm.get("fresh"):
+                self._one = self._make_cache(1, self.max_len)
+                pref = adm.get("seed_prefix")
+                if pref is not None and pref in self._prefix:
+                    pk, pv = self._prefix[pref]
+                    self._one = self._seed_prefix(self._one, pk, pv)
+            tokens = jnp.asarray(adm["tokens"])
+            eff = jnp.asarray([adm["eff"]], jnp.int32)
+            start = jnp.asarray([adm["start"]], jnp.int32)
+            if not adm["final"]:
+                self._one = self._chunk_mid(
+                    self.params, self._one, tokens, eff, start
+                )
+            else:
+                tok, self.cache = self._chunk_final(
+                    self.params, self.cache, self._one, tokens, eff, start,
+                    jnp.int32(adm["slot"]),
+                    jnp.asarray(adm["temp"], jnp.float32),
+                    jnp.asarray(adm["top_k"], jnp.int32),
+                    jnp.asarray(adm["key"], jnp.uint32),
+                )
+                self._one = None
+                admit_tok = int(SPMDGenerator._host(tok))
+        store = plan.get("store")
+        if store is not None and store["key"] not in self._prefix:
+            pk, pv = self._extract(store["m"])(self.cache, jnp.int32(store["slot"]))
+            self._prefix[store["key"]] = (pk, pv)
+        toks = None
+        dec = plan.get("decode")
+        if dec is not None:
+            toks_dev, self.cache = self._decode(
+                self.params,
+                self.cache,
+                jnp.asarray(dec["tokens"], jnp.int32),
+                jnp.asarray(dec["temps"], jnp.float32),
+                jnp.asarray(dec["top_ks"], jnp.int32),
+                jnp.asarray(dec["keys"], jnp.uint32),
+            )
+            toks = SPMDGenerator._host(toks_dev).tolist()
+        return {"admit_tok": admit_tok, "toks": toks}

@@ -521,15 +521,6 @@ def _pipeline_hidden(stacked, x, cfg: LlamaConfig, mesh: Mesh, pp: int, policy):
     microbatches of the batch dim."""
     from ray_tpu.parallel.pipeline import gpipe_spmd
 
-    if cfg.moe_experts:
-        raise NotImplementedError("pp>1 with MoE layers is not supported yet")
-    if cfg.attention != "full":
-        # inside the vmapped stage the layers see mesh=None, so ring/ulysses
-        # would silently degrade to dense and flash/splash would misclassify
-        # the sharded program as single-device (no SPMD partitioning rule)
-        raise NotImplementedError(
-            f"pp>1 requires attention='full' (got {cfg.attention!r})"
-        )
     L = cfg.n_layers
     if L % pp:
         raise ValueError(f"n_layers {L} not divisible by pp={pp}")
@@ -546,22 +537,29 @@ def _pipeline_hidden(stacked, x, cfg: LlamaConfig, mesh: Mesh, pp: int, policy):
     )
 
     def stage_fn(p_stage, y):
-        # mesh=None inside the vmapped stage: activation constraints can't
-        # name mesh axes under the stage vmap; tp still applies via the
-        # params' shardings and XLA propagation
-        lyr = lambda p, z: _layer(p, z, pos, cfg, None)
+        # the stage sees the REAL mesh: activation constraints, MoE's ep
+        # all_to_all, and ring/ulysses' sp collectives all compose under the
+        # stage vmap (sharding constraints and shard_map both have batching
+        # rules, and the vmapped stage dim keeps its pp sharding); tp also
+        # flows through the params' shardings as before
+        lyr = lambda p, z: _layer(p, z, pos, cfg, mesh)
         if cfg.remat:
             lyr = jax.checkpoint(lyr, policy=policy)
 
-        def body(z, p):
-            z2, _ = lyr(p, z)
-            return z2, None
+        def body(carry, p):
+            z, aux = carry
+            z2, a = lyr(p, z)
+            return (z2, aux + a.astype(jnp.float32)), None
 
-        y, _ = jax.lax.scan(body, y, p_stage)
-        return y
+        (y, aux), _ = jax.lax.scan(body, (y, jnp.zeros((), jnp.float32)), p_stage)
+        return y, aux
 
-    out = gpipe_spmd(stage_params, x_mb, stage_fn, mesh)
-    return out.reshape(B, T, e), jnp.zeros((), jnp.float32)
+    out, aux = gpipe_spmd(stage_params, x_mb, stage_fn, mesh, with_aux=True)
+    # per-microbatch aux values are token-MEAN statistics; averaging over
+    # the M microbatches matches the non-pp full-batch scale (mean of
+    # per-microbatch load-balance terms vs. the batch-level term — equal in
+    # expectation, which is all the Switch-style aux promises)
+    return out.reshape(B, T, e), aux / jnp.float32(M)
 
 
 def _project_logits(x, params, cfg: LlamaConfig, mesh: Optional[Mesh]):

@@ -109,6 +109,7 @@ def gpipe_spmd(
     mesh: Mesh,
     *,
     axis_name: str = "pp",
+    with_aux: bool = False,
 ):
     """GPipe inside one jit/GSPMD program (no shard_map).
 
@@ -123,8 +124,10 @@ def gpipe_spmd(
     Args:
       stage_params: pytree, each leaf [pp, ...] (one slice per stage).
       x_mb: [M, mb, ...] microbatched input.
-      stage_fn: (stage_param_slice, activation [mb, ...]) -> activation.
-    Returns [M, mb, ...] outputs.
+      stage_fn: (stage_param_slice, activation [mb, ...]) -> activation,
+        or -> (activation, aux_scalar) when ``with_aux`` (e.g. the MoE
+        load-balancing loss; bubble-tick garbage is masked out).
+    Returns [M, mb, ...] outputs (plus the summed aux when ``with_aux``).
     """
     from jax.sharding import NamedSharding
 
@@ -139,10 +142,12 @@ def gpipe_spmd(
     stage_params = jax.tree.map(cst, stage_params)
     buf = cst(jnp.zeros((pp,) + x_mb.shape[1:], x_mb.dtype))
     outs = jnp.zeros_like(x_mb)
+    aux_acc = jnp.zeros((), jnp.float32)
     vmapped = jax.vmap(stage_fn)
+    stage_ids = jnp.arange(pp)
 
     def tick(carry, t):
-        buf, outs = carry
+        buf, outs, aux_acc = carry
         # previous stage's output becomes this stage's input (roll on the
         # pp-sharded dim = collective permute); stage 0 takes the next
         # fresh microbatch (clipped reads past M feed bubbles whose outputs
@@ -152,16 +157,27 @@ def gpipe_spmd(
             x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
         )
         inp = cst(shifted.at[0].set(fresh))
-        out = cst(vmapped(stage_params, inp))
+        if with_aux:
+            out, aux = vmapped(stage_params, inp)  # aux: [pp]
+            # stage s holds REAL microbatch (t - s) only for s <= t < s + M;
+            # bubble ticks run on clipped/garbage activations whose aux
+            # must not leak into the loss
+            valid = ((t >= stage_ids) & (t - stage_ids < M)).astype(jnp.float32)
+            aux_acc = aux_acc + jnp.sum(aux.astype(jnp.float32) * valid)
+        else:
+            out = vmapped(stage_params, inp)
+        out = cst(out)
         # last stage's output for microbatch t-(pp-1); early garbage writes
         # at clipped index 0 are overwritten by the real store at t=pp-1
         outs = jax.lax.dynamic_update_index_in_dim(
             outs, out[pp - 1], jnp.clip(t - (pp - 1), 0, M - 1), 0
         )
-        return (out, outs), None
+        return (out, outs, aux_acc), None
 
-    (_, outs), _ = jax.lax.scan(tick, (buf, outs), jnp.arange(ticks))
-    return outs
+    (_, outs, aux_acc), _ = jax.lax.scan(
+        tick, (buf, outs, aux_acc), jnp.arange(ticks)
+    )
+    return (outs, aux_acc) if with_aux else outs
 
 
 def _strip_stage_dim(stage_fn):

@@ -20,6 +20,7 @@ from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.grpc_proxy import GrpcProxyActor, start_grpc_proxy
 from ray_tpu.serve.proxy import ProxyActor, Request, start_proxy
 
 __all__ = [
@@ -42,5 +43,7 @@ __all__ = [
     "run",
     "shutdown",
     "start_proxy",
+    "GrpcProxyActor",
+    "start_grpc_proxy",
     "status",
 ]

@@ -1,0 +1,165 @@
+"""gRPC ingress: a second proxy front end over the same Router/handle plane.
+
+Reference: the reference serves gRPC beside HTTP through one proxy
+(``python/ray/serve/_private/proxy.py:521`` gRPCProxy; wire schema
+``src/ray/protobuf/serve.proto``). Here the service is implemented with
+grpc's generic handlers — no codegen step — speaking the equivalent wire
+contract:
+
+    service ray_tpu.serve.ServeAPI {
+      rpc Predict        (bytes) returns (bytes);          // unary
+      rpc PredictStreamed(bytes) returns (stream bytes);   // server stream
+    }
+
+Requests carry the serve route in invocation metadata:
+  ``route``  — full path, e.g. "/myapp/predict" (matched against route
+               prefixes exactly like the HTTP proxy's path matching)
+The request bytes are the body (typically JSON) handed to the ingress
+deployment as a POST ``Request``; unary responses are the handler's JSON
+(or raw bytes) result; streamed responses yield one message per handler
+chunk (SSE-framing stripped — gRPC has native message framing).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.serve.proxy import Request, RouteTable
+
+SERVICE = "ray_tpu.serve.ServeAPI"
+
+
+def _encode_message(item) -> Optional[bytes]:
+    """One deployment chunk -> one gRPC message (None = skip framing-only
+    chunks). SSE ``data:`` framing from HTTP-oriented generators is
+    stripped — gRPC messages are already delimited."""
+    from ray_tpu.serve.streaming import StreamStart
+
+    if isinstance(item, StreamStart):
+        return None
+    if isinstance(item, bytes):
+        return item
+    if isinstance(item, str):
+        text = item
+        if text.startswith("data: "):
+            text = text[len("data: "):]
+        text = text.strip()
+        if not text or text == "[DONE]":
+            return None
+        return text.encode()
+    return json.dumps(item).encode()
+
+
+class GrpcProxyActor:
+    """Runs the gRPC server; shares the HTTP proxy's route-resolution
+    machinery (RouteTable) so both ingresses see identical applications."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+        from concurrent import futures
+
+        import grpc
+
+        self._rt = RouteTable()
+        actor = self
+
+        def _resolve(request: bytes, context):
+            md = {k: v for k, v in (context.invocation_metadata() or ())}
+            route = md.get("route", "/")
+            handle, rest = actor._rt.match(route)
+            if handle is None:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND, f"no route for {route!r}"
+                )
+            return handle, Request("POST", rest, {}, md, request)
+
+        def predict(request: bytes, context) -> bytes:
+            handle, req = _resolve(request, context)
+            try:
+                result = handle.remote(req).result(timeout_s=120)
+            except Exception as e:  # noqa: BLE001 — surface as gRPC status
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                return b""
+            if isinstance(result, bytes):
+                return result
+            return json.dumps(result).encode()
+
+        def predict_streamed(request: bytes, context):
+            handle, req = _resolve(request, context)
+            chunks = handle.options(stream=True).remote(req)
+            while True:
+                try:
+                    item = chunks.next(timeout_s=120)
+                except StopIteration:
+                    return
+                except Exception as e:  # noqa: BLE001
+                    context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                    return
+                msg = _encode_message(item)
+                if msg is not None:
+                    yield msg
+
+        ident = lambda b: b  # raw-bytes (de)serializers
+        handlers = grpc.method_handlers_generic_handler(
+            SERVICE,
+            {
+                "Predict": grpc.unary_unary_rpc_method_handler(
+                    predict, request_deserializer=ident,
+                    response_serializer=ident,
+                ),
+                "PredictStreamed": grpc.unary_stream_rpc_method_handler(
+                    predict_streamed, request_deserializer=ident,
+                    response_serializer=ident,
+                ),
+            },
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="grpc-proxy"
+            )
+        )
+        self._server.add_generic_rpc_handlers((handlers,))
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        if self._port == 0:
+            raise OSError(f"could not bind gRPC proxy to {host}:{port}")
+        self._server.start()
+
+    def get_port(self) -> int:
+        return self._port
+
+    def ready(self) -> bool:
+        return True
+
+    def shutdown(self):
+        self._server.stop(grace=1.0)
+        return True
+
+
+_grpc_proxy_handle = None
+_grpc_lock = threading.Lock()
+
+
+def start_grpc_proxy(port: int = 9000):
+    """Ensure the gRPC proxy actor is running; returns (handle, port)."""
+    global _grpc_proxy_handle
+    with _grpc_lock:
+        if _grpc_proxy_handle is not None:
+            try:
+                return _grpc_proxy_handle, ray_tpu.get(
+                    _grpc_proxy_handle.get_port.remote(), timeout=5
+                )
+            except Exception:  # noqa: BLE001 — stale handle
+                _grpc_proxy_handle = None
+        try:
+            _grpc_proxy_handle = ray_tpu.get_actor("serve-grpc-proxy")
+        except Exception:  # noqa: BLE001
+            cls = ray_tpu.remote(GrpcProxyActor)
+            _grpc_proxy_handle = cls.options(
+                name="serve-grpc-proxy", num_cpus=0.1, max_concurrency=32
+            ).remote(port=port)
+        real_port = ray_tpu.get(
+            _grpc_proxy_handle.get_port.remote(), timeout=60
+        )
+        return _grpc_proxy_handle, real_port

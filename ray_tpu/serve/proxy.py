@@ -297,6 +297,67 @@ class AsyncHTTPServer:
         self._pool.shutdown(wait=False)
 
 
+class RouteTable:
+    """Route-prefix → ingress DeploymentHandle map, refreshed from the
+    serve controller — shared by every proxy front end (HTTP and gRPC speak
+    different wire protocols into the SAME Router/handle plane; reference:
+    both proxies in ``serve/_private/proxy.py`` share one route state)."""
+
+    def __init__(self):
+        self._routes: dict = {}
+        self._routes_lock = threading.Lock()
+        self._refresher = threading.Thread(
+            target=self._refresh_loop, daemon=True, name="serve-routes"
+        )
+        self._refresher.start()
+
+    def _refresh_loop(self):
+        import time
+
+        from ray_tpu.serve.api import _get_controller_handle
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        while True:
+            try:
+                controller = _get_controller_handle()
+                routes = ray_tpu.get(controller.list_routes.remote(), timeout=10)
+                with self._routes_lock:
+                    # reuse unchanged handles: a fresh handle per refresh
+                    # tick would discard replica caches and strand drainer
+                    # threads
+                    self._routes = {
+                        prefix: (
+                            self._routes[prefix]
+                            if prefix in self._routes
+                            and self._routes[prefix].deployment_name
+                            == info["ingress"]
+                            else DeploymentHandle(info["ingress"])
+                        )
+                        for prefix, info in routes.items()
+                    }
+            except Exception:
+                pass
+            time.sleep(1.0)
+
+    def table(self) -> dict:
+        with self._routes_lock:
+            return {p: h.deployment_name for p, h in self._routes.items()}
+
+    def match(self, path: str):
+        with self._routes_lock:
+            routes = dict(self._routes)
+        best = None
+        for prefix, handle in routes.items():
+            norm = prefix.rstrip("/") or ""
+            if path == norm or path.startswith(norm + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, handle)
+        if best is None:
+            return None, path
+        rest = path[len(best[0].rstrip("/")) :] or "/"
+        return best[1], rest
+
+
 class ProxyActor:
     """Runs the HTTP server; one per node in a real cluster (here: one)."""
 
@@ -306,10 +367,7 @@ class ProxyActor:
     ):
         import os
 
-        from ray_tpu.serve.handle import DeploymentHandle
-
-        self._routes: dict[str, DeploymentHandle] = {}
-        self._routes_lock = threading.Lock()
+        self._rt = RouteTable()
         proxy = self
         # data plane: 'async' (default — persistent-connection asyncio
         # server) or 'threading' (stdlib thread-per-request, kept for
@@ -319,10 +377,6 @@ class ProxyActor:
             self._async = AsyncHTTPServer(self, host, port)
             self._server = None
             self._port = self._async.port
-            self._refresher = threading.Thread(
-                target=self._refresh_loop, daemon=True, name="serve-routes"
-            )
-            self._refresher.start()
             return
         self._async = None
 
@@ -446,58 +500,14 @@ class ProxyActor:
             target=self._server.serve_forever, daemon=True, name="serve-http"
         )
         self._thread.start()
-        self._refresher = threading.Thread(
-            target=self._refresh_loop, daemon=True, name="serve-routes"
-        )
-        self._refresher.start()
 
     # -- routing table ------------------------------------------------------
 
-    def _refresh_loop(self):
-        import time
-
-        from ray_tpu.serve.api import _get_controller_handle
-        from ray_tpu.serve.handle import DeploymentHandle
-
-        while True:
-            try:
-                controller = _get_controller_handle()
-                routes = ray_tpu.get(controller.list_routes.remote(), timeout=10)
-                with self._routes_lock:
-                    # reuse unchanged handles: a fresh handle per refresh
-                    # tick would discard replica caches and strand drainer
-                    # threads
-                    self._routes = {
-                        prefix: (
-                            self._routes[prefix]
-                            if prefix in self._routes
-                            and self._routes[prefix].deployment_name
-                            == info["ingress"]
-                            else DeploymentHandle(info["ingress"])
-                        )
-                        for prefix, info in routes.items()
-                    }
-            except Exception:
-                pass
-            time.sleep(1.0)
-
     def _route_table(self) -> dict:
-        with self._routes_lock:
-            return {p: h.deployment_name for p, h in self._routes.items()}
+        return self._rt.table()
 
     def _match(self, path: str):
-        with self._routes_lock:
-            routes = dict(self._routes)
-        best = None
-        for prefix, handle in routes.items():
-            norm = prefix.rstrip("/") or ""
-            if path == norm or path.startswith(norm + "/") or prefix == "/":
-                if best is None or len(prefix) > len(best[0]):
-                    best = (prefix, handle)
-        if best is None:
-            return None, path
-        rest = path[len(best[0].rstrip("/")) :] or "/"
-        return best[1], rest
+        return self._rt.match(path)
 
     # -- control ------------------------------------------------------------
 

@@ -1,0 +1,112 @@
+"""gRPC ingress for Serve (VERDICT r4 missing #4).
+
+Reference: the gRPC proxy beside HTTP in
+``python/ray/serve/_private/proxy.py:521`` (schema
+``src/ray/protobuf/serve.proto``). Both ingresses route through the same
+RouteTable/handle plane; a gRPC client calls a deployment unary and
+streams a response."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+pytestmark = pytest.mark.timeout(180) if hasattr(pytest.mark, "timeout") else []
+
+
+class Echo:
+    def __call__(self, request):
+        body = request.json() or {}
+        if body.get("stream"):
+            def gen():
+                for i in range(int(body.get("n", 3))):
+                    yield {"i": i, "path": request.path}
+            return gen()
+        return {"echo": body.get("msg"), "path": request.path}
+
+
+@pytest.fixture
+def grpc_app(ray_start_thread):
+    serve.run(
+        serve.deployment(Echo, name="grpc-echo").bind(),
+        name="grpc-app",
+        route_prefix="/echo",
+    )
+    from ray_tpu.serve.grpc_proxy import start_grpc_proxy
+
+    proxy, port = start_grpc_proxy(port=0)
+    # wait for the route table to pick up the app
+    deadline = time.time() + 30
+    import grpc
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    predict = channel.unary_unary(
+        "/ray_tpu.serve.ServeAPI/Predict",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    while time.time() < deadline:
+        try:
+            predict(b"{}", metadata=(("route", "/echo/ping"),), timeout=10)
+            break
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                time.sleep(0.3)
+                continue
+            break  # INTERNAL etc: route resolved — good enough to proceed
+    yield channel, port
+    channel.close()
+    ray_tpu.get(proxy.shutdown.remote(), timeout=30)
+    serve.shutdown()
+
+
+def test_grpc_unary_predict(grpc_app):
+    channel, _ = grpc_app
+    predict = channel.unary_unary(
+        "/ray_tpu.serve.ServeAPI/Predict",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    out = predict(
+        json.dumps({"msg": "hi"}).encode(),
+        metadata=(("route", "/echo/predict"),),
+        timeout=60,
+    )
+    data = json.loads(out)
+    assert data == {"echo": "hi", "path": "/predict"}
+
+
+def test_grpc_streamed_predict(grpc_app):
+    channel, _ = grpc_app
+    stream = channel.unary_stream(
+        "/ray_tpu.serve.ServeAPI/PredictStreamed",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    msgs = [
+        json.loads(m)
+        for m in stream(
+            json.dumps({"stream": True, "n": 4}).encode(),
+            metadata=(("route", "/echo/gen"),),
+            timeout=60,
+        )
+    ]
+    assert [m["i"] for m in msgs] == [0, 1, 2, 3]
+    assert msgs[0]["path"] == "/gen"
+
+
+def test_grpc_unknown_route(grpc_app):
+    import grpc
+
+    channel, _ = grpc_app
+    predict = channel.unary_unary(
+        "/ray_tpu.serve.ServeAPI/Predict",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    with pytest.raises(grpc.RpcError) as ei:
+        predict(b"{}", metadata=(("route", "/nope"),), timeout=30)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
