@@ -78,6 +78,10 @@ class NodeAgent:
         os.makedirs(self.base_dir, mode=0o700, exist_ok=True)
         self.node_ip = node_ip or P.routable_host()
         self.shutting_down = False
+        # Quiesce handshake (reference: DrainRaylet): while True, new leases
+        # are spilled back instead of queued and a watcher reports
+        # AgentDrained once local work is finished and logs are flushed.
+        self.draining = False
 
         # Local data plane: this node's arena (native C++ store required —
         # cross-host pulls need arena-format locations).
@@ -325,6 +329,8 @@ class NodeAgent:
         """Tear down workers + data plane for a clean re-registration."""
         from ray_tpu._private.object_store import NativePlasmaStore
 
+        self.draining = False  # fresh incarnation accepts leases again
+
         with self.workers_lock:
             workers = list(self.workers.values())
             self.workers.clear()
@@ -417,8 +423,44 @@ class NodeAgent:
                     self.store.delete(oid)
                 except Exception:  # noqa: BLE001
                     pass
+        elif isinstance(msg, P.DrainAgent):
+            self._on_drain(msg)
         elif isinstance(msg, P.Shutdown):
             self.shutting_down = True
+
+    def _on_drain(self, msg: P.DrainAgent):
+        """Quiesce for graceful release (the raylet half of the drain
+        protocol): stop accepting leases, let local work finish within the
+        deadline, flush captured logs, report back."""
+        if self.draining:
+            return
+        self.draining = True
+        logger.info(
+            "drain requested (deadline %.0fs): %s", msg.deadline_s, msg.reason
+        )
+        threading.Thread(
+            target=self._drain_quiesce, args=(msg.deadline_s,),
+            daemon=True, name="agent-drain",
+        ).start()
+
+    def _drain_quiesce(self, deadline_s: float):
+        deadline = time.monotonic() + max(deadline_s, 0.0)
+        remaining = 0
+        while not self.shutting_down:
+            with self._lease_lock:
+                remaining = len(self._leased) + len(self._local_queue)
+            if remaining == 0 or time.monotonic() > deadline:
+                break
+            time.sleep(0.1)
+        # flush: captured worker output must reach the head before release
+        try:
+            self._log_monitor_scan()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._send(P.AgentDrained(self.node_id, remaining=remaining))
+        except (OSError, EOFError):
+            pass
 
     def _heartbeat_loop(self):
         while not self.shutting_down:
@@ -429,6 +471,7 @@ class NodeAgent:
                         {
                             "arena_used_bytes": self.store.used_bytes(),
                             "num_workers": len(self.workers),
+                            "draining": self.draining,
                         },
                     )
                 )
@@ -448,6 +491,19 @@ class NodeAgent:
         """Second-level dispatch: the head picked this node; the agent picks
         (or spawns) the worker (reference: LocalTaskManager dispatch,
         local_task_manager.h:60)."""
+        if self.draining:
+            # quiesce: reject new leases outright — the head re-places them
+            # elsewhere (the drain window race: the head marked us DRAINING
+            # after this lease was already on the wire)
+            try:
+                self._send(
+                    P.TaskSpilled(
+                        [lease.spec.task_id.binary()], reason="draining"
+                    )
+                )
+            except (OSError, EOFError):
+                pass
+            return
         spill = None
         with self._lease_lock:
             self._leased[lease.spec.task_id.binary()] = lease

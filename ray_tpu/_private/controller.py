@@ -65,6 +65,14 @@ class NodeState:
         self.available = dict(resources)
         self.labels = labels or {}
         self.alive = True
+        # Graceful drain (reference: NodeManager::HandleDrainRaylet,
+        # node_manager.cc:1989): a DRAINING node accepts no new leases,
+        # placements, or placement-group bundles; running work finishes
+        # within the drain deadline, restartable actors migrate off, and
+        # resident objects are pulled to the head before release.
+        self.draining = False
+        self.drain_reason: Optional[str] = None
+        self.drain_deadline = 0.0
         # Set for REAL remote nodes (agent-backed); None for the head node
         # and fake test nodes (reference: raylet vs. cluster_utils nodes).
         self.agent: Optional["AgentHandle"] = None
@@ -80,6 +88,13 @@ class NodeState:
         # (two-level scheduling): task_id binary -> PendingTask. The head
         # holds the resource charge; the agent owns worker pop/queueing.
         self.leased: dict[bytes, "PendingTask"] = {}
+
+    @property
+    def schedulable(self) -> bool:
+        """May the scheduler place NEW work here? One predicate for every
+        scheduler site — a node state added here (drain today, cordon
+        tomorrow) applies everywhere at once."""
+        return self.alive and not self.draining
 
     def fits(self, demand: dict[str, float]) -> bool:
         return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
@@ -389,6 +404,11 @@ class Controller:
         # The pin transfers to the consumer at stream_consumed_report; any
         # leftovers release when the completion record is freed.
         self._stream_pins: dict[TaskID, set[int]] = {}
+
+        # Node drain records: node_id -> status dict (kept after completion
+        # so the state API / autoscaler can observe the outcome of a drain
+        # whose node has already left the cluster). Bounded FIFO.
+        self.drains: "OrderedDict[NodeID, dict]" = OrderedDict()
 
         # Real remote nodes (agent-backed): node_id -> AgentHandle; plus
         # which objects are resident on each remote arena (the controller
@@ -1098,6 +1118,254 @@ class Controller:
                 self.memory_store.put(oid, ("error", err))
                 self._on_object_sealed(oid)
 
+    # -------------------------------------------------------------- node drain
+
+    def drain_node(
+        self, node_id: NodeID, deadline_s: float = 60.0, reason: str = ""
+    ) -> dict:
+        """Begin a graceful drain (reference: the DrainRaylet protocol,
+        ``node_manager.cc:1989`` / ``ray drain-node``). Marks the node
+        DRAINING (no new leases/placements), quiesces its agent, waits for
+        in-flight work within ``deadline_s``, migrates restartable actors
+        and resident objects off, then releases the node. Idempotent:
+        re-draining a draining node returns the existing status."""
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                raise ValueError(f"unknown or dead node {node_id.hex()[:12]}")
+            if node_id == self.head_node_id:
+                raise ValueError("cannot drain the head node")
+            if node.draining:
+                return self._drain_record_public(self.drains[node_id])
+            node.draining = True
+            node.drain_reason = reason
+            node.drain_deadline = time.time() + deadline_s
+            rec = {
+                "node_id": node_id.hex(),
+                "state": "draining",
+                "phase": "quiesce",
+                "reason": reason,
+                "started_t": time.time(),
+                "deadline_s": deadline_s,
+                "migrated_actors": 0,
+                "migrated_objects": 0,
+                "agent_quiesced": node.agent is None,
+                "agent_remaining": 0,
+            }
+            self.drains[node_id] = rec
+            while len(self.drains) > 64:
+                old_id, old_rec = next(iter(self.drains.items()))
+                if old_rec["state"] == "draining":
+                    break  # never evict an ACTIVE drain's record
+                del self.drains[old_id]
+            agent = node.agent
+            # the scheduler must stop picking this node immediately
+            self.sched_cv.notify_all()
+        self.publish(
+            "nodes",
+            {"node_id": node_id.hex(), "event": "draining", "reason": reason},
+        )
+        if agent is not None:
+            try:
+                agent.send(P.DrainAgent(deadline_s, reason))
+            except (OSError, EOFError):
+                rec["agent_quiesced"] = True  # dead agent: nothing to quiesce
+        threading.Thread(
+            target=self._drain_loop,
+            args=(node, rec, node.drain_deadline),
+            daemon=True,
+            name=f"drain-{node_id.hex()[:8]}",
+        ).start()
+        return self._drain_record_public(rec)
+
+    @staticmethod
+    def _drain_record_public(rec: dict) -> dict:
+        return dict(rec)
+
+    def drain_status(self, node_hex: Optional[str] = None):
+        """One drain record (by node-id hex prefix) or all of them."""
+        with self.lock:
+            recs = [dict(r) for r in self.drains.values()]
+        if node_hex is None:
+            return recs
+        matches = [r for r in recs if r["node_id"].startswith(node_hex)]
+        return matches[0] if matches else None
+
+    def _drain_loop(self, node: NodeState, rec: dict, deadline: float):
+        try:
+            # 1) migrate restartable actors (their in-flight calls finish
+            # first; queued calls survive the controlled restart)
+            rec["phase"] = "migrate-actors"
+            rec["migrated_actors"] = self._drain_migrate_actors(node, deadline)
+            # 2) wait for in-flight normal tasks (head-dispatched + leased)
+            rec["phase"] = "wait-tasks"
+            clean = self._drain_wait_tasks(node, deadline)
+            # 3) pull resident objects to the head before the arena dies
+            rec["phase"] = "migrate-objects"
+            rec["migrated_objects"] = self._migrate_node_objects(node, deadline)
+            # 4) agent quiesce handshake (logs flushed, local queue empty).
+            # A node that died mid-drain has nothing left to quiesce — stop
+            # waiting instead of spinning out the whole deadline.
+            rec["phase"] = "wait-agent"
+            while (
+                not rec["agent_quiesced"]
+                and node.alive
+                and time.time() < deadline
+                and not self.shutting_down
+            ):
+                time.sleep(0.05)
+            rec["state"] = (
+                "drained" if clean and rec["agent_quiesced"] else "timeout"
+            )
+        except Exception:  # noqa: BLE001 — a drain bug must still release
+            logger.error("drain of node %s failed:\n%s",
+                         node.node_id.hex()[:8], traceback.format_exc())
+            rec["state"] = "error"
+        rec["phase"] = "release"
+        rec["completed_t"] = time.time()
+        self.publish(
+            "nodes",
+            {"node_id": node.node_id.hex(), "event": "drained",
+             "state": rec["state"]},
+        )
+        logger.info(
+            "node %s drain %s: %d actor(s) migrated, %d object(s) pulled",
+            node.node_id.hex()[:8], rec["state"],
+            rec["migrated_actors"], rec["migrated_objects"],
+        )
+        self.remove_node(node.node_id)
+
+    def _drain_migrate_actors(self, node: NodeState, deadline: float) -> int:
+        """Respawn restartable actors elsewhere: wait for each actor's
+        in-flight calls to finish, hold its queue, then retire its worker —
+        the normal restart path re-places it (the scheduler no longer picks
+        the draining node). The restart budget is NOT charged (this is a
+        controlled migration, not a failure)."""
+        migrated = 0
+        while time.time() < deadline and not self.shutting_down:
+            candidate = None
+            waiting = False
+            with self.lock:
+                for actor in self.actors.values():
+                    if (
+                        actor.state == "ALIVE"
+                        and actor.worker is not None
+                        and actor.worker.node_id == node.node_id
+                        and actor.restarts_left != 0
+                        and not getattr(actor, "_drain_migrating", False)
+                    ):
+                        # stop dispatching queued calls onto the old worker
+                        # (they replay on the migrated incarnation)
+                        actor._drain_hold = True  # noqa: SLF001
+                        if actor.inflight == 0:
+                            candidate = actor
+                            actor._drain_migrating = True  # noqa: SLF001
+                            break
+                        waiting = True  # in-flight calls still draining
+            if candidate is None:
+                if not waiting:
+                    return migrated
+                time.sleep(0.02)
+                continue
+            worker = candidate.worker
+            if worker is None:
+                continue  # died concurrently: the restart path owns it now
+            try:
+                worker.send(P.KillActor(candidate.actor_id))
+            except (OSError, EOFError):
+                pass
+            if worker.proc is not None:
+                try:
+                    worker.proc.terminate()
+                except OSError:
+                    pass
+            elif worker.agent is not None:
+                try:
+                    worker.agent.send(P.KillWorker(worker.worker_id))
+                except (OSError, EOFError):
+                    pass
+            migrated += 1
+        return migrated
+
+    def _drain_wait_tasks(self, node: NodeState, deadline: float) -> bool:
+        """Block until no task runs on the node (head-dispatched workers +
+        agent leases). Returns False when the deadline lapsed first."""
+        while time.time() < deadline and not self.shutting_down:
+            with self.lock:
+                busy = bool(node.leased) or any(
+                    w.running
+                    for w in self.workers.values()
+                    if w.node_id == node.node_id and not w.dead
+                )
+            if not busy:
+                return True
+            time.sleep(0.05)
+        with self.lock:
+            return not node.leased and not any(
+                w.running
+                for w in self.workers.values()
+                if w.node_id == node.node_id and not w.dead
+            )
+
+    def _migrate_node_objects(self, node: NodeState, deadline: float) -> int:
+        """Pull-before-release: reseal the draining node's resident objects
+        into the head's store so node removal loses nothing (the inverse of
+        the lazy pull protocol — eager evacuation, reference: the object
+        migration step of safe raylet drain)."""
+        from ray_tpu._private.object_store import ObjectExistsError
+
+        store = self.node_stores.get(node.node_id)
+        if store is None or store is self.plasma:
+            return 0  # shared-store fallback: nothing dies with the node
+        is_remote = getattr(store, "is_remote", False)
+        arena = getattr(store, "arena_name", None)
+        with self.lock:
+            if is_remote:
+                oids = list(self._remote_resident.get(arena, ()))
+                oids += [
+                    oid
+                    for oid, ag in self._agent_spills.items()
+                    if ag is store.agent and oid not in oids
+                ]
+            else:
+                prefix = f"@{arena}#"
+                oids = [
+                    oid
+                    for oid, (name, _) in self.plasma_resident.items()
+                    if name.startswith(prefix)
+                ]
+        moved = 0
+        for oid in oids:
+            if time.time() > deadline:
+                logger.warning(
+                    "drain deadline hit with %d object(s) left on node %s",
+                    len(oids) - moved, node.node_id.hex()[:8],
+                )
+                break
+            entry = self.memory_store.get([oid], timeout=0)[0]
+            if entry is None or entry[0] not in ("plasma", "spilled"):
+                continue  # freed or already inline meanwhile
+            try:
+                data = self.resolve_object(entry, object_id=oid).to_bytes()
+            except Exception:  # noqa: BLE001 — freed/unreachable: skip
+                continue
+            try:
+                seg, name = self._plasma_create_with_spill(oid, len(data))
+                seg.buf[: len(data)] = data
+                self._seal_plasma(oid, name, len(data))
+            except ObjectExistsError:
+                pass  # already resident on the head
+            except Exception:  # noqa: BLE001
+                logger.warning("object migration failed for %s", oid.hex(),
+                               exc_info=True)
+                continue
+            with self.lock:
+                if is_remote:
+                    self._remote_resident.get(arena, set()).discard(oid)
+                    self._agent_spills.pop(oid, None)
+            moved += 1
+        return moved
+
     # ------------------------------------------------------------ object plane
 
     def put_serialized(self, object_id: ObjectID, sobj: SerializedObject, is_error=False):
@@ -1622,6 +1890,10 @@ class Controller:
         """Dispatch queued actor calls respecting max_concurrency + ordering."""
         if actor.state != "ALIVE" or actor.worker is None:
             return
+        if getattr(actor, "_drain_hold", False):
+            # node drain is retiring this worker: queued calls wait for the
+            # migrated incarnation (released in _on_actor_worker_death)
+            return
         maxc = actor.creation_spec.max_concurrency
         while actor.queue and actor.inflight < maxc:
             pt = actor.queue[0]
@@ -1721,7 +1993,9 @@ class Controller:
         spec = pt.spec
         strat = spec.strategy
         demand = dict(spec.resources)
-        alive = [n for n in self.nodes.values() if n.alive]
+        # draining nodes accept no new work (they are finishing what they
+        # have; reference: DrainRaylet rejects new leases)
+        alive = [n for n in self.nodes.values() if n.schedulable]
 
         if strat.kind == "placement_group":
             pg = self.placement_groups.get(strat.placement_group_id)
@@ -1739,14 +2013,17 @@ class Controller:
                 avail = pg.bundle_available[i]
                 if all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
                     node = self.nodes.get(nid)
-                    if node is not None and node.alive:
+                    # a DRAINING node takes no new work, bundle or not —
+                    # the task waits (it would be killed mid-run at release
+                    # otherwise, the exact loss the drain protocol prevents)
+                    if node is not None and node.schedulable:
                         pt._pg_bundle = (pg, i)  # type: ignore[attr-defined]
                         return node
             return None
 
         if strat.kind == "node_affinity":
             node = self.nodes.get(strat.node_id)
-            if node is not None and node.alive and node.fits(demand):
+            if node is not None and node.schedulable and node.fits(demand):
                 return node
             if strat.soft:
                 pass  # fall through to default policy
@@ -1772,7 +2049,7 @@ class Controller:
         head = self.nodes.get(self.head_node_id)
         if (
             head is not None
-            and head.alive
+            and head.schedulable
             and head.fits(demand)
             and head.utilization() < self.config.scheduler_spread_threshold
         ):
@@ -1902,6 +2179,9 @@ class Controller:
         for w in cands:
             if w.dead:
                 continue
+            wnode = self.nodes.get(w.node_id)
+            if wnode is not None and not wnode.schedulable:
+                continue  # draining nodes take no new work
             n = len(w.running)
             if n < best_n:
                 best, best_n = w, n
@@ -1935,7 +2215,10 @@ class Controller:
                 continue
             env_fp = shape[-1]
             thief = None
-            for idle in self.idle_workers.values():
+            for nid, idle in self.idle_workers.items():
+                inode = self.nodes.get(nid)
+                if inode is not None and not inode.schedulable:
+                    continue  # never steal work ONTO a draining node
                 for w in idle:
                     if not w.dead and w.fingerprint == env_fp:
                         thief = w
@@ -1948,7 +2231,7 @@ class Controller:
                 # because a blocked pipeline stops completing tasks)
                 node = self.nodes.get(victim.node_id)
                 sample = next(iter(victim.running.values()), None)
-                if node is not None and sample is not None:
+                if node is not None and node.schedulable and sample is not None:
                     self._acquire_worker(node, sample)
                 continue
             victim.steal_pending = True
@@ -2520,6 +2803,10 @@ class Controller:
                 msg = conn.recv()
             except (EOFError, OSError):
                 break
+            except TypeError:
+                # another thread close()d this connection mid-recv (drain's
+                # remove_node): the handle is None now — same as EOF
+                break
             self.worker_msg_count += 1
             if isinstance(msg, P.FromWorker):
                 with self.lock:
@@ -2549,6 +2836,12 @@ class Controller:
                     if node is not None:
                         node.last_heartbeat = time.monotonic()
                 agent.load = msg.load
+            elif isinstance(msg, P.AgentDrained):
+                with self.lock:
+                    rec = self.drains.get(agent.node_id)
+                if rec is not None:
+                    rec["agent_remaining"] = msg.remaining
+                    rec["agent_quiesced"] = True
             elif isinstance(msg, P.WorkerDied):
                 with self.lock:
                     handle = self.workers.get(msg.worker_id)
@@ -3210,6 +3503,7 @@ class Controller:
                             for k, v in n.total.items()
                         ),
                         "alive": n.alive,
+                        "draining": n.draining,
                     }
                     for n in self.nodes.values()
                 ]
@@ -3222,6 +3516,17 @@ class Controller:
 
             self.remove_node(_NodeID(bytes.fromhex(payload)))
             return True
+        if op == "drain_node":
+            from ray_tpu._private.ids import NodeID as _NodeID
+
+            node_hex, deadline_s, reason = payload
+            return self.drain_node(
+                _NodeID(bytes.fromhex(node_hex)),
+                deadline_s=float(deadline_s),
+                reason=reason or "",
+            )
+        if op == "drain_status":
+            return self.drain_status(payload)
         raise ValueError(f"unknown controller op: {op}")
 
     # ------------------------------------------------------------ dispatching
@@ -3540,8 +3845,13 @@ class Controller:
             actor.worker = None
             actor.inflight = 0
             self._release_actor_resources(actor)
+            migrating = getattr(actor, "_drain_migrating", False)
+            actor._drain_migrating = False
+            actor._drain_hold = False
             if actor.restarts_left != 0:
-                if actor.restarts_left > 0:
+                if actor.restarts_left > 0 and not migrating:
+                    # a drain-driven migration is a controlled respawn, not a
+                    # failure — it must not consume the restart budget
                     actor.restarts_left -= 1
                 actor.state = "RESTARTING"
                 self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "RESTARTING", "reason": reason})
@@ -3714,7 +4024,7 @@ class Controller:
     def _try_place_pg(self, pg: PlacementGroupState):
         """All-or-nothing bundle reservation (2-phase commit analog;
         reference: ``gcs_placement_group_scheduler.h`` PACK/SPREAD/STRICT_*)."""
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values() if n.schedulable]
         assignment: list[Optional[NodeState]] = [None] * len(pg.bundles)
         scratch = {n.node_id: dict(n.available) for n in alive}
 
@@ -3819,6 +4129,12 @@ class Controller:
                     "Resources": dict(n.total),
                     "Available": dict(n.available),
                     "Labels": dict(n.labels),
+                    "Draining": n.draining,
+                    "DrainState": (
+                        self.drains[n.node_id]["state"]
+                        if n.node_id in self.drains
+                        else None
+                    ),
                 }
                 for n in self.nodes.values()
             ]

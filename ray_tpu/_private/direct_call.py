@@ -172,12 +172,15 @@ class DirectActorTransport:
                 return None
             if st[0] == "pending":
                 # an earlier direct call's result, still in flight — wait
-                # briefly (chained fast calls resolve in ms); a slow
-                # producer falls back to the head, whose dep-waiting is
-                # asynchronous (the dep is promoted when it lands — see
-                # promote's deferred path), so .remote() never blocks long
+                # briefly (chained fast calls resolve in ms). The bound is
+                # tight: .remote() is a nominally non-blocking API, so a
+                # slow producer falls back to the head IMMEDIATELY after it,
+                # whose dep-waiting is asynchronous (the dep is promoted
+                # when it lands — see promote's deferred path). Reference:
+                # dependency_resolver.h resolves asynchronously; 250 ms is
+                # the ceiling on submission stall, not a typical cost.
                 try:
-                    st = self.wait_local(entry.binary(), timeout=5.0)
+                    st = self.wait_local(entry.binary(), timeout=0.25)
                 except GetTimeoutError:
                     return None
                 if st[0] in ("fallback", "pending"):

@@ -417,6 +417,29 @@ class WorkerDied:
 
 
 @dataclasses.dataclass
+class DrainAgent:
+    """Controller → agent: quiesce for graceful node release (reference:
+    ``NodeManager::HandleDrainRaylet``, ``src/ray/raylet/node_manager.cc:1989``).
+    The agent must reject new leases (spill them back with reason
+    "draining"), let running/queued leased work finish within the deadline,
+    flush captured worker logs, and reply with ``AgentDrained``."""
+
+    deadline_s: float
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class AgentDrained:
+    """Agent → controller: the quiesce handshake completed — no leased task
+    is running or queued locally and worker logs were flushed. ``remaining``
+    reports tasks still in flight when the quiesce deadline lapsed (0 on a
+    clean drain)."""
+
+    node_id: Any  # NodeID
+    remaining: int = 0
+
+
+@dataclasses.dataclass
 class Heartbeat:
     """Agent → controller: periodic liveness + load (reference: the GCS
     health-check service, gcs_health_check_manager.h)."""

@@ -425,6 +425,13 @@ class WorkerRuntime:
                 if self._shutdown or not self._client_reconnect():
                     break
                 continue
+            except TypeError:
+                # recv on a handle another thread just close()d (detach/
+                # shutdown) dies with TypeError (handle is None) — same as
+                # EOF (see _DirectConn._read_loop)
+                if self._shutdown or not self._client_reconnect():
+                    break
+                continue
             if isinstance(msg, (P.GetReply, P.PutAck, P.Reply)):
                 self._handle_reply(msg)
             elif isinstance(msg, P.Shutdown):

@@ -14,9 +14,12 @@ allocation cannot run an SPMD program).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -49,6 +52,11 @@ class AutoscalerConfig:
     # one controller restart or heartbeat blip must not terminate healthy
     # long-running slices.
     dead_reap_s: float = 30.0
+    # Scale-down is drain-then-terminate (reference: ray drain-node /
+    # DrainRaylet before autoscaler termination): each node of the launch
+    # gets this long to quiesce — finish in-flight work, migrate restartable
+    # actors, evacuate objects — before the provider node is killed anyway.
+    drain_deadline_s: float = 60.0
 
 
 class NodeProvider:
@@ -111,6 +119,7 @@ class Autoscaler:
         self._idle_since: dict[str, float] = {}  # launch key -> first idle t
         self._launch_t: dict[str, float] = {}  # launch key -> create time
         self._dead_since: dict[str, float] = {}  # launch key -> first dead t
+        self._draining: dict[str, float] = {}  # launch key -> drain start t
         self._registered: set = set()  # launch keys that ever had a node
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -129,9 +138,6 @@ class Autoscaler:
             self._thread.join(timeout=5)
 
     def _loop(self):
-        import logging
-
-        logger = logging.getLogger(__name__)
         while not self._stop.wait(self.config.poll_interval_s):
             try:
                 self.update()
@@ -218,6 +224,7 @@ class Autoscaler:
                 self._launch_t.pop(key, None)
                 self._idle_since.pop(key, None)
                 self._dead_since.pop(key, None)
+                self._draining.pop(key, None)
                 self._registered.discard(key)
                 actions["scaled_down"].append(g.name)
 
@@ -248,29 +255,92 @@ class Autoscaler:
                     actions["scaled_up"].append(g.name)
                     break
 
-        # scale down idle groups (whole slices only)
+        # scale down idle groups (whole slices only): drain-then-terminate —
+        # each node quiesces (no new leases, in-flight work finishes,
+        # restartable actors migrate, objects evacuate) before the provider
+        # node is released (reference: ray drain-node before termination,
+        # NOT the old reap-by-kill)
         now = time.time()
         for g in self.config.node_groups:
             for launch in list(self.launched[g.name]):
-                if len(self.launched[g.name]) <= g.min_groups:
-                    break
                 key = ",".join(launch)
+                if key in self._draining:
+                    # drain in progress from an earlier tick: poll, then kill
+                    if self._drain_complete(launch, state):
+                        self._finish_scaledown(g, launch, actions)
+                    continue
+                # launches already draining are committed to removal but
+                # still sit in launched[] until terminated — count them
+                # against the floor, or two idle launches both drain and the
+                # group dips below min_groups (then churns a fresh slice)
+                remaining = len(self.launched[g.name]) - sum(
+                    1
+                    for l in self.launched[g.name]
+                    if ",".join(l) in self._draining
+                )
+                if remaining <= g.min_groups:
+                    break
                 infos = self._nodes_for_launch(launch, state)
                 if len(infos) >= g.nodes_per_group and all(
                     i["idle"] and i["alive"] for i in infos
                 ):
                     since = self._idle_since.setdefault(key, now)
                     if now - since >= self.config.idle_timeout_s:
-                        self.provider.terminate_nodes(launch)
-                        self.launched[g.name].remove(launch)
-                        self._idle_since.pop(key, None)
-                        self._launch_t.pop(key, None)
-                        self._dead_since.pop(key, None)
-                        self._registered.discard(key)
-                        actions["scaled_down"].append(g.name)
+                        self._start_drain(launch, infos)
+                        if self._drain_complete(launch, state):
+                            self._finish_scaledown(g, launch, actions)
                 else:
                     self._idle_since.pop(key, None)
         return actions
+
+    # -- graceful scale-down --------------------------------------------------
+
+    def _start_drain(self, launch: list[str], infos: list[dict]) -> None:
+        self._draining[",".join(launch)] = time.time()
+        for i in infos:
+            if not i["alive"]:
+                continue
+            try:
+                self._call(
+                    "drain_node",
+                    (i["node_id"], self.config.drain_deadline_s,
+                     "autoscaler downscale"),
+                )
+            except Exception:  # noqa: BLE001 — node already gone is fine
+                logger.warning(
+                    "drain request for %s failed", i["node_id"][:12],
+                    exc_info=True,
+                )
+
+    def _drain_complete(self, launch: list[str], state: dict) -> bool:
+        """True once every node of the launch finished draining (or left the
+        cluster, or the drain deadline lapsed — termination then proceeds
+        regardless; drain is best-effort protection, not a veto)."""
+        key = ",".join(launch)
+        started = self._draining.get(key, 0.0)
+        if time.time() - started > self.config.drain_deadline_s + 10.0:
+            return True  # stuck drain must not pin a billing slice forever
+        for i in self._nodes_for_launch(launch, state):
+            if not i["alive"]:
+                continue  # drained-and-released (or died) already
+            try:
+                rec = self._call("drain_status", i["node_id"])
+            except Exception:  # noqa: BLE001 — controller gone: just kill
+                return True
+            if rec is None or rec.get("state") == "draining":
+                return False
+        return True
+
+    def _finish_scaledown(self, g: NodeGroup, launch: list[str], actions: dict):
+        key = ",".join(launch)
+        self.provider.terminate_nodes(launch)
+        self.launched[g.name].remove(launch)
+        self._idle_since.pop(key, None)
+        self._launch_t.pop(key, None)
+        self._dead_since.pop(key, None)
+        self._draining.pop(key, None)
+        self._registered.discard(key)
+        actions["scaled_down"].append(g.name)
 
     def _satisfiable(self, shape: dict, nodes_by_id: dict) -> bool:
         for n in nodes_by_id.values():

@@ -11,9 +11,50 @@ from __future__ import annotations
 
 import logging
 import subprocess
+import time
 from typing import Optional
 
 logger = logging.getLogger(__name__)
+
+# ssh/gcloud-ssh exit code for transport failure (host unreachable, sshd not
+# up yet) — the retriable class; a remote COMMAND failure exits with the
+# command's own code and must surface immediately.
+_SSH_TRANSPORT_RC = 255
+_RETRY_BACKOFF_S = (1.0, 2.0, 4.0)
+
+
+def _run_with_ssh_retry(argv: list[str], timeout: float, label: str) -> str:
+    """Run an ssh-like command, retrying transport failures with backoff
+    (reference: the ssh retry loop in ``_private/command_runner.py`` — VMs
+    take seconds to accept connections after provisioning). ``timeout`` is a
+    SHARED deadline across attempts, not per attempt — the caller's contract
+    is "this call returns within timeout", retries included."""
+    deadline = time.monotonic() + timeout
+    last = None
+    for attempt, backoff in enumerate((*_RETRY_BACKOFF_S, None)):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=remaining
+        )
+        if out.returncode == 0:
+            return out.stdout
+        last = out
+        if out.returncode != _SSH_TRANSPORT_RC or backoff is None:
+            break
+        if time.monotonic() + backoff >= deadline:
+            break  # no budget left for another attempt
+        logger.warning(
+            "%s transport failure (attempt %d); retrying in %.0fs",
+            label, attempt + 1, backoff,
+        )
+        time.sleep(backoff)
+    if last is None:
+        raise RuntimeError(f"{label} failed: deadline exhausted: {argv[-1]}")
+    raise RuntimeError(
+        f"{label} failed ({last.returncode}): {argv[-1]}\n{last.stderr[-2000:]}"
+    )
 
 
 class CommandRunner:
@@ -82,13 +123,7 @@ class SSHCommandRunner(CommandRunner):
                 full, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
             )
             return ""
-        out = subprocess.run(full, capture_output=True, text=True, timeout=timeout)
-        if out.returncode != 0:
-            raise RuntimeError(
-                f"ssh {self.host} failed ({out.returncode}): {cmd}\n"
-                f"{out.stderr[-2000:]}"
-            )
-        return out.stdout
+        return _run_with_ssh_retry(full, timeout, f"ssh {self.host}")
 
 
 class TPUCommandRunner(CommandRunner):
@@ -118,10 +153,4 @@ class TPUCommandRunner(CommandRunner):
                 full, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
             )
             return ""
-        out = subprocess.run(full, capture_output=True, text=True, timeout=timeout)
-        if out.returncode != 0:
-            raise RuntimeError(
-                f"tpu-vm ssh {self.tpu_name} failed ({out.returncode}): "
-                f"{cmd}\n{out.stderr[-2000:]}"
-            )
-        return out.stdout
+        return _run_with_ssh_retry(full, timeout, f"tpu-vm ssh {self.tpu_name}")

@@ -305,34 +305,62 @@ class TPUVMProvider(ClusterNodeProvider):
             f"--accelerator-type={group.accelerator_type}",
             f"--version={self.runtime_version}",
         ], timeout=1800.0)
-        runner = TPUCommandRunner(name, self.project_id, self.zone)
-        for cmd in self.config.setup_commands:
-            runner.run(cmd)
-        labels = json.dumps({"group": group.name, "provider_node_id": name})
-        runner.run(
-            "export RAY_TPU_CLUSTER_TOKEN="
-            + shlex.quote(self.config.cluster_token) + "; "
-            + self.AGENT_START.format(
-                head=self.head_address(), labels=shlex.quote(labels)
+        # past this point the slice EXISTS and bills: a mid-slice failure
+        # (setup command, agent start, ssh that never comes up) must tear it
+        # down, not leak it — the create/setup pair is all-or-nothing
+        try:
+            runner = TPUCommandRunner(name, self.project_id, self.zone)
+            for cmd in self.config.setup_commands:
+                runner.run(cmd)
+            labels = json.dumps({"group": group.name, "provider_node_id": name})
+            runner.run(
+                "export RAY_TPU_CLUSTER_TOKEN="
+                + shlex.quote(self.config.cluster_token) + "; "
+                + self.AGENT_START.format(
+                    head=self.head_address(), labels=shlex.quote(labels)
+                )
             )
-        )
+        except Exception:
+            logger.warning(
+                "slice %s setup failed mid-launch; terminating it", name
+            )
+            try:
+                self.terminate([name])
+            except Exception:  # noqa: BLE001 — surface the ORIGINAL failure
+                logger.warning("cleanup of failed slice %s also failed", name,
+                               exc_info=True)
+            raise
         return [name]  # one provider node = the whole slice
 
     def ids_per_slice(self, group: NodeGroupConfig) -> int:
         return 1
 
     def terminate(self, node_ids: list[str]) -> None:
+        # best-effort across the whole list: one failed delete must not
+        # strand the rest of the slices (billing!) — failures aggregate and
+        # surface at the end
+        failures: list[tuple[str, Exception]] = []
         for nid in node_ids:
-            if nid == self._head_name:
-                self._gcloud([
-                    "compute", "instances", "delete", nid, "--quiet",
-                    f"--project={self.project_id}", f"--zone={self.zone}",
-                ])
-            else:
-                self._gcloud([
-                    "compute", "tpus", "tpu-vm", "delete", nid, "--quiet",
-                    f"--project={self.project_id}", f"--zone={self.zone}",
-                ], timeout=1800.0)
+            try:
+                if nid == self._head_name:
+                    self._gcloud([
+                        "compute", "instances", "delete", nid, "--quiet",
+                        f"--project={self.project_id}", f"--zone={self.zone}",
+                    ])
+                else:
+                    self._gcloud([
+                        "compute", "tpus", "tpu-vm", "delete", nid, "--quiet",
+                        f"--project={self.project_id}", f"--zone={self.zone}",
+                    ], timeout=1800.0)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("terminate of %s failed", nid, exc_info=True)
+                failures.append((nid, e))
+        if failures:
+            raise RuntimeError(
+                "terminate failed for "
+                + ", ".join(nid for nid, _ in failures)
+                + f" (first cause: {failures[0][1]})"
+            )
 
     def non_terminated(self) -> list[str]:
         out = self._gcloud([

@@ -10,7 +10,8 @@ like the rest of the single-host control plane.
 Endpoints:
   /                     the UI
   /api/overview         cluster + store + autoscaler summary
-  /api/nodes            node table
+  /api/nodes            node table (incl. Draining/DrainState)
+  /api/drains           node drain records (graceful downscale status)
   /api/actors           actor table
   /api/workers          worker table
   /api/tasks            recent task events + state summary
@@ -137,6 +138,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(_overview())
             elif path == "/api/nodes":
                 self._json(st.list_nodes())
+            elif path == "/api/drains":
+                # node drain records (the `ray-tpu drain-node` status view);
+                # the node table's Draining/DrainState columns summarize this
+                self._json(st.drain_status())
             elif path == "/api/actors":
                 self._json(st.list_actors())
             elif path == "/api/workers":

@@ -8,7 +8,11 @@ XLA; see ``engine.py``).
 """
 
 from ray_tpu.llm.batch import ProcessorConfig, build_llm_processor
-from ray_tpu.llm.builders import build_llm_deployment, build_openai_app
+from ray_tpu.llm.builders import (
+    build_gang_deployment,
+    build_llm_deployment,
+    build_openai_app,
+)
 from ray_tpu.llm.disagg import build_pd_disagg_app
 from ray_tpu.llm.config import (
     EngineConfig,
@@ -32,6 +36,7 @@ __all__ = [
     "ProcessorConfig",
     "RequestOutput",
     "SamplingParams",
+    "build_gang_deployment",
     "build_llm_deployment",
     "build_llm_processor",
     "build_openai_app",

@@ -19,8 +19,33 @@ def build_llm_deployment(llm_config: LLMConfig) -> "serve.Application":
         max_ongoing_requests=llm_config.engine.max_num_seqs * 2,
         ray_actor_options=llm_config.ray_actor_options,
         autoscaling_config=llm_config.autoscaling_config,
+        # replica startup = compile every engine program (+ gang rendezvous
+        # for sharded meshes): bound STARTING by the compile budget instead
+        # of serve's generic grace
+        initial_health_grace_s=llm_config.compile_budget_s(),
     )
     return d.bind(llm_config)
+
+
+def build_gang_deployment(
+    llm_config: LLMConfig,
+    num_workers: int = 2,
+    **gang_kwargs,
+) -> "serve.Application":
+    """A multi-process (slice-spanning) gang replica deployment: ONE replica
+    = N engine-worker processes in a STRICT_PACK placement group. The
+    startup grace covers the gang's jax.distributed rendezvous + per-worker
+    compile (the compile budget), so serve never reaps a replica that is
+    merely mid-first-jit."""
+    from ray_tpu.llm.gang import GangLLMServer
+
+    d = serve.deployment(
+        GangLLMServer,
+        name=f"gang:{llm_config.served_name}",
+        max_ongoing_requests=llm_config.engine.max_num_seqs,
+        initial_health_grace_s=llm_config.compile_budget_s(),
+    )
+    return d.bind(llm_config, num_workers=num_workers, **gang_kwargs)
 
 
 def build_openai_app(llm_configs: Union[LLMConfig, list[LLMConfig]]) -> "serve.Application":

@@ -135,7 +135,23 @@ class LLMConfig:
     # with model="<served_name>:<adapter>" (reference: the LoRA model-id
     # convention in llm/_internal/serve)
     lora_adapters: dict = dataclasses.field(default_factory=dict)
+    # Startup (compile) budget override: how long a replica may legitimately
+    # sit in __init__ before serve may treat it as hung. None = derive from
+    # the engine shape via compile_budget_s().
+    startup_grace_s: Optional[float] = None
 
     @property
     def served_name(self) -> str:
         return self.name or self.model.model_id
+
+    def compile_budget_s(self) -> float:
+        """Worst-case replica startup: one XLA compile per prefill bucket +
+        one decode program per KV pool, doubled for sharded (gang) meshes
+        whose jax.distributed world must also rendezvous. Serve uses this as
+        ``initial_health_grace_s`` so a slow first jit is STARTING, not dead."""
+        if self.startup_grace_s is not None:
+            return self.startup_grace_s
+        e = self.engine
+        programs = len(e.prefill_buckets) + max(len(e.seq_len_buckets), 1)
+        sharded = e.tensor_parallel_degree * e.sequence_parallel_degree > 1
+        return 120.0 + 30.0 * programs * (2 if sharded else 1)

@@ -59,9 +59,24 @@ class EngineWorker:
         return f"{routable_host()}:{port}"
 
     def setup(self, config: LLMConfig, rank: int, world: int, coordinator: str):
+        import os
+
         import jax
 
         if world > 1:
+            platform = (os.environ.get("JAX_PLATFORMS") or "").split(",")[0]
+            if platform.strip().lower() == "cpu":
+                # CPU gangs (tests / dev hosts): XLA's default CPU client
+                # cannot execute cross-process programs ("Multiprocess
+                # computations aren't implemented on the CPU backend");
+                # the gloo collectives backend can. Must be set before the
+                # backend initializes. TPU/GPU worlds are unaffected.
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo"
+                    )
+                except Exception:  # noqa: BLE001 — older jaxlib: no option
+                    pass
             # must precede this process's first backend use; afterwards
             # jax.devices() is the GLOBAL device set across the gang
             jax.distributed.initialize(
@@ -695,8 +710,12 @@ class GangLLMServer:
 
     def shutdown(self):
         self._stop = True
-        with self._cv:
-            self._cv.notify_all()
+        # shutdown may run as __init__'s cleanup BEFORE the scheduler state
+        # exists (a failed gang spawn) — it must still reap workers + pg
+        # instead of masking the original failure with an AttributeError
+        if hasattr(self, "_cv"):
+            with self._cv:
+                self._cv.notify_all()
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

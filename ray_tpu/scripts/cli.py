@@ -88,6 +88,51 @@ def cmd_logs(args):
     print(text, end="" if text.endswith("\n") else "\n")
 
 
+def cmd_drain_node(args):
+    """``ray-tpu drain-node <node-id-prefix>``: gracefully quiesce and
+    release a node (reference: ``ray drain-node`` over
+    ``NodeManager::HandleDrainRaylet``) — the safe way to return a TPU
+    slice without killing its in-flight gang steps."""
+    import time
+
+    from ray_tpu.util.state.api import drain_node, drain_status, list_nodes
+
+    _ensure_init(args)
+    matches = [
+        n
+        for n in list_nodes()
+        if n["Alive"] and n["NodeID"].startswith(args.node_id)
+    ]
+    if not matches:
+        print(f"error: no alive node with id prefix {args.node_id!r}",
+              file=sys.stderr)
+        sys.exit(1)
+    if len(matches) > 1:
+        print(
+            f"error: ambiguous node prefix {args.node_id!r}: "
+            f"{[n['NodeID'][:12] for n in matches]}",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    node_id = matches[0]["NodeID"]
+    try:
+        rec = drain_node(node_id, deadline_s=args.deadline, reason=args.reason)
+    except Exception as e:  # noqa: BLE001 — e.g. "cannot drain the head node"
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(1)
+    print(f"draining node {node_id[:12]} (deadline {args.deadline:g}s)")
+    if not args.no_wait:
+        deadline = time.time() + args.deadline + 15
+        while time.time() < deadline:
+            rec = drain_status(node_id) or rec
+            if rec.get("state") != "draining":
+                break
+            time.sleep(0.5)
+    print(json.dumps(rec, indent=1, default=str))
+    if not args.no_wait and rec.get("state") != "drained":
+        sys.exit(1)
+
+
 def cmd_microbenchmark(args):
     from ray_tpu.scripts.microbenchmark import main
 
@@ -322,6 +367,18 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("status", help="cluster resources + nodes")
     s.add_argument("--num-cpus", type=int, default=4)
     s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser(
+        "drain-node", help="gracefully drain + release a node (safe downscale)"
+    )
+    s.add_argument("node_id", help="node id hex prefix (see `ray-tpu status`)")
+    s.add_argument("--deadline", type=float, default=60.0,
+                   help="seconds for in-flight work to finish")
+    s.add_argument("--reason", default="manual drain")
+    s.add_argument("--no-wait", action="store_true",
+                   help="initiate and return without polling completion")
+    s.add_argument("--num-cpus", type=int, default=4)
+    s.set_defaults(fn=cmd_drain_node)
 
     s = sub.add_parser("microbenchmark", help="core throughput suite")
     s.add_argument("--mode", default="thread", choices=["thread", "process"])

@@ -39,7 +39,9 @@ def _get_controller_handle(create: bool = False):
             )
     cls = ray_tpu.remote(ServeControllerActor)
     _controller_handle = cls.options(
-        name=CONTROLLER_NAME, num_cpus=0.1, max_concurrency=64
+        # zero-CPU like the reference's ServeController: the control plane
+        # must always be placeable, even on a node the data plane saturates
+        name=CONTROLLER_NAME, num_cpus=0, max_concurrency=64
     ).remote()
     ray_tpu.get(_controller_handle.ping.remote(), timeout=60)
     return _controller_handle
@@ -86,6 +88,7 @@ def run(
                 "ray_actor_options": cfg.ray_actor_options,
                 "health_check_timeout_s": cfg.health_check_timeout_s,
                 "health_check_period_s": cfg.health_check_period_s,
+                "initial_health_grace_s": cfg.initial_health_grace_s,
                 "graceful_shutdown_timeout_s": cfg.graceful_shutdown_timeout_s,
                 "user_config": cfg.user_config,
             }

@@ -48,6 +48,13 @@ class DeploymentConfig:
     health_check_period_s: float = 2.0
     health_check_timeout_s: float = 30.0
     graceful_shutdown_timeout_s: float = 20.0
+    # Bound on how long a replica may stay in STARTING (alive but still in
+    # __init__ / first jit) before it is replaced. None = unbounded: a
+    # replica whose constructor is still RUNNING is never killed for slow
+    # startup — only a dead actor is (reference: the slow-startup branch of
+    # the deployment state machine, _private/deployment_state.py:1391).
+    # Gang/LLM deployments set this from their compile budget.
+    initial_health_grace_s: Optional[float] = None
     user_config: Optional[Any] = None
 
     def initial_replicas(self) -> int:

@@ -40,6 +40,12 @@ class _DeploymentState:
         self.last_health_t = 0.0
         self.replica_started_t: dict[str, float] = {}
         self.replica_healthy_once: set[str] = set()
+        # replica name -> first time its actor was observed ALIVE (i.e.
+        # __init__ returned). The hung-replica timeout clock starts HERE,
+        # not at actor submission: a replica still constructing (first jit
+        # can take minutes on TPU) is STARTING, not hung (reference: the
+        # slow-startup states of deployment_state.py:1391).
+        self.replica_alive_t: dict[str, float] = {}
         # replica name -> code_version it was started with (rolling updates)
         self.replica_code: dict[str, str] = {}
         # long-poll versioning: RANDOMIZED start (reference long_poll uses
@@ -198,6 +204,14 @@ class ServeControllerActor:
                             d: {
                                 "status": self._deployments[d].status,
                                 "replicas": len(self._deployments[d].replicas),
+                                # replicas alive but not yet past their first
+                                # successful health check (__init__/first jit)
+                                "starting": sum(
+                                    1
+                                    for n in self._deployments[d].replicas
+                                    if n
+                                    not in self._deployments[d].replica_healthy_once
+                                ),
                                 "target": self._deployments[d].target,
                             }
                             for d in a["deployments"]
@@ -255,10 +269,7 @@ class ServeControllerActor:
                         )
                         victims = ordered[: -delta]
                         for name, h in victims:
-                            del state.replicas[name]
-                            state.replica_started_t.pop(name, None)
-                            state.replica_healthy_once.discard(name)
-                            state.replica_code.pop(name, None)
+                            self._forget_replica(state, name)
                         if victims:
                             self._bump_version(state)
                     grace = state.spec.get("graceful_shutdown_timeout_s", 20.0)
@@ -277,12 +288,10 @@ class ServeControllerActor:
                         victim = None
                         if new_ready:
                             name = stale[0]
-                            h = state.replicas.pop(name, None)
+                            h = state.replicas.get(name)
                             if h is not None:
                                 victim = (name, h)
-                                state.replica_started_t.pop(name, None)
-                                state.replica_healthy_once.discard(name)
-                                state.replica_code.pop(name, None)
+                                self._forget_replica(state, name)
                                 self._bump_version(state)
                     if victim is not None:
                         grace = state.spec.get(
@@ -344,6 +353,68 @@ class ServeControllerActor:
             state.replica_code[replica_name] = spec.get("code_version", "")
             self._bump_version(state)
 
+    @staticmethod
+    def _replica_actor_state(h) -> Optional[str]:
+        """The replica actor's controller-side state (PENDING while its
+        __init__ is still running, ALIVE after, DEAD on crash), or None when
+        unknowable (control-plane hiccup)."""
+        try:
+            from ray_tpu.util.state.api import _call
+
+            return _call("actor_state", h._actor_id)
+        except Exception:  # noqa: BLE001
+            return None
+
+    @staticmethod
+    def _starting_verdict(
+        actor_state: Optional[str],
+        started_t: float,
+        alive_t: Optional[float],
+        grace_s: Optional[float],
+        timeout_s: float,
+        now: float,
+    ) -> str:
+        """Decide a STARTING (never-yet-healthy) replica's fate after a
+        health-check timeout — the slow-startup half of the replica state
+        machine (reference: ``deployment_state.py:1391``):
+
+        - actor DEAD/gone                  -> "replace" (crashed in __init__)
+        - actor PENDING (still in __init__) -> "wait", unless the
+          deployment's ``initial_health_grace_s`` is set and exceeded —
+          "alive but compiling" is STARTING, not hung, so the default grace
+          is unbounded and actor liveness is the watchdog
+        - actor ALIVE (init returned)       -> the hung-replica timeout
+          clock starts at this FIRST READINESS: replace only once
+          ``timeout_s`` has elapsed since the actor came alive without a
+          single successful health check
+        - state unknowable                  -> "wait" (never kill on a
+          control-plane hiccup)
+        """
+        if actor_state == "DEAD":
+            return "replace"
+        if actor_state == "ALIVE":
+            if alive_t is not None and now - alive_t > timeout_s:
+                return "replace"
+            return "wait"
+        if actor_state in ("PENDING", "RESTARTING"):
+            # still constructing: only an explicit per-deployment grace
+            # bounds this window
+            if grace_s is not None and now - started_t > grace_s:
+                return "replace"
+            return "wait"
+        # unknowable (lookup failed): never kill on a control-plane hiccup —
+        # a nearly-compiled replica must not die to one failed state query;
+        # the next period re-queries and the real state decides
+        return "wait"
+
+    def _forget_replica(self, state: _DeploymentState, name: str):
+        """Drop all per-replica bookkeeping (callers hold self._lock)."""
+        state.replicas.pop(name, None)
+        state.replica_started_t.pop(name, None)
+        state.replica_alive_t.pop(name, None)
+        state.replica_healthy_once.discard(name)
+        state.replica_code.pop(name, None)
+
     def _health_check(self, state: _DeploymentState):
         now = time.time()
         if now - state.last_health_t < state.spec.get("health_check_period_s", 2.0):
@@ -357,6 +428,7 @@ class ServeControllerActor:
         # one shared deadline for the whole gang — a single hung replica must
         # not stall the reconcile loop for timeout × num_replicas
         timeout = state.spec.get("health_check_timeout_s", 30)
+        grace = state.spec.get("initial_health_grace_s")
         refs = [(name, h, h.check_health.remote()) for name, h in replicas]
         deadline = time.time() + timeout
         from ray_tpu.exceptions import GetTimeoutError
@@ -365,26 +437,33 @@ class ServeControllerActor:
             try:
                 ray_tpu.get(ref, timeout=max(0.1, deadline - time.time()))
                 state.replica_healthy_once.add(name)
+                state.replica_alive_t.setdefault(name, time.time())
             except GetTimeoutError:
-                # a replica still running __init__ (model build / first jit
-                # can take minutes on TPU) must not be killed for slow
-                # startup — pre-healthy replicas get a long grace on TIMEOUT
-                # only; a dead actor (below) is replaced immediately
-                started = state.replica_started_t.get(name, 0.0)
-                if name not in state.replica_healthy_once and (
-                    time.time() - started < max(120.0, timeout * 4)
-                ):
+                if name in state.replica_healthy_once:
+                    dead.append((name, h))  # was serving, now unresponsive
                     continue
-                dead.append((name, h))
+                # STARTING: distinguish "alive but still in __init__/first
+                # jit" from "hung" via the actor's real state instead of a
+                # flat wall-clock grace
+                actor_state = self._replica_actor_state(h)
+                if actor_state == "ALIVE":
+                    state.replica_alive_t.setdefault(name, time.time())
+                verdict = self._starting_verdict(
+                    actor_state,
+                    state.replica_started_t.get(name, 0.0),
+                    state.replica_alive_t.get(name),
+                    grace,
+                    timeout,
+                    time.time(),
+                )
+                if verdict == "replace":
+                    dead.append((name, h))
             except Exception:
                 dead.append((name, h))
         for name, h in dead:
             logger.warning("replica %s unhealthy; replacing", name)
             with self._lock:
-                state.replicas.pop(name, None)
-                state.replica_started_t.pop(name, None)
-                state.replica_healthy_once.discard(name)
-                state.replica_code.pop(name, None)
+                self._forget_replica(state, name)
                 self._bump_version(state)
             self._kill_replica(h)
 

@@ -105,6 +105,7 @@ def deployment(
     ray_actor_options: Optional[dict] = None,
     health_check_period_s: float = 2.0,
     health_check_timeout_s: float = 30.0,
+    initial_health_grace_s: Optional[float] = None,
     user_config: Optional[Any] = None,
     route_prefix: Optional[str] = None,
 ) -> Union[Deployment, Callable[..., Deployment]]:
@@ -126,6 +127,7 @@ def deployment(
             ray_actor_options=ray_actor_options,
             health_check_period_s=health_check_period_s,
             health_check_timeout_s=health_check_timeout_s,
+            initial_health_grace_s=initial_health_grace_s,
             user_config=user_config,
         )
         return Deployment(

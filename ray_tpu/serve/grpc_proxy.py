@@ -157,7 +157,7 @@ def start_grpc_proxy(port: int = 9000):
         except Exception:  # noqa: BLE001
             cls = ray_tpu.remote(GrpcProxyActor)
             _grpc_proxy_handle = cls.options(
-                name="serve-grpc-proxy", num_cpus=0.1, max_concurrency=32
+                name="serve-grpc-proxy", num_cpus=0, max_concurrency=32
             ).remote(port=port)
         real_port = ray_tpu.get(
             _grpc_proxy_handle.get_port.remote(), timeout=60

@@ -541,7 +541,9 @@ def start_proxy(port: int = 8000):
     except Exception:
         cls = ray_tpu.remote(ProxyActor)
         _proxy_handle = cls.options(
-            name="serve-proxy", num_cpus=0.1, max_concurrency=32
+            # zero-CPU (reference: proxy actors reserve no CPU) — a saturated
+            # node must still be able to host the ingress
+            name="serve-proxy", num_cpus=0, max_concurrency=32
         ).remote(port=port)
     real_port = ray_tpu.get(_proxy_handle.get_port.remote(), timeout=60)
     return _proxy_handle, real_port

@@ -42,6 +42,23 @@ def list_nodes() -> list[dict]:
     return _call("nodes")
 
 
+def drain_node(
+    node_id: str, deadline_s: float = 60.0, reason: str = ""
+) -> dict:
+    """Gracefully drain a node before release (``ray drain-node`` analog,
+    reference: ``NodeManager::HandleDrainRaylet``): stop new work, finish
+    in-flight tasks within ``deadline_s``, migrate restartable actors,
+    evacuate resident objects, then remove the node. Returns the drain
+    status record; poll :func:`drain_status` for completion."""
+    return _call("drain_node", (node_id, deadline_s, reason))
+
+
+def drain_status(node_id: Optional[str] = None):
+    """Status of one drain (by node-id hex prefix) or all known drains.
+    Records outlive their nodes, so a completed drain stays observable."""
+    return _call("drain_status", node_id)
+
+
 def summarize_tasks() -> dict:
     """Event counts per task name (``ray summary tasks`` analog)."""
     events = _call("task_events")

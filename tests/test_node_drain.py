@@ -1,0 +1,318 @@
+"""Graceful node drain: quiesce-then-release instead of reap-by-kill.
+
+Reference: ``NodeManager::HandleDrainRaylet``
+(``src/ray/raylet/node_manager.cc:1989``) surfaced as ``ray drain-node`` —
+safe downscale lets in-flight work finish, migrates restartable actors, and
+evacuates resident objects before the node leaves. On a multi-slice TPU
+cluster this is the difference between returning a slice and killing the
+gang steps running on it.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.state.api import drain_node, drain_status
+
+
+def _controller():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller
+
+
+def _wait_drained(node_hex: str, timeout: float = 30.0) -> dict:
+    deadline = time.time() + timeout
+    rec = None
+    while time.time() < deadline:
+        rec = drain_status(node_hex)
+        if rec is not None and rec["state"] != "draining":
+            return rec
+        time.sleep(0.05)
+    raise AssertionError(f"drain of {node_hex[:12]} never completed: {rec}")
+
+
+@pytest.fixture
+def drain_cluster():
+    cluster = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 2, "mode": "thread"},
+    )
+    yield cluster
+    ray_tpu.shutdown()
+
+
+def test_drain_completes_inflight_and_migrates_actor(drain_cluster):
+    """Draining a node with running tasks and a restartable actor finishes
+    every in-flight task (zero failures), respawns the actor on another
+    node WITHOUT charging its restart budget, and releases the node."""
+    node_a = drain_cluster.add_node(num_cpus=2, resources={"pool": 2})
+
+    @ray_tpu.remote(resources={"pool": 0.2})
+    def slow(i):
+        time.sleep(0.4)
+        return i
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    actor = Counter.options(
+        resources={"pool": 0.5}, max_restarts=2
+    ).remote()
+    assert ray_tpu.get(actor.incr.remote(), timeout=30) == 1
+
+    refs = [slow.remote(i) for i in range(6)]
+    time.sleep(0.2)  # let dispatch land on node A
+
+    # the migration target must exist before the drain begins
+    node_b = drain_cluster.add_node(num_cpus=2, resources={"pool": 2})
+
+    rec = drain_node(node_a.hex(), deadline_s=30.0, reason="test downscale")
+    assert rec["state"] in ("draining", "drained")
+
+    # zero task failures: every in-flight/queued task completes
+    assert ray_tpu.get(refs, timeout=60) == list(range(6))
+
+    rec = _wait_drained(node_a.hex())
+    assert rec["state"] == "drained", rec
+    assert rec["migrated_actors"] >= 1
+
+    # node released
+    infos = {n["NodeID"]: n for n in ray_tpu.nodes()}
+    assert not infos[node_a.hex()]["Alive"]
+    assert infos[node_a.hex()]["DrainState"] == "drained"
+
+    # the actor respawned on the surviving node and still serves calls
+    assert ray_tpu.get(actor.incr.remote(), timeout=60) == 1  # fresh state
+    ctrl = _controller()
+    astate = ctrl.actors[actor._actor_id]
+    assert astate.state == "ALIVE"
+    assert astate.worker is not None and astate.worker.node_id == node_b
+    # controlled migration, not a failure: budget untouched
+    assert astate.restarts_left == 2
+
+
+def test_draining_node_takes_no_new_work(drain_cluster):
+    """A DRAINING node stops being a placement target immediately; work
+    needing its resources waits for (and lands on) a replacement node."""
+    node_a = drain_cluster.add_node(num_cpus=4, resources={"pool": 4})
+
+    @ray_tpu.remote(resources={"pool": 1})
+    def probe():
+        return "ok"
+
+    assert ray_tpu.get(probe.remote(), timeout=30) == "ok"  # A serves
+
+    drain_node(node_a.hex(), deadline_s=10.0, reason="test")
+    ref = probe.remote()  # submitted mid-drain: must NOT land on A
+    done, _ = ray_tpu.wait([ref], timeout=1.0)
+    assert not done, "a draining node accepted new work"
+
+    drain_cluster.add_node(num_cpus=4, resources={"pool": 4})
+    assert ray_tpu.get(ref, timeout=30) == "ok"
+    assert _wait_drained(node_a.hex())["state"] == "drained"
+
+
+def test_drain_evacuates_resident_objects(drain_cluster):
+    """Pull-before-release: a plasma object resident only on the draining
+    node survives the node's removal (max_retries=0 ⇒ no lineage rebuild —
+    the bytes must have been migrated, not reconstructed)."""
+    import numpy as np
+
+    node_a = drain_cluster.add_node(num_cpus=2, resources={"pool": 2})
+
+    @ray_tpu.remote(resources={"pool": 1}, max_retries=0)
+    def big():
+        return np.arange(300_000, dtype=np.int64)
+
+    ref = big.remote()
+    np.testing.assert_array_equal(
+        ray_tpu.get(ref, timeout=30), np.arange(300_000, dtype=np.int64)
+    )  # sealed (into node A's arena when per-node arenas are active)
+
+    drain_node(node_a.hex(), deadline_s=30.0, reason="test")
+    _wait_drained(node_a.hex())
+
+    out = ray_tpu.get(ref, timeout=30)  # must not raise ObjectLostError
+    np.testing.assert_array_equal(out, np.arange(300_000, dtype=np.int64))
+
+
+def test_autoscaler_downscale_drains_before_terminate():
+    """The autoscaler's scale-down path goes through the drain protocol:
+    at provider-terminate time every node of the launch has a COMPLETED
+    drain record (drain-then-terminate, not reap-by-kill)."""
+    from ray_tpu.autoscaler.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        FakeNodeProvider,
+        NodeGroup,
+    )
+
+    ray_tpu.init(num_cpus=2, mode="thread")
+    try:
+        records = []
+
+        class SpyProvider(FakeNodeProvider):
+            def terminate_nodes(self, node_ids):
+                for nid in node_ids:
+                    records.append((nid, drain_status(nid)))
+                super().terminate_nodes(node_ids)
+
+        group = NodeGroup(
+            name="g",
+            resources_per_node={"CPU": 1, "elastic": 1},
+            min_groups=0,
+            max_groups=1,
+        )
+        scaler = Autoscaler(
+            AutoscalerConfig(node_groups=[group], idle_timeout_s=0.4),
+            provider=SpyProvider(),
+        )
+
+        @ray_tpu.remote(resources={"elastic": 0.5})
+        def work(i):
+            return i * 2
+
+        refs = [work.remote(i) for i in range(3)]
+        deadline = time.monotonic() + 60
+        scaled_up = False
+        while time.monotonic() < deadline:
+            actions = scaler.update()
+            scaled_up = scaled_up or bool(actions["scaled_up"])
+            done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0.05)
+            if len(done) == len(refs):
+                break
+            time.sleep(0.1)
+        assert scaled_up, "autoscaler never scaled up for pending demand"
+        assert ray_tpu.get(refs, timeout=30) == [0, 2, 4]
+
+        scaled_down = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not scaled_down:
+            scaled_down = bool(scaler.update()["scaled_down"])
+            time.sleep(0.1)
+        assert scaled_down, "autoscaler never scaled the idle node down"
+        assert records, "terminate ran without any drain record"
+        for nid, rec in records:
+            assert rec is not None, f"node {nid} terminated without a drain"
+            assert rec["state"] == "drained", (nid, rec)
+    finally:
+        ray_tpu.shutdown()
+
+
+def _native_available():
+    from ray_tpu._native import plasma
+
+    return plasma.available()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not _native_available(), reason="node agents require the native store"
+)
+def test_drain_real_agent_quiesce_handshake(tmp_path):
+    """End-to-end over a REAL node agent process: the quiesce handshake
+    (reject new leases, finish leased work, flush logs, AgentDrained)
+    completes, resident objects are pulled off the agent's arena before
+    release, and no task fails."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    ray_tpu.init(num_cpus=2, mode="process", config={"tcp_port": 0})
+    proc = None
+    try:
+        ctrl = _controller()
+        assert ctrl.tcp_address is not None
+        env = dict(os.environ)
+        env["RAY_TPU_AUTHKEY"] = ctrl._authkey.hex()
+        env.pop("RAY_TPU_ARENA", None)
+        env.pop("RAY_TPU_WORKER", None)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu._private.agent",
+                "--address", ctrl.tcp_address,
+                "--resources", json.dumps({"CPU": 2, "agent_pool": 2}),
+                "--base-dir", str(tmp_path / "agent"),
+                "--object-store-memory", str(128 * 1024**2),
+            ],
+            env=env,
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not ctrl.agents:
+            time.sleep(0.2)
+        assert ctrl.agents, "agent never registered"
+        node_id = next(iter(ctrl.agents))
+
+        @ray_tpu.remote(
+            resources={"agent_pool": 0.5}, num_cpus=0.5, max_retries=0
+        )
+        def produce(i):
+            import numpy as _np
+            import time as _time
+
+            _time.sleep(2.0)
+            return _np.full(200_000, i, dtype=_np.int64)
+
+        refs = [produce.remote(i) for i in range(4)]
+        # every task must be ON the agent before the drain begins — a task
+        # still queued at the head would have nowhere else to run (this is
+        # the only node with agent_pool)
+        node = ctrl.nodes[node_id]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(node.leased) < len(refs):
+            time.sleep(0.05)
+        assert len(node.leased) == len(refs), "tasks never leased to agent"
+
+        rec = drain_node(node_id.hex(), deadline_s=60.0, reason="agent test")
+        assert rec["state"] in ("draining", "drained")
+
+        # zero failures: leased work finishes on the draining agent
+        outs = ray_tpu.get(refs, timeout=120)
+        for i, out in enumerate(outs):
+            np.testing.assert_array_equal(
+                out, np.full(200_000, i, dtype=np.int64)
+            )
+
+        rec = _wait_drained(node_id.hex(), timeout=90)
+        assert rec["state"] == "drained", rec
+        assert rec["agent_quiesced"] is True
+        assert rec["agent_remaining"] == 0
+
+        # results sealed on the agent's arena survived its release
+        # (max_retries=0 ⇒ the bytes were evacuated, not reconstructed)
+        out = ray_tpu.get(refs[0], timeout=60)
+        np.testing.assert_array_equal(
+            out, np.full(200_000, 0, dtype=np.int64)
+        )
+        infos = {n["NodeID"]: n for n in ray_tpu.nodes()}
+        assert not infos[node_id.hex()]["Alive"]
+    finally:
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        ray_tpu.shutdown()
+
+
+def test_drain_head_node_rejected():
+    ray_tpu.init(num_cpus=2, mode="thread")
+    try:
+        head_hex = _controller().head_node_id.hex()
+        with pytest.raises(Exception, match="head"):
+            drain_node(head_hex)
+    finally:
+        ray_tpu.shutdown()

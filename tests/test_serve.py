@@ -160,6 +160,91 @@ def test_multiplex(serve_instance):
     assert handle.remote("a").result() == ("a", "a")
 
 
+def test_starting_verdict_state_machine():
+    """The slow-startup decision table (reference: the STARTING/slow-start
+    states of ``deployment_state.py:1391``): a replica still in __init__ is
+    STARTING, not hung; the hung-replica timeout clock starts at first
+    readiness (actor ALIVE), and only an explicit per-deployment
+    ``initial_health_grace_s`` bounds construction."""
+    from ray_tpu.serve.controller import ServeControllerActor
+
+    v = ServeControllerActor._starting_verdict
+    now = 1000.0
+    # crashed in __init__ -> replace immediately
+    assert v("DEAD", now - 5, None, None, 30.0, now) == "replace"
+    # still constructing (first jit), no grace -> wait indefinitely: actor
+    # liveness is the watchdog, not wall-clock
+    assert v("PENDING", now - 10_000, None, None, 30.0, now) == "wait"
+    # explicit compile budget bounds construction
+    assert v("PENDING", now - 61, None, 60.0, 30.0, now) == "replace"
+    assert v("PENDING", now - 10, None, 60.0, 30.0, now) == "wait"
+    # init returned: the timeout clock starts at first readiness, NOT at
+    # replica start — a 10k-second compile followed by responsive health
+    # checks is fine
+    assert v("ALIVE", now - 10_000, now - 5, None, 30.0, now) == "wait"
+    assert v("ALIVE", now - 10_000, now - 31, None, 30.0, now) == "replace"
+    # control-plane hiccup (state unknowable): never kill on missing
+    # information, even past an explicit grace — the next period re-queries
+    assert v(None, now - 10_000, None, None, 30.0, now) == "wait"
+    assert v(None, now - 10_000, None, 60.0, 30.0, now) == "wait"
+
+
+def test_slow_start_not_killed_while_constructing(serve_instance):
+    """A replica whose __init__ outlives many health-check timeouts must
+    NOT be replaced while its constructor is still running (the red-test
+    mechanism: a flat pre-healthy grace killed slow-compiling replicas)."""
+
+    @serve.deployment(health_check_period_s=0.1, health_check_timeout_s=0.2)
+    class SlowStart:
+        def __init__(self):
+            time.sleep(2.0)  # >> health_check_timeout_s
+
+        def __call__(self, req):
+            return "ready"
+
+    handle = serve.run(SlowStart.bind(), name="slowstart")
+    assert handle.remote(None).result(timeout_s=60) == "ready"
+    controller = ray_tpu.get_actor("serve-controller")
+    names = ray_tpu.get(
+        controller.get_replica_names.remote("SlowStart"), timeout=10
+    )
+    assert names == ["serve:SlowStart#0"], (
+        f"slow-starting replica was churned: {names}"
+    )
+
+
+def test_slow_start_grace_bounds_stuck_init(serve_instance):
+    """``initial_health_grace_s`` is the per-deployment compile budget: a
+    constructor that outlives it IS hung and gets replaced."""
+
+    @serve.deployment(
+        initial_health_grace_s=0.5,
+        health_check_period_s=0.1,
+        health_check_timeout_s=0.2,
+    )
+    class Stuck:
+        def __init__(self):
+            time.sleep(120)  # far past the declared budget
+
+        def __call__(self, req):
+            return None
+
+    serve.run(Stuck.bind(), name="stuck", _wait_for_ready_s=10)
+    controller = ray_tpu.get_actor("serve-controller")
+    deadline = time.time() + 30
+    names = []
+    while time.time() < deadline:
+        names = ray_tpu.get(
+            controller.get_replica_names.remote("Stuck"), timeout=10
+        )
+        if names and "serve:Stuck#0" not in names:
+            return  # original replica was reaped and replaced
+        time.sleep(0.2)
+    raise AssertionError(
+        f"stuck replica outlived its startup grace: {names}"
+    )
+
+
 def test_replica_failure_recovery(serve_instance):
     @serve.deployment
     class Fragile:
