@@ -94,6 +94,36 @@ class SerializedObject:
 _thread_state = threading.local()
 
 
+class _ContextPickler(cloudpickle.CloudPickler):
+    """CloudPickler bound to a SerializationContext via instance attributes
+    (``_rtpu_ctx``/``_rtpu_extra``, set by ``serialize``)."""
+
+    def reducer_override(self, obj):
+        from ray_tpu.object_ref import ObjectRef
+
+        ctx = self._rtpu_ctx
+        if isinstance(obj, ObjectRef):
+            if ctx._ref_serializer is not None:
+                ctx._ref_serializer(obj)
+            return (_deserialize_object_ref, (obj.id_binary(),))
+        if _is_jax_array(obj):
+            arr = np.asarray(obj)  # device→host copy
+            if not arr.flags["C_CONTIGUOUS"]:
+                arr = np.ascontiguousarray(arr)
+            idx = len(self._rtpu_extra)
+            self._rtpu_extra.append(arr.data.cast("B"))
+            return (_rebuild_jax_array, (idx, arr.shape, arr.dtype.str))
+        reducer = ctx._custom.get(type(obj))
+        if reducer is not None:
+            ser, deser = reducer
+            return (deser, (ser(obj),))
+        # delegate to cloudpickle: its own function/class-by-value
+        # support lives in reducer_override, so returning
+        # NotImplemented here would silently disable it (local
+        # closures would fall back to pickle-by-reference and fail)
+        return super().reducer_override(obj)
+
+
 class SerializationContext:
     def __init__(
         self,
@@ -112,35 +142,16 @@ class SerializationContext:
     def serialize(self, value: Any) -> SerializedObject:
         extra: list = []
         oob: list = []
-        ctx = self
-
-        class Pickler(cloudpickle.CloudPickler):
-            def reducer_override(self, obj):
-                from ray_tpu.object_ref import ObjectRef
-
-                if isinstance(obj, ObjectRef):
-                    if ctx._ref_serializer is not None:
-                        ctx._ref_serializer(obj)
-                    return (_deserialize_object_ref, (obj.id_binary(),))
-                if _is_jax_array(obj):
-                    arr = np.asarray(obj)  # device→host copy
-                    if not arr.flags["C_CONTIGUOUS"]:
-                        arr = np.ascontiguousarray(arr)
-                    idx = len(extra)
-                    extra.append(arr.data.cast("B"))
-                    return (_rebuild_jax_array, (idx, arr.shape, arr.dtype.str))
-                reducer = ctx._custom.get(type(obj))
-                if reducer is not None:
-                    ser, deser = reducer
-                    return (deser, (ser(obj),))
-                # delegate to cloudpickle: its own function/class-by-value
-                # support lives in reducer_override, so returning
-                # NotImplemented here would silently disable it (local
-                # closures would fall back to pickle-by-reference and fail)
-                return super().reducer_override(obj)
-
         sink = io.BytesIO()
-        p = Pickler(sink, protocol=5, buffer_callback=lambda b: oob.append(b.raw()))
+        p = _ContextPickler(
+            sink, protocol=5, buffer_callback=lambda b: oob.append(b.raw())
+        )
+        # instance state instead of a closure: defining the Pickler class
+        # inside this method executed __build_class__ on EVERY serialize —
+        # two class creations per task round trip (args + result), measured
+        # at ~20% of the 1:1 sync actor-call cost
+        p._rtpu_ctx = self
+        p._rtpu_extra = extra
         p.dump(value)
         return SerializedObject(sink.getvalue(), extra, oob)
 

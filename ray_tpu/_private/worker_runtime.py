@@ -683,7 +683,43 @@ class WorkerRuntime:
             self._shm_client = PlasmaClient()
         return self._shm_client
 
+    def _inproc_controller(self):
+        """Thread mode only: the controller object lives in this process.
+        Blocking MID-TASK ops (stream-item seals, backpressure polls) must
+        use it directly instead of the worker channel: inline actor tasks
+        run ON the channel's run loop, so a channel round trip issued from
+        inside one can never receive its reply — the loop that would pump
+        the ack is the thread waiting for it (the test_streaming
+        actor-method hang the conftest watchdog used to eat 300 s on)."""
+        if not self.in_process:
+            return None
+        from ray_tpu._private import worker as worker_mod
+
+        if worker_mod.is_initialized():
+            return getattr(worker_mod.global_worker(), "controller", None)
+        return None
+
+    def _call_controller_inproc_safe(self, op: str, payload=None):
+        """``call_controller``, but routed through the in-process dispatch
+        when this worker IS the channel pump (thread mode): a channel round
+        trip issued from an inline task mid-execution can never receive its
+        own reply (the pump is the blocked thread)."""
+        if self._inproc_controller() is not None:
+            from ray_tpu._private import worker as worker_mod
+
+            return worker_mod.global_worker().controller_call(op, payload)
+        return self.call_controller(op, payload)
+
     def put_serialized(self, object_id: ObjectID, sobj: SerializedObject):
+        ctrl = self._inproc_controller()
+        if ctrl is not None:
+            if sobj.total_bytes() <= self.max_inline:
+                ctrl.seal_object(object_id, "inline", sobj.to_bytes())
+            else:
+                ctrl.seal_object(
+                    object_id, "plasma", self._write_shm(object_id, sobj)
+                )
+            return
         if (
             sobj.total_bytes() > self.max_inline
             and self.client_mode
@@ -740,8 +776,12 @@ class WorkerRuntime:
         data = sobj.to_bytes()
         if os.environ.get("RAY_TPU_ARENA"):
             # native arena: allocate via the store authority, write through
-            # this process's mapping (plasma create/seal protocol)
-            name = self.call_controller("shm_create", (object_id, len(data)))
+            # this process's mapping (plasma create/seal protocol).
+            # inproc-safe: an inline actor task sealing a large stream item
+            # must not issue a channel round trip from the pump thread
+            name = self._call_controller_inproc_safe(
+                "shm_create", (object_id, len(data))
+            )
             if isinstance(name, tuple) and name[0] == "exists":
                 # duplicate put — the sealed object stands; skip the write
                 return name[1], name[2]
@@ -961,6 +1001,10 @@ class WorkerRuntime:
         count += 1
         payload = self._store_error(spec, exc)[0][2]
         oid = ObjectID.for_return(spec.task_id, count)
+        ctrl = self._inproc_controller()
+        if ctrl is not None:
+            ctrl.seal_object(oid, "error", payload)
+            return count
         req_id = next(self._req_counter)
         epoch = self._conn_epoch
         self._send(P.PutObject(req_id, oid, "error", payload))
@@ -978,7 +1022,11 @@ class WorkerRuntime:
             return
         delay = 0.002
         while True:
-            consumed = self.call_controller("stream_consumed_get", spec.task_id)
+            # same no-channel rule as put_serialized: an inline actor task
+            # polling over the channel would deadlock its own pump
+            consumed = self._call_controller_inproc_safe(
+                "stream_consumed_get", spec.task_id
+            )
             if consumed < 0:
                 # the consumer freed the generator: stop producing rather
                 # than poll a dead stream forever

@@ -459,6 +459,13 @@ class StreamingExecutor:
         actors = [cls.options(**opts).remote(blob) for _ in range(strategy.min_size)]
         inflight: dict[int, int] = {i: 0 for i in range(len(actors))}
         pending: deque = deque()  # (ref, actor_idx) in input order
+        # the LAST yielded ref per actor, kept for the teardown drain.
+        # Per-actor FIFO execution means waiting on it covers every
+        # earlier yielded task of that actor — an exact drain bounded at
+        # len(actors) pinned refs, preserving the stage's constant-memory
+        # streaming property (pinning EVERY output ref would hold the
+        # whole dataset resident).
+        last_yielded: dict = {}
         per_actor = 2  # pipeline depth per actor
         it = iter(stream)
         exhausted = False
@@ -485,9 +492,31 @@ class StreamingExecutor:
                     continue
                 if pending:
                     ref, idx = pending.popleft()
+                    # recorded BEFORE the yield: an early generator close
+                    # raises GeneratorExit at the yield itself, and the ref
+                    # just handed to the consumer must be covered by the
+                    # teardown drain
+                    last_yielded[idx] = ref
                     yield ref
                     inflight[idx] -= 1
         finally:
+            # drain before kill: refs are yielded while their apply tasks
+            # may still be queued/running (per-actor pipelining), so a
+            # force-kill here would fail downstream consumers of those refs
+            # with ActorDiedError. Waiting on each actor's last YIELDED ref
+            # covers, via that actor's FIFO queue, every earlier yielded
+            # task — and nothing more: un-yielded `pending` refs have no
+            # downstream holder, so an early generator close kills their
+            # tasks immediately instead of stalling teardown on work
+            # nobody will consume. (Holding the refs also pins the
+            # entries, so the wait cannot block on an
+            # already-consumed-and-freed ref.)
+            drain = list(last_yielded.values())
+            try:
+                if drain:
+                    ray_tpu.wait(drain, num_returns=len(drain), timeout=60)
+            except Exception:  # noqa: BLE001 — best effort before teardown
+                pass
             for a in actors:
                 try:
                     ray_tpu.kill(a)

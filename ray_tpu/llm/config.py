@@ -29,6 +29,10 @@ class EngineConfig:
     max_num_seqs: int = 8  # decode slot count (continuous batching width)
     max_seq_len: int = 512
     prefill_buckets: tuple = (32, 64, 128, 256, 512)
+    # tp=1 in a MULTI-PROCESS gang = replicated lockstep (every process
+    # computes the identical full batch; zero per-step collectives — the
+    # gang buys availability + host throughput). tp>1 shards params/KV
+    # over the gang's global mesh (the model-bigger-than-one-host shape).
     tensor_parallel_degree: int = 1
     sequence_parallel_degree: int = 1
     dtype: str = "bfloat16"

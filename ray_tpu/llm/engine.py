@@ -33,6 +33,7 @@ from typing import Any, Callable, Iterator, Optional
 import numpy as np
 
 from ray_tpu.llm.config import EngineConfig, LLMConfig, ModelConfig, SamplingParams
+from ray_tpu.llm.pacing import TokenPacer
 from ray_tpu.llm.tokenizer import get_tokenizer
 
 logger = logging.getLogger(__name__)
@@ -68,6 +69,7 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.lora_idx = lora_idx
         self.prefix_hit_tokens = 0
+        self.pacer = TokenPacer()  # smooths multi-step token bursts for SSE
 
 
 class _Admission:
@@ -514,11 +516,14 @@ class JaxEngine:
 
     def drain(self, req: "_Request") -> Iterator[dict]:
         """Token increments of a submitted request until its end sentinel;
-        raises the request's error, if any, after the stream ends."""
+        raises the request's error, if any, after the stream ends. Bursts
+        from multi-step decode are paced into spaced emissions (see
+        ``llm/pacing.py``) so SSE clients observe a steady token cadence."""
         while True:
             item = req.stream_queue.get()
             if item is None:
                 break
+            req.pacer.gate(backlog=not req.stream_queue.empty())
             yield item
         if req.error is not None:
             raise req.error
@@ -842,10 +847,15 @@ class JaxEngine:
                 except BaseException as e:  # noqa: BLE001
                     self._fail_pool(pool, e)
                     break
+                applied: dict[int, list] = {}
                 for k in range(arr.shape[0]):
                     for slot, req in binding.items():
                         if pool.slots[slot] is req:
                             self._emit(pool, slot, int(arr[k, slot]))
+                            entry = applied.setdefault(id(req), [req, 0])
+                            entry[1] += 1
+                for req, n in applied.values():
+                    req.pacer.note_block(n)
                 progressed = True
         return progressed
 

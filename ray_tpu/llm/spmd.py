@@ -23,6 +23,7 @@ each batch to all gang workers.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 from ray_tpu.llm.config import LLMConfig, SamplingParams, resolve_llama_config
@@ -56,16 +57,42 @@ class SPMDGenerator:
             mc, ec, min_vocab=self.tokenizer.vocab_size
         )
         if mesh is None:
-            # all GLOBAL devices (jax.devices() spans the jax.distributed
-            # world): tp*sp must cover them; -1 infers tp
-            spec = MeshSpec(
-                tp=ec.tensor_parallel_degree or -1,
-                sp=ec.sequence_parallel_degree,
-            )
-            try:
-                spec = spec.resolve(len(jax.devices()))
-            except ValueError:
-                spec = MeshSpec(tp=-1).resolve(len(jax.devices()))
+            n = len(jax.devices())
+            if (
+                n > 1
+                and ec.tensor_parallel_degree == 1
+                and ec.sequence_parallel_degree == 1
+            ):
+                # tp=1 on a multi-device world = REPLICATED lockstep: every
+                # process computes the identical full batch over a pure
+                # data axis (params and cache replicate; zero per-step
+                # collectives). The gang then buys availability and
+                # host-side throughput, not memory — the right shape when
+                # the model fits one process, and the collective-free
+                # regime the decode_steps/run-ahead knobs are benched in.
+                # NOTE: defaults used to fall through to tp=n sharding —
+                # log the switch so a gang that NEEDS sharding to fit is
+                # told which knob restores it instead of OOMing silently.
+                logging.getLogger(__name__).warning(
+                    "tp=1 on %d devices: building a REPLICATED (dp=%d) "
+                    "mesh; set tensor_parallel_degree>1 to shard params/KV "
+                    "across the gang",
+                    n,
+                    n,
+                )
+                spec = MeshSpec(dp=n)
+            else:
+                # all GLOBAL devices (jax.devices() spans the
+                # jax.distributed world): tp*sp must cover them; -1 infers
+                # tp; explicit tp>1 shards params/KV over the gang
+                spec = MeshSpec(
+                    tp=ec.tensor_parallel_degree or -1,
+                    sp=ec.sequence_parallel_degree,
+                )
+                try:
+                    spec = spec.resolve(n)
+                except ValueError:
+                    spec = MeshSpec(tp=-1).resolve(n)
             mesh = build_mesh(spec)
         self.mesh = mesh
         self.max_seq_len = ec.max_seq_len
@@ -265,6 +292,7 @@ class SPMDEngineWorker:
 
     def __init__(self, config: LLMConfig, generator: SPMDGenerator):
         import jax
+        import jax.numpy as jnp
         import numpy as np  # noqa: F401
 
         ec = config.engine
@@ -279,7 +307,13 @@ class SPMDEngineWorker:
         self._prefix: dict[str, tuple] = {}  # key -> (k, v) device arrays
         self._compile()
         self.cache = self._make_cache(self.n_slots, self.max_len)
-        self._one = None  # scratch stripe for the in-flight admission
+        # per-slot scratch stripes: one per in-flight chunked admission
+        # (pipelined admissions — up to max_concurrent_admissions coexist)
+        self._ones: dict[int, dict] = {}
+        # device-resident next-token inputs: decode programs and run-ahead
+        # plans chain on these without the host ever seeing the tokens
+        # (the host may dispatch plan N+1 before plan N's tokens arrive)
+        self._dev_toks = jnp.zeros((self.n_slots,), jnp.int32)
 
     def _compile(self):
         import jax
@@ -348,12 +382,33 @@ class SPMDEngineWorker:
         )
 
         def decode(params, cache, tokens, temps, top_ks, keys):
-            logits, cache = decode_step(params, cache, tokens, cfg)
-            toks = jax.vmap(sample_row)(logits, temps, top_ks, keys)
-            return toks, cache
+            """K lockstep decode steps in ONE broadcast program (lax.scan).
+            ``keys``: [K, S, 2] per-step/per-slot PRNG keys derived host-side
+            from (request_seed, token_index) so the sampled stream is
+            byte-identical at any K. Returns ([K, S] tokens, last tokens,
+            cache) — the last tokens stay device-resident for chaining."""
 
+            def body(carry, step_keys):
+                toks, cache = carry
+                logits, cache = decode_step(params, cache, toks, cfg)
+                nt = jax.vmap(sample_row)(logits, temps, top_ks, step_keys)
+                return (nt, cache), nt
+
+            (last, cache), out = jax.lax.scan(body, (tokens, cache), keys)
+            return out, last, cache
+
+        # one jitted program; XLA specializes per K (keys.shape[0]) — the
+        # sweepable decode_steps values each compile once
         self._decode = jax.jit(
-            decode, donate_argnums=(1,), out_shardings=(rep, cache_sh)
+            decode, donate_argnums=(1,), out_shardings=(rep, rep, cache_sh)
+        )
+        # tiny device-side scatter keeping the decode token chain host-free
+        # when an admission's first token lands (same idiom as the engine's
+        # _set_tok_jit)
+        self._set_tok = jax.jit(
+            lambda toks, slot, tok: toks.at[slot].set(tok),
+            donate_argnums=(0,),
+            out_shardings=rep,
         )
 
         def seed_prefix(one, pk, pv):
@@ -387,52 +442,72 @@ class SPMDEngineWorker:
 
     def step(self, plan: dict):
         """Execute one lockstep plan; returns the sampled tokens
-        {"admit_tok": int|-1, "toks": [n_slots]|None} (all ranks compute
-        them, only rank 0's copy is consumed)."""
+        {"admit_toks": {slot: int}, "toks": [K][n_slots]|None} (all ranks
+        compute them, only rank 0's copy is consumed).
+
+        Plan sections execute in a fixed order every rank must share:
+        evict → stores → admits → decode. ``stores`` precedes ``admits`` so a
+        plan that both snapshots a finished prompt's prefix KV and admits a
+        new request into the same (just-freed) slot reads the OLD stripe.
+        Each ``admits`` entry is one chunk of one in-flight admission — up
+        to max_concurrent_admissions interleave per plan. ``decode`` runs a
+        K-step scanned program chained on the device-resident token vector
+        (run-ahead plans never wait for the host to see sampled tokens)."""
         import jax.numpy as jnp
 
         for key in plan.get("evict", ()):
             self._prefix.pop(key, None)
-        admit_tok = -1
-        adm = plan.get("admit")
-        if adm is not None:
+        # several admissions can finalize in one plan, so stores is a list
+        for store in plan.get("stores", ()):
+            if store["key"] not in self._prefix:
+                pk, pv = self._extract(store["m"])(
+                    self.cache, jnp.int32(store["slot"])
+                )
+                self._prefix[store["key"]] = (pk, pv)
+        admit_toks: dict[int, int] = {}
+        for adm in plan.get("admits", ()):
+            slot = adm["slot"]
             if adm.get("fresh"):
-                self._one = self._make_cache(1, self.max_len)
+                self._ones[slot] = self._make_cache(1, self.max_len)
                 pref = adm.get("seed_prefix")
                 if pref is not None and pref in self._prefix:
                     pk, pv = self._prefix[pref]
-                    self._one = self._seed_prefix(self._one, pk, pv)
+                    self._ones[slot] = self._seed_prefix(
+                        self._ones[slot], pk, pv
+                    )
             tokens = jnp.asarray(adm["tokens"])
             eff = jnp.asarray([adm["eff"]], jnp.int32)
             start = jnp.asarray([adm["start"]], jnp.int32)
             if not adm["final"]:
-                self._one = self._chunk_mid(
-                    self.params, self._one, tokens, eff, start
+                self._ones[slot] = self._chunk_mid(
+                    self.params, self._ones[slot], tokens, eff, start
                 )
             else:
                 tok, self.cache = self._chunk_final(
-                    self.params, self.cache, self._one, tokens, eff, start,
-                    jnp.int32(adm["slot"]),
+                    self.params, self.cache, self._ones.pop(slot), tokens,
+                    eff, start,
+                    jnp.int32(slot),
                     jnp.asarray(adm["temp"], jnp.float32),
                     jnp.asarray(adm["top_k"], jnp.int32),
                     jnp.asarray(adm["key"], jnp.uint32),
                 )
-                self._one = None
-                admit_tok = int(SPMDGenerator._host(tok))
-        store = plan.get("store")
-        if store is not None and store["key"] not in self._prefix:
-            pk, pv = self._extract(store["m"])(self.cache, jnp.int32(store["slot"]))
-            self._prefix[store["key"]] = (pk, pv)
+                # chain the first sampled token into the decode inputs ON
+                # DEVICE: the next decode plan may already be dispatched
+                self._dev_toks = self._set_tok(
+                    self._dev_toks, jnp.int32(slot), tok
+                )
+                admit_toks[slot] = int(SPMDGenerator._host(tok))
         toks = None
         dec = plan.get("decode")
         if dec is not None:
-            toks_dev, self.cache = self._decode(
+            keys = jnp.asarray(dec["keys"], jnp.uint32)  # [K, S, 2]
+            toks_dev, self._dev_toks, self.cache = self._decode(
                 self.params,
                 self.cache,
-                jnp.asarray(dec["tokens"], jnp.int32),
+                self._dev_toks,
                 jnp.asarray(dec["temps"], jnp.float32),
                 jnp.asarray(dec["top_ks"], jnp.int32),
-                jnp.asarray(dec["keys"], jnp.uint32),
+                keys,
             )
-            toks = SPMDGenerator._host(toks_dev).tolist()
-        return {"admit_tok": admit_tok, "toks": toks}
+            toks = SPMDGenerator._host(toks_dev).tolist()  # [K][S]
+        return {"admit_toks": admit_toks, "toks": toks}

@@ -77,6 +77,303 @@ _GANG_ENV = {
 }
 
 
+# ---- multi-step decode / run-ahead / pipelined admissions ----------------
+#
+# These run a ONE-worker gang (no jax.distributed world, thread-mode
+# runtime) so the scheduler logic under test — K-step scanned decode,
+# bounded-window dispatch with ordered apply, concurrent chunked
+# admissions, stop-token discard — runs in seconds in the fast tier; the
+# slow 2-process gloo tests below exercise the same plans cross-process.
+
+
+def test_gang_multistep_decode_byte_identical(ray_start_thread):
+    """decode_steps/decode_runahead must not change a fixed-seed stream:
+    keys are (seed, token_index)-derived, so K=4 scanned decode with a
+    2-deep run-ahead window replays byte-identically at K=1 sync — greedy
+    AND temperature sampling."""
+    from ray_tpu.llm.gang import GangLLMServer
+
+    gang = GangLLMServer(
+        _tiny_config(decode_steps=4, decode_runahead=2), num_workers=1
+    )
+    try:
+        greedy = SamplingParams(max_tokens=12, ignore_eos=True, seed=3)
+        sampled = SamplingParams(
+            max_tokens=10, ignore_eos=True, temperature=0.8, seed=11
+        )
+        a = gang.submit("hello world", greedy)
+        b = gang.submit("sampled path", sampled)
+        assert a.done.wait(timeout=240) and b.done.wait(timeout=240)
+        assert len(a.out_tokens) == 12
+        assert gang.stats()["max_inflight_seen"] >= 2, "run-ahead never engaged"
+        gang.set_perf_knobs(decode_steps=1, decode_runahead=1)
+        a1 = gang.submit("hello world", greedy)
+        b1 = gang.submit("sampled path", sampled)
+        assert a1.done.wait(timeout=240) and b1.done.wait(timeout=240)
+        assert a.out_tokens == a1.out_tokens
+        assert b.out_tokens == b1.out_tokens
+    finally:
+        gang.shutdown()
+
+
+def test_gang_stop_token_mid_scan_discards_tail(ray_start_thread):
+    """A stop token landing mid-scan (step k < K) must truncate the stream
+    there: the K-k over-decoded tail tokens are discarded host-side and the
+    request finishes with reason 'stop'."""
+    from ray_tpu.llm.gang import GangLLMServer
+
+    gang = GangLLMServer(
+        _tiny_config(decode_steps=4, decode_runahead=2), num_workers=1
+    )
+    try:
+        ref = gang.submit(
+            "stop test", SamplingParams(max_tokens=12, ignore_eos=True, seed=1)
+        )
+        assert ref.done.wait(timeout=240)
+        stop_tok = ref.out_tokens[2]
+        # a tiny model may repeat tokens: the stop lands at stop_tok's FIRST
+        # occurrence, which is ≤ 2 — always mid-scan for K=4
+        cut = ref.out_tokens.index(stop_tok)
+        r = gang.submit(
+            "stop test",
+            SamplingParams(
+                max_tokens=12, ignore_eos=True, seed=1,
+                stop_token_ids=[stop_tok],
+            ),
+        )
+        assert r.done.wait(timeout=240)
+        assert r.finish_reason == "stop"
+        assert r.out_tokens == ref.out_tokens[:cut], (r.out_tokens, ref.out_tokens)
+    finally:
+        gang.shutdown()
+
+
+def test_gang_concurrent_admissions_interleave(ray_start_thread):
+    """Multiple chunked prefills must be in flight at once (VERDICT weak
+    #6: one admission at a time serializes arrival waves), and the
+    max_concurrent_admissions cap must hold."""
+    from ray_tpu.llm.gang import GangLLMServer
+
+    gang = GangLLMServer(
+        _tiny_config(decode_steps=2, max_concurrent_admissions=2),
+        num_workers=1,
+    )
+    try:
+        long_p = "a chunky prompt needing several prefill chunks to admit! "
+        reqs = [
+            gang.submit(
+                long_p + str(i), SamplingParams(max_tokens=4, ignore_eos=True)
+            )
+            for i in range(3)
+        ]
+        for r in reqs:
+            assert r.done.wait(timeout=240)
+        st = gang.stats()
+        assert st["max_admissions_seen"] == 2, st
+        # interleaved admissions must not corrupt streams: each request
+        # decodes from ITS prompt (different prompts, tiny greedy model —
+        # identical outputs would mean crossed slots only if all three
+        # matched; just require completion + token counts here)
+        assert all(len(r.out_tokens) == 4 for r in reqs)
+    finally:
+        gang.shutdown()
+
+
+def test_gang_same_plan_prefix_store_and_hit(ray_start_thread):
+    """A prompt resubmitted right after its first prefill completes: the
+    hit admission may ride the SAME plan that snapshots the first's prefix
+    KV (store is pending until the next plan) — the worker must apply
+    store before admits or the hit seeds garbage. Two truly concurrent
+    identical prompts both miss (the index fills at final-chunk dispatch)
+    but must still be byte-identical."""
+    from ray_tpu.llm.gang import GangLLMServer
+
+    gang = GangLLMServer(_tiny_config(decode_steps=2), num_workers=1)
+    try:
+        p = "another shared preamble for racing store and seed paths!!"
+        c1 = gang.submit(p, SamplingParams(max_tokens=3, ignore_eos=True))
+        c2 = gang.submit(p, SamplingParams(max_tokens=3, ignore_eos=True))
+        assert c1.done.wait(timeout=240) and c2.done.wait(timeout=240)
+        assert c1.out_tokens == c2.out_tokens  # concurrent double-miss
+        h = gang.submit(p, SamplingParams(max_tokens=3, ignore_eos=True))
+        assert h.done.wait(timeout=240)
+        assert h.prefix_hit_tokens > 0
+        assert h.out_tokens == c1.out_tokens
+        assert gang.stats()["prefix_hits"] >= 1
+    finally:
+        gang.shutdown()
+
+
+def test_gang_two_stores_in_one_plan_both_hittable(ray_start_thread):
+    """Two DIFFERENT equal-length prompts admitted together under
+    max_concurrent_admissions=2: their final chunks ride the same plan, so
+    the plan carries TWO prefix-KV stores. Both must actually snapshot on
+    the worker — a single-slot pending store would drop one while still
+    indexing its key, making the later 'hit' decode from an unseeded
+    cache (silent garbage)."""
+    from ray_tpu.llm.gang import GangLLMServer
+
+    gang = GangLLMServer(
+        _tiny_config(decode_steps=2, max_concurrent_admissions=2),
+        num_workers=1,
+    )
+    try:
+        pa = "prompt alpha shares admission plan with its twin brother!"
+        pb = "prompt bravo shares admission plan with its twin sibling!"
+        sp = SamplingParams(max_tokens=3, ignore_eos=True)
+        a = gang.submit(pa, sp)
+        b = gang.submit(pb, sp)
+        assert a.done.wait(timeout=240) and b.done.wait(timeout=240)
+        ha = gang.submit(pa, sp)
+        hb = gang.submit(pb, sp)
+        assert ha.done.wait(timeout=240) and hb.done.wait(timeout=240)
+        assert ha.prefix_hit_tokens > 0 and hb.prefix_hit_tokens > 0
+        assert ha.out_tokens == a.out_tokens
+        assert hb.out_tokens == b.out_tokens
+    finally:
+        gang.shutdown()
+
+
+def test_token_pacer_spreads_bursts():
+    """Unit: a K-token burst is paced over the observed block interval;
+    single-token blocks are never delayed."""
+    import time as _time
+
+    from ray_tpu.llm.pacing import TokenPacer
+
+    p = TokenPacer()
+    p.note_block(4)  # first block: floor pacing only
+    assert 0.0 < p.pace_s <= 0.001
+    _time.sleep(0.04)
+    p.note_block(4)  # ~40ms block interval / 4 tokens ≈ 10ms each
+    assert 0.005 <= p.pace_s <= 0.1, p.pace_s
+    t0 = _time.monotonic()
+    p.gate(backlog=True)
+    assert _time.monotonic() - t0 >= 0.005
+    t0 = _time.monotonic()
+    p.gate(backlog=False)  # lone token: no delay
+    assert _time.monotonic() - t0 < 0.005
+    p.note_block(1)  # single-step mode: pacing off
+    assert p.pace_s == 0.0
+
+
+def test_gang_stream_paces_multistep_bursts(ray_start_thread):
+    """completions_stream with K=4 yields one chunk per token (not one blob
+    per dispatch), with nonzero inter-chunk gaps for paced bursts."""
+    import time as _time
+
+    from ray_tpu.llm.gang import GangLLMServer
+
+    gang = GangLLMServer(
+        _tiny_config(decode_steps=4, decode_runahead=2), num_workers=1
+    )
+    try:
+        arrivals = []
+        chunks = []
+        for c in gang.completions_stream(
+            {"prompt": "pace me", "max_tokens": 12, "seed": 2}
+        ):
+            assert "error" not in c, c
+            arrivals.append(_time.monotonic())
+            chunks.append(c)
+        # 12 tokens (byte tokenizer: 1 chunk each) + final finish chunk
+        assert len(chunks) >= 8, len(chunks)
+        assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+        import numpy as np
+
+        gaps = np.diff(np.asarray(arrivals[:-1]))
+        assert gaps.size and float(np.percentile(gaps, 50)) > 0.0
+    finally:
+        gang.shutdown()
+
+
+def test_gang_runahead_worker_death_replays_byte_identical(ray_start_process):
+    """Worker death with plans in the run-ahead window: the rebuild must
+    discard undelivered records, replay from the prompt, and regenerate the
+    EXACT stream (keys replay from (seed, 0))."""
+    from ray_tpu.llm.gang import GangLLMServer
+
+    gang = GangLLMServer(
+        _tiny_config(decode_steps=4, decode_runahead=2),
+        num_workers=1,
+        worker_env=_GANG_ENV,
+    )
+    try:
+        warm = gang.submit("warm", SamplingParams(max_tokens=2, ignore_eos=True))
+        assert warm.done.wait(timeout=240)
+        params = SamplingParams(
+            max_tokens=40, ignore_eos=True, temperature=0.7, seed=5
+        )
+        req = gang.submit("tell me a story", params)
+        assert isinstance(req.stream_queue.get(timeout=120), int)
+        import ray_tpu as _rt
+
+        _rt.kill(gang.workers[0])
+        assert req.done.wait(timeout=300), "request never completed after rebuild"
+        assert req.finish_reason == "length"
+        assert len(req.out_tokens) == 40, "replay duplicated or dropped tokens"
+        assert gang.stats()["rebuilds"] >= 1
+        ref = gang.submit("tell me a story", params)
+        assert ref.done.wait(timeout=240)
+        assert ref.out_tokens == req.out_tokens
+    finally:
+        gang.shutdown()
+
+
+def test_gang_shutdown_unblocks_inflight_streams(ray_start_thread):
+    """shutdown() while a request is mid-stream must fail the request (and
+    queue its stream sentinel) instead of stranding consumers blocked in
+    _drain/_wait_unary forever."""
+    from ray_tpu.llm.gang import GangLLMServer
+
+    gang = GangLLMServer(
+        _tiny_config(decode_steps=4, decode_runahead=2), num_workers=1
+    )
+    try:
+        req = gang.submit(
+            "stream me into a shutdown",
+            SamplingParams(max_tokens=400, ignore_eos=True),
+        )
+        assert isinstance(req.stream_queue.get(timeout=120), int)
+    finally:
+        gang.shutdown()
+    assert req.done.wait(timeout=60), "shutdown stranded an in-flight request"
+    assert req.finish_reason == "error"
+    assert req.error is not None
+
+
+def test_gang_worker_death_with_fully_dispatched_budget(ray_start_process):
+    """max_tokens <= decode_steps: the request's whole budget rides ONE
+    in-flight decode record and its dispatch slot is freed immediately, so
+    on worker death the record (popped or not-yet-appended) is the only
+    reference left — the rebuild must still find and replay it instead of
+    hanging the client forever."""
+    from ray_tpu.llm.gang import GangLLMServer
+
+    gang = GangLLMServer(
+        _tiny_config(decode_steps=8, decode_runahead=2),
+        num_workers=1,
+        worker_env=_GANG_ENV,
+    )
+    try:
+        warm = gang.submit("warm", SamplingParams(max_tokens=2, ignore_eos=True))
+        assert warm.done.wait(timeout=240)
+        params = SamplingParams(max_tokens=4, ignore_eos=True, seed=13)
+        req = gang.submit("short budget", params)
+        assert isinstance(req.stream_queue.get(timeout=120), int)
+        import ray_tpu as _rt
+
+        _rt.kill(gang.workers[0])
+        assert req.done.wait(timeout=300), "request lost across rebuild"
+        assert req.finish_reason == "length"
+        assert len(req.out_tokens) == 4
+        ref = gang.submit("short budget", params)
+        assert ref.done.wait(timeout=240)
+        assert ref.out_tokens == req.out_tokens
+    finally:
+        gang.shutdown()
+
+
 @pytest.mark.slow
 def test_gang_continuous_batching_and_prefix_cache(ray_start_process):
     """Continuous batching at gang scale (VERDICT r4 missing #3): a request
@@ -127,11 +424,14 @@ def test_gang_worker_death_rebuilds_and_replays(ray_start_process):
     EngineWorker mid-request rebuilds the gang INTO THE HELD placement
     group and deterministically replays the in-flight request — the stream
     completes with no duplicate tokens and no controller-level replica
-    replacement."""
+    replacement. Runs with multi-step decode + run-ahead so the rebuild
+    also covers discarding undelivered window records cross-process."""
     from ray_tpu.llm.gang import GangLLMServer
 
     gang = GangLLMServer(
-        _tiny_config(tensor_parallel_degree=2),
+        _tiny_config(
+            tensor_parallel_degree=2, decode_steps=4, decode_runahead=2
+        ),
         num_workers=2,
         worker_env=_GANG_ENV,
     )
