@@ -703,7 +703,67 @@ def ttft_tradeoff_sweep(on_tpu: bool, headline: Optional[dict] = None) -> list:
     return out
 
 
+def check_floor(max_regress: float = 0.25) -> int:
+    """``--check-floor``: regression gate for the 1:1 sync actor-call rate.
+
+    Runs the thread- and process-mode 1:1 sync microbenches on THIS host
+    and compares them against the rates recorded in MICROBENCH.json (same
+    host by contract — the file is re-recorded whenever the call path
+    changes). Exit nonzero when either mode regresses more than
+    ``max_regress`` below its recorded value, so a control-plane regression
+    bisects in CI instead of surfacing rounds later.
+
+    Load calibration: the shared host's ambient load swings measured rates
+    up to 4x between runs. ``put (small)`` is pure in-process work that
+    degrades with ambient CPU contention the same way the call path does
+    but is untouched by call-path changes — each mode's floor is scaled by
+    ``min(1, measured_put / recorded_put)`` so the gate stays strict on an
+    idle box and doesn't flake on a loaded one (a real call-path regression
+    moves the sync rate WITHOUT moving the put rate).
+    """
+    import os
+
+    import ray_tpu
+    from ray_tpu.scripts.microbenchmark import timed_call_rate, warm_sync_actor
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "MICROBENCH.json")
+    with open(path) as f:
+        recorded = json.load(f)
+
+    def recorded_rate(mode: str, name: str = "1:1 actor calls sync") -> float:
+        return next(
+            r["rate_per_s"] for r in recorded[mode] if r["name"] == name
+        )
+
+    failures = []
+    out = {}
+    for mode in ("thread", "process"):
+        ray_tpu.init(num_cpus=4, mode=mode)
+        a = warm_sync_actor()
+        rate = timed_call_rate(
+            lambda: ray_tpu.get(a.m.remote()), windows=2, secs=2.0
+        )
+        payload = b"x" * 100
+        put_rate = timed_call_rate(lambda: ray_tpu.put(payload), secs=0.5)
+        ray_tpu.shutdown()
+        load_scale = min(1.0, put_rate / recorded_rate(mode, "single client put (small)"))
+        floor = recorded_rate(mode) * (1.0 - max_regress) * load_scale
+        out[mode] = {
+            "rate_per_s": round(rate, 1),
+            "recorded_per_s": round(recorded_rate(mode), 1),
+            "load_scale": round(load_scale, 3),
+            "floor_per_s": round(floor, 1),
+            "ok": rate >= floor,
+        }
+        if rate < floor:
+            failures.append(mode)
+    print(json.dumps({"check_floor": out, "failed": failures}))
+    return 1 if failures else 0
+
+
 if __name__ == "__main__":
+    if "--check-floor" in sys.argv:
+        sys.exit(check_floor())
     try:
         main()
     except Exception as e:  # never leave the driver without a JSON line

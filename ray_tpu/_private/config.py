@@ -83,6 +83,19 @@ class Config:
     # Objects <= this many bytes are returned inline through the control plane
     # (reference: max_direct_call_object_size, ray_config_def.h).
     max_inline_object_size: int = 100 * 1024
+    # --- actor call paths ---
+    # Same-process inline execution of eligible sync actor calls (thread
+    # mode, or a worker calling a co-located actor): the method body runs on
+    # the caller's thread under the actor's execution lock, skipping the
+    # worker loop, the per-actor executor, and the controller reply round
+    # trip entirely. Kill switch: RAY_TPU_INLINE_ACTOR_CALLS=0.
+    inline_actor_calls: bool = True
+    # Direct (worker-to-worker) call results <= this many bytes ride inline
+    # in the reply frame; larger results are written to a shared-memory
+    # segment on the callee and mapped zero-copy by the caller (single-host
+    # only — cross-host direct replies always inline). Env:
+    # RAY_TPU_DIRECT_INLINE_MAX_BYTES.
+    direct_inline_max_bytes: int = 8 * 1024**2
     object_store_memory: int = 2 * 1024**3
     # C++ arena store (ray_tpu/_native/plasma_store.cc); falls back to the
     # Python per-segment store when the native build is unavailable.

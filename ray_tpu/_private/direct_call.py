@@ -40,7 +40,18 @@ _NEG_TTL = 0.25  # s between endpoint re-queries while an actor has no address
 
 
 class _DirectConn:
-    """One pooled connection to an actor worker's direct listener."""
+    """One pooled connection to an actor worker's direct listener.
+
+    Reading is a single-reader protocol with HANDOFF: a background read
+    loop owns the socket by default, but a blocked ``get()`` can ADOPT the
+    reader role (``adopt_read``) and receive its own reply inline — no
+    read-loop → settle → condition-variable wakeup chain on the sync call
+    path. ``_recv_lock`` serializes the socket; ``_role_cv``/``_adopters``
+    park the background loop while a getter holds the role, with a short
+    stickiness window after each adoption so tight call loops re-adopt
+    without ping-ponging the socket back to the background thread."""
+
+    _ADOPT_GRACE_S = 0.05
 
     def __init__(self, address: str, conn, transport: "DirectActorTransport"):
         self.address = address
@@ -50,6 +61,10 @@ class _DirectConn:
         # req_id -> (spec, oid_binary) for conn-failure handling
         self.inflight: dict[int, tuple] = {}
         self.alive = True
+        self._recv_lock = threading.Lock()
+        self._role_cv = threading.Condition()
+        self._adopters = 0
+        self._adopt_grace_until = 0.0
         self.reader = threading.Thread(
             target=self._read_loop, daemon=True, name=f"direct-client-{address}"
         )
@@ -61,10 +76,44 @@ class _DirectConn:
                 raise OSError("direct connection closed")
             self.conn.send(P.DirectActorCall(req_id, spec, resolved_args))
 
+    def _dispatch(self, msg):
+        """Route one received message (shared by the background loop and
+        adopting getters — both are 'the reader' when they call this)."""
+        t = self.transport
+        if isinstance(msg, P.DirectCallReply):
+            entry = self.inflight.pop(msg.req_id, None)
+            if entry is None:
+                return
+            spec, oid_bin = entry
+            if msg.results == "stale":
+                # callee no longer hosts the actor: re-resolve + reroute
+                t._reroute(spec, oid_bin, stale_address=self.address)
+                return
+            t._complete(oid_bin, msg.results)
+
     def _read_loop(self):
         t = self.transport
         while True:
+            with self._role_cv:
+                # short park slices, NOT woken per adoption: an adopter
+                # handoff must cost the getter nothing — the background
+                # thread re-checks on its own clock (bounded resume lag)
+                while self._adopters > 0 and self.alive:
+                    self._role_cv.wait(timeout=0.05)
+            if not self.alive:
+                break
+            if time.monotonic() < self._adopt_grace_until:
+                # stickiness: a sync-call loop will re-adopt within
+                # microseconds; grabbing the socket back now would put its
+                # next reply on the slow wakeup path
+                time.sleep(0.005)
+                continue
+            if not self._recv_lock.acquire(timeout=0.2):
+                continue  # an adopter holds the socket
+            msg = None
             try:
+                if self._adopters > 0:
+                    continue
                 msg = self.conn.recv()
             except (EOFError, OSError):
                 break
@@ -73,29 +122,101 @@ class _DirectConn:
                 # dies with TypeError (handle is None) — a normal shutdown
                 # race, same as EOF
                 break
-            if isinstance(msg, P.DirectCallReply):
-                entry = self.inflight.pop(msg.req_id, None)
-                if entry is None:
-                    continue
-                spec, oid_bin = entry
-                if msg.results == "stale":
-                    # callee no longer hosts the actor: re-resolve + reroute
-                    t._reroute(spec, oid_bin, stale_address=self.address)
-                    continue
-                t._complete(oid_bin, msg.results)
+            finally:
+                self._recv_lock.release()
+            if msg is not None:
+                self._dispatch(msg)
         self.alive = False
+        with self._role_cv:
+            self._role_cv.notify_all()
         t._on_conn_lost(self)
+
+    def adopt_read(self, oid_bin: bytes, deadline: Optional[float]):
+        """Become this connection's reader until ``oid_bin`` reaches a
+        terminal table state; other replies drained on the way are
+        dispatched normally. Returns the terminal entry, or None when the
+        connection died mid-adoption (the caller falls back to wait_local,
+        where the conn-lost handler has rerouted/failed the call)."""
+        t = self.transport
+        with self._role_cv:
+            self._adopters += 1
+        try:
+            while True:
+                with t.cv:
+                    st = t.table.get(oid_bin)
+                    if st is None or st[0] != "pending":
+                        return st
+                if not self.alive:
+                    return None
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError("direct actor call timed out")
+                if self._recv_lock.acquire(timeout=0.002):
+                    try:
+                        st = self._pump_locked(oid_bin, deadline)
+                    finally:
+                        self._recv_lock.release()
+                    if st is not None:
+                        return st
+                    if not self.alive:
+                        return None
+                else:
+                    # the background loop (or another adopter) owns the
+                    # socket right now; wait for it to settle our entry
+                    with t.cv:
+                        st = t.table.get(oid_bin)
+                        if st is not None and st[0] == "pending":
+                            t.cv.wait(timeout=0.02)
+        finally:
+            with self._role_cv:
+                self._adopters -= 1
+                self._adopt_grace_until = time.monotonic() + self._ADOPT_GRACE_S
+                if not self.alive:
+                    self._role_cv.notify_all()  # death signal only
+
+    def _pump_locked(self, oid_bin: bytes, deadline: Optional[float]):
+        """Receive+dispatch under ``_recv_lock`` until ``oid_bin`` settles.
+        Returns the terminal entry; None on connection death or timeout
+        (caller re-checks)."""
+        t = self.transport
+        while True:
+            with t.cv:
+                st = t.table.get(oid_bin)
+                if st is None or st[0] != "pending":
+                    return st
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError("direct actor call timed out")
+            try:
+                slice_t = 0.2 if remaining is None else min(remaining, 0.2)
+                if not self.conn.poll(slice_t):
+                    continue
+                msg = self.conn.recv()
+            except (EOFError, OSError, TypeError, ValueError):
+                self.alive = False
+                with self._role_cv:
+                    self._role_cv.notify_all()
+                return None
+            self._dispatch(msg)
 
 
 class DirectActorTransport:
-    """Per-process transport shared by every actor handle of one WorkerAPI."""
+    """Per-process transport shared by every actor handle of one WorkerAPI.
 
-    def __init__(self, api, authkey: bytes):
+    Also the bookkeeping plane for the SAME-PROCESS inline fast path: inline
+    results live in the same caller-owned table (so get/wait/promote/release
+    need no second ownership domain), and inline calls count as in-flight for
+    ``wait_direct_drained`` — the drain protocol observes every call. With
+    ``authkey=None`` (thread mode) the socket machinery is dormant and only
+    the inline path uses the transport."""
+
+    def __init__(self, api, authkey: Optional[bytes]):
         self.api = api
         self.authkey = authkey
         self.cv = threading.Condition()
-        # oid binary -> ("pending",) | ("done", kind, payload_bytes)
-        #             | ("fallback",) | ("promoted", kind, payload_bytes)
+        # oid binary -> ("pending",) | ("done", kind, payload)
+        #             | ("fallback",) | ("promoted", kind, payload)
+        # payload: flattened SerializedObject bytes for kind inline/error;
+        # (shm_name, size) for kind plasma (a spilled oversized direct reply)
         self.table: dict[bytes, tuple] = {}
         self._conns: dict[str, _DirectConn] = {}
         self._conn_lock = threading.Lock()
@@ -107,6 +228,16 @@ class DirectActorTransport:
         # head-queued one (per-caller submission order, reference:
         # sequence_number ordering in actor_task_submitter.h)
         self._head_pending: dict[bytes, set] = {}
+        # actor_id binary -> {thread_ident: count} of inline calls currently
+        # EXECUTING on a caller thread (guarded by self.cv). Keyed by thread
+        # so wait_direct_drained can exclude the calling thread's own nested
+        # calls (they cannot complete while it blocks).
+        self._inline_inflight: dict[bytes, dict[int, int]] = {}
+        # oid binary -> shm segment name for caller-owned plasma replies
+        # (unlinked on release; see _unlink_loop)
+        self._owned_segments: dict[bytes, str] = {}
+        self._unlink_queue: list = []
+        self._unlinker: Optional[threading.Thread] = None
         self._req = itertools.count(1)
         # fast-path flag: get()/wait() skip the table entirely until the
         # first direct submission happens
@@ -117,6 +248,8 @@ class DirectActorTransport:
     def try_submit(self, spec: TaskSpec) -> bool:
         """Push ``spec`` directly to its actor's worker. False = caller must
         use the head-mediated path (this method has then done nothing)."""
+        if self.authkey is None:
+            return False  # loopback-only transport (thread mode)
         if (
             spec.num_returns != 1
             or spec.generator_backpressure
@@ -189,21 +322,27 @@ class DirectActorTransport:
         return resolved
 
     def wait_direct_drained(self, actor_bin: bytes, timeout: float = 300.0) -> bool:
-        """Block until no direct call to ``actor_bin`` is in flight — a
-        head-mediated submission must not overtake direct calls already on
-        the wire (the direct→head half of cross-path per-caller ordering;
-        the head→direct half is _head_queue_drained). Best effort: returns
-        False on timeout and the caller proceeds."""
+        """Block until no direct OR inline call to ``actor_bin`` is in
+        flight — a head-mediated submission must not overtake calls already
+        on the wire / executing (the direct→head half of cross-path
+        per-caller ordering; the head→direct half is _head_queue_drained).
+        The calling thread's own inline calls are excluded: they cannot
+        complete while it blocks here (reentrant self-call → head fallback
+        must not self-deadlock). Best effort: returns False on timeout and
+        the caller proceeds."""
         deadline = time.monotonic() + timeout
+        me = threading.get_ident()
         with self.cv:
-            while self._direct_inflight_for(actor_bin) > 0:
+            while self._direct_inflight_for(actor_bin, exclude_thread=me) > 0:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self.cv.wait(timeout=min(remaining, 1.0))
         return True
 
-    def _direct_inflight_for(self, actor_bin: bytes) -> int:
+    def _direct_inflight_for(
+        self, actor_bin: bytes, exclude_thread: Optional[int] = None
+    ) -> int:
         with self._conn_lock:
             conns = list(self._conns.values())
         n = 0
@@ -214,16 +353,95 @@ class DirectActorTransport:
                     and spec.actor_id.binary() == actor_bin
                 ):
                     n += 1
+        for tid, count in self._inline_inflight.get(actor_bin, {}).items():
+            if tid != exclude_thread:
+                n += count
         return n
 
+    # ----------------------------------------------------- inline fast path
+
+    def can_inline(self, actor_bin: bytes) -> bool:
+        """Cross-path FIFO fence for the same-process inline path: any
+        in-flight slow-path call (head-queued or on a direct conn) forces
+        this call through the slow path too — per-caller→callee submission
+        order must hold across paths."""
+        if not self._head_queue_drained(actor_bin):
+            return False
+        with self.cv:
+            return self._direct_inflight_for(actor_bin) == 0
+
+    def begin_inline(self, actor_bin: bytes, oid_bin: bytes):
+        """Mark an inline call in flight (drain accounting observes it) and
+        register its pending result entry."""
+        me = threading.get_ident()
+        with self.cv:
+            per = self._inline_inflight.setdefault(actor_bin, {})
+            per[me] = per.get(me, 0) + 1
+            self.table[oid_bin] = ("pending", actor_bin, False)
+            self.active = True
+
+    def end_inline(self, actor_bin: bytes):
+        me = threading.get_ident()
+        with self.cv:
+            per = self._inline_inflight.get(actor_bin)
+            if per is not None:
+                n = per.get(me, 0) - 1
+                if n <= 0:
+                    per.pop(me, None)
+                    if not per:
+                        del self._inline_inflight[actor_bin]
+                else:
+                    per[me] = n
+            self.cv.notify_all()
+
+    def settle_inline(self, oid_bin: bytes, kind: str, payload):
+        """Record an inline call's result (same table/ownership semantics as
+        a direct reply — including deferred promotion if the ref escaped
+        mid-call, impossible today but harmless to honor)."""
+        self._settle(oid_bin, kind, payload)
+
+    def abandon_inline(self, oid_bin: bytes):
+        """The inline attempt fell back after registering (lock busy / actor
+        gone): drop the pending entry so the slow path owns the ref."""
+        with self.cv:
+            self.table.pop(oid_bin, None)
+            self.cv.notify_all()
+
+    def resolve_args_inline(self, spec: TaskSpec) -> Optional[list]:
+        """Non-blocking dependency resolution for the inline path: every ref
+        arg must be immediately available — from this table (an earlier
+        inline/direct result) or the caller-local head store probe. Any
+        unresolved upstream ref → None (slow path does the dep waiting)."""
+        resolved = [("value", spec.args[0][1])]
+        for kind, entry in spec.args[1:]:
+            if kind != "ref":
+                continue
+            ob = entry.binary()
+            st = self.table.get(ob)
+            if st is not None:
+                if st[0] not in ("done", "promoted"):
+                    return None  # pending/fallback: not immediately local
+                resolved.append((st[1], st[2]))
+                continue
+            e = self.api._local_entry(ob)
+            if e is None:
+                return None
+            resolved.append(e)
+        return resolved
+
     def note_head_submit(self, spec: TaskSpec):
-        """Record a head-mediated submission to an actor: later direct
-        calls must wait for the head's queue to drain (cross-path order)."""
+        """Record a head-mediated submission to an actor: later direct/
+        inline calls must wait for the head's queue to drain (cross-path
+        order). Self-compacting: past a threshold, completed entries are
+        dropped via one liveness poll — an actor that never leaves the head
+        path must not accumulate TaskIDs forever."""
         if spec.actor_id is None:
             return
-        self._head_pending.setdefault(spec.actor_id.binary(), set()).add(
-            spec.task_id
-        )
+        abin = spec.actor_id.binary()
+        pending = self._head_pending.setdefault(abin, set())
+        pending.add(spec.task_id)
+        if len(pending) >= 256:
+            self._head_queue_drained(abin)  # drops finished entries
 
     def _head_queue_drained(self, actor_bin: bytes) -> bool:
         pending = self._head_pending.get(actor_bin)
@@ -309,17 +527,90 @@ class DirectActorTransport:
             if old is not None:  # may have been released already
                 promote_after = old[0] == "pending" and len(old) > 2 and old[2]
                 self.table[oid_bin] = ("done", kind, payload)
+                if kind == "plasma":
+                    # caller-owned spilled reply: we unlink the segment when
+                    # the last local handle drops (unless promoted — then
+                    # the head copy owns lifetime and we still unlink ours)
+                    self._owned_segments[oid_bin] = payload[0]
+                    self._ensure_unlinker()  # plain call site, not __del__
+            else:
+                if kind == "plasma":
+                    # released before the reply landed: nobody will ever
+                    # read the segment — reclaim it now (reader thread, not
+                    # __del__, so starting the unlinker here is safe)
+                    self._queue_unlink(payload[0])
+                    self._ensure_unlinker()
             self.cv.notify_all()
         if promote_after:
-            from ray_tpu._private.ids import ObjectID
-
             try:
-                self.api._put_entry(ObjectID(oid_bin), kind, payload)
-                with self.cv:
-                    if self.table.get(oid_bin, ("?",))[0] == "done":
-                        self.table[oid_bin] = ("promoted", kind, payload)
+                self._promote_entry(oid_bin, kind, payload)
             except Exception:  # noqa: BLE001 — head gone; local copy stands
                 pass
+
+    def _promote_entry(self, oid_bin: bytes, kind: str, payload):
+        """Seal a terminal entry into the head store. Plasma (spilled-reply)
+        payloads are materialized to bytes first: the head must own a copy
+        whose lifetime it controls — handing it a caller-owned segment would
+        tie a head-store entry to this process's unlink queue."""
+        from ray_tpu._private.ids import ObjectID
+
+        if kind == "plasma":
+            data = bytes(self._read_segment(payload).to_bytes())
+            self.api._put_entry(ObjectID(oid_bin), "inline", data)
+        else:
+            self.api._put_entry(ObjectID(oid_bin), kind, payload)
+        with self.cv:
+            if self.table.get(oid_bin, ("?",))[0] == "done":
+                self.table[oid_bin] = ("promoted", kind, payload)
+
+    def _read_segment(self, payload) -> SerializedObject:
+        """Map a caller-owned plasma reply (zero-copy view over the callee's
+        shared-memory segment)."""
+        from ray_tpu._private.object_store import PlasmaClient
+
+        if not hasattr(self, "_plasma_client"):
+            self._plasma_client = PlasmaClient()
+        name, size = payload
+        return self._plasma_client.read(name, size)
+
+    def entry_payload(self, st: tuple) -> SerializedObject:
+        """Terminal table entry → SerializedObject (maps spilled replies)."""
+        if st[1] == "plasma":
+            return self._read_segment(st[2])
+        return SerializedObject.from_buffer(st[2])
+
+    # segment reclamation rides a background thread: release_local runs on
+    # GC (__del__) where unlink's resource-tracker traffic could deadlock a
+    # lock the interrupted thread already holds — so __del__ only appends
+    def _queue_unlink(self, name: str):
+        self._unlink_queue.append(name)
+
+    def _ensure_unlinker(self):
+        if self._unlinker is None or not self._unlinker.is_alive():
+            self._unlinker = threading.Thread(
+                target=self._unlink_loop, daemon=True, name="direct-unlink"
+            )
+            self._unlinker.start()
+
+    def _unlink_loop(self):
+        from multiprocessing import shared_memory
+
+        while True:
+            time.sleep(0.1)
+            while self._unlink_queue:
+                name = self._unlink_queue.pop()
+                pc = getattr(self, "_plasma_client", None)
+                if pc is not None:
+                    # drop OUR zero-copy mapping too — unlink alone leaves
+                    # the attached segment (and its pages) cached in the
+                    # client for the process lifetime
+                    pc.detach(name)
+                try:
+                    seg = shared_memory.SharedMemory(name=name)
+                    seg.close()
+                    seg.unlink()
+                except (FileNotFoundError, OSError):
+                    pass
 
     def _reroute(self, spec: TaskSpec, oid_bin: bytes, stale_address: str):
         """Resubmit through the head (restart window / stale endpoint)."""
@@ -379,6 +670,36 @@ class DirectActorTransport:
     def state(self, oid_bin: bytes) -> Optional[str]:
         st = self.table.get(oid_bin)
         return None if st is None else st[0]
+
+    def wait_local_adopt(self, oid_bin: bytes, timeout: Optional[float]) -> tuple:
+        """``wait_local`` with caller-thread completion: when the result is
+        in flight on a live direct connection, the getter adopts that
+        connection's reader role and receives the reply itself —
+        single-reader handoff instead of read-loop → settle → cv wakeup."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.cv:
+            st = self.table.get(oid_bin)
+            if st is None:
+                return ("fallback",)  # released/promoted-and-dropped
+            if st[0] != "pending":
+                return st
+            abin = st[1] if len(st) > 1 else None
+        conn = None
+        if abin is not None:
+            cached = self._endpoints.get(abin)
+            if cached is not None and cached[0] is not None:
+                with self._conn_lock:
+                    conn = self._conns.get(cached[0])
+        if conn is not None and conn.alive:
+            st = conn.adopt_read(oid_bin, deadline)
+            if st is not None:
+                return st
+            # conn died mid-adoption: the conn-lost handler rerouted/failed
+            # the call — fall through and pick up the terminal state
+        remaining = (
+            None if deadline is None else max(deadline - time.monotonic(), 0.0)
+        )
+        return self.wait_local(oid_bin, remaining)
 
     def wait_local(self, oid_bin: bytes, timeout: Optional[float]) -> tuple:
         """Block until the entry is terminal; returns the table entry.
@@ -461,16 +782,19 @@ class DirectActorTransport:
         _, kind, payload = st
         oid = ObjectID(oid_bin)
         self.api.add_refs([oid])  # the head-side pin for the escaped ref
-        self.api._put_entry(oid, kind, payload)
-        with self.cv:
-            self.table[oid_bin] = ("promoted", kind, payload)
+        self._promote_entry(oid_bin, kind, payload)
         return True
 
     def release_local(self, oid_bin: bytes) -> str:
-        """ObjectRef.__del__ path — dict ops only (GC-safe, no locks).
-        Returns "local" (fully handled here), "promoted" (caller must also
-        release the head-side pin), or "absent"."""
+        """ObjectRef.__del__ path — dict ops + list append only (GC-safe,
+        no locks). Returns "local" (fully handled here), "promoted" (caller
+        must also release the head-side pin), or "absent"."""
         st = self.table.pop(oid_bin, None)
+        seg = self._owned_segments.pop(oid_bin, None)
+        if seg is not None:
+            # spilled direct reply: reclaim the segment off-thread (unlink
+            # talks to the resource tracker — not safe from __del__)
+            self._queue_unlink(seg)
         if st is None:
             return "absent"
         return "promoted" if st[0] in ("promoted", "fallback") else "local"
@@ -484,3 +808,19 @@ class DirectActorTransport:
                 c.conn.close()
             except OSError:
                 pass
+        # reclaim caller-owned reply segments (their objects die with this
+        # process's table)
+        from multiprocessing import shared_memory
+
+        pc = getattr(self, "_plasma_client", None)
+        for name in list(self._owned_segments.values()) + self._unlink_queue:
+            if pc is not None:
+                pc.detach(name)
+            try:
+                seg = shared_memory.SharedMemory(name=name)
+                seg.close()
+                seg.unlink()
+            except (FileNotFoundError, OSError):
+                pass
+        self._owned_segments.clear()
+        self._unlink_queue = []

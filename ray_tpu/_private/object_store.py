@@ -65,6 +65,11 @@ class MemoryStore:
         self._lock = threading.Lock()
         # object id -> list of waiters blocked on it
         self._waiters: dict[ObjectID, list[_Waiter]] = {}
+        # object id -> one-shot callbacks fired on seal (async consumers —
+        # e.g. the asyncio serve proxy — park on these instead of burning a
+        # thread per wait; callbacks run on the SEALING thread and must not
+        # block)
+        self._seal_callbacks: dict[ObjectID, list] = {}
 
     def put(self, object_id: ObjectID, obj: SerializedObject, is_error: bool = False):
         to_wake = []
@@ -79,12 +84,50 @@ class MemoryStore:
                     w.remaining -= 1  # under the lock: concurrent puts race
                     if w.remaining <= 0:
                         to_wake.append(w)
+            callbacks = self._seal_callbacks.pop(object_id, None) if fresh else None
         for w in to_wake:
             w.event.set()
+        for cb in callbacks or ():
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — a consumer bug must not break seals
+                pass
+
+    def add_seal_callback(self, object_id: ObjectID, cb) -> bool:
+        """Register a one-shot seal callback. Returns True (and fires ``cb``
+        synchronously) if the object is already sealed."""
+        with self._lock:
+            if object_id in self._objects:
+                sealed = True
+            else:
+                self._seal_callbacks.setdefault(object_id, []).append(cb)
+                sealed = False
+        if sealed:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                pass
+        return sealed
+
+    def remove_seal_callback(self, object_id: ObjectID, cb) -> None:
+        with self._lock:
+            lst = self._seal_callbacks.get(object_id)
+            if lst is not None:
+                try:
+                    lst.remove(cb)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self._seal_callbacks[object_id]
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             return object_id in self._objects
+
+    def peek(self, object_id: ObjectID) -> Optional[SerializedObject]:
+        """Non-blocking entry probe (no waiter registration)."""
+        with self._lock:
+            return self._objects.get(object_id)
 
     def _register(self, object_ids: list[ObjectID], threshold: int):
         """Under lock: count missing ids; if ready-count < threshold,

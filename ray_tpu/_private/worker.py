@@ -61,6 +61,15 @@ class WorkerAPI:
         # _ensure_direct; None until the first actor call, or always None
         # for transports that can't dial workers)
         self._direct = None
+        # same-process inline execution gate (config inline_actor_calls /
+        # env RAY_TPU_INLINE_ACTOR_CALLS)
+        self._inline_enabled = get_config().inline_actor_calls
+        # (actor_id, "ClassName.method") pairs submitted at least once: each
+        # actor-method's FIRST call always takes the queued path, so
+        # rendezvous methods get one executor-threaded run in which to flag
+        # themselves never-inline (note_execution_blocked) before the
+        # inline gate considers them
+        self._inline_seen: set = set()
         self.serialization = SerializationContext(
             ref_serializer=self._on_ref_serialized,
             ref_deserializer=self._on_ref_deserialized,
@@ -106,14 +115,27 @@ class WorkerAPI:
         return None
 
     def _ensure_direct(self):
+        """The caller-owned-result transport. Built on the first actor call
+        even when the socket plane is unavailable (authkey None, thread
+        mode): the same-process inline fast path shares its result table
+        and drain accounting."""
         if self._direct is None:
-            authkey = self._direct_authkey()
-            if authkey is None:
-                return None
             from ray_tpu._private.direct_call import DirectActorTransport
 
-            self._direct = DirectActorTransport(self, authkey)
+            self._direct = DirectActorTransport(self, self._direct_authkey())
         return self._direct
+
+    def _local_entry(self, oid_bin: bytes):
+        """Non-blocking probe of a head-store entry reachable WITHOUT a
+        round trip (driver in thread mode) — resolved-args shaped
+        ``(kind, payload)`` or None. Default: no local store."""
+        return None
+
+    def _actor_alive(self, abin: bytes) -> bool:
+        """Liveness probe for the inline gate. Default: trust the inline-
+        host registry (workers can't cheaply consult the directory); the
+        thread-mode driver overrides with the controller's actor state."""
+        return True
 
     # ref tracking ----------------------------------------------------------
     def _on_ref_serialized(self, ref: ObjectRef):
@@ -260,24 +282,112 @@ class WorkerAPI:
         )
         return_ids = spec.return_ids()
         refs = [ObjectRef(oid) for oid in return_ids]
-        # direct worker-to-worker path first: the head never sees the call
+        direct = self._ensure_direct()
+        # 1) same-process INLINE fast path: the actor lives in this process
+        # and the method is eligible — execute on THIS thread under the
+        # actor's lock; zero thread hops, no controller traffic at all
+        # (reference shape: core_worker submitting to a local actor without
+        # a raylet round trip).
+        if self._try_inline(spec, direct):
+            return refs
+        # 2) direct worker-to-worker path: the head never sees the call
         # (reference: ActorTaskSubmitter's direct PushTask). Falls back to
         # head mediation for streaming/multi-return/retry_exceptions specs,
         # unknown endpoints, and restart windows.
-        direct = self._ensure_direct()
-        if direct is not None and direct.try_submit(spec):
+        if direct.try_submit(spec):
             return refs
         self.add_refs(return_ids)
         self._promote_ref_args(spec)
-        if direct is not None:
-            # cross-path per-caller ordering, both directions: this head
-            # submission must not overtake direct calls already on the wire,
-            # and later direct calls must queue behind this one
-            if direct.active:
-                direct.wait_direct_drained(actor_id.binary())
+        # cross-path per-caller ordering, both directions: this head
+        # submission must not overtake direct/inline calls already in
+        # flight, and later fast-path calls must queue behind this one.
+        if direct.active:
+            direct.wait_direct_drained(actor_id.binary())
+        if direct.authkey is not None or self._inline_enabled:
+            # the fence must cover actors that BECOME inline-hosted after
+            # this submit (creation still in flight): a later inline call
+            # must not overtake this head-queued one. note_head_submit
+            # self-compacts, so never-fast actors don't grow it unboundedly.
             direct.note_head_submit(spec)
         self._submit(spec)
         return refs
+
+    @staticmethod
+    def _inline_host(actor_bin: bytes):
+        from ray_tpu._private.worker_runtime import inline_host
+
+        return inline_host(actor_bin)
+
+    def _try_inline(self, spec: TaskSpec, direct) -> bool:
+        """Attempt same-process inline execution of a sync actor call.
+        True = executed (result is in the caller-owned table); False = use
+        the slow paths (nothing happened). Eligibility: hosted in this
+        process, sync max_concurrency=1, single return, not streaming/
+        backpressured/retry_exceptions, all ref args immediately local, and
+        the cross-path FIFO fence clear — except for reentrant self-calls
+        (the calling thread IS the actor), which always run inline (their
+        own in-flight call can never drain while they wait)."""
+        if not self._inline_enabled:
+            return False
+        if (
+            spec.num_returns != 1
+            or spec.generator_backpressure
+            or spec.retry_exceptions
+        ):
+            return False
+        abin = spec.actor_id.binary()
+        from ray_tpu._private.worker_runtime import (
+            current_actor_id,
+            method_blocks,
+        )
+
+        reentrant = current_actor_id() == abin
+        if not reentrant:
+            # rendezvous-shaped methods (flagged by their first queued run)
+            # must never block the caller's thread — see _noinline_methods
+            if method_blocks(spec.name):
+                return False
+            if (abin, spec.name) not in self._inline_seen:
+                # recorded BEFORE the host lookup: a first call that races
+                # actor creation is queued too, and satisfies the
+                # one-queued-run-before-inline invariant. Keyed per ACTOR:
+                # a same-class fan-out (4 ranks entering a collective) must
+                # queue every rank's first call — the class-wide blocking
+                # flag only lands once one of them ENTERS the rendezvous,
+                # and by then siblings would already be stuck inline
+                self._inline_seen.add((abin, spec.name))
+                return False
+        rt = self._inline_host(abin)
+        if rt is None:
+            return False
+        if not reentrant:
+            # kill()/restart marks the directory before the hosting loop
+            # drops its registry entry — don't execute on a zombie
+            if not self._actor_alive(abin):
+                return False
+            if not direct.can_inline(abin):
+                return False
+        resolved = direct.resolve_args_inline(spec)
+        if resolved is None:
+            return False
+        oid_bin = spec.return_ids()[0].binary()
+        direct.begin_inline(abin, oid_bin)
+        try:
+            results = rt.execute_inline(spec, resolved)
+        except BaseException:
+            # KeyboardInterrupt/SystemExit propagating off the caller's
+            # thread: release the pending entry so nothing waits on it
+            direct.abandon_inline(oid_bin)
+            raise
+        finally:
+            direct.end_inline(abin)
+        if results is None:
+            # actor vanished / lock busy: hand the ref back to the slow path
+            direct.abandon_inline(oid_bin)
+            return False
+        _, kind, payload = results[0]
+        direct.settle_inline(oid_bin, kind, payload)
+        return True
 
     def _encode_args(self, args: tuple, kwargs: dict) -> list:
         """Encode (args, kwargs) as a template + top-level ref dependencies."""
@@ -362,9 +472,12 @@ class WorkerAPI:
                 rest_pos.append(i)
                 continue
             remaining = None if deadline is None else max(deadline - time.monotonic(), 0.0)
-            st = d.wait_local(ob, remaining)
+            # adopt: a blocked sync get() becomes the direct connection's
+            # reader and receives the reply on THIS thread (no read-loop →
+            # cv wakeup hop); inline results return immediately
+            st = d.wait_local_adopt(ob, remaining)
             if st[0] in ("done", "promoted"):
-                out[i] = (st[1], SerializedObject.from_buffer(st[2]))
+                out[i] = (st[1], d.entry_payload(st))
             else:  # fallback — the head owns it now
                 rest_ids.append(oid)
                 rest_pos.append(i)
@@ -463,6 +576,23 @@ class DriverAPI(WorkerAPI):
             object_id, (kind, SerializedObject.from_buffer(payload))
         )
         self.controller._on_object_sealed(object_id)
+
+    def _local_entry(self, oid_bin: bytes):
+        from ray_tpu._private.ids import ObjectID
+
+        entry = self.controller.memory_store.peek(ObjectID(oid_bin))
+        if entry is None:
+            return None
+        kind, payload = entry
+        if kind in ("inline", "error"):
+            return (kind, payload.to_bytes())
+        return (kind, payload)  # plasma/spilled locations pass through
+
+    def _actor_alive(self, abin: bytes) -> bool:
+        from ray_tpu._private.ids import ActorID
+
+        actor = self.controller.actors.get(ActorID(abin))
+        return actor is not None and actor.state == "ALIVE"
 
     def _direct_authkey(self):
         # thread mode runs actors in-process: the direct transport would be
@@ -811,10 +941,31 @@ def shutdown():
         controller = getattr(api, "controller", None)
         if controller is not None:
             controller.shutdown()
+        # thread-mode inline hosts live in this process: drop any stragglers
+        # so a later init() in the same process starts from a clean registry
+        from ray_tpu._private import worker_runtime as _wr
+
+        with _wr._inline_hosts_lock:
+            _wr._inline_hosts.clear()
+
+
+def _noting_blocked(fn):
+    """Run ``fn``; if it stalls noticeably and we're inside an actor-method
+    execution, flag the method never-inline (belt-and-braces next to the
+    collective-primitive flagging — a method that blocks on runtime waits
+    must not hold a caller's thread)."""
+    t0 = time.monotonic()
+    try:
+        return fn()
+    finally:
+        if time.monotonic() - t0 > 0.05:
+            from ray_tpu._private.worker_runtime import note_execution_blocked
+
+            note_execution_blocked()
 
 
 def get(refs, *, timeout: Optional[float] = None):
-    return global_worker().get(refs, timeout=timeout)
+    return _noting_blocked(lambda: global_worker().get(refs, timeout=timeout))
 
 
 async def get_async(ref):
@@ -831,7 +982,11 @@ def put(value) -> ObjectRef:
 
 
 def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None):
-    return global_worker().wait(refs, num_returns=num_returns, timeout=timeout)
+    return _noting_blocked(
+        lambda: global_worker().wait(
+            refs, num_returns=num_returns, timeout=timeout
+        )
+    )
 
 
 def kill(actor_handle, *, no_restart: bool = True):

@@ -53,6 +53,46 @@ def current_actor_id() -> Optional[bytes]:
     return getattr(_exec_ctx, "actor_id", None)
 
 
+# Actors hosted in THIS process that are eligible for same-process inline
+# execution (sync, max_concurrency=1): actor_id binary -> hosting runtime.
+# The inline fast path (WorkerAPI submit) executes eligible calls on the
+# caller's thread under the actor's execution lock, with zero thread hops
+# (reference shape: core_worker submits to a same-process actor without a
+# raylet round trip). Thread mode has many runtimes in one process; process
+# mode has one per worker process — both index here.
+_inline_hosts: dict[bytes, "WorkerRuntime"] = {}
+_inline_hosts_lock = threading.Lock()
+
+
+def inline_host(actor_bin: bytes) -> Optional["WorkerRuntime"]:
+    """The runtime hosting this actor in the calling process, if inline-
+    eligible (sync max_concurrency=1) — None otherwise."""
+    return _inline_hosts.get(actor_bin)
+
+
+# Actor methods ("ClassName.method", spec.name) observed performing a
+# BLOCKING runtime wait mid-execution: never run these inline. A caller
+# thread stuck inside one cannot submit the peer work the method is waiting
+# for (collective rendezvous, cross-actor barriers) — the queued paths
+# overlap such calls on executor threads, the inline path would serialize
+# them into a deadlock. Flagged from the runtime's own blocking primitives
+# (collective _run, long get/wait), so the first queued execution marks the
+# method before the inline gate ever considers it.
+_noinline_methods: set[str] = set()
+
+
+def note_execution_blocked():
+    """Flag the actor method executing on THIS thread (if any) as blocking
+    — called from runtime wait primitives (get/wait/collective)."""
+    key = getattr(_exec_ctx, "method_key", None)
+    if key is not None:
+        _noinline_methods.add(key)
+
+
+def method_blocks(name: str) -> bool:
+    return name in _noinline_methods
+
+
 class InProcessChannel:
     """Duplex in-process channel with the multiprocessing.Connection API
     subset (send/recv/close) — used for thread-mode workers."""
@@ -146,6 +186,18 @@ class WorkerRuntime:
         self._shm_client = None
         self._shutdown = False
         self.max_inline = int(os.environ.get(_INLINE_LIMIT_ENV, 100 * 1024))
+        # direct-call replies above this ride shared memory instead of the
+        # reply frame (single-host only; see _store_returns). Env override
+        # mirrors the config field direct_inline_max_bytes.
+        try:
+            from ray_tpu._private.config import get_config
+
+            _default_dimb = get_config().direct_inline_max_bytes
+        except Exception:  # noqa: BLE001 — env-only processes
+            _default_dimb = 8 * 1024**2
+        self.direct_inline_max = int(
+            os.environ.get("RAY_TPU_DIRECT_INLINE_MAX_BYTES", _default_dimb)
+        )
         self.current_task_name: Optional[str] = None
         # The reader loop must never block on task execution (tasks make
         # controller calls — get/submit — whose replies arrive on the reader).
@@ -224,14 +276,30 @@ class WorkerRuntime:
 
     def _free_flush_loop(self):
         while not self._shutdown:
-            time.sleep(0.1)
+            time.sleep(0.05)
             if not self._free_queue:
                 continue
-            batch, self._free_queue = self._free_queue, []
-            try:
-                self._send(P.FreeObjects(batch))
-            except (OSError, EOFError):
+            # coalescing window: GC frees arrive in bursts (a dropped list of
+            # refs fires N __del__s back to back); a short extra beat batches
+            # the whole burst into one FreeObjects message
+            time.sleep(0.02)
+            if not self._flush_frees():
                 return
+        # shutdown: flush the final batch instead of dropping it (a flush
+        # racing teardown used to leak whatever queued after the last tick)
+        self._flush_frees()
+
+    def _flush_frees(self) -> bool:
+        """Send every queued free in one batch; False when the connection is
+        gone (the head will reap this worker's refs on death instead)."""
+        batch, self._free_queue = self._free_queue, []
+        if not batch:
+            return True
+        try:
+            self._send(P.FreeObjects(batch))
+            return True
+        except (OSError, EOFError):
+            return False
 
     def register_driver(self):
         """Synchronous client-driver registration: MUST be on the wire before
@@ -287,7 +355,11 @@ class WorkerRuntime:
             elif isinstance(msg, P.Shutdown):
                 break
         self._shutdown = True
+        self._drop_inline_hosts()
         if not self.in_process:
+            # final free batch must hit the wire before the hard exit (a
+            # flush racing teardown used to drop it — head-side ref leak)
+            self._flush_frees()
             os._exit(0)
         # thread-mode worker retiring (e.g. KillActor): close the channel so
         # the controller's reader thread sees EOF and exits — otherwise every
@@ -773,8 +845,8 @@ class WorkerRuntime:
             offset += len(chunk)
 
     def _write_shm(self, object_id: ObjectID, sobj: SerializedObject):
-        data = sobj.to_bytes()
         if os.environ.get("RAY_TPU_ARENA"):
+            data = sobj.to_bytes()
             # native arena: allocate via the store authority, write through
             # this process's mapping (plasma create/seal protocol).
             # inproc-safe: an inline actor task sealing a large stream item
@@ -787,13 +859,21 @@ class WorkerRuntime:
                 return name[1], name[2]
             self._plasma().write_arena(name, data)
             return name, len(data)
+        return self._write_plain_shm(object_id, sobj)
+
+    def _write_plain_shm(self, object_id: ObjectID, sobj: SerializedObject):
+        """Write into a standalone SharedMemory segment (never the arena —
+        direct-call results bypass the store authority entirely; lifecycle
+        belongs to whoever seals or releases the object)."""
+        data = sobj.to_bytes()
         from multiprocessing import shared_memory
 
         name = f"rt_{object_id.hex()[:20]}_{os.getpid() & 0xFFFF:x}"
         seg = shared_memory.SharedMemory(create=True, size=max(len(data), 1), name=name)
         seg.buf[: len(data)] = data
-        # Hand lifecycle ownership to the controller: stop this process's
-        # resource tracker from unlinking the segment at exit.
+        # Hand lifecycle ownership to the consumer (controller or direct
+        # caller): stop this process's resource tracker from unlinking the
+        # segment at exit.
         try:
             from multiprocessing import resource_tracker
 
@@ -836,6 +916,58 @@ class WorkerRuntime:
             _marker_state.values = None
         args, kwargs = template
         return list(args), dict(kwargs)
+
+    def _drop_inline_hosts(self):
+        """Retire this runtime's actors from the inline-host registry (run
+        on loop exit: KillActor / Shutdown / connection loss). Only entries
+        still pointing at THIS runtime are removed — a restarted incarnation
+        on another runtime must not be evicted by the old one's teardown."""
+        with _inline_hosts_lock:
+            for key in list(self.actors):
+                if _inline_hosts.get(key) is self:
+                    del _inline_hosts[key]
+
+    def execute_inline(self, spec: TaskSpec, resolved_args: list):
+        """Zero-hop fast path: run an eligible sync actor call ON the
+        calling thread under the actor's execution lock, returning the
+        TaskDone-shaped results list. The worker loop, the per-actor
+        executor, and the controller reply round trip are all bypassed.
+
+        Returns None when the call must fall back to the slow path: the
+        actor is gone from this runtime, or its lock is held by another
+        thread — blocking a nominally non-blocking ``.remote()`` behind a
+        busy actor would serialize callers the queued paths let overlap.
+        A reentrant self-call (the calling thread IS the actor) re-enters
+        the RLock and runs nested instead of deadlocking.
+        """
+        abin = spec.actor_id.binary()
+        lock = self.actor_exec_locks.get(abin)
+        if lock is None or not lock.acquire(blocking=False):
+            return None
+        prev_name = self.current_task_name
+        prev_actor = getattr(_exec_ctx, "actor_id", None)
+        prev_mkey = getattr(_exec_ctx, "method_key", None)
+        try:
+            if abin not in self.actors:
+                return None
+            try:
+                args, kwargs = self._deserialize_args(spec, resolved_args)
+                value = self._invoke(spec, args, kwargs)
+                return self._store_returns(spec, value, inline_only=True)
+            except (KeyboardInterrupt, SystemExit):
+                # unlike the queued paths (executor threads never receive
+                # signals), inline runs on the signal-delivery thread: a
+                # Ctrl-C must terminate the driver, not become a result
+                raise
+            except BaseException as e:  # noqa: BLE001 — becomes the call's error result
+                return self._store_error(spec, e)
+        finally:
+            # restore the OUTER execution context: a nested inline call from
+            # an actor method must not leave the callee's identity behind
+            self.current_task_name = prev_name
+            _exec_ctx.actor_id = prev_actor
+            _exec_ctx.method_key = prev_mkey
+            lock.release()
 
     def _execute_task(self, msg: P.ExecuteTask):
         spec = msg.spec
@@ -912,6 +1044,11 @@ class WorkerRuntime:
             if spec.task_type != TaskType.NORMAL_TASK and spec.actor_id
             else None
         )
+        # blocking-wait attribution (note_execution_blocked): only actor
+        # METHODS are inline candidates, so only they carry a key
+        _exec_ctx.method_key = (
+            spec.name if spec.task_type == TaskType.ACTOR_TASK else None
+        )
         if spec.task_type == TaskType.NORMAL_TASK:
             fn = cloudpickle.loads(spec.function_blob)
             return fn(*args, **kwargs)
@@ -930,7 +1067,13 @@ class WorkerRuntime:
                 threading.Thread(target=loop.run_forever, daemon=True, name="actor-loop").start()
             elif spec.max_concurrency <= 1:
                 # enables inline direct-call execution (see _direct_conn_loop)
-                self.actor_exec_locks[key] = threading.Lock()
+                # and the same-process inline fast path (execute_inline).
+                # RLock, not Lock: a reentrant self-call (an actor method
+                # calling its own handle) runs nested on the same thread
+                # instead of deadlocking on its own execution lock.
+                self.actor_exec_locks[key] = threading.RLock()
+                with _inline_hosts_lock:
+                    _inline_hosts[key] = self
             return None
         # ACTOR_TASK
         instance = self.actors[spec.actor_id.binary()]
@@ -958,10 +1101,23 @@ class WorkerRuntime:
         results = []
         for oid, v in zip(return_ids, values):
             sobj = self.serialization.serialize(v)
-            if inline_only or sobj.total_bytes() <= self.max_inline:
-                # inline_only: direct-call results ride the caller's
-                # connection whatever their size — the caller owns them and
-                # the head's store never sees them
+            if inline_only:
+                # direct-call / inline-path results are CALLER-owned — the
+                # head's store never sees them. Small ones ride the reply
+                # frame; past direct_inline_max the bytes go through a plain
+                # shared-memory segment the caller maps zero-copy (same-host
+                # only — a cross-host caller could not attach it, so agent
+                # hosts keep everything in-frame)
+                if (
+                    sobj.total_bytes() > self.direct_inline_max
+                    and not self.in_process
+                    and not os.environ.get("RAY_TPU_NODE_IP")
+                ):
+                    name, size = self._write_plain_shm(oid, sobj)
+                    results.append((oid, "plasma", (name, size)))
+                else:
+                    results.append((oid, "inline", sobj.to_bytes()))
+            elif sobj.total_bytes() <= self.max_inline:
                 results.append((oid, "inline", sobj.to_bytes()))
             else:
                 name, size = self._write_shm(oid, sobj)

@@ -23,6 +23,13 @@ import ray_tpu
 _REFRESH_PERIOD_S = 1.0
 
 
+class WouldBlock(Exception):
+    """Raised by nowait submission paths instead of anything that could
+    stall the calling thread (controller refresh RPC, empty-replica retry
+    sleep) — the asyncio proxy submits on its event loop and needs a
+    guaranteed-non-blocking answer or a clean fallback signal."""
+
+
 class _HandleMarker:
     """Serialization marker: an Application arg becomes a handle in the
     replica (composition edge)."""
@@ -211,21 +218,33 @@ class DeploymentHandle:
 
     # -- routing ------------------------------------------------------------
 
-    def _pick_replica(self):
-        """Power-of-two-choices on client-side in-flight counts."""
-        self._refresh()
-        deadline = time.monotonic() + 30.0
-        while True:
+    def _pick_replica(self, nowait: bool = False):
+        """Power-of-two-choices on client-side in-flight counts. With
+        ``nowait``: raise WouldBlock rather than refresh (controller RPC)
+        or wait out an empty replica list — callers on an event loop fall
+        back to their executor path."""
+        if nowait:
             with self._lock:
-                replicas = list(self._replicas)
-            if replicas:
-                break
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no replicas for deployment {self.deployment_name!r}"
+                stale = (
+                    time.monotonic() - self._last_refresh >= _REFRESH_PERIOD_S
                 )
-            time.sleep(0.1)
-            self._refresh(force=True)
+                replicas = list(self._replicas)
+            if stale or not replicas:
+                raise WouldBlock(self.deployment_name)
+        else:
+            self._refresh()
+            deadline = time.monotonic() + 30.0
+            while True:
+                with self._lock:
+                    replicas = list(self._replicas)
+                if replicas:
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"no replicas for deployment {self.deployment_name!r}"
+                    )
+                time.sleep(0.1)
+                self._refresh(force=True)
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
@@ -323,13 +342,16 @@ class DeploymentHandle:
             # pinning a freed/abandoned stream's refcount above zero
             ref = name = ready = None
 
-    def _call_streaming(self, method: str, args: tuple, kwargs: dict):
+    def _call_streaming(
+        self, method: str, args: tuple, kwargs: dict, nowait: bool = False
+    ):
         """Streaming call (reference: ``handle.options(stream=True)``): the
         replica method runs as a streaming-generator actor task; chunks are
-        consumable as they are produced."""
+        consumable as they are produced. ``nowait`` raises WouldBlock
+        instead of blocking on replica routing (see _pick_replica)."""
         from ray_tpu.serve.streaming import DeploymentResponseGenerator
 
-        name, actor = self._pick_replica()
+        name, actor = self._pick_replica(nowait=nowait)
         with self._lock:
             self._inflight[name] = self._inflight.get(name, 0) + 1
 

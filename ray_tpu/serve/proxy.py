@@ -100,6 +100,11 @@ class AsyncHTTPServer:
         )
         self._started = threading.Event()
         self.port: Optional[int] = None
+        # thread-mode fast path: with the controller in-process, chunk waits
+        # park on store seal-callbacks that wake the loop directly — zero
+        # per-request executor hand-offs (False = unknown, probe lazily)
+        self._store = None
+        self._store_probed = False
 
         def runner():
             asyncio.set_event_loop(self._loop)
@@ -179,19 +184,39 @@ class AsyncHTTPServer:
         )
         loop = asyncio.get_running_loop()
         try:
-            # the ENTIRE backend call runs off the loop: handle.remote can
-            # block (replica-cache refresh → controller RPC) and a blocked
-            # loop thread would freeze every open connection
-            def call_backend():
-                chunks = handle.options(stream=True).remote(req)
-                try:
-                    return chunks, chunks.next(timeout_s=120), False
-                except StopIteration:
-                    return chunks, None, True
+            from ray_tpu.serve.handle import WouldBlock
 
-            chunks, first, done = await loop.run_in_executor(
-                self._pool, call_backend
-            )
+            streamh = handle.options(stream=True)
+            chunks = None
+            if self._inproc_store() is not None:
+                # zero-hand-off path (thread mode): a nowait submit is
+                # enqueue-only — WouldBlock (stale replica cache, replicas
+                # cycling) falls back to the executor path below rather
+                # than letting a controller RPC or empty-replica retry
+                # sleep freeze the event loop (and every open connection)
+                try:
+                    chunks = streamh._call_streaming(
+                        "__call__", (req,), {}, nowait=True
+                    )
+                except WouldBlock:
+                    chunks = None
+            if chunks is not None:
+                first, done = await self._next_chunk_async(chunks)
+            else:
+                # the ENTIRE backend call runs off the loop: handle.remote
+                # can block (replica-cache refresh → controller RPC,
+                # replica wait) and a blocked loop thread would freeze
+                # every open connection
+                def call_backend():
+                    chunks = streamh.remote(req)
+                    try:
+                        return chunks, chunks.next(timeout_s=120), False
+                    except StopIteration:
+                        return chunks, None, True
+
+                chunks, first, done = await loop.run_in_executor(
+                    self._pool, call_backend
+                )
             if chunks.stream_start is not None:
                 return await self._stream_body(
                     writer, chunks.stream_start, first, done,
@@ -208,6 +233,80 @@ class AsyncHTTPServer:
             return await self._respond(
                 writer, 500, traceback.format_exc().encode(), "text/plain"
             )
+
+    def _inproc_store(self):
+        """The controller's memory store when it lives in THIS process
+        (thread mode) — the async chunk-wait fast path needs its
+        seal-callback hook. None in process mode / client drivers."""
+        if not self._store_probed:
+            self._store_probed = True
+            try:
+                from ray_tpu._private.worker import global_worker
+
+                ctrl = getattr(global_worker(), "controller", None)
+                self._store = None if ctrl is None else ctrl.memory_store
+            except Exception:  # noqa: BLE001 — runtime not up yet
+                self._store_probed = False
+                self._store = None
+        return self._store
+
+    async def _next_chunk_async(self, chunks, timeout_s: float = 120.0):
+        """Await the next deployment chunk. With an in-process store: probe
+        non-blocking, then park on seal callbacks for the next stream item /
+        completion record — the sealing thread wakes this loop directly
+        (one cross-thread signal, no executor hand-off, no polling).
+        Otherwise: the blocking ``next`` runs on the executor pool."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        store = self._inproc_store()
+        if store is None:
+            def call():
+                try:
+                    return chunks.next(timeout_s=timeout_s), False
+                except StopIteration:
+                    return None, True
+
+            return await loop.run_in_executor(self._pool, call)
+        from ray_tpu._private.ids import ObjectID
+
+        deadline = loop.time() + timeout_s
+        while True:
+            try:
+                # non-blocking probe; consumption bookkeeping (ref take,
+                # consumed report) is in-process dict work — loop-safe
+                return chunks.next(timeout_s=0), False
+            except StopIteration:
+                return None, True
+            except TimeoutError:
+                pass
+            gen = chunks._ref_gen
+            watch = [ObjectID.for_return(gen._task_id, gen._index + 1)]
+            if gen._total is None:
+                watch.append(gen._completion_ref.id())
+            fut = loop.create_future()
+
+            def _wake():
+                loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_result(None)
+                )
+
+            try:
+                if not any(store.add_seal_callback(i, _wake) for i in watch):
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"no stream chunk ready within {timeout_s}s"
+                        )
+                    try:
+                        await asyncio.wait_for(fut, timeout=remaining)
+                    except asyncio.TimeoutError:
+                        raise TimeoutError(
+                            f"no stream chunk ready within {timeout_s}s"
+                        ) from None
+            finally:
+                for i in watch:
+                    store.remove_seal_callback(i, _wake)
 
     async def _respond(self, writer, code, body, ctype):
         import http.client as _hc
@@ -249,12 +348,6 @@ class AsyncHTTPServer:
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
         await writer.drain()
 
-        def next_chunk():
-            try:
-                return chunks.next(timeout_s=120), False
-            except StopIteration:
-                return None, True
-
         if _bodiless(status):
             # no body and no chunk framing on the wire; still drain the
             # replica's stream so its resources release. The head is already
@@ -263,9 +356,7 @@ class AsyncHTTPServer:
             try:
                 done_ = done
                 while not done_:
-                    _, done_ = await loop.run_in_executor(
-                        self._pool, next_chunk
-                    )
+                    _, done_ = await self._next_chunk_async(chunks)
             except Exception:  # noqa: BLE001
                 try:
                     writer.close()
@@ -283,7 +374,7 @@ class AsyncHTTPServer:
                             f"{len(data):x}\r\n".encode() + data + b"\r\n"
                         )
                         await writer.drain()
-                item, done = await loop.run_in_executor(self._pool, next_chunk)
+                item, done = await self._next_chunk_async(chunks)
             writer.write(b"0\r\n\r\n")
             await writer.drain()
         except Exception:

@@ -230,6 +230,13 @@ def _get(group_name: str) -> _GroupHandle:
 
 
 def _run(g: _GroupHandle, op_key: str, value, timeout: float = 120.0):
+    # a collective op rendezvouses with SIBLING actor calls: an actor method
+    # running one must never execute inline on its caller's thread (the
+    # caller couldn't submit the peers it is waiting for) — flag it on the
+    # first queued execution, before the inline gate ever considers it
+    from ray_tpu._private.worker_runtime import note_execution_blocked
+
+    note_execution_blocked()
     rnd = g.next_round()
     ray_tpu.get(
         g.coordinator.contribute.remote(op_key, rnd, g.rank, _wrap(value)),

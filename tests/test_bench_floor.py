@@ -1,0 +1,29 @@
+"""Slow-tier wrapper around ``bench.py --check-floor`` (ISSUE 4 satellite):
+the 1:1 sync actor-call rate must stay within 25% of the values recorded in
+MICROBENCH.json — a control-plane regression fails here instead of surfacing
+as a mystery rounds later."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_sync_call_floor():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--check-floor"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=repo,
+    )
+    assert proc.returncode == 0, (
+        f"--check-floor failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert '"check_floor"' in proc.stdout

@@ -1,0 +1,400 @@
+"""Same-process inline-execution fast path for sync actor calls.
+
+The tentpole contract (ISSUE 4): an eligible sync actor call (thread mode,
+or a worker calling a co-located actor) executes ON the caller's thread
+under the actor's execution lock — no worker-loop hop, no per-actor
+executor, no controller reply round trip — while preserving exactly the
+slow path's observable semantics:
+
+- reentrant self-calls run nested instead of deadlocking on the exec lock
+- exceptions carry the same TaskError shape as the slow path
+- per-caller→callee FIFO holds across fast- and slow-path calls
+- max_concurrency > 1 / async actors never take the fast path
+- drain accounting (wait_direct_drained) observes inline calls in flight
+- a method's FIRST submission takes the queued path, and methods that block
+  on runtime waits (collective rendezvous, long gets) are flagged
+  never-inline there — a caller thread stuck inside one could not submit
+  the peer work it waits for
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+
+
+def _transport():
+    api = global_worker()
+    return api._ensure_direct()
+
+
+def test_inline_path_taken_and_result_caller_owned(ray_start_thread):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    # the first call races actor creation (not yet inline-hosted) and may
+    # legitimately take the slow path; after it completes the fast path is
+    # available and stays available
+    assert ray_tpu.get(c.inc.remote(), timeout=30) == 1
+    ref = c.inc.remote()
+    d = _transport()
+    # inline results are caller-owned: they live in the transport table,
+    # never the head store
+    assert d.manages(ref.id().binary())
+    assert ray_tpu.get(ref, timeout=30) == 2
+
+
+def test_inline_disabled_for_max_concurrency(ray_start_thread):
+    @ray_tpu.remote(max_concurrency=4)
+    class Pool:
+        def work(self, x):
+            return x
+
+    p = Pool.remote()
+    ref = p.work.remote(7)
+    d = _transport()
+    # concurrency-pool actors stay on the queued path (the inline path
+    # would serialize what the pool is meant to overlap)
+    assert not d.manages(ref.id().binary())
+    assert ray_tpu.get(ref, timeout=30) == 7
+
+
+def test_inline_disabled_for_async_actor(ray_start_thread):
+    @ray_tpu.remote
+    class Async:
+        async def work(self, x):
+            return x * 2
+
+    a = Async.remote()
+    ref = a.work.remote(4)
+    d = _transport()
+    assert not d.manages(ref.id().binary())
+    assert ray_tpu.get(ref, timeout=30) == 8
+
+
+def test_reentrant_self_call_does_not_deadlock(ray_start_thread):
+    @ray_tpu.remote
+    class Selfish:
+        def __init__(self):
+            self.depth_seen = 0
+
+        def outer(self, name, depth):
+            from ray_tpu.actor import get_actor
+
+            self.depth_seen = max(self.depth_seen, depth)
+            if depth == 0:
+                return depth
+            h = get_actor(name)
+            return ray_tpu.get(h.outer.remote(name, depth - 1), timeout=30)
+
+    s = Selfish.options(name="selfish").remote()
+    # a sync max_concurrency=1 actor calling its own handle re-enters its
+    # execution RLock and runs nested on the same thread (the slow path
+    # would deadlock here — the conftest watchdog is the failure mode)
+    assert ray_tpu.get(s.outer.remote("selfish", 3), timeout=60) == 0
+
+
+def test_exception_shape_matches_slow_path(ray_start_thread):
+    from ray_tpu.exceptions import TaskError  # noqa: F401 — the shape under test
+
+    @ray_tpu.remote
+    class Faulty:
+        def fail(self):
+            raise KeyError("inline-kaboom")
+
+    @ray_tpu.remote(max_concurrency=2)
+    class SlowFaulty:
+        def fail(self):
+            raise KeyError("slow-kaboom")
+
+    f = Faulty.remote()
+    with pytest.raises(KeyError):
+        ray_tpu.get(f.fail.remote(), timeout=30)  # first submit: queued path
+    with pytest.raises(KeyError) as fast_err:
+        ray_tpu.get(f.fail.remote(), timeout=30)  # inline
+    s = SlowFaulty.remote()
+    with pytest.raises(KeyError) as slow_err:
+        ray_tpu.get(s.fail.remote(), timeout=30)
+    # same instanceof-cause surface (dynamic TaskError_<cls> subclass of the
+    # original exception type), same remote-traceback marker
+    assert type(fast_err.value).__name__ == type(slow_err.value).__name__
+    assert isinstance(fast_err.value, KeyError) and isinstance(slow_err.value, KeyError)
+    assert "Remote traceback" in str(fast_err.value)
+    assert "inline-kaboom" in str(fast_err.value)
+
+
+def test_fifo_across_fast_and_slow_paths(ray_start_thread):
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def slow_append(self, x):
+            time.sleep(0.5)
+            self.items.append(x)
+            return x
+
+        def append(self, x):
+            self.items.append(x)
+            return x
+
+        def dump(self):
+            return list(self.items)
+
+    log = Log.remote()
+    ray_tpu.get(log.dump.remote(), timeout=30)
+    ray_tpu.get(log.append.remote("warm"), timeout=30)  # consume first-submit gate
+    # retry_exceptions specs are fast-path-ineligible → controller-routed;
+    # the inline call submitted right after must NOT overtake it
+    r1 = log.slow_append.options(retry_exceptions=True, max_retries=1).remote("slow")
+    r2 = log.append.remote("fast")
+    ray_tpu.get([r1, r2], timeout=60)
+    assert ray_tpu.get(log.dump.remote(), timeout=30) == ["warm", "slow", "fast"]
+
+
+def test_wait_direct_drained_counts_inline_calls(ray_start_thread):
+    @ray_tpu.remote
+    class Sleeper:
+        def nap(self, s):
+            time.sleep(s)
+            return "woke"
+
+    s = Sleeper.remote()
+    ray_tpu.get(s.nap.remote(0), timeout=30)  # ensure registered/warm
+    d = _transport()
+    abin = s._actor_id.binary()
+    t_done = {}
+
+    def call_inline():
+        t_done["result"] = ray_tpu.get(s.nap.remote(0.8), timeout=30)
+        t_done["t"] = time.monotonic()
+
+    caller = threading.Thread(target=call_inline)
+    caller.start()
+    time.sleep(0.2)  # let the inline call start executing
+    t0 = time.monotonic()
+    assert d.wait_direct_drained(abin, timeout=30)
+    waited = time.monotonic() - t0
+    caller.join(timeout=30)
+    assert t_done.get("result") == "woke"
+    # the drain must have blocked on the in-flight inline call (~0.6s left)
+    assert waited > 0.3, f"drain returned in {waited:.3f}s — inline call not counted"
+
+
+def test_inline_refs_interop_with_tasks(ray_start_thread):
+    """An inline result escaping into a task is promoted into the head
+    store (same ownership rules as direct-call results)."""
+
+    @ray_tpu.remote
+    class Producer:
+        def make(self):
+            return 21
+
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    p = Producer.remote()
+    rv = p.make.remote()
+    assert ray_tpu.get(double.remote(rv), timeout=60) == 42
+    # nested (serialization-path promotion)
+    rv2 = p.make.remote()
+
+    @ray_tpu.remote
+    def unwrap(d):
+        return ray_tpu.get(d["ref"])
+
+    assert ray_tpu.get(unwrap.remote({"ref": rv2}), timeout=60) == 21
+
+
+def test_first_submission_takes_queued_path(ray_start_thread):
+    """A method's first-ever submission always rides the queued path — the
+    one executor-threaded run in which a rendezvous method can flag itself
+    never-inline before a caller thread is on the hook."""
+
+    @ray_tpu.remote
+    class Gate:
+        def m(self):
+            return 1
+
+    g = Gate.remote()
+    r1 = g.m.remote()
+    d = _transport()
+    assert not d.manages(r1.id().binary())
+    assert ray_tpu.get(r1, timeout=30) == 1
+    r2 = g.m.remote()
+    assert d.manages(r2.id().binary())
+    assert ray_tpu.get(r2, timeout=30) == 1
+
+
+def test_blocking_method_never_inlines(ray_start_thread):
+    """A method observed blocking on a runtime wait (here: a long get on an
+    in-flight task) is flagged never-inline — executing it on the caller's
+    thread could deadlock rendezvous patterns (collective ops flag
+    themselves the same way via note_execution_blocked)."""
+
+    @ray_tpu.remote
+    def slow_task():
+        time.sleep(0.2)
+        return 7
+
+    @ray_tpu.remote
+    class Waiter:
+        def waits(self):
+            return ray_tpu.get(slow_task.remote(), timeout=30)
+
+    w = Waiter.remote()
+    assert ray_tpu.get(w.waits.remote(), timeout=30) == 7  # queued; flags itself
+    ref = w.waits.remote()
+    d = _transport()
+    assert not d.manages(ref.id().binary()), "blocking method took the inline path"
+    assert ray_tpu.get(ref, timeout=30) == 7
+
+
+def test_inline_kill_switch(ray_start_thread, monkeypatch):
+    """RAY_TPU_INLINE_ACTOR_CALLS=0 (config inline_actor_calls) forces the
+    slow path — the inline gate is operational, not decorative."""
+    api = global_worker()
+    monkeypatch.setattr(api, "_inline_enabled", False)
+
+    @ray_tpu.remote
+    class C:
+        def m(self):
+            return 5
+
+    c = C.remote()
+    ref = c.m.remote()
+    d = _transport()
+    assert not d.manages(ref.id().binary())
+    assert ray_tpu.get(ref, timeout=30) == 5
+
+
+def test_inline_after_kill_raises(ray_start_thread):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote(), timeout=30) == "pong"
+    assert ray_tpu.get(v.ping.remote(), timeout=30) == "pong"  # inline now live
+    ray_tpu.kill(v)
+    # no settling sleep: kill marks the directory synchronously and the
+    # inline gate's liveness probe must see it BEFORE the hosting loop
+    # drops its registry entry (no zombie inline execution)
+    with pytest.raises(ray_tpu.ActorError):
+        ray_tpu.get(v.ping.remote(), timeout=10)
+
+
+def test_direct_inline_max_bytes_spills_to_shm(ray_start_process):
+    """Direct-call replies above direct_inline_max_bytes ride shared memory
+    instead of the reply frame; the caller maps them zero-copy and the
+    segment is reclaimed with the ref."""
+    import numpy as np
+
+    @ray_tpu.remote
+    class Big:
+        def blob(self):
+            import numpy as np
+
+            return np.ones(2_000_000)  # 16 MB > the 8 MB default
+
+        def small(self):
+            return 1
+
+    b = Big.remote()
+    assert ray_tpu.get(b.small.remote(), timeout=60) == 1
+    time.sleep(0.3)
+    ray_tpu.get(b.small.remote(), timeout=60)  # settle onto the direct path
+    ref = b.blob.remote()
+    out = ray_tpu.get(ref, timeout=60)
+    assert out.shape == (2_000_000,) and float(out.sum()) == 2_000_000.0
+    d = _transport()
+    ob = ref.id().binary()
+    st = d.table.get(ob)
+    if st is not None and st[0] == "done":
+        assert st[1] == "plasma", f"16MB reply rode the frame: {st[1]}"
+    # promotion of a spilled reply into a task still works (materialized)
+    @ray_tpu.remote
+    def total(x):
+        return float(np.asarray(x).sum())
+
+    assert ray_tpu.get(total.remote(ref), timeout=120) == 2_000_000.0
+
+
+def test_queue_free_flusher_flushes_on_shutdown():
+    """Satellite: the free-flusher must deliver the FINAL batch when the
+    runtime shuts down — a flush racing teardown used to drop it (head-side
+    ref leak)."""
+    import threading as _threading
+
+    from ray_tpu._private import protocol as P
+    from ray_tpu._private.ids import ObjectID, WorkerID
+    from ray_tpu._private.worker_runtime import WorkerRuntime
+
+    sent = []
+
+    class StubConn:
+        def send(self, msg):
+            sent.append(msg)
+
+        def close(self):
+            pass
+
+    rt = WorkerRuntime(WorkerID.from_random(), StubConn(), in_process=False)
+    flusher = _threading.Thread(target=rt._free_flush_loop, daemon=True)
+    flusher.start()
+    time.sleep(0.02)
+    # frees queued right at teardown: the loop must flush them on exit
+    rt._shutdown = True
+    rt.queue_free(ObjectID.from_put(1, rt.worker_id))
+    rt.queue_free(ObjectID.from_put(2, rt.worker_id))
+    flusher.join(timeout=5)
+    assert not flusher.is_alive()
+    frees = [m for m in sent if isinstance(m, P.FreeObjects)]
+    assert frees and len(frees[-1].object_ids) == 2, f"final batch dropped: {sent}"
+    assert rt._free_queue == []
+
+
+def test_queue_free_flusher_coalesces_bursts():
+    """A GC burst of frees lands as one batched FreeObjects message, not N."""
+    import threading as _threading
+
+    from ray_tpu._private import protocol as P
+    from ray_tpu._private.ids import ObjectID, WorkerID
+    from ray_tpu._private.worker_runtime import WorkerRuntime
+
+    sent = []
+
+    class StubConn:
+        def send(self, msg):
+            sent.append(msg)
+
+        def close(self):
+            pass
+
+    rt = WorkerRuntime(WorkerID.from_random(), StubConn(), in_process=False)
+    flusher = _threading.Thread(target=rt._free_flush_loop, daemon=True)
+    flusher.start()
+    for i in range(50):
+        rt.queue_free(ObjectID.from_put(i + 1, rt.worker_id))
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if sum(len(m.object_ids) for m in sent if isinstance(m, P.FreeObjects)) == 50:
+            break
+        time.sleep(0.01)
+    frees = [m for m in sent if isinstance(m, P.FreeObjects)]
+    assert sum(len(m.object_ids) for m in frees) == 50
+    assert len(frees) <= 3, f"burst fragmented into {len(frees)} messages"
+    rt._shutdown = True
+    flusher.join(timeout=5)
