@@ -43,6 +43,7 @@ from io import BytesIO
 from multiprocessing.connection import Client, Listener
 from typing import Any, Optional
 
+from ray_tpu._private import locktrace
 from ray_tpu._private import protocol as P
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 
@@ -99,7 +100,9 @@ class NodeAgent:
 
         # Workers on this host.
         self.workers: dict[WorkerID, dict] = {}  # wid -> {conn, proc, lock}
-        self.workers_lock = threading.Lock()
+        self.workers_lock = locktrace.register_lock(
+            "agent.workers_lock", threading.Lock()
+        )
         # kills that arrived before their spawn finished
         self._pending_kills: set[WorkerID] = set()
 
@@ -108,7 +111,9 @@ class NodeAgent:
         # pop/spawn and a local queue (two-level scheduling,
         # local_task_manager.h:60). Keyed by env fingerprint so workers are
         # only reused by compatible tasks.
-        self._lease_lock = threading.RLock()
+        self._lease_lock = locktrace.register_lock(
+            "agent.lease_lock", threading.RLock()
+        )
         self._leased: dict[bytes, P.LeaseTask] = {}  # task_id -> lease msg
         # workers THIS agent spawned for leased tasks (vs head-managed
         # spawns): wid -> env fingerprint, set at spawn time
@@ -136,7 +141,9 @@ class NodeAgent:
         # Own-request plumbing (agent → controller RPCs).
         self._req_counter = itertools.count(1)
         self._replies: dict[int, Any] = {}
-        self._reply_cv = threading.Condition()
+        self._reply_cv = locktrace.register_lock(
+            "agent.reply_cv", threading.Condition()
+        )
 
         # Node-local object lifecycle: seal order for LRU spilling when the
         # arena fills (the agent owns its data plane's spilling the way the
@@ -144,7 +151,9 @@ class NodeAgent:
         # the spill table for serving spilled objects to readers.
         self._resident: "dict[bytes, tuple[str, int]]" = {}
         self._resident_order: list[bytes] = []
-        self._resident_lock = threading.Lock()
+        self._resident_lock = locktrace.register_lock(
+            "agent.resident_lock", threading.Lock()
+        )
         self._spilled: dict[bytes, tuple[str, int]] = {}
         self.spill_dir = os.path.join(self.base_dir, "spill")
 

@@ -34,6 +34,7 @@ from concurrent.futures import ThreadPoolExecutor
 from multiprocessing.connection import Listener
 from typing import Any, Optional
 
+from ray_tpu._private import locktrace
 from ray_tpu._private import protocol as P
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import (
@@ -286,7 +287,7 @@ class Controller:
     def __init__(self, config: Config, head_resources: dict[str, float], mode: str = "process"):
         self.config = config
         self.mode = mode
-        self.lock = threading.RLock()
+        self.lock = locktrace.register_lock("controller.lock", threading.RLock())
         self.shutting_down = False
         # A shared cluster token derives a stable authkey so agents/drivers
         # on other hosts can join without the head's session file.
@@ -397,7 +398,9 @@ class Controller:
             lambda: deque(maxlen=1000)
         )
         self._pubsub_seq: dict[str, int] = defaultdict(int)
-        self._pubsub_cv = threading.Condition()
+        self._pubsub_cv = locktrace.register_lock(
+            "controller.pubsub_cv", threading.Condition()
+        )
         # Producer-side pins of streamed items: sealed stream items have no
         # consumer handle yet, so the producer pins them (else the eager
         # refcount-0 free in _on_object_sealed reclaims them instantly).
@@ -447,7 +450,9 @@ class Controller:
             self._rpc_chaos[op_name.strip()] = float(p)
         # serializes snapshot+rename: without it an in-flight background
         # write (stale snapshot) can land AFTER the shutdown flush
-        self._kv_write_lock = threading.Lock()
+        self._kv_write_lock = locktrace.register_lock(
+            "controller.kv_write_lock", threading.Lock()
+        )
         self._boot_snapshot = None
         if self._kv_snapshot_path and os.path.exists(self._kv_snapshot_path):
             try:
@@ -510,7 +515,9 @@ class Controller:
         from collections import OrderedDict as _OD
 
         self.plasma_resident: "_OD[ObjectID, tuple[str, int]]" = _OD()
-        self._spill_lock = threading.Lock()
+        self._spill_lock = locktrace.register_lock(
+            "controller.spill_lock", threading.Lock()
+        )
         # spilled objects' plasma blocks are reclaimed after a grace period
         # (in-flight readers may hold the already-sent shm location);
         # entries: (spill_time, object_id, size, location_name)
@@ -859,6 +866,8 @@ class Controller:
     def _kv_flush_loop(self):
         while not self.shutting_down:
             self._kv_dirty.wait(timeout=1.0)
+            if self.shutting_down:
+                return  # shutdown() writes the final snapshot itself
             if not self._kv_dirty.is_set():
                 continue
             self._kv_dirty.clear()
@@ -1492,7 +1501,16 @@ class Controller:
                 mature_at = self._spill_trash[0][0] + self._spill_grace_s
                 delay = mature_at - time.time()
                 if delay > 0:
-                    time.sleep(delay)
+                    # sliced, liveness-aware grace wait: _spill_lock only
+                    # serializes spilling itself (pacing under it is the
+                    # intended design), but shutdown must not sit out the
+                    # full reader grace
+                    deadline = time.monotonic() + delay
+                    while not self.shutting_down:
+                        step = min(0.05, deadline - time.monotonic())
+                        if step <= 0:
+                            break
+                        time.sleep(step)  # tpulint: disable=blocking-under-lock
                 self._reclaim_trash_locked()
             return True
 
@@ -4178,6 +4196,13 @@ class Controller:
         self._data_pool.close()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
+        # stop the background KV flusher BEFORE the final synchronous flush —
+        # a flusher mid-write could otherwise land its (now stale) snapshot
+        # after the final one. Its dirty-wait is bounded at 1 s and the loop
+        # re-checks shutting_down right after it, so this join is bounded too
+        # (waking it via _kv_dirty would instead force one more full —
+        # redundant — snapshot write before the loop notices shutdown).
+        locktrace.join_if_alive(self._kv_flusher, timeout=2.0)
         self.flush_kv_now()
         self._remove_session_file()
         # attached clients must not hang in _await_reply forever

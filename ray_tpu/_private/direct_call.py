@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Optional
 
+from ray_tpu._private import locktrace
 from ray_tpu._private import protocol as P
 from ray_tpu._private.serialization import SerializedObject
 from ray_tpu._private.task_spec import TaskSpec
@@ -212,14 +213,16 @@ class DirectActorTransport:
     def __init__(self, api, authkey: Optional[bytes]):
         self.api = api
         self.authkey = authkey
-        self.cv = threading.Condition()
+        self.cv = locktrace.register_lock("direct.table_cv", threading.Condition())
         # oid binary -> ("pending",) | ("done", kind, payload)
         #             | ("fallback",) | ("promoted", kind, payload)
         # payload: flattened SerializedObject bytes for kind inline/error;
         # (shm_name, size) for kind plasma (a spilled oversized direct reply)
         self.table: dict[bytes, tuple] = {}
         self._conns: dict[str, _DirectConn] = {}
-        self._conn_lock = threading.Lock()
+        self._conn_lock = locktrace.register_lock(
+            "direct.conn_lock", threading.Lock()
+        )
         # actor_id binary -> (address | None, recheck_after_monotonic)
         self._endpoints: dict[bytes, tuple] = {}
         # actor_id binary -> set of head-submitted TaskIDs still possibly
@@ -238,6 +241,7 @@ class DirectActorTransport:
         self._owned_segments: dict[bytes, str] = {}
         self._unlink_queue: list = []
         self._unlinker: Optional[threading.Thread] = None
+        self._unlinker_stop = threading.Event()
         self._req = itertools.count(1)
         # fast-path flag: get()/wait() skip the table entirely until the
         # first direct submission happens
@@ -568,8 +572,12 @@ class DirectActorTransport:
         shared-memory segment)."""
         from ray_tpu._private.object_store import PlasmaClient
 
+        # two getter threads can race the lazy init; the loser's client would
+        # leak its shm mapping — create under the table cv
         if not hasattr(self, "_plasma_client"):
-            self._plasma_client = PlasmaClient()
+            with self.cv:
+                if not hasattr(self, "_plasma_client"):
+                    self._plasma_client = PlasmaClient()
         name, size = payload
         return self._plasma_client.read(name, size)
 
@@ -595,8 +603,9 @@ class DirectActorTransport:
     def _unlink_loop(self):
         from multiprocessing import shared_memory
 
-        while True:
-            time.sleep(0.1)
+        # stop-event pacing (not a bare sleep) so shutdown can join this
+        # thread instead of racing it over the queue it is about to drain
+        while not self._unlinker_stop.wait(0.1):
             while self._unlink_queue:
                 name = self._unlink_queue.pop()
                 pc = getattr(self, "_plasma_client", None)
@@ -808,6 +817,10 @@ class DirectActorTransport:
                 c.conn.close()
             except OSError:
                 pass
+        # park the unlinker before reclaiming segments below — otherwise the
+        # loop races this drain over the same queue entries
+        self._unlinker_stop.set()
+        locktrace.join_if_alive(self._unlinker, timeout=1.0)
         # reclaim caller-owned reply segments (their objects die with this
         # process's table)
         from multiprocessing import shared_memory

@@ -1,0 +1,138 @@
+"""Runtime lock registry for deadlock triage.
+
+Core modules register their long-lived locks here by name; the conftest
+watchdog (tests/conftest.py) dumps the owner table — lock name → owning
+thread — next to every thread's stack when a test times out, so a deadlock
+triages from the log instead of a 300 s bisect (the PR 3 ``test_streaming``
+hang took exactly that bisect).
+
+Registration costs nothing on the lock hot path: the registry keeps weak
+references and derives ownership *at dump time only* from the lock's repr
+(CPython's RLock repr carries the owner thread ident and recursion count;
+a plain Lock only exposes locked/unlocked — its owner is untracked by the
+interpreter itself). Conditions report their wrapped lock; Events report
+set/cleared.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import weakref
+
+_REG_LOCK = threading.Lock()
+_REGISTRY: dict[str, "weakref.ref"] = {}
+_COUNTER: dict[str, int] = {}
+
+_RLOCK_RE = re.compile(r"<(locked|unlocked) _thread\.RLock object owner=(\d+) count=(\d+)")
+
+
+def join_if_alive(thread, timeout: float) -> bool:
+    """Bounded best-effort join for shutdown paths: no-op for a missing,
+    finished, or current thread. Returns True when the thread is gone."""
+    if thread is None or not thread.is_alive():
+        return True
+    if thread is threading.current_thread():
+        return False
+    thread.join(timeout=timeout)
+    return not thread.is_alive()
+
+
+def register_lock(name: str, lock):
+    """Register `lock` under `name` for watchdog dumps; returns the lock
+    (so call sites can wrap construction). Re-registration under the same
+    name replaces a dead entry and suffixes a live one (``name#2``)."""
+    with _REG_LOCK:
+        ref = _REGISTRY.get(name)
+        if ref is not None and ref() is not None and ref() is not lock:
+            _COUNTER[name] = _COUNTER.get(name, 1) + 1
+            name = f"{name}#{_COUNTER[name]}"
+        try:
+            _REGISTRY[name] = weakref.ref(lock)
+        except TypeError:  # non-weakref-able lock-alike: keep a strong ref
+            _REGISTRY[name] = (lambda obj: (lambda: obj))(lock)
+    return lock
+
+
+def _describe(lock, threads: dict) -> str:
+    # Condition: report its wrapped lock (acquiring the cv == that lock)
+    inner = getattr(lock, "_lock", None)
+    if inner is not None and hasattr(lock, "wait"):
+        return f"condition({_describe(inner, threads)})"
+    if isinstance(lock, threading.Event):
+        return "event:set" if lock.is_set() else "event:cleared"
+    m = _RLOCK_RE.match(repr(lock))
+    if m:
+        state, owner, count = m.group(1), int(m.group(2)), int(m.group(3))
+        if state == "unlocked":
+            return "unlocked"
+        return f"locked by {threads.get(owner, f'<ident {owner}>')} (count={count})"
+    locked = getattr(lock, "locked", None)
+    if callable(locked):
+        return "locked (owner untracked)" if locked() else "unlocked"
+    return repr(lock)
+
+
+def _registry_items() -> list:
+    """Signal-safe snapshot: the watchdog dump runs from a SIGALRM handler
+    that may have interrupted THIS thread inside register_lock — never block
+    on _REG_LOCK here (a plain Lock self-deadlocks), degrade to a best-effort
+    unlocked read instead."""
+    acquired = _REG_LOCK.acquire(timeout=0.25)
+    try:
+        for _ in range(3):
+            try:
+                return list(_REGISTRY.items())
+            except RuntimeError:  # dict resized mid-iteration (lock not held)
+                continue
+        return []
+    finally:
+        if acquired:
+            _REG_LOCK.release()
+
+
+def owner_table() -> dict:
+    """Snapshot: registered lock name -> human-readable ownership state."""
+    threads = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    items = _registry_items()
+    for name, ref in items:
+        lock = ref()
+        if lock is None:
+            continue  # owner object got collected; drop silently
+        try:
+            out[name] = _describe(lock, threads)
+        except Exception as e:  # noqa: BLE001 — a dump must never throw
+            out[name] = f"<describe failed: {e}>"
+    return out
+
+
+def format_owner_table() -> str:
+    table = owner_table()
+    if not table:
+        return "(no registered locks)"
+    width = max(len(n) for n in table)
+    lines = [f"{name:<{width}}  {state}" for name, state in sorted(table.items())]
+    return "\n".join(lines)
+
+
+def dump_all(file=None) -> str:
+    """Thread stacks + lock owner table, formatted for a watchdog log."""
+    import sys
+    import traceback
+
+    frames = sys._current_frames()
+    threads = {t.ident: t for t in threading.enumerate()}
+    parts = ["=== locktrace: thread stacks ==="]
+    for ident, frame in sorted(frames.items()):
+        t = threads.get(ident)
+        label = t.name if t is not None else f"<ident {ident}>"
+        daemon = " daemon" if (t is not None and t.daemon) else ""
+        parts.append(f"--- thread {label}{daemon} (ident={ident}) ---")
+        parts.append("".join(traceback.format_stack(frame)).rstrip())
+    parts.append("=== locktrace: registered lock owners ===")
+    parts.append(format_owner_table())
+    text = "\n".join(parts)
+    if file is not None:
+        print(text, file=file, flush=True)
+    return text

@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ray_tpu._private import locktrace
+
 logger = logging.getLogger(__name__)
 
 
@@ -63,6 +65,8 @@ class MemoryMonitor:
 
     def stop(self):
         self._stop.set()
+        # the loop's wait is bounded by poll_interval_s, so this join is too
+        locktrace.join_if_alive(self._thread, timeout=self.poll_interval_s + 1.0)
 
     def _loop(self):
         while not self._stop.wait(self.poll_interval_s):

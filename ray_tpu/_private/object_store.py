@@ -21,6 +21,7 @@ import time
 from collections import OrderedDict
 from typing import Iterable, Optional
 
+from ray_tpu._private import locktrace
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.serialization import SerializedObject
 from ray_tpu.exceptions import ObjectStoreFullError
@@ -62,7 +63,7 @@ class MemoryStore:
     def __init__(self):
         self._objects: dict[ObjectID, SerializedObject] = {}
         self._errors: dict[ObjectID, SerializedObject] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.register_lock("store.memory_lock", threading.Lock())
         # object id -> list of waiters blocked on it
         self._waiters: dict[ObjectID, list[_Waiter]] = {}
         # object id -> one-shot callbacks fired on seal (async consumers —
@@ -270,7 +271,7 @@ class PlasmaStore:
     def __init__(self, capacity_bytes: int):
         self._capacity = capacity_bytes
         self._used = 0
-        self._lock = threading.Lock()
+        self._lock = locktrace.register_lock("store.plasma_lock", threading.Lock())
         # object id -> (shm_name, size)
         self._sealed: "OrderedDict[ObjectID, tuple[str, int]]" = OrderedDict()
         self._pins: dict[ObjectID, int] = {}

@@ -16,6 +16,7 @@ lifetime; ordered execution and ``max_concurrency`` mirror the reference's
 from __future__ import annotations
 
 import asyncio
+import functools
 import itertools
 import os
 import queue
@@ -27,6 +28,7 @@ from typing import Any, Optional
 
 import cloudpickle
 
+from ray_tpu._private import locktrace
 from ray_tpu._private import protocol as P
 from ray_tpu._private.ids import ObjectID, WorkerID
 from ray_tpu._private.serialization import SerializationContext, SerializedObject
@@ -173,17 +175,25 @@ class WorkerRuntime:
         self.actors: dict[bytes, Any] = {}  # actor_id binary -> instance
         self.actor_pools: dict[bytes, ThreadPoolExecutor] = {}
         self.actor_loops: dict[bytes, asyncio.AbstractEventLoop] = {}
+        # async actors: FIFO admission lock per actor (see _execute_async) —
+        # created lazily ON the actor's loop, keyed like actor_loops
+        self._async_admission: dict[bytes, asyncio.Lock] = {}
         # max_concurrency=1 sync actors: every execution path (task pool AND
         # inline direct calls) serializes on this per-actor lock, so direct
         # calls can run on the caller-connection reader thread — one fewer
         # context switch per call — without breaking the concurrency contract
         self.actor_exec_locks: dict[bytes, threading.Lock] = {}
         self._get_replies: dict[int, Any] = {}
-        self._get_cv = threading.Condition()
+        self._get_cv = locktrace.register_lock(
+            "worker.get_cv", threading.Condition()
+        )
         self._req_counter = itertools.count(1)
-        self._send_lock = threading.Lock()
+        self._send_lock = locktrace.register_lock(
+            "worker.send_lock", threading.Lock()
+        )
         self._put_counter = itertools.count(1)
         self._shm_client = None
+        self._shm_client_lock = threading.Lock()
         self._shutdown = False
         self.max_inline = int(os.environ.get(_INLINE_LIMIT_ENV, 100 * 1024))
         # direct-call replies above this ride shared memory instead of the
@@ -301,6 +311,15 @@ class WorkerRuntime:
         except (OSError, EOFError):
             return False
 
+    def shutdown(self):
+        """Deterministic teardown: park the free flusher (its loop flushes
+        the final batch on exit), then push any remainder synchronously —
+        the final free batch must hit the wire before the process exits."""
+        self._shutdown = True
+        locktrace.join_if_alive(self._free_flusher, timeout=1.0)
+        if not self.in_process:
+            self._flush_frees()
+
     def register_driver(self):
         """Synchronous client-driver registration: MUST be on the wire before
         any API request, or the controller's handshake closes the conn."""
@@ -356,10 +375,8 @@ class WorkerRuntime:
                 break
         self._shutdown = True
         self._drop_inline_hosts()
+        self.shutdown()  # joins the free flusher + final flush (see above)
         if not self.in_process:
-            # final free batch must hit the wire before the hard exit (a
-            # flush racing teardown used to drop it — head-side ref leak)
-            self._flush_frees()
             os._exit(0)
         # thread-mode worker retiring (e.g. KillActor): close the channel so
         # the controller's reader thread sees EOF and exits — otherwise every
@@ -752,7 +769,11 @@ class WorkerRuntime:
         if self._shm_client is None:
             from ray_tpu._private.object_store import PlasmaClient
 
-            self._shm_client = PlasmaClient()
+            # raced from every get/put thread on first use; the losing
+            # thread's client would leak its shm mapping
+            with self._shm_client_lock:
+                if self._shm_client is None:
+                    self._shm_client = PlasmaClient()
         return self._shm_client
 
     def _inproc_controller(self):
@@ -1012,20 +1033,42 @@ class WorkerRuntime:
         spec = msg.spec
         direct = getattr(msg, "direct_reply", None)
         start = time.monotonic()
+        loop = asyncio.get_running_loop()
         try:
-            args, kwargs = self._deserialize_args(spec, msg.resolved_args)
-            instance = self.actors[spec.actor_id.binary()]
-            if spec.method_name == "__rtpu_call__":
-                value = args[0](instance, *args[1:], **kwargs)
-            else:
-                method = getattr(instance, spec.method_name)
-                value = method(*args, **kwargs)
+            key = spec.actor_id.binary()
+            adm = self._async_admission.get(key)
+            if adm is None:
+                adm = self._async_admission.setdefault(key, asyncio.Lock())
+            # Arg materialization can retry-sleep on store contention; on the
+            # event loop that stalls every other coroutine of this actor —
+            # route it through the default executor. The admission lock keeps
+            # the pre-executor semantics intact: asyncio.Lock wakes waiters
+            # FIFO, so tasks still START in submission order and plain-def
+            # methods still run atomically in that order; only the await of
+            # an async method body (below, outside the lock) overlaps.
+            async with adm:
+                args, kwargs = await loop.run_in_executor(
+                    None, self._deserialize_args, spec, msg.resolved_args
+                )
+                instance = self.actors[key]
+                if spec.method_name == "__rtpu_call__":
+                    value = args[0](instance, *args[1:], **kwargs)
+                else:
+                    method = getattr(instance, spec.method_name)
+                    value = method(*args, **kwargs)
             if asyncio.iscoroutine(value):
                 value = await value
             if spec.num_returns == "streaming" and hasattr(value, "__anext__"):
                 results = await self._stream_returns_async(spec, value)
             else:
-                results = self._store_returns(spec, value, inline_only=direct is not None)
+                # same store-contention retry shape as the args pull above
+                results = await loop.run_in_executor(
+                    None,
+                    functools.partial(
+                        self._store_returns, spec, value,
+                        inline_only=direct is not None,
+                    ),
+                )
         except BaseException as e:  # noqa: BLE001
             results = self._store_error(spec, e)
         exec_ms = (time.monotonic() - start) * 1e3
@@ -1071,7 +1114,10 @@ class WorkerRuntime:
                 # RLock, not Lock: a reentrant self-call (an actor method
                 # calling its own handle) runs nested on the same thread
                 # instead of deadlocking on its own execution lock.
-                self.actor_exec_locks[key] = threading.RLock()
+                self.actor_exec_locks[key] = locktrace.register_lock(
+                    f"worker.actor_exec[{spec.actor_id.hex()[:8]}]",
+                    threading.RLock(),
+                )
                 with _inline_hosts_lock:
                     _inline_hosts[key] = self
             return None

@@ -1,0 +1,1 @@
+"""Developer tooling for ray_tpu (not imported by the runtime)."""

@@ -1,0 +1,40 @@
+"""tpulint — concurrency static analysis for ray_tpu.
+
+An AST + project-call-graph analyzer in the lockset tradition (Eraser,
+Savage et al. 1997; compositional propagation à la RacerD, Blackshear et
+al. 2018) specialised to the bug shapes this codebase has actually shipped:
+
+- ``blocking-under-lock`` — the PR 3 `test_streaming` deadlock shape (an
+  inline actor sealing stream items through its own channel pump while
+  holding its execution lock);
+- ``lock-order`` — ABBA cycles in the global acquisition graph;
+- ``async-stall`` — the PR 4 serve-proxy freeze shape (a blocking call on
+  the event loop);
+- ``unguarded-shared-state`` — attribute mutated from two thread entry
+  points with no common lock;
+- ``shutdown-hygiene`` — the PR 4 free-flusher leak shape (a thread whose
+  join/flush is unreachable from its owner's shutdown path).
+
+Programmatic use::
+
+    from ray_tpu.devtools.lint import lint_paths
+    findings = lint_paths(["ray_tpu"])           # list[Finding]
+
+CLI: ``python -m ray_tpu.devtools.lint`` (see ``--help``); findings not in
+``tools/tpulint_baseline.json`` fail the run. Inline suppression:
+``# tpulint: disable=<check-id>[,<check-id>...]`` on the reported line.
+"""
+
+from .checks import run_checks
+from .discovery import discover
+from .engine import analyze
+from .model import CHECKS, Finding
+
+__all__ = ["CHECKS", "Finding", "lint_paths", "discover", "analyze", "run_checks"]
+
+
+def lint_paths(paths, checks=None, root=None):
+    """Index, analyze, and run checks over `paths`; returns list[Finding]."""
+    project = discover(list(paths), root=root)
+    analyze(project)
+    return run_checks(project, checks)

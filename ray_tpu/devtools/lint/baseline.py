@@ -1,0 +1,80 @@
+"""Baseline handling: pre-existing debt is *recorded*, not silenced.
+
+The baseline file is a checked-in JSON listing every accepted finding by
+stable fingerprint (check | file | qualname | line-free key — survives code
+motion) together with a human reason. The CLI exits non-zero on any finding
+whose fingerprint is not baselined; stale baseline entries (fixed findings)
+are reported so the file shrinks over time instead of fossilising.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+DEFAULT_REASON = "pre-existing debt recorded at baseline creation; review pending"
+
+
+def load(path: str) -> dict:
+    """Return fingerprint -> entry dict. Missing file -> empty baseline."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out = {}
+    for entry in data.get("findings", []):
+        fp = entry.get("fingerprint")
+        if fp:
+            out[fp] = entry
+    return out
+
+
+def write(path: str, findings: list, old: dict | None = None) -> None:
+    """Write a fresh baseline from `findings`, preserving reasons by
+    fingerprint from the previous baseline."""
+    old = old or {}
+    entries = []
+    for f in findings:
+        prev = old.get(f.fingerprint, {})
+        entries.append(
+            {
+                "fingerprint": f.fingerprint,
+                "check": f.check,
+                "file": f.file,
+                "qualname": f.qualname,
+                "line": f.line,  # informational; NOT part of the fingerprint
+                "message": f.message,
+                "reason": prev.get("reason", DEFAULT_REASON),
+            }
+        )
+    entries.sort(key=lambda e: (e["file"], e["check"], e["qualname"], e["fingerprint"]))
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "version": 1,
+                "tool": "tpulint",
+                "note": (
+                    "Accepted pre-existing findings. Regenerate with "
+                    "`python -m ray_tpu.devtools.lint --write-baseline`; "
+                    "reasons are preserved by fingerprint. Fix the finding "
+                    "and the entry must be deleted (the CLI flags it stale)."
+                ),
+                "findings": entries,
+            },
+            f,
+            indent=1,
+            sort_keys=True,
+        )
+        f.write("\n")
+
+
+def split(findings: list, base: dict):
+    """Partition findings into (new, accepted); also return stale entries."""
+    new, accepted = [], []
+    seen = set()
+    for f in findings:
+        seen.add(f.fingerprint)
+        (accepted if f.fingerprint in base else new).append(f)
+    stale = [e for fp, e in sorted(base.items()) if fp not in seen]
+    return new, accepted, stale

@@ -1,0 +1,476 @@
+"""tpulint check families: turn engine facts into findings.
+
+Five families (see ``model.CHECKS``): blocking-under-lock, lock-order,
+async-stall, unguarded-shared-state, shutdown-hygiene. Every finding carries
+a stable line-free ``key`` (for baseline fingerprints that survive code
+motion) and a human call path down to the offending primitive.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from .discovery import Project
+from .model import CHECKS, Finding, SHUTDOWN_METHOD_NAMES
+
+
+def _fmt_chain(witness) -> list:
+    out = [f"via {hop}" for hop in witness.chain]
+    out.append(f"blocks at {witness.kind}: {witness.desc} ({witness.loc})")
+    return out
+
+
+# --------------------------------------------------------------------------
+# blocking-under-lock
+
+
+def check_blocking_under_lock(project: Project) -> list:
+    findings = []
+    for f in project.functions.values():
+        for bs in f.block_sites:
+            if bs.timed:
+                continue
+            held_eff = [h for h in bs.held if h not in bs.witness.releases]
+            if not held_eff:
+                continue
+            findings.append(
+                Finding(
+                    check="blocking-under-lock",
+                    file=f.file,
+                    line=bs.line,
+                    qualname=f.qualname,
+                    message=(
+                        f"{bs.witness.kind} ({bs.witness.desc}) while holding "
+                        f"{' -> '.join(held_eff)}"
+                    ),
+                    key=f"{bs.witness.kind}|{','.join(sorted(held_eff))}|{bs.witness.desc}",
+                )
+            )
+        for cs in f.call_sites:
+            if not cs.held:
+                continue
+            callee = project.functions.get(cs.callee)
+            if callee is None or callee.summary_blocks is None:
+                continue
+            w = callee.summary_blocks
+            held_eff = [h for h in cs.held if h not in w.releases]
+            if not held_eff:
+                continue
+            findings.append(
+                Finding(
+                    check="blocking-under-lock",
+                    file=f.file,
+                    line=cs.line,
+                    qualname=f.qualname,
+                    message=(
+                        f"call {cs.desc}() can block ({w.kind}) while holding "
+                        f"{' -> '.join(held_eff)}"
+                    ),
+                    key=f"call:{cs.callee}|{w.kind}|{','.join(sorted(held_eff))}",
+                    path=_fmt_chain(w.chained(f"{cs.desc}() at {f.file}:{cs.line}")),
+                )
+            )
+    return findings
+
+
+# --------------------------------------------------------------------------
+# lock-order
+
+
+def check_lock_order(project: Project) -> list:
+    findings = []
+    # edges: (held, acquired) -> (file, line, qualname, chainlines)
+    edges: dict[tuple, tuple] = {}
+
+    def _reentrant(lock_id: str) -> bool:
+        info = project.locks.get(lock_id)
+        # unknown locks default to reentrant: no self-deadlock finding
+        return info.reentrant if info is not None else True
+
+    for f in project.functions.values():
+        for a in f.acquire_sites:
+            for h in a.held_before:
+                if h == a.lock_id:
+                    if not a.reentrant:
+                        findings.append(
+                            Finding(
+                                check="lock-order",
+                                file=f.file,
+                                line=a.line,
+                                qualname=f.qualname,
+                                message=(
+                                    f"non-reentrant lock {a.lock_id} re-acquired "
+                                    f"while already held (self-deadlock)"
+                                ),
+                                key=f"self|{a.lock_id}",
+                            )
+                        )
+                    continue
+                edges.setdefault(
+                    (h, a.lock_id), (f.file, a.line, f.qualname, [])
+                )
+        for cs in f.call_sites:
+            if not cs.held:
+                continue
+            callee = project.functions.get(cs.callee)
+            if callee is None:
+                continue
+            for lock_id, aw in callee.summary_acquires.items():
+                if lock_id in cs.held:
+                    if not _reentrant(lock_id):
+                        findings.append(
+                            Finding(
+                                check="lock-order",
+                                file=f.file,
+                                line=cs.line,
+                                qualname=f.qualname,
+                                message=(
+                                    f"call {cs.desc}() re-acquires non-reentrant "
+                                    f"lock {lock_id} already held (self-deadlock)"
+                                ),
+                                key=f"self-call|{cs.callee}|{lock_id}",
+                                path=[f"via {hop}" for hop in aw.chain]
+                                + [f"acquires {lock_id} at {aw.loc}"],
+                            )
+                        )
+                    continue
+                for h in cs.held:
+                    chain = [f"via {hop}" for hop in aw.chain] + [
+                        f"acquires {lock_id} at {aw.loc}"
+                    ]
+                    edges.setdefault((h, lock_id), (f.file, cs.line, f.qualname, chain))
+
+    # cycle detection over the acquisition digraph (DFS, simple cycles,
+    # deduped by node set)
+    graph = defaultdict(set)
+    for (a, b) in edges:
+        graph[a].add(b)
+    seen_cycles = set()
+
+    def _dfs(start):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in graph.get(node, ()):
+                if nxt == start and len(path) >= 2:
+                    key = frozenset(path)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        yield path + [start]
+                elif nxt not in path and len(path) < 5:
+                    stack.append((nxt, path + [nxt]))
+
+    for start in sorted(graph):
+        for cyc in _dfs(start):
+            file, line, qual, chain = edges[(cyc[0], cyc[1])]
+            pathlines = []
+            for x, y in zip(cyc, cyc[1:]):
+                ef, el, eq, _ = edges[(x, y)]
+                pathlines.append(f"{x} -> {y} in {eq} ({ef}:{el})")
+            findings.append(
+                Finding(
+                    check="lock-order",
+                    file=file,
+                    line=line,
+                    qualname=qual,
+                    message=(
+                        "lock acquisition cycle (potential ABBA deadlock): "
+                        + " -> ".join(cyc)
+                    ),
+                    key="cycle|" + "|".join(sorted(set(cyc))),
+                    path=pathlines + (chain or []),
+                )
+            )
+    return findings
+
+
+# --------------------------------------------------------------------------
+# async-stall
+
+
+def check_async_stall(project: Project) -> list:
+    findings = []
+    for f in project.functions.values():
+        if not f.is_async:
+            continue
+        for bs in f.block_sites:
+            findings.append(
+                Finding(
+                    check="async-stall",
+                    file=f.file,
+                    line=bs.line,
+                    qualname=f.qualname,
+                    message=(
+                        f"blocking {bs.witness.kind} ({bs.witness.desc}) in async "
+                        f"def body stalls the event loop"
+                        + (" (bounded, still a stall)" if bs.timed else "")
+                    ),
+                    key=f"{bs.witness.kind}|{bs.witness.desc}",
+                )
+            )
+        for cs in f.call_sites:
+            if cs.awaited:
+                continue
+            callee = project.functions.get(cs.callee)
+            if callee is None or callee.is_async or callee.summary_blocks is None:
+                continue
+            w = callee.summary_blocks
+            findings.append(
+                Finding(
+                    check="async-stall",
+                    file=f.file,
+                    line=cs.line,
+                    qualname=f.qualname,
+                    message=(
+                        f"sync call {cs.desc}() can block ({w.kind}) inside async "
+                        f"def — route through an executor"
+                    ),
+                    key=f"call:{cs.callee}|{w.kind}",
+                    path=_fmt_chain(w.chained(f"{cs.desc}() at {f.file}:{cs.line}")),
+                )
+            )
+    return findings
+
+
+# --------------------------------------------------------------------------
+# unguarded-shared-state
+
+
+def _intra_class_edges(project: Project, cls) -> dict:
+    edges = defaultdict(set)
+    prefix = cls.qualkey + "."
+    for name, m in cls.methods.items():
+        for cs in m.call_sites:
+            if cs.callee and cs.callee.startswith(prefix):
+                edges[name].add(cs.callee.rsplit(".", 1)[1])
+    return edges
+
+
+def _reach(edges: dict, root: str) -> set:
+    seen = {root}
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        for nxt in edges.get(n, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return seen
+
+
+def check_unguarded_shared_state(project: Project) -> list:
+    findings = []
+    for cls in project.classes.values():
+        thread_targets = set()
+        for m in cls.methods.values():
+            for tc in m.thread_creates:
+                if tc.target:
+                    thread_targets.add(tc.target)
+        if not thread_targets:
+            continue  # class doesn't run its own threads
+        public = {
+            n
+            for n in cls.methods
+            if not n.startswith("_") and n not in thread_targets
+        }
+        roots = thread_targets | public
+        if len(roots) < 2:
+            continue
+        edges = _intra_class_edges(project, cls)
+        reach = {r: _reach(edges, r) for r in roots}
+
+        # entry-held propagation: a private helper only ever called with a
+        # lock held inherits that lock in its effective set (3 rounds covers
+        # helper->helper chains)
+        entry_held: dict[str, frozenset] = {n: frozenset() for n in cls.methods}
+        callers = defaultdict(list)  # method name -> [(caller, held)]
+        prefix = cls.qualkey + "."
+        for name, m in cls.methods.items():
+            for cs in m.call_sites:
+                if cs.callee and cs.callee.startswith(prefix):
+                    callers[cs.callee.rsplit(".", 1)[1]].append((name, frozenset(cs.held)))
+        for _ in range(3):
+            for name in cls.methods:
+                if name in roots or not name.startswith("_") or not callers.get(name):
+                    continue
+                sets = [
+                    held | entry_held.get(cname, frozenset())
+                    for cname, held in callers[name]
+                ]
+                inter = frozenset.intersection(*sets) if sets else frozenset()
+                entry_held[name] = inter
+
+        # attr -> [(method, MutationSite)]
+        per_attr = defaultdict(list)
+        for name, m in cls.methods.items():
+            if name in ("__init__", "__new__", "__enter__"):
+                continue
+            for mu in m.mutations:
+                if mu.attr.startswith("_"):
+                    per_attr[mu.attr].append((name, mu))
+        for attr, sites in sorted(per_attr.items()):
+            mut_methods = {name for name, _ in sites}
+            hit_roots = sorted(
+                r for r in roots if reach[r] & mut_methods
+            )
+            if len(hit_roots) < 2:
+                continue
+            if all(mu.constant_only for _, mu in sites):
+                continue  # pure flag stores; GIL-atomic, near-zero risk
+            eff_sets = [
+                mu.held | entry_held.get(name, frozenset()) for name, mu in sites
+            ]
+            common = frozenset.intersection(*eff_sets) if eff_sets else frozenset()
+            if common:
+                continue
+            name0, mu0 = sites[0]
+            findings.append(
+                Finding(
+                    check="unguarded-shared-state",
+                    file=cls.file,
+                    line=mu0.line,
+                    qualname=f"{cls.qualkey}.{name0}",
+                    message=(
+                        f"self.{attr} mutated from >=2 thread entry points "
+                        f"({', '.join(hit_roots[:4])}) with no common lock"
+                    ),
+                    key=f"{attr}|{','.join(hit_roots[:4])}",
+                    path=[
+                        f"mutated in {n} ({cls.file}:{mu.line}) held="
+                        + ("{" + ",".join(sorted(mu.held)) + "}" if mu.held else "{}")
+                        for n, mu in sites[:5]
+                    ],
+                )
+            )
+    return findings
+
+
+# --------------------------------------------------------------------------
+# shutdown-hygiene
+
+
+def check_shutdown_hygiene(project: Project) -> list:
+    findings = []
+    for cls in project.classes.values():
+        # aggregate thread-attr lifecycle across methods
+        created: dict[str, tuple] = {}  # attr -> (method, ThreadCreate)
+        started_attrs = set()
+        joined: dict[str, set] = defaultdict(set)  # method -> attrs joined
+        for name, m in cls.methods.items():
+            for tc in m.thread_creates:
+                if tc.attr is not None:
+                    if tc.started:
+                        started_attrs.add(tc.attr)
+                    else:
+                        created.setdefault(tc.attr, (name, tc))
+            for attr in m.joined_attrs:
+                joined[name].add(attr)
+        edges = _intra_class_edges(project, cls)
+        shutdown_methods = [
+            n for n in cls.methods if n in SHUTDOWN_METHOD_NAMES
+        ]
+        if not shutdown_methods:
+            for base in cls.bases:
+                bc = project.classes.get(base)
+                if bc is not None:
+                    shutdown_methods = [
+                        n for n in bc.methods if n in SHUTDOWN_METHOD_NAMES
+                    ]
+                    if shutdown_methods:
+                        break
+        shutdown_reach = set()
+        for sm in shutdown_methods:
+            shutdown_reach |= _reach(edges, sm)
+
+        for attr, (mname, tc) in sorted(created.items()):
+            if attr not in started_attrs:
+                # require an observed .start() to avoid flagging dormant
+                # thread templates that are never actually run
+                continue
+            # a join only counts if it sits in a method reachable from the
+            # shutdown path — a join buried in an unrelated helper is not a
+            # teardown guarantee
+            joined_reachable = any(
+                attr in joined.get(m, ()) for m in shutdown_reach
+            )
+            if joined_reachable:
+                continue
+            daemon = " daemon" if tc.daemon else ""
+            if not shutdown_methods:
+                msg = (
+                    f"{cls.name} starts{daemon} thread self.{attr} but has no "
+                    f"shutdown path ({'/'.join(sorted(SHUTDOWN_METHOD_NAMES)[:4])}"
+                    f"/...) that could join it"
+                )
+            else:
+                msg = (
+                    f"{cls.name} starts{daemon} thread self.{attr} but no join "
+                    f"is reachable from its shutdown path "
+                    f"({', '.join(sorted(shutdown_methods))})"
+                )
+            findings.append(
+                Finding(
+                    check="shutdown-hygiene",
+                    file=cls.file,
+                    line=tc.line,
+                    qualname=f"{cls.qualkey}.{mname}",
+                    message=msg,
+                    key=f"{attr}",
+                )
+            )
+    # non-daemon local threads started and never joined in-function
+    # (module-level functions included — they have no shutdown path at all)
+    for f in project.functions.values():
+        for tc in f.thread_creates:
+            if (
+                tc.attr is None
+                and tc.local is not None
+                and tc.started
+                and not tc.daemon
+                and tc.local not in f.joined_locals
+            ):
+                findings.append(
+                    Finding(
+                        check="shutdown-hygiene",
+                        file=f.file,
+                        line=tc.line,
+                        qualname=f.qualname,
+                        message=(
+                            f"non-daemon local thread `{tc.local}` started "
+                            f"but never joined in {f.name} (leaks at teardown)"
+                        ),
+                        key=f"local|{tc.local}",
+                    )
+                )
+    return findings
+
+
+# --------------------------------------------------------------------------
+
+_ALL = {
+    "blocking-under-lock": check_blocking_under_lock,
+    "lock-order": check_lock_order,
+    "async-stall": check_async_stall,
+    "unguarded-shared-state": check_unguarded_shared_state,
+    "shutdown-hygiene": check_shutdown_hygiene,
+}
+
+assert set(_ALL) == set(CHECKS)
+
+
+def run_checks(project: Project, enabled=None) -> list:
+    enabled = set(enabled) if enabled else set(_ALL)
+    findings = []
+    for name, fn in _ALL.items():
+        if name in enabled:
+            findings.extend(fn(project))
+    # drop suppressed + dedupe by fingerprint (keep first occurrence)
+    out, seen = [], set()
+    for f in sorted(findings, key=lambda f: (f.file, f.line, f.check)):
+        if project.suppressed(f.file, f.line, f.check):
+            continue
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        out.append(f)
+    return out

@@ -1,0 +1,212 @@
+"""`python -m ray_tpu.devtools.lint` — the tpulint CLI.
+
+Exit codes: 0 = clean (every finding baselined), 1 = new findings (or
+requested strictness violated), 2 = usage/config error.
+
+Config comes from ``[tool.tpulint]`` in pyproject.toml (found by walking up
+from the first target path): ``paths``, ``baseline``, ``checks``,
+``exclude``. CLI flags override config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+from . import baseline as baseline_mod
+from .checks import run_checks
+from .discovery import discover
+from .engine import analyze
+from .model import CHECKS
+
+
+def _parse_toml_section(path: str, section: str) -> dict:
+    """Minimal TOML reader for our own flat section (py3.10: no tomllib).
+
+    Supports `key = "str"`, `key = true/false`, and (multi-line) string
+    arrays — exactly the shapes [tool.tpulint] uses.
+    """
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except OSError:
+        return {}
+    m = re.search(rf"^\[{re.escape(section)}\]\s*$(.*?)(?=^\[|\Z)", src, re.M | re.S)
+    if not m:
+        return {}
+    body = m.group(1)
+    out: dict = {}
+    # join multi-line arrays
+    body = re.sub(r"\[\s*\n", "[", body)
+    while re.search(r"\[[^\]]*\n", body):
+        body = re.sub(r"(\[[^\]]*)\n\s*", r"\1 ", body, count=1)
+    for line in body.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line or "=" not in line:
+            continue
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip()
+        if val.startswith("["):
+            out[key] = re.findall(r"\"([^\"]*)\"|'([^']*)'", val)
+            out[key] = [a or b for a, b in out[key]]
+        elif val in ("true", "false"):
+            out[key] = val == "true"
+        else:
+            out[key] = val.strip("\"'")
+    return out
+
+
+def _find_pyproject(start: str) -> str | None:
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    for _ in range(10):
+        cand = os.path.join(d, "pyproject.toml")
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint",
+        description=(
+            "tpulint: concurrency static analysis for ray_tpu "
+            "(lock-order, blocking-under-lock, async-stall, "
+            "unguarded-shared-state, shutdown-hygiene)"
+        ),
+    )
+    ap.add_argument("paths", nargs="*", help="files/trees to lint (default: config paths, else the ray_tpu package)")
+    ap.add_argument("--baseline", help="baseline JSON path ('' disables)")
+    ap.add_argument("--no-baseline", action="store_true", help="ignore any baseline: report every finding as new")
+    ap.add_argument("--write-baseline", action="store_true", help="accept current findings into the baseline (reasons preserved by fingerprint)")
+    ap.add_argument("--checks", help="comma-separated check ids to run (default: all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-checks", action="store_true")
+    ap.add_argument("--stats", action="store_true", help="print index/analysis counters")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for name, desc in CHECKS.items():
+            print(f"{name}\n    {desc}")
+        return 0
+
+    # ---- config ----------------------------------------------------------
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    repo_root = os.path.dirname(pkg_root)
+    seed = args.paths[0] if args.paths else repo_root
+    pyproject = _find_pyproject(seed)
+    cfg = _parse_toml_section(pyproject, "tool.tpulint") if pyproject else {}
+    cfg_root = os.path.dirname(pyproject) if pyproject else repo_root
+
+    paths = args.paths or [
+        os.path.join(cfg_root, p) for p in cfg.get("paths", [])
+    ] or [pkg_root]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"tpulint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    enabled = None
+    if args.checks:
+        enabled = [c.strip() for c in args.checks.split(",") if c.strip()]
+    elif cfg.get("checks"):
+        enabled = cfg["checks"]
+    if enabled:
+        unknown = set(enabled) - set(CHECKS)
+        if unknown:
+            print(f"tpulint: unknown checks: {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    if args.baseline is not None:
+        baseline_path = args.baseline or None
+    else:
+        rel = cfg.get("baseline", os.path.join("tools", "tpulint_baseline.json"))
+        baseline_path = os.path.join(cfg_root, rel)
+
+    # ---- run --------------------------------------------------------------
+    t0 = time.monotonic()
+    project = discover(paths)
+    analyze(project)
+    findings = run_checks(project, enabled)
+    # config-level excludes (path prefixes relative to the report root)
+    for pat in cfg.get("exclude", []):
+        findings = [f for f in findings if not f.file.startswith(pat)]
+    elapsed = time.monotonic() - t0
+
+    base = {} if (args.no_baseline or not baseline_path) else baseline_mod.load(baseline_path)
+    new, accepted, stale = baseline_mod.split(findings, base)
+    # Stale entries gate FULL runs only: a leftover fingerprint would
+    # silently re-accept the same bug if it were ever reintroduced, so the
+    # baseline must shrink when findings are fixed. On an explicit path
+    # slice most of the baseline is legitimately unmatched — report, don't
+    # fail.
+    full_run = not args.paths
+
+    if args.write_baseline:
+        if not baseline_path:
+            print("tpulint: --write-baseline needs a baseline path", file=sys.stderr)
+            return 2
+        baseline_mod.write(baseline_path, findings, old=base)
+        print(
+            f"tpulint: wrote {len(findings)} findings to {baseline_path} "
+            f"({len(new)} newly accepted)"
+        )
+        return 0
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "new": [vars(f) | {"fingerprint": f.fingerprint} for f in new],
+                    "accepted": len(accepted),
+                    "stale_baseline": [e["fingerprint"] for e in stale],
+                    "elapsed_s": round(elapsed, 2),
+                },
+                indent=1,
+                default=str,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(
+                f"\ntpulint: {len(stale)} stale baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} (finding fixed — delete "
+                f"from {baseline_path}"
+                + ("; stale entries FAIL full runs" if full_run else "")
+                + "):"
+            )
+            for e in stale:
+                print(f"    {e['fingerprint']}  {e['file']}  [{e['check']}] {e['qualname']}")
+        summary = (
+            f"tpulint: {len(new)} new, {len(accepted)} baselined, "
+            f"{len(stale)} stale baseline entries; "
+            f"{len(project.modules)} modules in {elapsed:.1f}s"
+        )
+        print(("\n" if new else "") + summary)
+        if args.stats:
+            nfuncs = len(project.functions)
+            nlocks = len(getattr(project, "locks", {}))
+            nblocks = sum(len(f.block_sites) for f in project.functions.values())
+            print(
+                f"tpulint: stats: {nfuncs} functions, {nlocks} locks, "
+                f"{nblocks} blocking sites, {len(project.errors)} parse errors"
+            )
+            for file, msg in project.errors:
+                print(f"    {file}: {msg}")
+
+    return 1 if new or (stale and full_run) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,392 @@
+"""Project indexing for tpulint.
+
+Walks the target trees once, parses every ``.py`` file, and builds:
+
+- a module index (dotted name -> AST, imports, module-level functions/locks)
+- a class index (methods, lock-typed attributes, project-typed attributes)
+- an inline-suppression index (``# tpulint: disable=check-a,check-b``)
+
+Lock discovery recognises ``threading.Lock/RLock/Condition/Event/Semaphore``
+and ``queue.Queue/LifoQueue/PriorityQueue/SimpleQueue`` constructor calls —
+as module-level globals, as ``self.x = ...`` in any method, and as
+dict-of-lock tables (``self.tbl[k] = RLock()`` registers ``tbl[*]``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from .model import ClassInfo, FuncInfo, LockInfo, SourceLoc
+
+_THREADING_LOCKS = {
+    "Lock": ("lock", False),
+    "RLock": ("rlock", True),
+    "Condition": ("condition", True),
+    "Event": ("event", False),
+    "Semaphore": ("semaphore", False),
+    "BoundedSemaphore": ("semaphore", False),
+}
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([\w\-, ]+)")
+
+
+@dataclass
+class ModuleInfo:
+    name: str  # dotted module name
+    file: str  # repo-relative posix path
+    tree: ast.Module = field(repr=False, default=None)
+    # imported alias -> dotted target ("from a import b" -> b: "a.b",
+    # "import a.b as c" -> c: "a.b", "import a" -> a: "a")
+    imports: dict = field(default_factory=dict)
+    functions: dict = field(default_factory=dict)  # name -> FuncInfo
+    classes: dict = field(default_factory=dict)  # name -> ClassInfo
+    global_locks: dict = field(default_factory=dict)  # name -> LockInfo
+    suppress: dict = field(default_factory=dict)  # line -> set(check ids)
+
+
+@dataclass
+class Project:
+    root: str  # absolute path all file paths are reported relative to
+    modules: dict = field(default_factory=dict)  # dotted name -> ModuleInfo
+    classes: dict = field(default_factory=dict)  # qualkey -> ClassInfo
+    functions: dict = field(default_factory=dict)  # qualname -> FuncInfo
+    errors: list = field(default_factory=list)  # (file, message)
+
+    def suppressed(self, file: str, line: int, check: str) -> bool:
+        mod = self._by_file.get(file)
+        if mod is None:
+            return False
+        marks = mod.suppress.get(line)
+        return bool(marks) and (check in marks or "all" in marks)
+
+    @property
+    def _by_file(self):
+        cache = getattr(self, "_by_file_cache", None)
+        if cache is None:
+            cache = {m.file: m for m in self.modules.values()}
+            self._by_file_cache = cache
+        return cache
+
+    def resolve_class(self, qualkey: str) -> ClassInfo | None:
+        return self.classes.get(qualkey)
+
+    def mro_lock_attr(self, cls: ClassInfo, attr: str) -> LockInfo | None:
+        """Look up a lock attr on the class, then single-level project bases."""
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c.qualkey in seen:
+                continue
+            seen.add(c.qualkey)
+            if attr in c.lock_attrs:
+                return c.lock_attrs[attr]
+            for b in c.bases:
+                bc = self.classes.get(b)
+                if bc is not None:
+                    stack.append(bc)
+        return None
+
+    def mro_method(self, cls: ClassInfo, name: str) -> FuncInfo | None:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop(0)
+            if c.qualkey in seen:
+                continue
+            seen.add(c.qualkey)
+            if name in c.methods:
+                return c.methods[name]
+            for b in c.bases:
+                bc = self.classes.get(b)
+                if bc is not None:
+                    stack.append(bc)
+        return None
+
+
+def _iter_py_files(path: str):
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in {"__pycache__", ".git", "node_modules"}
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _module_name(root: str, fpath: str) -> str:
+    rel = os.path.relpath(fpath, os.path.dirname(root) or ".")
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = rel.replace(os.sep, "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p) or "module"
+
+
+def _collect_imports(tree: ast.Module) -> dict:
+    imports: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                imports[al.asname or al.name.split(".")[0]] = (
+                    al.name if al.asname else al.name.split(".")[0]
+                )
+                if al.asname:
+                    imports[al.asname] = al.name
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for al in node.names:
+                if al.name == "*":
+                    continue
+                imports[al.asname or al.name] = f"{node.module}.{al.name}"
+    return imports
+
+
+def _unwrap_register(call: ast.expr) -> ast.expr:
+    """`locktrace.register_lock("name", Lock())` -> the inner ctor call, so
+    watchdog registration doesn't blind the analyzer to a lock."""
+    if (
+        isinstance(call, ast.Call)
+        and isinstance(call.func, (ast.Attribute, ast.Name))
+        and (
+            call.func.attr if isinstance(call.func, ast.Attribute) else call.func.id
+        )
+        == "register_lock"
+        and len(call.args) >= 2
+    ):
+        return call.args[1]
+    return call
+
+
+def _lock_ctor(call: ast.expr, imports: dict) -> tuple[str, bool] | None:
+    """Return (kind, reentrant) if the expression constructs a lock-ish object."""
+    call = _unwrap_register(call)
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    name = None
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        base = imports.get(fn.value.id, fn.value.id)
+        if base in ("threading", "queue", "collections", "multiprocessing"):
+            name = fn.attr
+    elif isinstance(fn, ast.Name):
+        target = imports.get(fn.id, "")
+        if target.startswith(("threading.", "queue.", "collections.")):
+            name = target.split(".")[-1]
+    if name is None:
+        return None
+    if name in _THREADING_LOCKS:
+        return _THREADING_LOCKS[name]
+    if name in _QUEUE_CTORS:
+        return ("queue", False)
+    return None
+
+
+def _condition_underlying(
+    call: ast.Call, owner_prefix: str, imports: dict
+) -> str | None:
+    """`Condition(self.lock)` / `Condition(GLOBAL)` -> wrapped lock id."""
+    call = _unwrap_register(call)
+    if not isinstance(call, ast.Call) or not call.args:
+        return None
+    arg = call.args[0]
+    if (
+        isinstance(arg, ast.Attribute)
+        and isinstance(arg.value, ast.Name)
+        and arg.value.id == "self"
+    ):
+        return f"{owner_prefix}.{arg.attr}"
+    if isinstance(arg, ast.Name):
+        return None  # resolved lazily by the engine against module globals
+    return None
+
+
+def _scan_suppressions(src: str) -> dict:
+    out: dict[int, set] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {c.strip() for c in m.group(1).split(",") if c.strip()}
+    return out
+
+
+def _register_func(
+    project: Project, mod: ModuleInfo, node, cls: ClassInfo | None
+) -> FuncInfo:
+    if cls is not None:
+        qual = f"{cls.qualkey}.{node.name}"
+    else:
+        qual = f"{mod.name}.{node.name}"
+    info = FuncInfo(
+        qualname=qual,
+        module=mod.name,
+        cls=cls.qualkey if cls else None,
+        name=node.name,
+        file=mod.file,
+        line=node.lineno,
+        is_async=isinstance(node, ast.AsyncFunctionDef),
+        node=node,
+    )
+    project.functions[qual] = info
+    if cls is not None:
+        cls.methods[node.name] = info
+    else:
+        mod.functions[node.name] = info
+    return info
+
+
+def _discover_class_attrs(project: Project, mod: ModuleInfo, cls: ClassInfo):
+    """Scan every method body for `self.x = <lock ctor>` / typed attrs."""
+    for meth in cls.methods.values():
+        for node in ast.walk(meth.node):
+            tgt = None
+            val = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt, val = node.target, node.value
+            if tgt is None:
+                continue
+            # self.attr = ...
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                attr = tgt.attr
+                kind = _lock_ctor(val, mod.imports)
+                if kind is not None:
+                    lock_id = f"{cls.qualkey}.{attr}"
+                    underlying = None
+                    if kind[0] == "condition":
+                        underlying = _condition_underlying(
+                            val, cls.qualkey, mod.imports
+                        )
+                    cls.lock_attrs[attr] = LockInfo(
+                        lock_id=lock_id,
+                        kind=kind[0],
+                        underlying=underlying,
+                        loc=SourceLoc(mod.file, node.lineno),
+                        reentrant=kind[1],
+                    )
+                elif isinstance(val, ast.Call):
+                    cname = None
+                    if isinstance(val.func, ast.Name):
+                        cname = mod.imports.get(val.func.id, None)
+                        if cname is None and val.func.id in mod.classes:
+                            cname = f"{mod.name}.{val.func.id}"
+                    elif isinstance(val.func, ast.Attribute) and isinstance(
+                        val.func.value, ast.Name
+                    ):
+                        base = mod.imports.get(val.func.value.id)
+                        if base:
+                            cname = f"{base}.{val.func.attr}"
+                    if cname:
+                        cls.attr_types.setdefault(attr, cname)
+            # self.table[key] = Lock()  -> dict-of-locks
+            elif (
+                isinstance(tgt, ast.Subscript)
+                and isinstance(tgt.value, ast.Attribute)
+                and isinstance(tgt.value.value, ast.Name)
+                and tgt.value.value.id == "self"
+            ):
+                kind = _lock_ctor(val, mod.imports)
+                if kind is not None:
+                    attr = f"{tgt.value.attr}[*]"
+                    cls.lock_attrs.setdefault(
+                        attr,
+                        LockInfo(
+                            lock_id=f"{cls.qualkey}.{attr}",
+                            kind=kind[0],
+                            underlying=None,
+                            loc=SourceLoc(mod.file, node.lineno),
+                            reentrant=kind[1],
+                        ),
+                    )
+
+
+def _discover_module(project: Project, root: str, fpath: str):
+    relfile = os.path.relpath(fpath, project.root).replace(os.sep, "/")
+    try:
+        with open(fpath, encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=relfile)
+    except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        project.errors.append((relfile, f"parse error: {e}"))
+        return
+    mod = ModuleInfo(name=_module_name(root, fpath), file=relfile, tree=tree)
+    mod.imports = _collect_imports(tree)
+    mod.suppress = _scan_suppressions(src)
+    project.modules[mod.name] = mod
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _register_func(project, mod, node, None)
+        elif isinstance(node, ast.ClassDef):
+            cls = ClassInfo(
+                qualkey=f"{mod.name}.{node.name}",
+                module=mod.name,
+                name=node.name,
+                file=relfile,
+                line=node.lineno,
+            )
+            for b in node.bases:
+                if isinstance(b, ast.Name):
+                    cand = mod.imports.get(b.id, f"{mod.name}.{b.id}")
+                    cls.bases.append(cand)
+                elif isinstance(b, ast.Attribute) and isinstance(b.value, ast.Name):
+                    base = mod.imports.get(b.value.id, b.value.id)
+                    cls.bases.append(f"{base}.{b.attr}")
+            mod.classes[node.name] = cls
+            project.classes[cls.qualkey] = cls
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _register_func(project, mod, sub, cls)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else ([node.target] if node.value is not None else [])
+            )
+            val = node.value
+            kind = _lock_ctor(val, mod.imports) if val is not None else None
+            if kind is None:
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    lock_id = f"{mod.name}.{tgt.id}"
+                    underlying = None
+                    if kind[0] == "condition" and isinstance(val, ast.Call):
+                        underlying = _condition_underlying(val, mod.name, mod.imports)
+                    mod.global_locks[tgt.id] = LockInfo(
+                        lock_id=lock_id,
+                        kind=kind[0],
+                        underlying=underlying,
+                        loc=SourceLoc(relfile, node.lineno),
+                        reentrant=kind[1],
+                    )
+
+
+def discover(paths: list, root: str | None = None) -> Project:
+    """Index every .py under `paths`. Paths and findings are reported
+    relative to `root` (default: common parent of the paths)."""
+    paths = [os.path.abspath(p) for p in paths]
+    if root is None:
+        root = os.path.commonpath([os.path.dirname(p) if os.path.isfile(p) else p for p in paths])
+        # report relative to the parent of the first tree so package dirs
+        # show up in paths (ray_tpu/...)
+        root = os.path.dirname(root) or root
+    project = Project(root=os.path.abspath(root))
+    for p in paths:
+        for fpath in _iter_py_files(p):
+            _discover_module(project, p, fpath)
+    for mod in project.modules.values():
+        for cls in mod.classes.values():
+            _discover_class_attrs(project, mod, cls)
+    return project

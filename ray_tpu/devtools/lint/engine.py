@@ -1,0 +1,735 @@
+"""tpulint analysis engine.
+
+Per-function *held-lock-set* tracking (Eraser-style lockset, intraprocedural
+over `with`/`acquire`/`release`), blocking-primitive classification, project
+call-graph resolution, and an interprocedural fixed point that summarises for
+every function (a) whether it can block (with a witness call chain down to
+the primitive, and which locks the primitive releases while blocked — a
+`Condition.wait` drops its wrapped lock) and (b) which locks it transitively
+acquires (for lock-order edges at call sites under a held lock).
+
+The walker is deliberately over-approximate in the classic static-analysis
+way (branches analysed with the entry lockset; acquire/release inside a
+branch do not escape it) — precision comes from the project's lock idiom
+being overwhelmingly `with lock:` blocks.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .discovery import ModuleInfo, Project
+from .model import (
+    AcquireSite,
+    AcquireWitness,
+    BlockSite,
+    BlockWitness,
+    CallSite,
+    ClassInfo,
+    FuncInfo,
+    LockInfo,
+    MutationSite,
+    SourceLoc,
+    ThreadCreate,
+)
+
+_SOCKET_BLOCKING_METHODS = {"recv", "recv_into", "recvfrom", "accept"}
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
+_QUEUEISH_NAME_HINTS = ("queue", "_q", "inbox", "mailbox")
+
+
+def _kwarg(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _has_timeout(call: ast.Call, pos: int = 0) -> bool:
+    """True if the call passes a (non-None) timeout positionally or by kw."""
+    v = _kwarg(call, "timeout")
+    if v is None and len(call.args) > pos:
+        v = call.args[pos]
+    if v is None:
+        return False
+    return not (isinstance(v, ast.Constant) and v.value is None)
+
+
+def _queue_get_timed(call: ast.Call) -> bool:
+    block = _kwarg(call, "block")
+    if block is None and len(call.args) >= 1:
+        block = call.args[0]
+    if isinstance(block, ast.Constant) and block.value is False:
+        return True
+    return _has_timeout(call, pos=1)
+
+
+def _name_looks_queueish(name: str) -> bool:
+    low = name.lower()
+    return any(h in low for h in _QUEUEISH_NAME_HINTS)
+
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "<expr>"
+
+
+def _self_attr_of(expr: ast.expr) -> str | None:
+    """`self.x` or `getattr(self, "x"[, default])` -> "x"."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Name)
+        and expr.func.id == "getattr"
+        and len(expr.args) >= 2
+        and isinstance(expr.args[0], ast.Name)
+        and expr.args[0].id == "self"
+        and isinstance(expr.args[1], ast.Constant)
+        and isinstance(expr.args[1].value, str)
+    ):
+        return expr.args[1].value
+
+
+class _Ctx:
+    """Per-function resolution context."""
+
+    def __init__(self, project: Project, mod: ModuleInfo, cls, func: FuncInfo):
+        self.project = project
+        self.mod = mod
+        self.cls: ClassInfo | None = cls
+        self.func = func
+        # local name -> ("lock", effective_held_id, LockInfo)
+        #            | ("instance", class qualkey)
+        #            | ("thread", ThreadCreate)
+        self.aliases: dict[str, tuple] = {}
+
+    # -- lock resolution ---------------------------------------------------
+
+    def lock_info_for(self, lock_id: str) -> LockInfo | None:
+        return self.project.locks.get(lock_id)
+
+    def resolve_lock(self, expr: ast.expr):
+        """Resolve an expression to (effective_held_id, LockInfo) or None.
+
+        For a Condition the effective held id is its wrapped lock (if known),
+        so `with self.cv:` and `with self.lock:` conflict correctly when
+        `cv = Condition(self.lock)`.
+        """
+        info = None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self.cls is not None
+        ):
+            info = self.project.mro_lock_attr(self.cls, expr.attr)
+        elif isinstance(expr, ast.Name):
+            al = self.aliases.get(expr.id)
+            if al is not None and al[0] == "lock":
+                return al[1], al[2]
+            info = self.mod.global_locks.get(expr.id)
+            if info is None:
+                # from other_mod import THE_LOCK
+                target = self.mod.imports.get(expr.id)
+                if target and "." in target:
+                    m, _, n = target.rpartition(".")
+                    other = self.project.modules.get(m)
+                    if other is not None:
+                        info = other.global_locks.get(n)
+        elif (
+            isinstance(expr, ast.Subscript)
+            and isinstance(expr.value, ast.Attribute)
+            and isinstance(expr.value.value, ast.Name)
+            and expr.value.value.id == "self"
+            and self.cls is not None
+        ):
+            info = self.project.mro_lock_attr(self.cls, f"{expr.value.attr}[*]")
+        if info is None:
+            return None
+        if info.kind in ("event", "queue"):
+            return None  # not holdable
+        held_id = info.underlying or info.lock_id
+        return held_id, info
+
+    def receiver_kind(self, expr: ast.expr):
+        """Classify a method-call receiver: ("event"|"condition"|"queue"|
+        "lock", LockInfo) | ("module", dotted) | ("instance", qualkey) |
+        ("thread", None) | ("name", text) | None."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self.cls is not None
+        ):
+            info = self.project.mro_lock_attr(self.cls, expr.attr)
+            if info is not None:
+                return (
+                    info.kind if info.kind in ("event", "condition", "queue") else "lock",
+                    info,
+                )
+            ty = self.cls.attr_types.get(expr.attr)
+            if ty == "threading.Thread":
+                return ("thread", None)
+            if ty and ty in self.project.classes:
+                return ("instance", ty)
+            # discovery saw every `self.x = ...` in the class; an attr it did
+            # NOT type as a queue must not fall back to name guessing (dicts
+            # named `*_queues` broke this)
+            return ("selfattr", expr.attr)
+        if isinstance(expr, ast.Name):
+            al = self.aliases.get(expr.id)
+            if al is not None:
+                if al[0] == "lock":
+                    info = al[2]
+                    return (
+                        info.kind
+                        if info.kind in ("event", "condition", "queue")
+                        else "lock",
+                        info,
+                    )
+                if al[0] == "instance":
+                    return ("instance", al[1])
+                if al[0] in ("thread", "threadattr"):
+                    return ("thread", None)
+            info = self.mod.global_locks.get(expr.id)
+            if info is not None:
+                return (
+                    info.kind if info.kind in ("event", "condition", "queue") else "lock",
+                    info,
+                )
+            target = self.mod.imports.get(expr.id)
+            if target is not None:
+                return ("module", target)
+            return ("name", expr.id)
+        return None
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_callee(self, call: ast.Call) -> str | None:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if (
+                isinstance(recv, ast.Name)
+                and recv.id == "self"
+                and self.cls is not None
+            ):
+                m = self.project.mro_method(self.cls, fn.attr)
+                return m.qualname if m else None
+            if isinstance(recv, ast.Name):
+                al = self.aliases.get(recv.id)
+                if al is not None and al[0] == "instance":
+                    c = self.project.classes.get(al[1])
+                    if c is not None:
+                        m = self.project.mro_method(c, fn.attr)
+                        return m.qualname if m else None
+                target = self.mod.imports.get(recv.id)
+                if target is not None and target in self.project.modules:
+                    other = self.project.modules[target]
+                    f = other.functions.get(fn.attr)
+                    return f.qualname if f else None
+            if (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+                and self.cls is not None
+            ):
+                ty = self.cls.attr_types.get(recv.attr)
+                if ty and ty in self.project.classes:
+                    m = self.project.mro_method(self.project.classes[ty], fn.attr)
+                    return m.qualname if m else None
+            return None
+        if isinstance(fn, ast.Name):
+            f = self.mod.functions.get(fn.id)
+            if f is not None:
+                return f.qualname
+            target = self.mod.imports.get(fn.id)
+            if target is not None and target in self.project.functions:
+                return target
+            return None
+        return None
+
+    # -- blocking classification -------------------------------------------
+
+    def classify_blocking(self, call: ast.Call):
+        """Return (kind, desc, releases frozenset, timed bool) or None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            target = self.mod.imports.get(fn.id, "")
+            if target == "time.sleep":
+                return ("time.sleep", _expr_text(call), frozenset(), False)
+            if target in ("ray_tpu.get", "ray_tpu.wait"):
+                return (target, _expr_text(call), frozenset(), _has_timeout(call, 99))
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        meth = fn.attr
+        rk = self.receiver_kind(fn.value)
+
+        if rk is not None and rk[0] == "module":
+            dotted = rk[1]
+            if dotted == "time" and meth == "sleep":
+                return ("time.sleep", _expr_text(call), frozenset(), False)
+            if dotted == "subprocess" and meth in _SUBPROCESS_BLOCKING:
+                return ("subprocess", _expr_text(call), frozenset(), False)
+            if dotted.split(".")[0] == "ray_tpu" and meth in ("get", "wait"):
+                return (
+                    f"ray_tpu.{meth}",
+                    _expr_text(call),
+                    frozenset(),
+                    _has_timeout(call, 99),
+                )
+            if dotted == "select" and meth == "select":
+                return ("select.select", _expr_text(call), frozenset(), len(call.args) >= 4)
+            return None
+
+        if meth == "wait":
+            if rk is not None and rk[0] == "event":
+                return ("Event.wait", _expr_text(call), frozenset(), _has_timeout(call))
+            if rk is not None and rk[0] == "condition":
+                info = rk[1]
+                held_id = info.underlying or info.lock_id
+                return (
+                    "Condition.wait",
+                    _expr_text(call),
+                    frozenset({held_id}),
+                    _has_timeout(call),
+                )
+            if rk is not None and rk[0] == "lock":
+                return None
+            # unknown receiver: Popen.wait / futures.wait / passed-in events
+            return ("wait", _expr_text(call), frozenset(), _has_timeout(call))
+        if meth == "wait_for" and rk is not None and rk[0] == "condition":
+            info = rk[1]
+            held_id = info.underlying or info.lock_id
+            return (
+                "Condition.wait_for",
+                _expr_text(call),
+                frozenset({held_id}),
+                _has_timeout(call, pos=1),
+            )
+        if meth == "get":
+            if rk is not None and rk[0] == "queue":
+                return ("queue.get", _expr_text(call), frozenset(), _queue_get_timed(call))
+            # local-name heuristic only — self attrs are typed by discovery
+            if rk is not None and rk[0] == "name" and _name_looks_queueish(rk[1]):
+                return ("queue.get", _expr_text(call), frozenset(), _queue_get_timed(call))
+            return None
+        if meth == "join":
+            if rk is not None and rk[0] == "thread":
+                return ("Thread.join", _expr_text(call), frozenset(), _has_timeout(call))
+            if rk is not None and rk[0] == "queue":
+                return ("queue.join", _expr_text(call), frozenset(), False)
+            return None
+        if meth in _SOCKET_BLOCKING_METHODS:
+            return ("socket." + meth, _expr_text(call), frozenset(), False)
+        if meth == "communicate":
+            return ("subprocess.communicate", _expr_text(call), frozenset(), _has_timeout(call))
+        if meth == "result" and rk is not None and rk[0] in ("name",) and (
+            "fut" in rk[1].lower() or "promise" in rk[1].lower()
+        ):
+            return ("Future.result", _expr_text(call), frozenset(), _has_timeout(call))
+        return None
+
+    # -- thread ctor --------------------------------------------------------
+
+    def is_thread_ctor(self, call: ast.Call) -> bool:
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            return (
+                self.mod.imports.get(fn.value.id, fn.value.id) == "threading"
+                and fn.attr == "Thread"
+            )
+        if isinstance(fn, ast.Name):
+            return self.mod.imports.get(fn.id, "") == "threading.Thread"
+        return False
+
+    def thread_target_method(self, call: ast.Call) -> str | None:
+        tgt = _kwarg(call, "target")
+        if (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"
+        ):
+            return tgt.attr
+        return None
+
+    def thread_daemon(self, call: ast.Call) -> bool:
+        d = _kwarg(call, "daemon")
+        return isinstance(d, ast.Constant) and d.value is True
+
+
+class _FuncWalker:
+    def __init__(self, ctx: _Ctx):
+        self.ctx = ctx
+        self.f = ctx.func
+        self.in_init = ctx.func.name in ("__init__", "__new__")
+
+    def run(self):
+        self.walk_block(self.f.node.body, [])
+
+    # held is a list of effective lock ids in acquisition order
+    def walk_block(self, stmts, held):
+        held = list(held)
+        for s in stmts:
+            held = self.walk_stmt(s, held)
+        return held
+
+    def walk_stmt(self, s, held):
+        ctx = self.ctx
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return held  # nested scopes analysed separately (or not at all)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            pushed = []
+            for item in s.items:
+                self.scan_expr(item.context_expr, held, top_call_is_ctx=True)
+                r = ctx.resolve_lock(item.context_expr)
+                if r is not None:
+                    held_id, info = r
+                    self.f.acquire_sites.append(
+                        AcquireSite(
+                            line=item.context_expr.lineno,
+                            lock_id=held_id,
+                            held_before=tuple(held),
+                            reentrant=info.reentrant,
+                        )
+                    )
+                    held = held + [held_id]
+                    pushed.append(held_id)
+            self.walk_block(s.body, held)
+            for _ in pushed:
+                held = held[:-1]
+            return held
+        if isinstance(s, ast.If):
+            self.scan_expr(s.test, held)
+            self.walk_block(s.body, held)
+            self.walk_block(s.orelse, held)
+            return held
+        if isinstance(s, (ast.While,)):
+            self.scan_expr(s.test, held)
+            self.walk_block(s.body, held)
+            self.walk_block(s.orelse, held)
+            return held
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self.scan_expr(s.iter, held)
+            self.walk_block(s.body, held)
+            self.walk_block(s.orelse, held)
+            return held
+        if isinstance(s, ast.Try) or (
+            hasattr(ast, "TryStar") and isinstance(s, getattr(ast, "TryStar"))
+        ):
+            held = self.walk_block(s.body, held)
+            for h in s.handlers:
+                self.walk_block(h.body, held)
+            self.walk_block(s.orelse, held)
+            held = self.walk_block(s.finalbody, held)
+            return held
+        if isinstance(s, ast.Expr):
+            call = s.value if isinstance(s.value, ast.Call) else None
+            if call is not None and isinstance(call.func, ast.Attribute):
+                meth = call.func.attr
+                if meth in ("acquire", "release"):
+                    r = ctx.resolve_lock(call.func.value)
+                    if r is not None:
+                        held_id, info = r
+                        if meth == "acquire":
+                            self.f.acquire_sites.append(
+                                AcquireSite(
+                                    line=s.lineno,
+                                    lock_id=held_id,
+                                    held_before=tuple(held),
+                                    reentrant=info.reentrant,
+                                )
+                            )
+                            return held + [held_id]
+                        if held_id in held:
+                            held = list(held)
+                            held.reverse()
+                            held.remove(held_id)
+                            held.reverse()
+                        return held
+                # thread lifecycle on statements like `self.t.start()`
+                self._note_thread_lifecycle(call)
+            self.scan_expr(s.value, held)
+            return held
+        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._handle_assign(s, held)
+            return held
+        if isinstance(s, (ast.Return, ast.Raise, ast.Assert, ast.Delete)):
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self.scan_expr(child, held)
+            return held
+        return held
+
+    def _note_thread_lifecycle(self, call: ast.Call):
+        fn = call.func
+        # locktrace.join_if_alive(self._t, timeout=...) — the shared bounded
+        # join helper counts as joining its first argument
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if fname == "join_if_alive" and call.args:
+            arg0 = call.args[0]
+            attr = _self_attr_of(arg0)
+            if attr is not None:
+                self.f.joined_attrs.add(attr)
+            elif isinstance(arg0, ast.Name):
+                al = self.ctx.aliases.get(arg0.id)
+                if al is not None and al[0] == "threadattr":
+                    self.f.joined_attrs.add(al[1])
+                else:
+                    self.f.joined_locals.add(arg0.id)
+            return
+        if not isinstance(fn, ast.Attribute) or fn.attr not in ("start", "join"):
+            return
+        recv = fn.value
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+        ):
+            if fn.attr == "start":
+                self.f.thread_creates.append(
+                    ThreadCreate(
+                        line=call.lineno,
+                        attr=recv.attr,
+                        local=None,
+                        target=None,
+                        daemon=False,
+                        started=True,
+                    )
+                )
+            else:
+                self.f.joined_attrs.add(recv.attr)
+        elif isinstance(recv, ast.Name):
+            al = self.ctx.aliases.get(recv.id)
+            if al is not None and al[0] == "thread":
+                if fn.attr == "start":
+                    al[1].started = True
+                else:
+                    self.f.joined_locals.add(recv.id)
+            elif al is not None and al[0] == "threadattr":
+                if fn.attr == "start":
+                    self.f.thread_creates.append(
+                        ThreadCreate(
+                            line=call.lineno,
+                            attr=al[1],
+                            local=None,
+                            target=None,
+                            daemon=False,
+                            started=True,
+                        )
+                    )
+                else:
+                    self.f.joined_attrs.add(al[1])
+
+    def _handle_assign(self, s, held):
+        ctx = self.ctx
+        if isinstance(s, ast.AugAssign):
+            targets, value = [s.target], s.value
+        elif isinstance(s, ast.AnnAssign):
+            targets = [s.target]
+            value = s.value
+        else:
+            targets, value = s.targets, s.value
+        if value is not None:
+            self.scan_expr(value, held)
+
+        for tgt in targets:
+            # alias / thread-create tracking
+            if isinstance(tgt, ast.Name) and value is not None:
+                r = ctx.resolve_lock(value)
+                if r is not None:
+                    ctx.aliases[tgt.id] = ("lock", r[0], r[1])
+                    continue
+                # `t = self._thread` / `t = getattr(self, "_thread", None)`
+                # where the attr is Thread-typed: joins on `t` count for the
+                # attr (the standard bounded-join idiom snapshots the attr)
+                src_attr = _self_attr_of(value)
+                if (
+                    src_attr is not None
+                    and ctx.cls is not None
+                    and ctx.cls.attr_types.get(src_attr) == "threading.Thread"
+                ):
+                    ctx.aliases[tgt.id] = ("threadattr", src_attr)
+                    continue
+                if isinstance(value, ast.Call):
+                    if ctx.is_thread_ctor(value):
+                        tc = ThreadCreate(
+                            line=s.lineno,
+                            attr=None,
+                            local=tgt.id,
+                            target=ctx.thread_target_method(value),
+                            daemon=ctx.thread_daemon(value),
+                        )
+                        self.f.thread_creates.append(tc)
+                        ctx.aliases[tgt.id] = ("thread", tc)
+                        continue
+                    cname = None
+                    if isinstance(value.func, ast.Name):
+                        cand = ctx.mod.imports.get(
+                            value.func.id, f"{ctx.mod.name}.{value.func.id}"
+                        )
+                        if cand in ctx.project.classes:
+                            cname = cand
+                    if cname:
+                        ctx.aliases[tgt.id] = ("instance", cname)
+                        continue
+                ctx.aliases.pop(tgt.id, None)
+            elif (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                if (
+                    value is not None
+                    and isinstance(value, ast.Call)
+                    and ctx.is_thread_ctor(value)
+                ):
+                    self.f.thread_creates.append(
+                        ThreadCreate(
+                            line=s.lineno,
+                            attr=tgt.attr,
+                            local=None,
+                            target=ctx.thread_target_method(value),
+                            daemon=ctx.thread_daemon(value),
+                        )
+                    )
+                if not self.in_init:
+                    self.f.mutations.append(
+                        MutationSite(
+                            attr=tgt.attr,
+                            line=s.lineno,
+                            held=frozenset(held),
+                            constant_only=isinstance(value, ast.Constant),
+                        )
+                    )
+
+    # -- expression scan ----------------------------------------------------
+
+    def scan_expr(self, expr, held, awaited=False, top_call_is_ctx=False):
+        if expr is None:
+            return
+        if isinstance(expr, ast.Await):
+            self.scan_expr(expr.value, held, awaited=True)
+            return
+        if isinstance(expr, ast.Lambda):
+            return
+        if isinstance(expr, ast.Call):
+            self._handle_call(expr, held, awaited, as_ctx=top_call_is_ctx)
+            self.scan_expr(expr.func if not isinstance(expr.func, (ast.Name, ast.Attribute)) else None, held)
+            # receivers of the call func still need scanning for inner calls
+            if isinstance(expr.func, ast.Attribute):
+                self.scan_expr(expr.func.value, held)
+            for a in expr.args:
+                self.scan_expr(a, held)
+            for kw in expr.keywords:
+                self.scan_expr(kw.value, held)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self.scan_expr(child, held, awaited=False)
+            elif isinstance(child, (ast.comprehension,)):
+                self.scan_expr(child.iter, held)
+                for cond in child.ifs:
+                    self.scan_expr(cond, held)
+
+    def _handle_call(self, call: ast.Call, held, awaited, as_ctx=False):
+        ctx = self.ctx
+        if as_ctx and ctx.resolve_lock(call) is not None:
+            return  # `with Lock():` style — not a blocking call
+        self._note_thread_lifecycle(call)
+        b = ctx.classify_blocking(call)
+        if b is not None:
+            kind, desc, releases, timed = b
+            self.f.block_sites.append(
+                BlockSite(
+                    line=call.lineno,
+                    witness=BlockWitness(
+                        kind=kind,
+                        desc=desc,
+                        loc=SourceLoc(self.f.file, call.lineno),
+                        releases=releases,
+                    ),
+                    held=tuple(held),
+                    timed=timed,
+                )
+            )
+        callee = ctx.resolve_callee(call)
+        if callee is not None and callee != self.f.qualname:
+            self.f.call_sites.append(
+                CallSite(
+                    line=call.lineno,
+                    callee=callee,
+                    held=tuple(held),
+                    awaited=awaited,
+                    desc=_expr_text(call.func),
+                )
+            )
+
+
+def _collect_locks(project: Project):
+    locks: dict[str, LockInfo] = {}
+    for mod in project.modules.values():
+        for info in mod.global_locks.values():
+            locks[info.lock_id] = info
+    for cls in project.classes.values():
+        for info in cls.lock_attrs.values():
+            locks[info.lock_id] = info
+    project.locks = locks
+
+
+def analyze(project: Project) -> Project:
+    """Walk every function, then run the interprocedural fixed point."""
+    _collect_locks(project)
+    for func in project.functions.values():
+        mod = project.modules.get(func.module)
+        if mod is None or func.node is None:
+            continue
+        cls = project.classes.get(func.cls) if func.cls else None
+        walker = _FuncWalker(_Ctx(project, mod, cls, func))
+        try:
+            walker.run()
+        except RecursionError:  # pathological nesting; skip the function
+            project.errors.append((func.file, f"walker overflow in {func.qualname}"))
+
+    funcs = project.functions
+    # seed summaries from direct facts
+    for f in funcs.values():
+        for bs in f.block_sites:
+            if not bs.timed and not f.is_async:
+                f.summary_blocks = bs.witness
+                break
+        for a in f.acquire_sites:
+            f.summary_acquires.setdefault(
+                a.lock_id,
+                AcquireWitness(lock_id=a.lock_id, loc=SourceLoc(f.file, a.line)),
+            )
+    # fixed point over the call graph
+    for _ in range(30):
+        changed = False
+        for f in funcs.values():
+            for cs in f.call_sites:
+                callee = funcs.get(cs.callee)
+                if callee is None or callee.is_async:
+                    continue
+                hop = f"{cs.desc}() at {f.file}:{cs.line}"
+                if f.summary_blocks is None and callee.summary_blocks is not None:
+                    if not f.is_async:
+                        f.summary_blocks = callee.summary_blocks.chained(hop)
+                        changed = True
+                for lock_id, aw in callee.summary_acquires.items():
+                    if lock_id not in f.summary_acquires:
+                        f.summary_acquires[lock_id] = aw.chained(hop)
+                        changed = True
+        if not changed:
+            break
+    return project

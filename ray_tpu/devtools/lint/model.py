@@ -1,0 +1,223 @@
+"""Data model for tpulint: findings, lock identities, per-function facts.
+
+Lock identity is a *static* name — ``module.Class.attr`` for instance locks,
+``module.NAME`` for module globals, with a ``[*]`` suffix for dict-of-lock
+tables (all instances of a table share one static identity; this is the usual
+lockset over-approximation, cf. Eraser's lockset discipline). A Condition is
+identified by the lock it wraps: acquiring ``self.cv`` where
+``cv = Condition(self.lock)`` holds ``...lock``, and ``cv.wait()`` *releases*
+it for the duration of the wait — the analysis models both.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+from dataclasses import dataclass, field
+
+# Check families (the catalog). Keys are the ids used by `--checks`,
+# `# tpulint: disable=<id>`, and the baseline file.
+CHECKS: dict[str, str] = {
+    "blocking-under-lock": (
+        "a blocking call (time.sleep, untimed Event/Condition wait, socket "
+        "recv/accept, subprocess, untimed queue.get, untimed ray_tpu.get/"
+        "wait, untimed join/result) executes while a registered lock is "
+        "held, directly or through the project call graph"
+    ),
+    "lock-order": (
+        "the global lock-acquisition graph has a cycle (or a non-reentrant "
+        "lock is re-acquired while already held) — a potential ABBA deadlock"
+    ),
+    "async-stall": (
+        "an `async def` body performs a blocking call (directly or via a "
+        "sync project callee) without routing through an executor — the "
+        "event loop freezes for every other request"
+    ),
+    "unguarded-shared-state": (
+        "an instance attribute is mutated from >= 2 distinct thread entry "
+        "points with no common lock held at every mutation site"
+    ),
+    "shutdown-hygiene": (
+        "a thread is started whose join/flush is not reachable from the "
+        "owning object's shutdown path (leaked work at teardown)"
+    ),
+}
+
+# Method names treated as an object's shutdown path for shutdown-hygiene
+# reachability (plus anything wired into __exit__/__del__).
+SHUTDOWN_METHOD_NAMES = frozenset(
+    {
+        "shutdown",
+        "close",
+        "stop",
+        "stop_all",
+        "terminate",
+        "disconnect",
+        "drain",
+        "teardown",
+        "finalize",
+        "join",
+        "__exit__",
+        "__del__",
+    }
+)
+
+
+@dataclass(frozen=True)
+class SourceLoc:
+    file: str  # repo-relative posix path
+    line: int
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}"
+
+
+@dataclass(frozen=True)
+class BlockWitness:
+    """Why (and where) a call blocks.
+
+    ``releases`` holds lock ids the blocking primitive itself releases while
+    blocked (a Condition.wait drops its wrapped lock), so callers subtract it
+    from their held set before deciding the block happens "under" a lock.
+    ``chain`` is the human call path from the reporting function down to the
+    primitive, outermost first.
+    """
+
+    kind: str
+    desc: str
+    loc: SourceLoc
+    releases: frozenset = frozenset()
+    chain: tuple = ()
+
+    def chained(self, hop: str) -> "BlockWitness":
+        return BlockWitness(
+            kind=self.kind,
+            desc=self.desc,
+            loc=self.loc,
+            releases=self.releases,
+            chain=(hop,) + self.chain,
+        )
+
+
+@dataclass(frozen=True)
+class AcquireWitness:
+    """Where a lock is (transitively) acquired, for lock-order edges."""
+
+    lock_id: str
+    loc: SourceLoc
+    chain: tuple = ()
+
+    def chained(self, hop: str) -> "AcquireWitness":
+        return AcquireWitness(
+            lock_id=self.lock_id, loc=self.loc, chain=(hop,) + self.chain
+        )
+
+
+@dataclass
+class BlockSite:
+    line: int
+    witness: BlockWitness
+    held: tuple  # lock ids held at the site, acquisition order
+    timed: bool  # bounded wait (not counted under-lock, still an async stall)
+
+
+@dataclass
+class AcquireSite:
+    line: int
+    lock_id: str
+    held_before: tuple
+    reentrant: bool  # RLock/Condition-on-RLock
+
+
+@dataclass
+class CallSite:
+    line: int
+    callee: str | None  # resolved project-function qualname (post-resolution)
+    held: tuple
+    awaited: bool
+    desc: str  # source-ish text of the call target, for messages
+
+
+@dataclass
+class MutationSite:
+    attr: str
+    line: int
+    held: frozenset
+    constant_only: bool  # plain `self.x = <literal>` store (GIL-atomic flag)
+
+
+@dataclass
+class ThreadCreate:
+    line: int
+    attr: str | None  # self.<attr> the Thread is stored into, if any
+    local: str | None  # local variable name, if any
+    target: str | None  # resolved target method name on self, if any
+    daemon: bool
+    started: bool = False
+
+
+@dataclass
+class FuncInfo:
+    qualname: str  # module.Class.name or module.name
+    module: str
+    cls: str | None  # class qualkey (module.Class) or None
+    name: str
+    file: str
+    line: int
+    is_async: bool
+    node: ast.AST = field(repr=False, default=None)
+    # facts (filled by the engine walker)
+    block_sites: list = field(default_factory=list)
+    acquire_sites: list = field(default_factory=list)
+    call_sites: list = field(default_factory=list)
+    mutations: list = field(default_factory=list)
+    thread_creates: list = field(default_factory=list)
+    joined_attrs: set = field(default_factory=set)  # self.<attr>.join() seen
+    joined_locals: set = field(default_factory=set)
+    # interprocedural summaries (fixed point)
+    summary_blocks: BlockWitness | None = None
+    summary_acquires: dict = field(default_factory=dict)  # lock_id -> AcquireWitness
+
+
+@dataclass
+class LockInfo:
+    lock_id: str
+    kind: str  # "lock" | "rlock" | "condition" | "event" | "queue" | "semaphore"
+    underlying: str | None  # for conditions: the wrapped lock's id
+    loc: SourceLoc
+    reentrant: bool = False
+
+
+@dataclass
+class ClassInfo:
+    qualkey: str  # module.ClassName
+    module: str
+    name: str
+    file: str
+    line: int
+    bases: list = field(default_factory=list)  # candidate qualkeys
+    methods: dict = field(default_factory=dict)  # name -> FuncInfo
+    lock_attrs: dict = field(default_factory=dict)  # attr -> LockInfo
+    attr_types: dict = field(default_factory=dict)  # attr -> project class qualkey
+
+
+@dataclass
+class Finding:
+    check: str
+    file: str
+    line: int
+    qualname: str
+    message: str
+    key: str  # stable (line-free) detail used in the fingerprint
+    path: list = field(default_factory=list)  # human chain lines
+
+    @property
+    def fingerprint(self) -> str:
+        raw = "|".join((self.check, self.file, self.qualname, self.key))
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        out = [f"{self.file}:{self.line}: [{self.check}] {self.message}"]
+        for hop in self.path:
+            out.append(f"    {hop}")
+        return "\n".join(out)

@@ -77,7 +77,7 @@ def build_llm_processor(
         ]
         texts = []
         for r in reqs:
-            r.done.wait()
+            engine._await_done(r)  # bounded; dead decode loop -> r.error
             if r.error is not None:
                 raise r.error
             texts.append(engine.tokenizer.decode(r.out_tokens))

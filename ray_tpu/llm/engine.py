@@ -494,7 +494,7 @@ class JaxEngine:
             prompt, prompt_token_ids=prompt_token_ids,
             sampling_params=sampling_params, lora=lora,
         )
-        req.done.wait()
+        self._await_done(req)
         if req.error is not None:
             raise req.error
         return self._output(req)
@@ -520,7 +520,24 @@ class JaxEngine:
         from multi-step decode are paced into spaced emissions (see
         ``llm/pacing.py``) so SSE clients observe a steady token cadence."""
         while True:
-            item = req.stream_queue.get()
+            try:
+                item = req.stream_queue.get(timeout=1.0)
+            except queue.Empty:
+                # liveness re-check (same contract as _await_done): a dead
+                # or stopped decode loop never pushes the None sentinel, and
+                # an untimed get here hung the SSE consumer forever
+                if not (self._stop.is_set() or not self._thread.is_alive()):
+                    continue
+                try:
+                    # the loop may have pushed in the race window on its way
+                    # out — sweep once before declaring the stream dead
+                    item = req.stream_queue.get_nowait()
+                except queue.Empty:
+                    if req.error is None:
+                        req.error = RuntimeError(
+                            "engine decode loop exited mid-stream"
+                        )
+                    break
             if item is None:
                 break
             req.pacer.gate(backlog=not req.stream_queue.empty())
@@ -569,6 +586,24 @@ class JaxEngine:
     def shutdown(self):
         self._stop.set()
         self._thread.join(timeout=5)
+
+    def _await_done(self, req) -> None:
+        """Bounded wait with a liveness re-check: a dead or stopped decode
+        loop must surface as a request error, not hang the caller forever
+        (an untimed ``done.wait()`` here survived every engine crash)."""
+        while not req.done.wait(1.0):
+            if self._stop.is_set() or not self._thread.is_alive():
+                # the loop may have finished THIS request on its way out —
+                # re-check done before declaring it dead, or a completed
+                # decode gets discarded as an error
+                if req.done.wait(0.1):
+                    return
+                if req.error is None:
+                    req.error = RuntimeError(
+                        "engine decode loop exited while the request was pending"
+                    )
+                req.done.set()
+                return
 
     def get_stats(self) -> dict:
         return {

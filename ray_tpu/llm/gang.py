@@ -50,6 +50,7 @@ from collections import OrderedDict, deque
 from typing import Optional
 
 import ray_tpu
+from ray_tpu._private import locktrace
 from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.pacing import TokenPacer
 from ray_tpu.llm.server import _sampling_from_dict
@@ -991,6 +992,9 @@ class GangLLMServer:
         if hasattr(self, "_cv"):
             with self._cv:
                 self._cv.notify_all()
+        # bounded: the loop re-checks _stop on every cv wakeup above
+        # (getattr: shutdown may run as a failed __init__'s cleanup)
+        locktrace.join_if_alive(getattr(self, "_loop_thread", None), timeout=2.0)
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

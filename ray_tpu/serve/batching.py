@@ -12,9 +12,12 @@ one program over the batch dimension instead of running per-request.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import time
 from typing import Any, Callable, Optional
+
+_WAIT_DEADLINE_S = float(os.environ.get("RAY_TPU_BATCH_WAIT_S", "600"))
 
 
 class _BatchQueue:
@@ -87,8 +90,22 @@ class _Waiter:
         self._error = e
         self._ev.set()
 
-    def wait(self):
-        self._ev.wait()
+    def wait(self, deadline_s: Optional[float] = None):
+        # bounded overall wait: _run delivers a result or error to every
+        # waiter, but if the runner thread is killed at teardown before
+        # delivering, an untimed wait here was an unrecoverable hang — now
+        # it surfaces. Default is deliberately generous (first-call JAX
+        # compile alone can run tens of seconds); RAY_TPU_BATCH_WAIT_S
+        # overrides for tighter SLOs.
+        if deadline_s is None:
+            deadline_s = _WAIT_DEADLINE_S
+        deadline = time.monotonic() + deadline_s
+        while not self._ev.wait(0.5):
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"batched call not completed within {deadline_s:.0f}s "
+                    f"(batch runner died before delivering?)"
+                )
         if self._error is not None:
             raise self._error
         return self._value

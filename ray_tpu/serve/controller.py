@@ -23,6 +23,7 @@ import traceback
 from typing import Any, Optional
 
 import ray_tpu
+from ray_tpu._private import locktrace
 
 logger = logging.getLogger(__name__)
 
@@ -62,7 +63,9 @@ class ServeControllerActor:
     def __init__(self):
         self._deployments: dict[str, _DeploymentState] = {}
         self._apps: dict[str, dict] = {}  # app name -> {ingress, route_prefix}
-        self._lock = threading.RLock()
+        self._lock = locktrace.register_lock(
+            "serve.controller_lock", threading.RLock()
+        )
         # long-poll: handles block here until a replica set changes
         # (reference: serve/_private/long_poll.py config push)
         self._change_cv = threading.Condition(self._lock)
@@ -135,6 +138,8 @@ class ServeControllerActor:
 
     def shutdown(self):
         self._stop.set()
+        # reconcile loop polls _stop every 0.5 s, so this join is bounded
+        locktrace.join_if_alive(self._loop, timeout=2.0)
         with self._reconcile_mutex, self._lock:
             for state in self._deployments.values():
                 for h in state.replicas.values():

@@ -75,7 +75,9 @@ def multiplexed(
                         cache.move_to_end(model_id)
                         _set_model_id(model_id)
                         return entry
-                ev.wait()  # another thread is loading; retry the cache
+                # bounded: the outer loop re-checks the cache entry, so a
+                # loader that died without setting the event can't strand us
+                ev.wait(1.0)
 
             try:
                 model = fn(self, model_id)  # load outside the lock (slow)

@@ -21,6 +21,7 @@ from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
 import ray_tpu
+from ray_tpu._private import locktrace
 
 
 class Request:
@@ -386,6 +387,7 @@ class AsyncHTTPServer:
     def shutdown(self):
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._pool.shutdown(wait=False)
+        locktrace.join_if_alive(self._thread, timeout=2.0)
 
 
 class RouteTable:
@@ -613,6 +615,8 @@ class ProxyActor:
             self._async.shutdown()
         else:
             self._server.shutdown()
+            # serve_forever returns on shutdown(), so this join is bounded
+            locktrace.join_if_alive(getattr(self, "_thread", None), timeout=2.0)
         return True
 
 

@@ -21,6 +21,7 @@ import traceback
 from typing import Any, Callable, Optional
 
 import ray_tpu
+from ray_tpu._private import locktrace
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.context import TrainContext
 from ray_tpu.train.config import ScalingConfig
@@ -132,6 +133,9 @@ class TrainWorker:
         return {"results": results, "done": done, "error": error}
 
     def shutdown(self):
+        # bounded best-effort: user train_fn may ignore us (the actor is
+        # killed right after), but a finished loop reaps cleanly
+        locktrace.join_if_alive(getattr(self, "_thread", None), timeout=1.0)
         return True
 
 

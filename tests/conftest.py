@@ -74,9 +74,27 @@ def pytest_runtest_protocol(item, nextitem):
         return
 
     def _on_alarm(signum, frame):
+        # Triage dump BEFORE unwinding: every thread's stack plus the
+        # registered-lock owner table (ray_tpu._private.locktrace), so a
+        # deadlock is diagnosed from this log instead of a 300 s bisect
+        # (the PR 3 seal-through-own-pump hang took exactly that).
+        import sys
+
+        try:
+            from ray_tpu._private import locktrace
+
+            sys.stderr.write(
+                f"\n===== watchdog: {item.nodeid} exceeded {timeout:.0f}s =====\n"
+            )
+            locktrace.dump_all(file=sys.stderr)
+        except Exception:  # noqa: BLE001 — the dump must never mask the timeout
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
         raise _TestTimeout(
             f"test exceeded its {timeout:.0f}s watchdog "
-            f"(per-test timeout guard; see tests/conftest.py)"
+            f"(per-test timeout guard; thread stacks + lock owner table "
+            f"dumped to stderr; see tests/conftest.py)"
         )
 
     old = signal.signal(signal.SIGALRM, _on_alarm)

@@ -1,0 +1,3 @@
+# Fixture package for tests/test_tpulint.py. These modules are ANALYZED by
+# tpulint, never imported by tests — each reproduces (or deliberately
+# avoids) a concurrency bug shape this repo has actually shipped.

@@ -1,0 +1,63 @@
+"""Negative fixture: the same shapes done RIGHT. tpulint must report zero
+findings here — every wait is bounded, blocking work happens outside locks
+or through an executor, threads are joined from the shutdown path, and
+shared state is mutated under one lock from every entry point.
+"""
+
+import asyncio
+import queue
+import threading
+import time
+
+
+class WellBehavedWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._inbox: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._count = 0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="well-behaved"
+        )
+        self._thread.start()
+
+    def _loop(self):
+        # bounded pacing wait; liveness re-check via the loop condition
+        while not self._stop.wait(0.05):
+            try:
+                item = self._inbox.get(timeout=0.1)  # bounded queue wait
+            except queue.Empty:
+                continue
+            with self._lock:
+                self._count += 1
+            self._handle(item)
+
+    def _handle(self, item):
+        time.sleep(0.001)  # blocking work happens OUTSIDE any lock
+        return item
+
+    def submit(self, item):
+        self._inbox.put(item)
+        with self._lock:
+            self._count += 1
+
+    def wait_quiesced(self, deadline_s: float = 5.0):
+        with self._cv:
+            # bounded condition wait (re-armed by the caller's loop)
+            self._cv.wait(timeout=deadline_s)
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+
+
+class WellBehavedProxy:
+    def __init__(self, router):
+        self._router = router
+
+    async def handle_request(self, body):
+        loop = asyncio.get_running_loop()
+        # blocking pick routed through the executor: the loop stays live
+        replica = await loop.run_in_executor(None, self._router.pick_replica)
+        return replica, body

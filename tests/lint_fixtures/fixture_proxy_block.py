@@ -1,0 +1,36 @@
+"""Regression fixture: the PR 4 serve-proxy event-loop freeze shape.
+
+An ``async def`` request handler calls a sync replica-picker that can block
+(a retry sleep on a stale replica cache) without routing it through an
+executor — one slow pick freezes the event loop for EVERY in-flight request.
+
+tpulint must flag ``handle_request`` as async-stall (interprocedurally:
+the blocking sleep is two sync hops down).
+"""
+
+import time
+
+
+class ReplicaRouter:
+    def __init__(self):
+        self._replicas: list = []
+
+    def _refresh_cache(self):
+        # stale-cache retry: blocks the caller until replicas appear
+        while not self._replicas:
+            time.sleep(0.05)
+
+    def pick_replica(self):
+        if not self._replicas:
+            self._refresh_cache()
+        return self._replicas[0]
+
+
+class Proxy:
+    def __init__(self):
+        self._router = ReplicaRouter()
+
+    async def handle_request(self, body):
+        # BUG SHAPE: sync, possibly-blocking call directly on the event loop
+        replica = self._router.pick_replica()
+        return replica, body

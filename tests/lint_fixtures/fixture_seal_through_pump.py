@@ -1,0 +1,46 @@
+"""Regression fixture: the PR 3 ``test_streaming`` deadlock shape.
+
+A thread-mode inline actor task executes ON the channel's pump thread while
+holding the actor's execution lock; sealing a stream item goes back through
+the actor's OWN channel pump — an untimed ``queue.get`` under the lock. The
+thread that would pump the reply is the thread blocked waiting for it, so
+the wait can never complete (it ate a 300 s watchdog per run until fixed).
+
+tpulint must flag the ``_execute_inline`` call chain as blocking-under-lock.
+"""
+
+import queue
+import threading
+
+
+class ChannelPump:
+    """Stand-in for the worker channel: one pump thread, one reply queue."""
+
+    def __init__(self):
+        self._replies: queue.Queue = queue.Queue()
+        self._exec_lock = threading.RLock()
+        self._pump_thread = threading.Thread(
+            target=self._pump_loop, daemon=True, name="channel-pump"
+        )
+        self._pump_thread.start()
+
+    def _pump_loop(self):
+        while True:
+            self._dispatch_one()
+
+    def _dispatch_one(self):
+        # inline actor tasks run on THIS thread, under the execution lock
+        with self._exec_lock:
+            self._execute_inline()
+
+    def _execute_inline(self):
+        # the task produced a stream item; seal it through the channel
+        self._seal_stream_item()
+
+    def _seal_stream_item(self):
+        # round-trips via the pump that is currently executing US: the
+        # untimed get below can never be satisfied
+        return self._replies.get()
+
+    def shutdown(self):
+        self._pump_thread.join()

@@ -1,0 +1,651 @@
+"""tpulint unit + integration tests.
+
+Per-check-family unit tests run the analyzer over small synthetic modules in
+tmp_path; the self-detection tests assert the two shipped bug shapes (PR 3
+seal-through-own-pump, PR 4 proxy blocking call) are flagged in the checked-in
+fixtures; the whole-tree test asserts the repo is clean modulo the baseline
+and that a full run stays under the 30 s budget.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ray_tpu.devtools.lint import CHECKS, lint_paths
+from ray_tpu.devtools.lint import baseline as baseline_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lint_fixtures")
+
+
+def _lint_src(tmp_path, src, checks=None, name="mod_under_test.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return lint_paths([str(p)], checks=checks)
+
+
+def _by_check(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.check, []).append(f)
+    return out
+
+
+# ---------------------------------------------------------------- unit tests
+
+
+def test_blocking_under_lock_direct(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+            def good(self):
+                with self._lock:
+                    x = 1
+                time.sleep(1.0)
+                return x
+        """,
+    )
+    hits = _by_check(findings).get("blocking-under-lock", [])
+    assert len(hits) == 1
+    assert hits[0].qualname.endswith("C.bad")
+    assert "time.sleep" in hits[0].message
+
+
+def test_blocking_under_lock_interprocedural_chain(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading, queue
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def outer(self):
+                with self._lock:
+                    self.middle()
+
+            def middle(self):
+                self.inner()
+
+            def inner(self):
+                return self._q.get()
+        """,
+    )
+    hits = _by_check(findings).get("blocking-under-lock", [])
+    assert len(hits) == 1
+    assert hits[0].qualname.endswith("C.outer")
+    # the witness chain walks down to the primitive
+    assert any("inner" in hop or "queue.get" in hop for hop in hits[0].path)
+
+
+def test_condition_wait_releases_own_lock(tmp_path):
+    # cv.wait under ONLY the cv's own lock is the normal idiom — no finding;
+    # the same wait while a SECOND lock is held is flagged.
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._other = threading.Lock()
+
+            def fine(self):
+                with self._cv:
+                    self._cv.wait()
+
+            def bad(self):
+                with self._other:
+                    with self._cv:
+                        self._cv.wait()
+        """,
+    )
+    hits = _by_check(findings).get("blocking-under-lock", [])
+    assert len(hits) == 1
+    assert hits[0].qualname.endswith("C.bad")
+    assert "_other" in hits[0].message
+
+
+def test_timed_waits_not_flagged_under_lock(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ev = threading.Event()
+
+            def fine(self):
+                with self._lock:
+                    self._ev.wait(timeout=0.5)
+        """,
+    )
+    assert _by_check(findings).get("blocking-under-lock", []) == []
+
+
+def test_lock_order_cycle(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """,
+    )
+    hits = _by_check(findings).get("lock-order", [])
+    assert len(hits) == 1
+    assert "cycle" in hits[0].message
+    assert "_a" in hits[0].message and "_b" in hits[0].message
+
+
+def test_lock_order_cycle_interprocedural(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def hold_a(self):
+                with self._a:
+                    self.take_b()
+
+            def take_b(self):
+                with self._b:
+                    pass
+
+            def hold_b(self):
+                with self._b:
+                    self.take_a()
+
+            def take_a(self):
+                with self._a:
+                    pass
+        """,
+    )
+    hits = _by_check(findings).get("lock-order", [])
+    assert len(hits) == 1 and "cycle" in hits[0].message
+
+
+def test_lock_order_self_deadlock_plain_lock(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rlock = threading.RLock()
+
+            def bad(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                with self._lock:
+                    pass
+
+            def fine(self):
+                with self._rlock:
+                    self.rhelper()
+
+            def rhelper(self):
+                with self._rlock:
+                    pass
+        """,
+    )
+    hits = _by_check(findings).get("lock-order", [])
+    assert len(hits) == 1
+    assert "self-deadlock" in hits[0].message
+    assert hits[0].qualname.endswith("C.bad")
+
+
+def test_async_stall(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import time, asyncio
+
+        class H:
+            def blocking_pick(self):
+                time.sleep(0.5)
+
+            async def bad(self):
+                self.blocking_pick()
+
+            async def also_bad(self):
+                time.sleep(0.1)
+
+            async def fine(self):
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, self.blocking_pick)
+        """,
+    )
+    hits = _by_check(findings).get("async-stall", [])
+    quals = sorted(h.qualname.rsplit(".", 1)[1] for h in hits)
+    assert quals == ["also_bad", "bad"]
+
+
+def test_unguarded_shared_state(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tally = {}
+                self._guarded = {}
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                self._tally = dict(x=1)          # no lock (thread side)
+                with self._lock:
+                    self._guarded = dict(x=1)
+
+            def update(self):
+                self._tally = dict(y=2)          # no lock (caller side)
+                with self._lock:
+                    self._guarded = dict(y=2)
+        """,
+    )
+    hits = _by_check(findings).get("unguarded-shared-state", [])
+    assert len(hits) == 1
+    assert "_tally" in hits[0].message
+
+
+def test_shutdown_hygiene(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading
+
+        class Leaky:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                pass
+
+            def shutdown(self):
+                pass  # forgets the join
+
+        class Clean:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                pass
+
+            def shutdown(self):
+                self._t.join(timeout=1.0)
+
+        class CleanViaAlias:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                pass
+
+            def close(self):
+                t = getattr(self, "_t", None)
+                if t is not None:
+                    t.join(timeout=1.0)
+
+        class CleanViaHelper:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                pass
+
+            def stop(self):
+                locktrace.join_if_alive(self._t, timeout=1.0)
+        """,
+    )
+    hits = _by_check(findings).get("shutdown-hygiene", [])
+    assert len(hits) == 1
+    assert "Leaky" in hits[0].message
+
+
+def test_inline_suppression(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def reviewed(self):
+                with self._lock:
+                    time.sleep(0.01)  # tpulint: disable=blocking-under-lock
+        """,
+    )
+    assert findings == []
+
+
+def test_finding_fingerprint_is_line_stable(tmp_path):
+    src = """
+    import threading, time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self):
+            with self._lock:
+                time.sleep(1.0)
+    """
+    f1 = _lint_src(tmp_path, src, name="a.py")
+    # same code shifted down two lines -> same fingerprint
+    f2 = _lint_src(tmp_path, "\n\n" + textwrap.dedent(src), name="a.py")
+    assert len(f1) == len(f2) == 1
+    assert f1[0].fingerprint == f2[0].fingerprint
+    assert f1[0].line != f2[0].line
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """,
+    )
+    assert len(findings) == 1
+    bpath = str(tmp_path / "baseline.json")
+    baseline_mod.write(bpath, findings)
+    base = baseline_mod.load(bpath)
+    new, accepted, stale = baseline_mod.split(findings, base)
+    assert new == [] and len(accepted) == 1 and stale == []
+    # reasons survive a rewrite
+    base[findings[0].fingerprint]["reason"] = "reviewed: example"
+    baseline_mod.write(bpath, findings, old=base)
+    assert (
+        baseline_mod.load(bpath)[findings[0].fingerprint]["reason"]
+        == "reviewed: example"
+    )
+    # a fixed finding shows up as stale
+    new, accepted, stale = baseline_mod.split([], baseline_mod.load(bpath))
+    assert len(stale) == 1
+
+
+# ------------------------------------------------- self-detection fixtures
+
+
+def test_fixture_seal_through_pump_flagged():
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_seal_through_pump.py")]
+    )
+    hits = _by_check(findings).get("blocking-under-lock", [])
+    assert hits, "the PR 3 deadlock shape must be flagged"
+    assert any("_exec_lock" in h.message for h in hits)
+
+
+def test_fixture_proxy_block_flagged():
+    findings = lint_paths([os.path.join(FIXTURES, "fixture_proxy_block.py")])
+    hits = _by_check(findings).get("async-stall", [])
+    assert hits, "the PR 4 proxy-freeze shape must be flagged"
+    assert any("handle_request" in h.qualname for h in hits)
+
+
+def test_fixture_clean_has_zero_findings():
+    findings = lint_paths([os.path.join(FIXTURES, "fixture_clean.py")])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_cli_exits_nonzero_on_fixtures():
+    for fx in ("fixture_seal_through_pump.py", "fixture_proxy_block.py"):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu.devtools.lint",
+                "--no-baseline",
+                os.path.join(FIXTURES, fx),
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------- whole-tree gate
+
+
+def test_whole_tree_zero_nonbaselined_and_fast():
+    """The repo lints clean modulo the checked-in baseline, in < 30 s."""
+    t0 = time.monotonic()
+    findings = lint_paths([os.path.join(REPO, "ray_tpu")], root=REPO)
+    elapsed = time.monotonic() - t0
+    base = baseline_mod.load(os.path.join(REPO, "tools", "tpulint_baseline.json"))
+    new, accepted, stale = baseline_mod.split(findings, base)
+    assert new == [], "un-baselined findings:\n" + "\n\n".join(
+        f.render() for f in new
+    )
+    assert stale == [], (
+        "stale baseline entries (finding fixed — delete them): "
+        + ", ".join(e["fingerprint"] for e in stale)
+    )
+    assert elapsed < 30.0, f"tpulint took {elapsed:.1f}s on the tree"
+
+
+def test_cli_stale_baseline_fails_full_run(tmp_path):
+    """A leftover baseline fingerprint would silently re-accept a
+    reintroduced bug — full runs must fail until it is deleted."""
+    base = json.load(open(os.path.join(REPO, "tools", "tpulint_baseline.json")))
+    base["findings"].append(
+        {
+            "fingerprint": "deadbeefdeadbeef",
+            "check": "lock-order",
+            "file": "ray_tpu/ghost.py",
+            "qualname": "ray_tpu.ghost.gone",
+            "line": 1,
+            "message": "finding that no longer exists",
+            "reason": "test stale entry",
+        }
+    )
+    doctored = tmp_path / "baseline.json"
+    doctored.write_text(json.dumps(base))
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu.devtools.lint",
+            "--baseline",
+            str(doctored),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale" in proc.stdout
+
+
+def test_cli_whole_tree_exit_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.lint"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new" in proc.stdout
+
+
+def test_lint_sees_through_locktrace_registration():
+    """register_lock() wrapping must not blind the analyzer to core locks."""
+    from ray_tpu.devtools.lint import analyze, discover
+
+    project = discover([os.path.join(REPO, "ray_tpu")], root=REPO)
+    analyze(project)
+    for lock_id in (
+        "ray_tpu._private.controller.Controller.lock",
+        "ray_tpu._private.worker_runtime.WorkerRuntime.actor_exec_locks[*]",
+        "ray_tpu._private.object_store.MemoryStore._lock",
+        "ray_tpu.serve.controller.ServeControllerActor._lock",
+    ):
+        assert lock_id in project.locks, lock_id
+
+
+# ------------------------------------------------------ locktrace + watchdog
+
+
+def test_locktrace_owner_table():
+    import threading
+
+    from ray_tpu._private import locktrace
+
+    rlock = locktrace.register_lock("t_owner.rlock", threading.RLock())
+    cv = locktrace.register_lock("t_owner.cv", threading.Condition(rlock))
+    ev = locktrace.register_lock("t_owner.event", threading.Event())
+    release = threading.Event()
+    acquired = threading.Event()
+
+    def holder():
+        with rlock:
+            acquired.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder, name="t-owner-holder", daemon=True)
+    t.start()
+    assert acquired.wait(5.0)
+    try:
+        table = locktrace.owner_table()
+        assert "t-owner-holder" in table["t_owner.rlock"]
+        assert "t-owner-holder" in table["t_owner.cv"]  # cv reports wrapped lock
+        assert table["t_owner.event"] == "event:cleared"
+        dump = locktrace.dump_all()
+        assert "t-owner-holder" in dump and "registered lock owners" in dump
+    finally:
+        release.set()
+        t.join(timeout=5.0)
+    assert "unlocked" in locktrace.owner_table()["t_owner.rlock"]
+
+
+def test_locktrace_name_collision_suffixes():
+    import threading
+
+    from ray_tpu._private import locktrace
+
+    a = threading.Lock()
+    b = threading.Lock()
+    locktrace.register_lock("t_collide.lock", a)
+    locktrace.register_lock("t_collide.lock", b)
+    table = locktrace.owner_table()
+    assert "t_collide.lock" in table and "t_collide.lock#2" in table
+
+
+def test_watchdog_dumps_lock_owner_table(tmp_path):
+    """End-to-end: a hung test holding a registered lock times out AND the
+    watchdog prints the thread stacks + lock owner table to stderr."""
+    test_src = textwrap.dedent(
+        """
+        import threading
+        from ray_tpu._private import locktrace
+
+        def test_hangs_holding_registered_lock():
+            lock = locktrace.register_lock("wd.hung_lock", threading.Lock())
+            with lock:
+                threading.Event().wait(30)  # > the 2 s watchdog below
+        """
+    )
+    (tmp_path / "test_wd.py").write_text(test_src)
+    env = dict(os.environ, RAY_TPU_TEST_TIMEOUT_S="2", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            str(tmp_path / "test_wd.py"),
+            "-q",
+            "-p",
+            "no:cacheprovider",
+            # tmp_path is outside tests/, so load the watchdog conftest as a
+            # plugin explicitly
+            "-p",
+            "tests.conftest",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+    )
+    assert proc.returncode != 0
+    # the inner pytest captures stderr and replays it in the failure report,
+    # so search the combined output
+    out = proc.stdout + proc.stderr
+    assert "exceeded" in out
+    assert "registered lock owners" in out, out[-2000:]
+    assert "wd.hung_lock" in out, out[-2000:]
+    assert "locked" in out, out[-2000:]
+
+
+def test_every_baseline_entry_has_a_real_reason():
+    with open(os.path.join(REPO, "tools", "tpulint_baseline.json")) as f:
+        data = json.load(f)
+    assert data["findings"], "baseline should record the accepted debt"
+    for e in data["findings"]:
+        assert e["reason"] and e["reason"] != baseline_mod.DEFAULT_REASON, (
+            f"baseline entry {e['fingerprint']} needs a reviewed reason"
+        )
+        assert e["check"] in CHECKS
