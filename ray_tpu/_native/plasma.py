@@ -133,13 +133,19 @@ class NativeArena:
             raise NativePlasmaError(
                 f"failed to {'create' if self.owner else 'attach'} arena {name!r}"
             )
-        base = lib.ps_base(self._h)
-        # offsets from alloc/lookup are mapping-relative, so the view spans
-        # the entire mapping (header + arena)
-        self._map_len = int(lib.ps_total_size(self._h))
-        self._view = memoryview(
-            (ctypes.c_ubyte * self._map_len).from_address(base)
-        ).cast("B")
+        try:
+            base = lib.ps_base(self._h)
+            # offsets from alloc/lookup are mapping-relative, so the view
+            # spans the entire mapping (header + arena)
+            self._map_len = int(lib.ps_total_size(self._h))
+            self._view = memoryview(
+                (ctypes.c_ubyte * self._map_len).from_address(base)
+            ).cast("B")
+        except BaseException:
+            # the native handle (and its mmap) is already open: release it
+            # or a failed attach leaks the mapping for the process lifetime
+            lib.ps_close(self._h)
+            raise
         self._closed = False
 
     # -- store-authority ops -------------------------------------------------

@@ -116,8 +116,87 @@ def format_owner_table() -> str:
     return "\n".join(lines)
 
 
+def resource_table() -> dict:
+    """Best-effort snapshot of live shared-memory / object-ref state for
+    leak triage: a leaked spill segment or a climbing ref count must be
+    readable straight off a watchdog dump (the PR 4 spilled-reply RSS leak
+    was found by hand). Every probe tolerates partial initialization — the
+    dump runs from a SIGALRM handler and must never throw."""
+    import os
+
+    out: dict = {}
+    # live POSIX shm segments created by this runtime (rt_* per-object
+    # spills and direct-reply segments; the arena has its own name)
+    try:
+        segs = []
+        arena = os.environ.get("RAY_TPU_ARENA")
+        for name in sorted(os.listdir("/dev/shm")):
+            if name.startswith("rt_") or (arena and name == arena):
+                try:
+                    size = os.stat(os.path.join("/dev/shm", name)).st_size
+                except OSError:
+                    size = -1
+                segs.append((name, size))
+        out["shm_segments"] = segs
+    except OSError:
+        out["shm_segments"] = []
+    # per-process plasma clients: attached segment / arena mapping counts
+    try:
+        from ray_tpu._private import object_store
+
+        clients = []
+        for pc in list(getattr(object_store, "_live_clients", ())):
+            clients.append(
+                {"attached": len(pc._attached), "arenas": len(pc._arenas)}
+            )
+        out["plasma_clients"] = clients
+    except Exception:  # noqa: BLE001 — triage only
+        out["plasma_clients"] = []
+    # outstanding ObjectRefs: the head's ref counts (thread mode / driver
+    # process) + the caller-owned direct-call table
+    try:
+        from ray_tpu._private import worker as worker_mod
+
+        if worker_mod.is_initialized():
+            w = worker_mod.global_worker()
+            ctrl = getattr(w, "controller", None)
+            if ctrl is not None:
+                out["head_ref_counts"] = len(getattr(ctrl, "ref_counts", ()))
+            api = getattr(w, "api", w)
+            direct = getattr(api, "_direct", None)
+            if direct is not None:
+                out["direct_table"] = len(getattr(direct, "table", ()))
+                out["direct_owned_segments"] = len(
+                    getattr(direct, "_owned_segments", ())
+                )
+    except Exception:  # noqa: BLE001 — triage only
+        pass
+    return out
+
+
+def format_resource_table() -> str:
+    table = resource_table()
+    lines = []
+    segs = table.get("shm_segments", [])
+    lines.append(f"shm segments ({len(segs)}):")
+    for name, size in segs[:40]:
+        lines.append(f"    {name}  {size} bytes")
+    if len(segs) > 40:
+        lines.append(f"    ... and {len(segs) - 40} more")
+    for pc in table.get("plasma_clients", []):
+        lines.append(
+            f"plasma client: {pc['attached']} attached segments, "
+            f"{pc['arenas']} arena mappings"
+        )
+    for key in ("head_ref_counts", "direct_table", "direct_owned_segments"):
+        if key in table:
+            lines.append(f"{key}: {table[key]}")
+    return "\n".join(lines) if lines else "(no resource state)"
+
+
 def dump_all(file=None) -> str:
-    """Thread stacks + lock owner table, formatted for a watchdog log."""
+    """Thread stacks + lock owner table + live-resource table, formatted
+    for a watchdog log."""
     import sys
     import traceback
 
@@ -132,6 +211,11 @@ def dump_all(file=None) -> str:
         parts.append("".join(traceback.format_stack(frame)).rstrip())
     parts.append("=== locktrace: registered lock owners ===")
     parts.append(format_owner_table())
+    parts.append("=== locktrace: live resources (shm / plasma / refs) ===")
+    try:
+        parts.append(format_resource_table())
+    except Exception as e:  # noqa: BLE001 — the dump must never mask a timeout
+        parts.append(f"<resource table failed: {e}>")
     text = "\n".join(parts)
     if file is not None:
         print(text, file=file, flush=True)

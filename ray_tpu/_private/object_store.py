@@ -482,6 +482,12 @@ def parse_arena_location(shm_name: str):
     return parts[0], int(parts[1]), None
 
 
+# live PlasmaClient instances, for the watchdog's resource dump
+import weakref
+
+_live_clients: "weakref.WeakSet" = weakref.WeakSet()
+
+
 class PlasmaClient:
     """Per-process client: write objects into / map objects out of shm.
 
@@ -495,6 +501,9 @@ class PlasmaClient:
         self._attached: dict[str, object] = {}
         self._arenas: dict[str, object] = {}
         self._lock = threading.Lock()
+        # weak registry for watchdog triage (locktrace.resource_table):
+        # a leaked mapping cache shows up in the timeout dump by count
+        _live_clients.add(self)
 
     def _arena(self, name: str):
         with self._lock:

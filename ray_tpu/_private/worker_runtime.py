@@ -891,16 +891,26 @@ class WorkerRuntime:
 
         name = f"rt_{object_id.hex()[:20]}_{os.getpid() & 0xFFFF:x}"
         seg = shared_memory.SharedMemory(create=True, size=max(len(data), 1), name=name)
-        seg.buf[: len(data)] = data
-        # Hand lifecycle ownership to the consumer (controller or direct
-        # caller): stop this process's resource tracker from unlinking the
-        # segment at exit.
         try:
-            from multiprocessing import resource_tracker
+            seg.buf[: len(data)] = data
+            # Hand lifecycle ownership to the consumer (controller or direct
+            # caller): stop this process's resource tracker from unlinking
+            # the segment at exit.
+            try:
+                from multiprocessing import resource_tracker
 
-            resource_tracker.unregister(seg._name, "shared_memory")  # type: ignore[attr-defined]
-        except Exception:
-            pass
+                resource_tracker.unregister(seg._name, "shared_memory")  # type: ignore[attr-defined]
+            except Exception:
+                pass
+        except BaseException:
+            # nobody will ever consume the segment: reclaim it now, or the
+            # spill leaks RSS until process exit (the PR 4 leak shape)
+            seg.close()
+            try:
+                seg.unlink()
+            except OSError:
+                pass
+            raise
         seg.close()
         return name, len(data)
 

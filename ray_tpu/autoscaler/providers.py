@@ -126,9 +126,11 @@ class LocalProcessProvider(ClusterNodeProvider):
 
         # pick a free port for the head's TCP control plane
         s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        self._head_port = s.getsockname()[1]
-        s.close()
+        try:
+            s.bind(("127.0.0.1", 0))
+            self._head_port = s.getsockname()[1]
+        finally:
+            s.close()
         node_id = "head"
         env = dict(os.environ)
         env.pop("RAY_TPU_ARENA", None)

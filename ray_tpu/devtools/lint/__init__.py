@@ -13,7 +13,15 @@ al. 2018) specialised to the bug shapes this codebase has actually shipped:
 - ``unguarded-shared-state`` — attribute mutated from two thread entry
   points with no common lock;
 - ``shutdown-hygiene`` — the PR 4 free-flusher leak shape (a thread whose
-  join/flush is unreachable from its owner's shutdown path).
+  join/flush is unreachable from its owner's shutdown path);
+- ``collective-uniformity`` — MPI-Checker-style collective matching: a
+  psum/all_gather/gang-step reachable under rank-/host-/time-/exception-
+  dependent control flow with no matching collective on the other arm, or
+  collectives issued in different orders across divergent arms;
+- ``ref-lifecycle`` — Pulse-style lifetime tracking: shm segments, plasma
+  client/arena mappings, sockets, tempfiles, and dropped ObjectRef puts
+  that leak on exception edges or early returns, double-releases, and
+  use-after-release (the PR 4 spilled-reply RSS-leak shape).
 
 Programmatic use::
 
@@ -33,8 +41,13 @@ from .model import CHECKS, Finding
 __all__ = ["CHECKS", "Finding", "lint_paths", "discover", "analyze", "run_checks"]
 
 
-def lint_paths(paths, checks=None, root=None):
-    """Index, analyze, and run checks over `paths`; returns list[Finding]."""
+def lint_paths(paths, checks=None, root=None, config=None):
+    """Index, analyze, and run checks over `paths`; returns list[Finding].
+
+    ``config`` is an optional ``[tool.tpulint]``-shaped dict (e.g.
+    ``collective_functions``) consumed by the check families."""
     project = discover(list(paths), root=root)
+    if config:
+        project.config = dict(config)
     analyze(project)
     return run_checks(project, checks)

@@ -1,16 +1,21 @@
 """tpulint check families: turn engine facts into findings.
 
-Five families (see ``model.CHECKS``): blocking-under-lock, lock-order,
-async-stall, unguarded-shared-state, shutdown-hygiene. Every finding carries
-a stable line-free ``key`` (for baseline fingerprints that survive code
-motion) and a human call path down to the offending primitive.
+Seven families (see ``model.CHECKS``): the five concurrency families from
+PR 5/6 (blocking-under-lock, lock-order, async-stall,
+unguarded-shared-state, shutdown-hygiene) plus the two SPMD/lifetime
+families built on the pluggable flow lattice (collective-uniformity,
+ref-lifecycle — see :mod:`.collective` and :mod:`.lifecycle`). Every
+finding carries a stable line-free ``key`` (for baseline fingerprints that
+survive code motion) and a human call path down to the offending primitive.
 """
 
 from __future__ import annotations
 
 from collections import defaultdict
 
+from .collective import check_collective_uniformity
 from .discovery import Project
+from .lifecycle import check_ref_lifecycle
 from .model import CHECKS, Finding, SHUTDOWN_METHOD_NAMES
 
 
@@ -453,6 +458,8 @@ _ALL = {
     "async-stall": check_async_stall,
     "unguarded-shared-state": check_unguarded_shared_state,
     "shutdown-hygiene": check_shutdown_hygiene,
+    "collective-uniformity": check_collective_uniformity,
+    "ref-lifecycle": check_ref_lifecycle,
 }
 
 assert set(_ALL) == set(CHECKS)

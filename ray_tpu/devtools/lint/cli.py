@@ -60,6 +60,47 @@ def _parse_toml_section(path: str, section: str) -> dict:
     return out
 
 
+def _changed_files(repo_root: str) -> list | None:
+    """Absolute paths of .py files differing from `git merge-base HEAD main`
+    plus uncommitted/untracked ones; None when git can't answer (no repo, no
+    main — the caller falls back to a full run)."""
+    import subprocess
+
+    def _git(*argv):
+        proc = subprocess.run(
+            ["git", *argv],
+            cwd=repo_root,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr.strip())
+        return proc.stdout
+
+    try:
+        base = _git("merge-base", "HEAD", "main").strip()
+        names = set(_git("diff", "--name-only", base, "--", "*.py").splitlines())
+        # working-tree edits and untracked files ride along
+        names |= set(_git("diff", "--name-only", "--", "*.py").splitlines())
+        for line in _git("status", "--porcelain").splitlines():
+            p = line[3:].strip()
+            if " -> " in p:  # rename entry: lint the new path
+                p = p.split(" -> ", 1)[1]
+            if p.startswith('"') and p.endswith('"'):
+                p = p[1:-1]
+            if p.endswith(".py"):
+                names.add(p)
+    except (RuntimeError, OSError, subprocess.TimeoutExpired):
+        return None
+    out = []
+    for n in sorted(names):
+        ap = os.path.join(repo_root, n)
+        if os.path.exists(ap):
+            out.append(os.path.abspath(ap))
+    return out
+
+
 def _find_pyproject(start: str) -> str | None:
     d = os.path.abspath(start)
     if os.path.isfile(d):
@@ -79,9 +120,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m ray_tpu.devtools.lint",
         description=(
-            "tpulint: concurrency static analysis for ray_tpu "
-            "(lock-order, blocking-under-lock, async-stall, "
-            "unguarded-shared-state, shutdown-hygiene)"
+            "tpulint: concurrency + SPMD + resource-lifecycle static "
+            "analysis for ray_tpu (lock-order, blocking-under-lock, "
+            "async-stall, unguarded-shared-state, shutdown-hygiene, "
+            "collective-uniformity, ref-lifecycle)"
         ),
     )
     ap.add_argument("paths", nargs="*", help="files/trees to lint (default: config paths, else the ray_tpu package)")
@@ -89,6 +131,15 @@ def main(argv=None) -> int:
     ap.add_argument("--no-baseline", action="store_true", help="ignore any baseline: report every finding as new")
     ap.add_argument("--write-baseline", action="store_true", help="accept current findings into the baseline (reasons preserved by fingerprint)")
     ap.add_argument("--checks", help="comma-separated check ids to run (default: all)")
+    ap.add_argument(
+        "--changed-only",
+        action="store_true",
+        help=(
+            "lint only files that differ from `git merge-base HEAD main` "
+            "(plus uncommitted changes), sharing the full-tree baseline — "
+            "the <1s inner-loop mode; the full-tree run remains the gate"
+        ),
+    )
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--list-checks", action="store_true")
     ap.add_argument("--stats", action="store_true", help="print index/analysis counters")
@@ -115,6 +166,28 @@ def main(argv=None) -> int:
             print(f"tpulint: no such path: {p}", file=sys.stderr)
             return 2
 
+    changed_slice = False
+    if args.changed_only:
+        changed = _changed_files(cfg_root)
+        if changed is None:
+            print(
+                "tpulint: --changed-only: git diff unavailable, "
+                "falling back to a full run",
+                file=sys.stderr,
+            )
+        else:
+            roots = [os.path.abspath(p) for p in paths]
+            picked = [
+                f
+                for f in changed
+                if any(f == r or f.startswith(r + os.sep) for r in roots)
+            ]
+            if not picked:
+                print("tpulint: --changed-only: no changed files under the lint paths; clean")
+                return 0
+            paths = picked
+            changed_slice = True
+
     enabled = None
     if args.checks:
         enabled = [c.strip() for c in args.checks.split(",") if c.strip()]
@@ -134,7 +207,10 @@ def main(argv=None) -> int:
 
     # ---- run --------------------------------------------------------------
     t0 = time.monotonic()
-    project = discover(paths)
+    # changed-only slices report relative to the config root so fingerprints
+    # line up with the (full-tree) baseline
+    project = discover(paths, root=cfg_root if changed_slice else None)
+    project.config = cfg
     analyze(project)
     findings = run_checks(project, enabled)
     # config-level excludes (path prefixes relative to the report root)
@@ -147,13 +223,25 @@ def main(argv=None) -> int:
     # Stale entries gate FULL runs only: a leftover fingerprint would
     # silently re-accept the same bug if it were ever reintroduced, so the
     # baseline must shrink when findings are fixed. On an explicit path
-    # slice most of the baseline is legitimately unmatched — report, don't
-    # fail.
-    full_run = not args.paths
+    # slice (including --changed-only) most of the baseline is legitimately
+    # unmatched — report, don't fail.
+    full_run = not args.paths and not changed_slice
 
     if args.write_baseline:
         if not baseline_path:
             print("tpulint: --write-baseline needs a baseline path", file=sys.stderr)
+            return 2
+        if changed_slice or (args.paths and args.baseline is None):
+            # baseline.write rebuilds the file from THIS run's findings: a
+            # slice would silently delete every out-of-slice entry from the
+            # shared full-tree baseline (reviewed reasons included)
+            print(
+                "tpulint: --write-baseline requires a full-tree run "
+                "(a slice would truncate the shared baseline); drop "
+                "--changed-only/path args, or pass an explicit --baseline "
+                "file for a standalone slice baseline",
+                file=sys.stderr,
+            )
             return 2
         baseline_mod.write(baseline_path, findings, old=base)
         print(

@@ -1,0 +1,434 @@
+"""collective-uniformity: SPMD collective-matching analysis.
+
+In the spirit of MPI-Checker's collective-call matching: every rank (or
+gang worker, or host) must issue the same collectives in the same order, or
+the gang hangs at the next rendezvous — the exact failure shape the PR 3/4
+watchdog hunts caught at runtime. This check finds collective call sites
+(jax ``psum``/``all_gather``/``ppermute``/... inside ``shard_map`` bodies,
+``util.collective`` / ``train.collective`` ops, gang step / broadcast-plan
+entry points) and flags any reachable under *divergence-prone* control flow:
+
+- **rank-/host-divergent branch**: an ``if`` whose condition depends on the
+  rank, process index, host identity, or wall clock, where one arm issues a
+  collective (directly or through the project call graph) with no matching
+  collective on the other arm — including the guard-return idiom
+  (``if rank != 0: return`` followed by a collective).
+- **order mismatch**: both arms of a divergence-prone branch issue the same
+  collectives but in different orders (ABBA at gang scale).
+- **exception-dependent collective**: a collective inside an ``except``
+  handler — only the ranks that raised execute it.
+
+Functions that ARE the collective implementations (the catalog entries and
+their modules' private helpers) are exempt: their bodies are the protocol,
+not a use of it. Project-specific collective entry points can be added via
+``[tool.tpulint] collective_functions``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import _Ctx, _expr_text
+from .model import Finding
+
+# dotted call target -> op label (resolved through module imports)
+CATALOG: dict[str, str] = {}
+for _op in (
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute",
+):
+    CATALOG[f"jax.lax.{_op}"] = _op
+for _mod in ("ray_tpu.util.collective", "ray_tpu.util.collective.collective"):
+    for _op in ("allreduce", "allgather", "reducescatter", "broadcast", "barrier"):
+        CATALOG[f"{_mod}.{_op}"] = _op
+for _op in ("broadcast_from_rank_zero", "barrier"):
+    CATALOG[f"ray_tpu.train.collective.{_op}"] = _op
+
+# project functions that act as collectives: every gang member must call
+# them uniformly (the gang step / broadcast-plan paths). Extended via
+# [tool.tpulint] collective_functions.
+DEFAULT_PROJECT_COLLECTIVES: dict[str, str] = {
+    "ray_tpu.util.collective.collective.allreduce": "allreduce",
+    "ray_tpu.util.collective.collective.allgather": "allgather",
+    "ray_tpu.util.collective.collective.reducescatter": "reducescatter",
+    "ray_tpu.util.collective.collective.broadcast": "broadcast",
+    "ray_tpu.util.collective.collective.barrier": "barrier",
+    "ray_tpu.train.collective.broadcast_from_rank_zero": "broadcast_from_rank_zero",
+    "ray_tpu.train.collective.barrier": "barrier",
+    "ray_tpu.llm.spmd.SPMDEngineWorker.step": "gang-step",
+    "ray_tpu.llm.spmd.SPMDGenerator.generate_batch": "gang-generate",
+    "ray_tpu.llm.gang.EngineWorker.engine_step": "gang-step",
+    "ray_tpu.llm.gang.EngineWorker.generate_batch": "gang-generate",
+}
+
+# modules whose private helpers implement the collective protocols — their
+# internal rank checks ARE the rendezvous, not a divergence bug
+_IMPL_MODULES = frozenset(
+    {"ray_tpu.util.collective.collective", "ray_tpu.train.collective"}
+)
+
+_RANK_RE = re.compile(
+    r"(?:^|_)(rank|ranks|process_index|process_id|proc_id|world_rank|"
+    r"local_rank|leader|master|is_master|coordinator|is_coordinator)(?:$|_)",
+    re.I,
+)
+_HOST_RE = re.compile(
+    r"(?:^|_)(host|hostname|node_id|nodeid|node_ip)(?:$|_)", re.I
+)
+_TIME_CALLS = frozenset(
+    {"time.time", "time.monotonic", "time.perf_counter", "time.time_ns"}
+)
+_RANK_CALL_SUFFIXES = ("process_index", "axis_index", "get_rank", "host_id")
+
+
+def _dotted(fn: ast.expr, imports: dict) -> str | None:
+    parts = []
+    node = fn
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        base = imports.get(node.id, node.id)
+        return ".".join([base] + list(reversed(parts)))
+    return None
+
+
+def divergence_kind(test: ast.expr, imports: dict) -> str | None:
+    """None if the condition looks uniform across the gang; else the
+    divergence class ("rank" | "host" | "time")."""
+    found = None
+    for node in ast.walk(test):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Call):
+            dotted = _dotted(node.func, imports)
+            if dotted in _TIME_CALLS:
+                found = found or "time"
+                continue
+            if dotted and dotted.endswith(_RANK_CALL_SUFFIXES):
+                return "rank"
+            continue
+        if name is None:
+            continue
+        if _RANK_RE.search(name):
+            return "rank"
+        if _HOST_RE.search(name):
+            found = found or "host"
+    return found
+
+
+class _Op:
+    __slots__ = ("op", "line", "desc", "chain")
+
+    def __init__(self, op, line, desc, chain=()):
+        self.op = op
+        self.line = line
+        self.desc = desc
+        self.chain = tuple(chain)
+
+
+class _CollectiveCheck:
+    def __init__(self, project, extra_collectives=None):
+        self.project = project
+        self.findings: list = []
+        self.project_collectives = dict(DEFAULT_PROJECT_COLLECTIVES)
+        for qual in extra_collectives or ():
+            self.project_collectives.setdefault(qual, qual.rsplit(".", 1)[1])
+        self._summary_cache: dict = {}
+
+    # -- op discovery -------------------------------------------------------
+
+    def _catalog_op(self, dotted: str | None) -> str | None:
+        if dotted is None:
+            return None
+        op = CATALOG.get(dotted)
+        if op is not None:
+            return op
+        parts = dotted.split(".")
+        if len(parts) >= 2 and parts[-2] == "lax" and f"jax.lax.{parts[-1]}" in CATALOG:
+            return parts[-1]
+        return None
+
+    def _exempt(self, func) -> bool:
+        return (
+            func.qualname in self.project_collectives
+            or func.module in _IMPL_MODULES
+        )
+
+    def summary_seq(self, qualname: str, _stack=None) -> list:
+        """Transitive collective-op sequence of a project function (capped)."""
+        if qualname in self._summary_cache:
+            return self._summary_cache[qualname]
+        if _stack is None:
+            _stack = set()
+        if qualname in _stack:
+            return []
+        func = self.project.functions.get(qualname)
+        if func is None or func.node is None:
+            return []
+        if qualname in self.project_collectives:
+            seq = [_Op(self.project_collectives[qualname], func.line, qualname)]
+            self._summary_cache[qualname] = seq
+            return seq
+        mod = self.project.modules.get(func.module)
+        if mod is None:
+            return []
+        cls = self.project.classes.get(func.cls) if func.cls else None
+        ctx = _Ctx(self.project, mod, cls, func)
+        _stack.add(qualname)
+        seq: list = []
+        for node in ast.walk(func.node):
+            if len(seq) >= 8:
+                break
+            if not isinstance(node, ast.Call):
+                continue
+            op = self._catalog_op(_dotted(node.func, mod.imports))
+            if op is not None:
+                seq.append(_Op(op, node.lineno, _expr_text(node.func)))
+                continue
+            callee = ctx.resolve_callee(node)
+            if callee is not None and callee != qualname:
+                for sub in self.summary_seq(callee, _stack)[:4]:
+                    hop = f"{_expr_text(node.func)}() at {func.file}:{node.lineno}"
+                    seq.append(_Op(sub.op, node.lineno, sub.desc, (hop,) + sub.chain))
+                    if len(seq) >= 8:
+                        break
+        _stack.discard(qualname)
+        self._summary_cache[qualname] = seq
+        return seq
+
+    def _ops_in_call(self, call: ast.Call, ctx: _Ctx, func) -> list:
+        """Collective ops this call issues (directly or transitively)."""
+        op = self._catalog_op(_dotted(call.func, ctx.mod.imports))
+        if op is not None:
+            return [_Op(op, call.lineno, _expr_text(call.func))]
+        callee = ctx.resolve_callee(call)
+        if callee is not None and callee != func.qualname:
+            out = []
+            for sub in self.summary_seq(callee):
+                hop = f"{_expr_text(call.func)}() at {func.file}:{call.lineno}"
+                out.append(_Op(sub.op, call.lineno, sub.desc, (hop,) + sub.chain))
+            return out
+        return []
+
+    # -- per-function analysis ---------------------------------------------
+
+    def analyze(self, func):
+        if func.node is None or self._exempt(func):
+            return
+        mod = self.project.modules.get(func.module)
+        if mod is None:
+            return
+        cls = self.project.classes.get(func.cls) if func.cls else None
+        ctx = _Ctx(self.project, mod, cls, func)
+        self._reported: set = set()
+        self._func = func
+        self._ctx = ctx
+        try:
+            self._walk(func.node.body, guards=[], in_handler=False)
+        except RecursionError:
+            self.project.errors.append(
+                (func.file, f"collective walk overflow in {func.qualname}")
+            )
+
+    def _emit(self, key, line, message, path=()):
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.findings.append(
+            Finding(
+                check="collective-uniformity",
+                file=self._func.file,
+                line=line,
+                qualname=self._func.qualname,
+                message=message,
+                key=key,
+                path=list(path),
+            )
+        )
+
+    def _stmt_ops(self, s, in_handler) -> list:
+        """Collective ops issued by expressions of one simple statement."""
+        out = []
+        for node in ast.walk(s):
+            if isinstance(node, ast.Call):
+                out.extend(self._ops_in_call(node, self._ctx, self._func))
+        return out
+
+    def _flag_op_under_guard(self, op: _Op, guard):
+        cond_text, line, kind = guard
+        self._emit(
+            f"divergent|{op.op}|{cond_text}",
+            op.line,
+            f"collective {op.op} ({op.desc}) runs only on gang members that "
+            f"pass the {kind}-dependent guard `{cond_text}` (line {line}) — "
+            f"the others never reach the rendezvous",
+            path=list(op.chain),
+        )
+
+    def _flag_op_in_handler(self, op: _Op):
+        self._emit(
+            f"exc|{op.op}",
+            op.line,
+            f"collective {op.op} ({op.desc}) inside an except handler — only "
+            f"the gang members that raised execute it",
+            path=list(op.chain),
+        )
+
+    def _terminates(self, stmts) -> bool:
+        return any(
+            isinstance(s, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+            for s in stmts
+        )
+
+    def _walk(self, stmts, guards, in_handler):
+        """Returns (ops issued by this block, block certainly terminates)."""
+        ops: list = []
+        terminated = False
+
+        def note(new_ops):
+            for op in new_ops:
+                if in_handler:
+                    self._flag_op_in_handler(op)
+                for g in guards:
+                    self._flag_op_under_guard(op, g)
+                ops.append(op)
+
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(s, ast.If):
+                div = divergence_kind(s.test, self._ctx.mod.imports)
+                t_ops, t_term = self._walk(list(s.body), list(guards), in_handler)
+                e_ops, e_term = self._walk(list(s.orelse), list(guards), in_handler)
+                if div is not None:
+                    cond_text = _expr_text(s.test)
+                    self._compare_arms(
+                        t_ops, e_ops, cond_text, s.lineno, div
+                    )
+                    if t_term != e_term:
+                        # guard-return idiom: ranks that took the exiting arm
+                        # never see anything issued after this statement
+                        guards = guards + [(cond_text, s.lineno, div)]
+                if t_term and e_term:
+                    ops.extend(t_ops)
+                    terminated = True
+                    break
+                if t_term:
+                    surviving = e_ops
+                elif e_term:
+                    surviving = t_ops
+                else:
+                    # join of both falling-through arms: then-arm ops plus
+                    # whatever the else arm issues beyond them (multiset) —
+                    # an else-only collective must stay visible to outer
+                    # divergence checks, without double-counting matched ops
+                    from collections import Counter
+
+                    surviving = list(t_ops)
+                    matched = Counter(o.op for o in t_ops)
+                    for o in e_ops:
+                        if matched[o.op] > 0:
+                            matched[o.op] -= 1
+                        else:
+                            surviving.append(o)
+                ops.extend(surviving)
+                continue
+            if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+                test = s.test if isinstance(s, ast.While) else None
+                div = (
+                    divergence_kind(test, self._ctx.mod.imports)
+                    if test is not None
+                    else None
+                )
+                body_guards = list(guards)
+                if div is not None:
+                    body_guards.append((_expr_text(test), s.lineno, div))
+                b_ops, _ = self._walk(list(s.body), body_guards, in_handler)
+                o_ops, _ = self._walk(list(s.orelse), list(guards), in_handler)
+                ops.extend(b_ops)
+                ops.extend(o_ops)
+                continue
+            if isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    note(self._stmt_ops(item.context_expr, in_handler))
+                b_ops, b_term = self._walk(list(s.body), guards, in_handler)
+                ops.extend(b_ops)
+                if b_term:
+                    terminated = True
+                    break
+                continue
+            if isinstance(s, ast.Try) or (
+                hasattr(ast, "TryStar") and isinstance(s, getattr(ast, "TryStar"))
+            ):
+                b_ops, b_term = self._walk(list(s.body), guards, in_handler)
+                ops.extend(b_ops)
+                for h in s.handlers:
+                    self._walk(list(h.body), guards, in_handler=True)
+                o_ops, _ = self._walk(list(s.orelse), guards, in_handler)
+                ops.extend(o_ops)
+                f_ops, f_term = self._walk(list(s.finalbody), guards, in_handler)
+                ops.extend(f_ops)
+                if b_term or f_term:
+                    terminated = True
+                    break
+                continue
+            # simple statement: collect its ops, then check termination
+            note(self._stmt_ops(s, in_handler))
+            if isinstance(s, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+                terminated = True
+                break
+        return ops, terminated
+
+    def _compare_arms(self, t_ops, e_ops, cond_text, line, div):
+        t_names = [o.op for o in t_ops]
+        e_names = [o.op for o in e_ops]
+        if t_names == e_names:
+            return
+        if sorted(t_names) == sorted(e_names):
+            self._emit(
+                f"order|{','.join(t_names)}|{','.join(e_names)}|{cond_text}",
+                line,
+                f"collectives issued in different orders across the "
+                f"{div}-dependent branch `{cond_text}`: "
+                f"[{', '.join(t_names)}] vs [{', '.join(e_names)}] — ranks "
+                f"rendezvous on mismatched operations",
+                path=[
+                    f"then-arm: {o.op} at line {o.line}" for o in t_ops
+                ] + [
+                    f"else-arm: {o.op} at line {o.line}" for o in e_ops
+                ],
+            )
+            return
+        # symmetric difference by multiset: ops present on exactly one arm
+        from collections import Counter
+
+        only_t = Counter(t_names) - Counter(e_names)
+        only_e = Counter(e_names) - Counter(t_names)
+        for arm_ops, only in ((t_ops, only_t), (e_ops, only_e)):
+            for op_obj in arm_ops:
+                if only[op_obj.op] <= 0:
+                    continue
+                only[op_obj.op] -= 1
+                self._emit(
+                    f"divergent|{op_obj.op}|{cond_text}",
+                    op_obj.line,
+                    f"collective {op_obj.op} ({op_obj.desc}) under the "
+                    f"{div}-dependent branch `{cond_text}` (line {line}) has "
+                    f"no matching collective on the other arm — gang members "
+                    f"that skip it hang the rendezvous",
+                    path=list(op_obj.chain),
+                )
+
+
+def check_collective_uniformity(project) -> list:
+    cfg = getattr(project, "config", None) or {}
+    extra = cfg.get("collective_functions") or ()
+    chk = _CollectiveCheck(project, extra_collectives=extra)
+    for func in project.functions.values():
+        chk.analyze(func)
+    return chk.findings

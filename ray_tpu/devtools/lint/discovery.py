@@ -55,6 +55,7 @@ class Project:
     classes: dict = field(default_factory=dict)  # qualkey -> ClassInfo
     functions: dict = field(default_factory=dict)  # qualname -> FuncInfo
     errors: list = field(default_factory=list)  # (file, message)
+    config: dict = field(default_factory=dict)  # [tool.tpulint] section
 
     def suppressed(self, file: str, line: int, check: str) -> bool:
         mod = self._by_file.get(file)
@@ -121,8 +122,16 @@ def _iter_py_files(path: str):
                 yield os.path.join(dirpath, fn)
 
 
-def _module_name(root: str, fpath: str) -> str:
-    rel = os.path.relpath(fpath, os.path.dirname(root) or ".")
+def _module_name(root: str, fpath: str, project_root: str | None = None) -> str:
+    # Dotted names come from the REPORT root so a single-file slice
+    # (--changed-only) produces the same qualnames — and therefore the same
+    # baseline fingerprints — as the full-tree run.
+    base = os.path.dirname(root) or "."
+    if project_root:
+        rel_probe = os.path.relpath(fpath, project_root)
+        if not rel_probe.startswith(".."):
+            base = project_root
+    rel = os.path.relpath(fpath, base)
     rel = rel[:-3] if rel.endswith(".py") else rel
     parts = rel.replace(os.sep, "/").split("/")
     if parts and parts[-1] == "__init__":
@@ -320,7 +329,11 @@ def _discover_module(project: Project, root: str, fpath: str):
     except (SyntaxError, UnicodeDecodeError, OSError) as e:
         project.errors.append((relfile, f"parse error: {e}"))
         return
-    mod = ModuleInfo(name=_module_name(root, fpath), file=relfile, tree=tree)
+    mod = ModuleInfo(
+        name=_module_name(root, fpath, project_root=project.root),
+        file=relfile,
+        tree=tree,
+    )
     mod.imports = _collect_imports(tree)
     mod.suppress = _scan_suppressions(src)
     project.modules[mod.name] = mod

@@ -19,6 +19,7 @@ from __future__ import annotations
 import ast
 
 from .discovery import ModuleInfo, Project
+from .flow import FlowWalker
 from .model import (
     AcquireSite,
     AcquireWitness,
@@ -366,8 +367,20 @@ class _Ctx:
         return isinstance(d, ast.Constant) and d.value is True
 
 
-class _FuncWalker:
+class _FuncWalker(FlowWalker):
+    """The lockset domain over the generic flow core (``flow.FlowWalker``).
+
+    State is the list of effective held lock ids in acquisition order.
+    Branch discipline is the historical one: acquire/release inside a branch
+    do not escape it (``effects_escape = False``) — precision comes from the
+    project's lock idiom being overwhelmingly `with lock:` blocks. ``try``
+    keeps its legacy escape semantics (acquires in the body flow onward).
+    """
+
+    effects_escape = False
+
     def __init__(self, ctx: _Ctx):
+        super().__init__()
         self.ctx = ctx
         self.f = ctx.func
         self.in_init = ctx.func.name in ("__init__", "__new__")
@@ -375,99 +388,88 @@ class _FuncWalker:
     def run(self):
         self.walk_block(self.f.node.body, [])
 
-    # held is a list of effective lock ids in acquisition order
-    def walk_block(self, stmts, held):
-        held = list(held)
-        for s in stmts:
-            held = self.walk_stmt(s, held)
+    def copy_state(self, held):
+        return list(held)
+
+    # -- lockset transfer hooks (legacy semantics) -------------------------
+
+    def walk_with(self, s, held):
+        ctx = self.ctx
+        pushed = []
+        for item in s.items:
+            self.scan_expr(item.context_expr, held, top_call_is_ctx=True)
+            r = ctx.resolve_lock(item.context_expr)
+            if r is not None:
+                held_id, info = r
+                self.f.acquire_sites.append(
+                    AcquireSite(
+                        line=item.context_expr.lineno,
+                        lock_id=held_id,
+                        held_before=tuple(held),
+                        reentrant=info.reentrant,
+                    )
+                )
+                held = held + [held_id]
+                pushed.append(held_id)
+        self.walk_block(s.body, held)
+        for _ in pushed:
+            held = held[:-1]
         return held
 
-    def walk_stmt(self, s, held):
+    def walk_try(self, s, held):
+        held = self.walk_block(s.body, self.copy_state(held))
+        for h in s.handlers:
+            self.walk_block(h.body, self.copy_state(held))
+        self.walk_block(s.orelse, self.copy_state(held))
+        return self.walk_block(s.finalbody, self.copy_state(held))
+
+    def walk_expr_stmt(self, s, held):
         ctx = self.ctx
-        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            return held  # nested scopes analysed separately (or not at all)
-        if isinstance(s, (ast.With, ast.AsyncWith)):
-            pushed = []
-            for item in s.items:
-                self.scan_expr(item.context_expr, held, top_call_is_ctx=True)
-                r = ctx.resolve_lock(item.context_expr)
+        call = s.value if isinstance(s.value, ast.Call) else None
+        if call is not None and isinstance(call.func, ast.Attribute):
+            meth = call.func.attr
+            if meth in ("acquire", "release"):
+                r = ctx.resolve_lock(call.func.value)
                 if r is not None:
                     held_id, info = r
-                    self.f.acquire_sites.append(
-                        AcquireSite(
-                            line=item.context_expr.lineno,
-                            lock_id=held_id,
-                            held_before=tuple(held),
-                            reentrant=info.reentrant,
-                        )
-                    )
-                    held = held + [held_id]
-                    pushed.append(held_id)
-            self.walk_block(s.body, held)
-            for _ in pushed:
-                held = held[:-1]
-            return held
-        if isinstance(s, ast.If):
-            self.scan_expr(s.test, held)
-            self.walk_block(s.body, held)
-            self.walk_block(s.orelse, held)
-            return held
-        if isinstance(s, (ast.While,)):
-            self.scan_expr(s.test, held)
-            self.walk_block(s.body, held)
-            self.walk_block(s.orelse, held)
-            return held
-        if isinstance(s, (ast.For, ast.AsyncFor)):
-            self.scan_expr(s.iter, held)
-            self.walk_block(s.body, held)
-            self.walk_block(s.orelse, held)
-            return held
-        if isinstance(s, ast.Try) or (
-            hasattr(ast, "TryStar") and isinstance(s, getattr(ast, "TryStar"))
-        ):
-            held = self.walk_block(s.body, held)
-            for h in s.handlers:
-                self.walk_block(h.body, held)
-            self.walk_block(s.orelse, held)
-            held = self.walk_block(s.finalbody, held)
-            return held
-        if isinstance(s, ast.Expr):
-            call = s.value if isinstance(s.value, ast.Call) else None
-            if call is not None and isinstance(call.func, ast.Attribute):
-                meth = call.func.attr
-                if meth in ("acquire", "release"):
-                    r = ctx.resolve_lock(call.func.value)
-                    if r is not None:
-                        held_id, info = r
-                        if meth == "acquire":
-                            self.f.acquire_sites.append(
-                                AcquireSite(
-                                    line=s.lineno,
-                                    lock_id=held_id,
-                                    held_before=tuple(held),
-                                    reentrant=info.reentrant,
-                                )
+                    if meth == "acquire":
+                        self.f.acquire_sites.append(
+                            AcquireSite(
+                                line=s.lineno,
+                                lock_id=held_id,
+                                held_before=tuple(held),
+                                reentrant=info.reentrant,
                             )
-                            return held + [held_id]
-                        if held_id in held:
-                            held = list(held)
-                            held.reverse()
-                            held.remove(held_id)
-                            held.reverse()
-                        return held
-                # thread lifecycle on statements like `self.t.start()`
-                self._note_thread_lifecycle(call)
-            self.scan_expr(s.value, held)
-            return held
-        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
-            self._handle_assign(s, held)
-            return held
-        if isinstance(s, (ast.Return, ast.Raise, ast.Assert, ast.Delete)):
-            for child in ast.iter_child_nodes(s):
-                if isinstance(child, ast.expr):
-                    self.scan_expr(child, held)
-            return held
+                        )
+                        return held + [held_id]
+                    if held_id in held:
+                        held = list(held)
+                        held.reverse()
+                        held.remove(held_id)
+                        held.reverse()
+                    return held
+            # thread lifecycle on statements like `self.t.start()`
+            self._note_thread_lifecycle(call)
+        self.scan_expr(s.value, held)
         return held
+
+    def walk_assign(self, s, held):
+        self._handle_assign(s, held)
+        return held
+
+    def walk_return(self, s, held):
+        if s.value is not None:
+            self.scan_expr(s.value, held)
+        return held
+
+    def walk_raise(self, s, held):
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self.scan_expr(child, held)
+        return held
+
+    def walk_jump(self, s, held):
+        return held  # break/continue never changes the held set
 
     def _note_thread_lifecycle(self, call: ast.Call):
         fn = call.func

@@ -1,0 +1,314 @@
+"""Generic forward abstract-interpretation core for tpulint.
+
+PR 5's engine hand-threaded ONE abstract state — the held-lock set — through
+its statement walk. This module generalizes that machinery into a pluggable
+lattice walk so new check families bring their own state:
+
+- the lockset domain (``engine._FuncWalker``) keeps its historical
+  discipline: branch effects do not escape the branch
+  (``effects_escape = False``), no exception edges;
+- the resource-lifecycle domain (``lifecycle``) joins branch states at merge
+  points and tracks *exception edges*: any may-raise operation threads the
+  current state into the innermost enclosing handler, or — uncaught — records
+  a function-escape snapshot (the state a propagating exception would strand,
+  Pulse-style);
+- the collective-uniformity domain (``collective``) reuses only the
+  branch-structure dispatch.
+
+A domain subclasses :class:`FlowWalker` and overrides the ``state`` hooks
+(`copy_state`/`join_states`) plus whichever transfer hooks it cares about.
+``None`` is bottom: a terminated path (return/raise/break) yields ``None``
+and joins as the identity.
+"""
+
+from __future__ import annotations
+
+import ast
+
+
+class TryFrame:
+    """One enclosing ``try`` during the walk.
+
+    ``handlers_active`` is False while walking the try's handler/orelse
+    bodies re-pushed for their ``finally`` protection only: an exception
+    raised inside a handler is NOT caught by its own try, but the finally
+    still runs before it propagates.
+    """
+
+    __slots__ = ("node", "handlers_active", "exc_state")
+
+    def __init__(self, node, handlers_active: bool = True):
+        self.node = node
+        self.handlers_active = handlers_active
+        self.exc_state = None  # joined lazily at may-raise points
+
+
+class EscapeEdge:
+    """A point where control may leave the function.
+
+    kind: "return" (explicit return), "raise" (explicit raise statement),
+    "call-raise" (an operation that may raise with no enclosing handler),
+    "end" (implicit fall-off-the-end return).
+    ``finallies`` lists the enclosing ``try`` nodes (innermost first) whose
+    ``finally`` blocks run before the edge leaves — consumers apply their
+    release effects before judging the stranded state.
+    """
+
+    __slots__ = ("kind", "line", "desc", "state", "finallies")
+
+    def __init__(self, kind, line, desc, state, finallies=()):
+        self.kind = kind
+        self.line = line
+        self.desc = desc
+        self.state = state
+        self.finallies = tuple(finallies)
+
+
+class FlowWalker:
+    """Forward walk of a function body threading a domain-defined state."""
+
+    #: True: branch/loop effects join into the fall-through state (lattice
+    #: join at merge points). False: arms are walked from the entry state and
+    #: the entry state flows on untouched (the lockset discipline — precise
+    #: because the project's lock idiom is `with lock:` blocks).
+    effects_escape = True
+
+    def __init__(self):
+        self._frames: list[TryFrame] = []
+        self.escapes: list[EscapeEdge] = []
+
+    # -- domain hooks -------------------------------------------------------
+
+    def copy_state(self, st):
+        return st
+
+    def join_states(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return self.merge(a, b)
+
+    def merge(self, a, b):
+        """Join two live states (both non-None). Domains override."""
+        return a
+
+    def scan_expr(self, expr, st, awaited=False):
+        """Visit an expression for effects. Default: recursive descent
+        calling :meth:`on_call` at every call node."""
+        if expr is None:
+            return
+        if isinstance(expr, ast.Await):
+            self.scan_expr(expr.value, st, awaited=True)
+            return
+        if isinstance(expr, ast.Lambda):
+            return
+        if isinstance(expr, ast.Call):
+            self.on_call(expr, st, awaited)
+            if isinstance(expr.func, ast.Attribute):
+                self.scan_expr(expr.func.value, st)
+            for a in expr.args:
+                self.scan_expr(a, st)
+            for kw in expr.keywords:
+                self.scan_expr(kw.value, st)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self.scan_expr(child, st)
+            elif isinstance(child, ast.comprehension):
+                self.scan_expr(child.iter, st)
+                for cond in child.ifs:
+                    self.scan_expr(cond, st)
+
+    def on_call(self, call: ast.Call, st, awaited: bool):
+        """Per-call transfer hook."""
+
+    # -- exception-edge machinery ------------------------------------------
+
+    def note_may_raise(self, st, line: int, desc: str, kind: str = "call-raise"):
+        """Record that the operation at ``line`` may raise with state ``st``.
+
+        The state joins the innermost enclosing try's handler-entry state; if
+        no enclosing try has (active) handlers, the exception propagates out
+        of the function and an :class:`EscapeEdge` is recorded, carrying the
+        finallies it unwinds through.
+        """
+        finallies = []
+        for frame in reversed(self._frames):
+            if frame.handlers_active and frame.node.handlers:
+                # the exception unwinds through the INNER finallies before
+                # the handler sees it — credit those effects. The catching
+                # try's own finally runs AFTER its handler, so it is
+                # deliberately NOT credited here (checked before appending).
+                st_c = self.copy_state(st)
+                if finallies:
+                    st_c = self.apply_finallies(st_c, tuple(finallies))
+                frame.exc_state = self.join_states(frame.exc_state, st_c)
+                return
+            if frame.node.finalbody:
+                finallies.append(frame.node)
+        self.escapes.append(
+            EscapeEdge(kind, line, desc, self.copy_state(st), finallies)
+        )
+
+    def apply_finallies(self, state, try_nodes):
+        """Domain hook: apply the effects of the given trys' ``finally``
+        blocks to ``state`` (an exception passes through them on its way to
+        an outer handler). Default: no effect."""
+        return state
+
+    # -- walk ---------------------------------------------------------------
+
+    def run(self, body, st):
+        st = self.walk_block(body, st)
+        if st is not None:
+            self.escapes.append(
+                EscapeEdge("end", body[-1].lineno if body else 0, "function end", st)
+            )
+        return st
+
+    def walk_block(self, stmts, st):
+        for s in stmts:
+            if st is None:
+                break  # unreachable after return/raise/break/continue
+            st = self.walk_stmt(s, st)
+        return st
+
+    def walk_stmt(self, s, st):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return st  # nested scopes analysed separately (or not at all)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            return self.walk_with(s, st)
+        if isinstance(s, ast.If):
+            return self.walk_if(s, st)
+        if isinstance(s, ast.While):
+            return self.walk_while(s, st)
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            return self.walk_for(s, st)
+        if isinstance(s, ast.Try) or (
+            hasattr(ast, "TryStar") and isinstance(s, getattr(ast, "TryStar"))
+        ):
+            return self.walk_try(s, st)
+        if isinstance(s, ast.Expr):
+            return self.walk_expr_stmt(s, st)
+        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            return self.walk_assign(s, st)
+        if isinstance(s, ast.Return):
+            return self.walk_return(s, st)
+        if isinstance(s, ast.Raise):
+            return self.walk_raise(s, st)
+        if isinstance(s, (ast.Break, ast.Continue)):
+            return self.walk_jump(s, st)
+        if isinstance(s, (ast.Assert, ast.Delete, ast.Global, ast.Nonlocal, ast.Pass)):
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self.scan_expr(child, st)
+            return st
+        return st
+
+    # -- structural defaults (join semantics) -------------------------------
+
+    def walk_with(self, s, st):
+        entry = st
+        for item in s.items:
+            self.scan_expr(item.context_expr, st)
+            st = self.on_with_enter(item, st)
+        body_exit = self.walk_block(s.body, st)
+        return self.on_with_exit(s, entry, body_exit)
+
+    def on_with_enter(self, item, st):
+        return st
+
+    def on_with_exit(self, s, entry, body_exit):
+        return body_exit if self.effects_escape else entry
+
+    def walk_if(self, s, st):
+        self.scan_expr(s.test, st)
+        a = self.walk_block(s.body, self.copy_state(st))
+        b = self.walk_block(s.orelse, self.copy_state(st))
+        if self.effects_escape:
+            return self.join_states(a, b)
+        return st
+
+    def walk_while(self, s, st):
+        self.scan_expr(s.test, st)
+        return self._walk_loop(s, st)
+
+    def walk_for(self, s, st):
+        self.scan_expr(s.iter, st)
+        return self._walk_loop(s, st)
+
+    def _walk_loop(self, s, st):
+        body_exit = self.walk_block(s.body, self.copy_state(st))
+        if self.effects_escape:
+            # one-pass approximation: the loop runs zero or more times, so
+            # the fall-through state is entry ⊔ one-iteration
+            st = self.join_states(self.copy_state(st), body_exit)
+        else:
+            self.walk_block(s.orelse, self.copy_state(st))
+            return st
+        return self.walk_block(s.orelse, st) if s.orelse else st
+
+    def walk_try(self, s, st):
+        frame = TryFrame(s)
+        self._frames.append(frame)
+        body_exit = self.walk_block(s.body, self.copy_state(st))
+        self._frames.pop()
+        # handler/orelse bodies stay protected by this try's finally (but
+        # not by its own handlers)
+        fin_guard = TryFrame(s, handlers_active=False) if s.finalbody else None
+        if fin_guard is not None:
+            self._frames.append(fin_guard)
+        handler_exits = []
+        if frame.exc_state is not None:
+            for h in s.handlers:
+                handler_exits.append(
+                    self.walk_block(h.body, self.copy_state(frame.exc_state))
+                )
+        out = body_exit
+        if s.orelse and body_exit is not None:
+            out = self.walk_block(s.orelse, body_exit)
+        for he in handler_exits:
+            out = self.join_states(out, he)
+        if fin_guard is not None:
+            self._frames.pop()
+        if s.finalbody:
+            # the finally runs on every path; walk it from the merged state
+            # (or the entry copy if every path inside terminated)
+            out = self.walk_block(
+                s.finalbody, out if out is not None else self.copy_state(st)
+            )
+        return out
+
+    def walk_expr_stmt(self, s, st):
+        self.scan_expr(s.value, st)
+        return st
+
+    def walk_assign(self, s, st):
+        if s.value is not None:
+            self.scan_expr(s.value, st)
+        return st
+
+    def walk_return(self, s, st):
+        if s.value is not None:
+            self.scan_expr(s.value, st)
+        self.on_return(s, st)
+        return None
+
+    def on_return(self, s, st):
+        finallies = [f.node for f in reversed(self._frames) if f.node.finalbody]
+        self.escapes.append(
+            EscapeEdge("return", s.lineno, "return", self.copy_state(st), finallies)
+        )
+
+    def walk_raise(self, s, st):
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self.scan_expr(child, st)
+        self.note_may_raise(st, s.lineno, "raise", kind="raise")
+        return None
+
+    def walk_jump(self, s, st):
+        # break/continue end this path; the loop join already folded the
+        # one-iteration state in, so dropping it here is the safe bottom
+        return None

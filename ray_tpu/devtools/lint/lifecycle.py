@@ -1,0 +1,765 @@
+"""ref-lifecycle: resource acquire/release tracking through exception edges.
+
+Infer/Pulse-style lifetime analysis over the generic flow core
+(:mod:`.flow`): a *resource* (shm segment, plasma client/arena mapping,
+socket, tempfile/tempdir, file handle, dropped ObjectRef put) is acquired
+into a local, and the walk tracks its status — open, released,
+maybe-released (join of both), escaped — through branches, loops, ``try``
+frames, and the function's escape edges. Findings:
+
+- **leak-on-raise**: an operation that may raise executes while an
+  unprotected resource is open and no enclosing handler catches — the
+  propagating exception strands the handle (the PR 4 spilled-reply RSS leak
+  shape). Releases performed by enclosing ``finally`` blocks are credited.
+- **leak-on-return / never released**: an early return (or the implicit
+  fall-off-the-end) with an open resource that neither escaped nor released.
+- **double-release**: a non-idempotent release op (``unlink``, ``os.close``)
+  applied twice to the same definitely-released handle.
+- **use-after-release**: a use-class operation on a definitely-released
+  handle (``seg.buf`` after close, ``sock.send`` after close).
+
+Escape is the precision valve: a handle that is returned, yielded, stored
+into an attribute/container, or passed to an unknown call belongs to someone
+else and is never reported. Interprocedural summaries credit project helpers
+that release a parameter (``_close_segment(seg)``) and propagate factory
+returns (``x = make_socket()`` acquires in the caller).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .engine import _Ctx, _expr_text
+from .flow import FlowWalker
+from .model import Finding, SourceLoc
+
+OPEN, MAYBE, RELEASED, ESCAPED = "open", "maybe", "released", "escaped"
+
+# dotted call target -> resource kind (resolved through module imports)
+ACQUIRES: dict[str, str] = {
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "multiprocessing.shared_memory.SharedMemory": "shm",
+    "tempfile.NamedTemporaryFile": "tempfile",
+    "tempfile.TemporaryFile": "tempfile",
+    "tempfile.mkstemp": "tempfile",
+    "tempfile.mkdtemp": "tempdir",
+    "ray_tpu._private.object_store.PlasmaClient": "plasma-client",
+    "ray_tpu._native.plasma.NativeArena": "arena",
+    "open": "file",
+    "os.fdopen": "file",
+}
+
+# ObjectRef puts are GC-managed; the only statically meaningful leak is a
+# put whose ref is dropped on the floor (dead put — the stored object is
+# reclaimed before anyone could read it)
+OBJECTREF_PUTS = {"ray_tpu.put"}
+
+RELEASE_METHODS: dict[str, frozenset] = {
+    "socket": frozenset({"close", "detach"}),
+    "shm": frozenset({"close", "unlink"}),
+    "tempfile": frozenset({"close"}),
+    "tempdir": frozenset(),
+    "plasma-client": frozenset({"close"}),
+    "arena": frozenset({"close"}),
+    "file": frozenset({"close"}),
+    "objectref": frozenset(),
+}
+
+# helper call target -> (release-op label, one-shot?) applied to its arg 0
+RELEASE_HELPERS: dict[str, tuple] = {
+    "os.close": ("os.close", True),
+    "shutil.rmtree": ("rmtree", False),
+    "os.rmdir": ("rmdir", True),
+    "os.remove": ("remove", True),
+    "os.unlink": ("unlink", True),
+}
+
+# release ops that are NOT idempotent: applying them twice is itself a bug
+NONIDEMPOTENT_OPS = frozenset({"unlink", "os.close", "rmdir", "remove"})
+
+USE_METHODS: dict[str, frozenset] = {
+    "socket": frozenset(
+        {"send", "sendall", "sendto", "recv", "recv_into", "recvfrom",
+         "connect", "bind", "listen", "accept", "getsockname", "makefile"}
+    ),
+    "file": frozenset({"read", "write", "seek", "flush", "readline", "readlines"}),
+    "tempfile": frozenset({"read", "write", "seek", "flush"}),
+    "shm": frozenset(),
+    "arena": frozenset({"view", "write", "alloc", "lookup"}),
+}
+USE_ATTRS: dict[str, frozenset] = {"shm": frozenset({"buf"})}
+
+# calls that neither raise (for edge purposes) nor capture their arguments
+_SAFE_CALLS = frozenset(
+    {"len", "str", "repr", "int", "float", "bool", "bytes", "bytearray",
+     "isinstance", "issubclass", "getattr", "hasattr", "id", "print",
+     "format", "min", "max", "abs", "sorted", "list", "dict", "tuple",
+     "set", "frozenset", "enumerate", "zip", "range", "type", "vars",
+     "memoryview"}
+)
+
+_KIND_LABEL = {
+    "socket": "socket",
+    "shm": "shm segment",
+    "tempfile": "tempfile",
+    "tempdir": "tempdir",
+    "plasma-client": "plasma client (cached mappings)",
+    "arena": "plasma arena mapping",
+    "file": "file handle",
+    "objectref": "ObjectRef",
+}
+
+
+def _dotted(fn: ast.expr, imports: dict) -> str | None:
+    """Attribute chain / Name -> dotted target via the module's imports."""
+    parts = []
+    node = fn
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        base = imports.get(node.id, node.id)
+        return ".".join([base] + list(reversed(parts)))
+    return None
+
+
+def _names_in(expr: ast.expr):
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            yield node.id
+
+
+class _Res:
+    """One tracked resource; aliases share the record within a state."""
+
+    __slots__ = (
+        "kind", "var", "line", "desc", "status", "released_ops",
+        "protected", "via",
+    )
+
+    def __init__(self, kind, var, line, desc, via=()):
+        self.kind = kind
+        self.var = var
+        self.line = line
+        self.desc = desc
+        self.status = OPEN
+        self.released_ops: set = set()
+        self.protected = False
+        self.via = tuple(via)  # interprocedural acquire chain, if any
+
+    def clone(self):
+        r = _Res(self.kind, self.var, self.line, self.desc, self.via)
+        r.status = self.status
+        r.released_ops = set(self.released_ops)
+        r.protected = self.protected
+        return r
+
+
+@dataclass
+class FnSummary:
+    """What a function does to resources across its boundary."""
+
+    releases: set = field(default_factory=set)  # param indices it releases
+    returns_kind: str | None = None  # factory: returns a fresh resource
+
+
+def _param_names(node) -> list:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    return names
+
+
+def summarize(project) -> dict:
+    """qualname -> FnSummary, with transitive propagation (3 rounds)."""
+    release_union = frozenset().union(*RELEASE_METHODS.values())
+    summaries: dict[str, FnSummary] = {}
+    for func in project.functions.values():
+        if func.node is None:
+            continue
+        mod = project.modules.get(func.module)
+        if mod is None:
+            continue
+        s = FnSummary()
+        params = _param_names(func.node)
+        skip0 = 1 if (func.cls is not None and params and params[0] == "self") else 0
+        idx = {p: i for i, p in enumerate(params)}
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in idx
+                    and idx[fn.value.id] >= skip0
+                    and fn.attr in release_union
+                ):
+                    s.releases.add(idx[fn.value.id])
+                else:
+                    dotted = _dotted(fn, mod.imports)
+                    if (
+                        dotted in RELEASE_HELPERS
+                        and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in idx
+                        and idx[node.args[0].id] >= skip0
+                    ):
+                        s.releases.add(idx[node.args[0].id])
+            elif isinstance(node, ast.Return) and isinstance(node.value, ast.Call):
+                dotted = _dotted(node.value.func, mod.imports)
+                kind = ACQUIRES.get(dotted) if dotted else None
+                if kind is not None:
+                    s.returns_kind = kind
+        if s.releases or s.returns_kind:
+            summaries[func.qualname] = s
+
+    # transitive: f(p) passes p to g which releases it / f returns g()
+    for _ in range(3):
+        changed = False
+        for func in project.functions.values():
+            if func.node is None:
+                continue
+            mod = project.modules.get(func.module)
+            if mod is None:
+                continue
+            cls = project.classes.get(func.cls) if func.cls else None
+            ctx = _Ctx(project, mod, cls, func)
+            params = _param_names(func.node)
+            skip0 = 1 if (func.cls is not None and params and params[0] == "self") else 0
+            idx = {p: i for i, p in enumerate(params)}
+            s = summaries.get(func.qualname)
+            for node in ast.walk(func.node):
+                if isinstance(node, ast.Call):
+                    callee = ctx.resolve_callee(node)
+                    cs = summaries.get(callee) if callee else None
+                    if cs is None:
+                        continue
+                    callee_func = project.functions.get(callee)
+                    callee_skip = 0
+                    if callee_func is not None and callee_func.cls is not None:
+                        callee_skip = 1
+                    for ai, arg in enumerate(node.args):
+                        if (
+                            isinstance(arg, ast.Name)
+                            and arg.id in idx
+                            and idx[arg.id] >= skip0
+                            and (ai + callee_skip) in cs.releases
+                        ):
+                            if s is None:
+                                s = summaries.setdefault(func.qualname, FnSummary())
+                            if idx[arg.id] not in s.releases:
+                                s.releases.add(idx[arg.id])
+                                changed = True
+                elif isinstance(node, ast.Return) and isinstance(node.value, ast.Call):
+                    callee = ctx.resolve_callee(node.value)
+                    cs = summaries.get(callee) if callee else None
+                    if cs is not None and cs.returns_kind:
+                        if s is None:
+                            s = summaries.setdefault(func.qualname, FnSummary())
+                        if s.returns_kind is None:
+                            s.returns_kind = cs.returns_kind
+                            changed = True
+        if not changed:
+            break
+    return summaries
+
+
+class _LifecycleWalker(FlowWalker):
+    effects_escape = True
+
+    def __init__(self, ctx: _Ctx, summaries: dict):
+        super().__init__()
+        self.ctx = ctx
+        self.f = ctx.func
+        self.summaries = summaries
+        self.findings: list = []
+        self._reported: set = set()
+
+    # -- state: dict name -> _Res (aliases share the record) ---------------
+
+    def copy_state(self, st):
+        memo: dict[int, _Res] = {}
+        out = {}
+        for name, rec in st.items():
+            c = memo.get(id(rec))
+            if c is None:
+                c = memo[id(rec)] = rec.clone()
+            out[name] = c
+        return out
+
+    def merge(self, a, b):
+        out = {}
+        memo: dict[tuple, _Res] = {}
+        for name in set(a) | set(b):
+            ra, rb = a.get(name), b.get(name)
+            if ra is None or rb is None:
+                out[name] = ra or rb
+                continue
+            key = (id(ra), id(rb))
+            m = memo.get(key)
+            if m is None:
+                m = ra.clone()
+                if ESCAPED in (ra.status, rb.status):
+                    m.status = ESCAPED
+                elif ra.status == rb.status:
+                    m.status = ra.status
+                else:
+                    m.status = MAYBE
+                m.released_ops = ra.released_ops | rb.released_ops
+                m.protected = ra.protected or rb.protected
+                memo[key] = m
+            out[name] = m
+        return out
+
+    # -- helpers ------------------------------------------------------------
+
+    def _emit(self, key, line, message, path=()):
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.findings.append(
+            Finding(
+                check="ref-lifecycle",
+                file=self.f.file,
+                line=line,
+                qualname=self.f.qualname,
+                message=message,
+                key=key,
+                path=list(path),
+            )
+        )
+
+    def _acquire_kind(self, call: ast.Call):
+        """(kind, via-chain) if the call constructs a tracked resource."""
+        dotted = _dotted(call.func, self.ctx.mod.imports)
+        if dotted:
+            kind = ACQUIRES.get(dotted)
+            if kind is not None:
+                return kind, ()
+            if dotted in OBJECTREF_PUTS:
+                return "objectref", ()
+        callee = self.ctx.resolve_callee(call)
+        if callee is not None:
+            s = self.summaries.get(callee)
+            if s is not None and s.returns_kind:
+                fi = self.ctx.project.functions.get(callee)
+                loc = SourceLoc(fi.file, fi.line) if fi is not None else "?"
+                return s.returns_kind, (f"acquired via {callee}() ({loc})",)
+        return None
+
+    def _release(self, rec: _Res, op: str, line: int):
+        if (
+            rec.status == RELEASED
+            and op in rec.released_ops
+            and op in NONIDEMPOTENT_OPS
+        ):
+            self._emit(
+                f"double|{rec.kind}|{rec.var}|{op}",
+                line,
+                f"{_KIND_LABEL.get(rec.kind, rec.kind)} `{rec.var}` released "
+                f"twice via {op} (first release already happened on every "
+                f"path to line {line})",
+            )
+        rec.status = RELEASED
+        rec.released_ops.add(op)
+
+    def _escape(self, rec: _Res):
+        rec.status = ESCAPED
+
+    def _escape_names(self, expr, st):
+        for n in _names_in(expr):
+            rec = st.get(n)
+            if rec is not None:
+                self._escape(rec)
+
+    def apply_finallies(self, st, try_nodes):
+        credited = _finally_released_names(try_nodes, self.ctx, self.summaries)
+        if credited:
+            for name, rec in st.items():
+                if (name in credited or rec.var in credited) and rec.status in (
+                    OPEN,
+                    MAYBE,
+                ):
+                    rec.status = RELEASED
+                    rec.released_ops.add("finally")
+        return st
+
+    # -- expression scan ----------------------------------------------------
+
+    def scan_expr(self, expr, st, awaited=False):
+        if expr is None:
+            return
+        if isinstance(expr, (ast.Yield, ast.YieldFrom)):
+            if expr.value is not None:
+                self._escape_names(expr.value, st)
+                self.scan_expr(expr.value, st)
+            return
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            rec = st.get(expr.value.id)
+            if (
+                rec is not None
+                and rec.status == RELEASED
+                and expr.attr in USE_ATTRS.get(rec.kind, ())
+            ):
+                self._emit(
+                    f"uar|{rec.kind}|{rec.var}|{expr.attr}",
+                    expr.lineno,
+                    f"use of `{rec.var}.{expr.attr}` after "
+                    f"{_KIND_LABEL.get(rec.kind, rec.kind)} was released "
+                    f"({'/'.join(sorted(rec.released_ops))})",
+                )
+        super().scan_expr(expr, st, awaited=awaited)
+
+    def on_call(self, call: ast.Call, st, awaited: bool):
+        fn = call.func
+        # 1) method calls on a tracked handle
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            rec = st.get(fn.value.id)
+            if rec is not None:
+                meth = fn.attr
+                if meth in RELEASE_METHODS.get(rec.kind, ()):
+                    self._release(rec, meth, call.lineno)
+                    return
+                if rec.status == RELEASED and meth in USE_METHODS.get(rec.kind, ()):
+                    self._emit(
+                        f"uar|{rec.kind}|{rec.var}|{meth}",
+                        call.lineno,
+                        f"call `{rec.var}.{meth}()` after "
+                        f"{_KIND_LABEL.get(rec.kind, rec.kind)} was released "
+                        f"({'/'.join(sorted(rec.released_ops))})",
+                    )
+                    return
+                # any other method on a live handle may raise mid-lifetime
+                self.note_may_raise(
+                    st, call.lineno, f"{rec.var}.{meth}({_args_preview(call)})"
+                )
+                return
+        dotted = _dotted(fn, self.ctx.mod.imports)
+        # 2) helper releases: os.close(fd), shutil.rmtree(d), _close_segment(seg)
+        if dotted in RELEASE_HELPERS and call.args:
+            arg0 = call.args[0]
+            if isinstance(arg0, ast.Name):
+                rec = st.get(arg0.id)
+                if rec is not None:
+                    op, _ = RELEASE_HELPERS[dotted]
+                    self._release(rec, op, call.lineno)
+                    return
+        callee = self.ctx.resolve_callee(call)
+        if callee is not None:
+            cs = self.summaries.get(callee)
+            if cs is not None and cs.releases:
+                cf = self.ctx.project.functions.get(callee)
+                skip = 1 if (cf is not None and cf.cls is not None) else 0
+                released_any = False
+                for ai, arg in enumerate(call.args):
+                    if isinstance(arg, ast.Name) and (ai + skip) in cs.releases:
+                        rec = st.get(arg.id)
+                        if rec is not None:
+                            self._release(rec, f"{callee.rsplit('.', 1)[1]}()", call.lineno)
+                            released_any = True
+                if released_any:
+                    return
+        # 3) unknown call: tracked handles passed as args escape; the call
+        #    itself is an exception edge for whatever is still open
+        if dotted is not None and dotted in _SAFE_CALLS:
+            return
+        for arg in call.args:
+            if isinstance(arg, ast.Name):
+                rec = st.get(arg.id)
+                if rec is not None:
+                    self._escape(rec)
+        for kw in call.keywords:
+            if isinstance(kw.value, ast.Name):
+                rec = st.get(kw.value.id)
+                if rec is not None:
+                    self._escape(rec)
+        if self._acquire_kind(call) is None:
+            self.note_may_raise(st, call.lineno, _expr_text(call.func) + "()")
+
+    # -- statements ---------------------------------------------------------
+
+    def walk_assign(self, s, st):
+        if isinstance(s, ast.AugAssign):
+            targets, value = [], s.value
+        elif isinstance(s, ast.AnnAssign):
+            targets, value = ([s.target] if s.value is not None else []), s.value
+        else:
+            targets, value = s.targets, s.value
+        if value is not None:
+            self.scan_expr(value, st)
+
+        acquired = None
+        if isinstance(value, ast.Call):
+            acquired = self._acquire_kind(value)
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                old = st.get(tgt.id)
+                if (
+                    old is not None
+                    and old.status == OPEN
+                    and not old.protected
+                    and old.kind != "objectref"  # GC releases a dropped ref
+                    and sum(1 for r in st.values() if r is old) == 1
+                    and not isinstance(value, ast.Name)
+                ):
+                    self._emit(
+                        f"leak-rebind|{old.kind}|{old.var}",
+                        s.lineno,
+                        f"{_KIND_LABEL.get(old.kind, old.kind)} `{old.var}` "
+                        f"(acquired line {old.line}) overwritten while still "
+                        f"open — the handle is unreachable and never released",
+                    )
+                if acquired is not None:
+                    kind, via = acquired
+                    st[tgt.id] = _Res(kind, tgt.id, s.lineno, _expr_text(value), via)
+                elif isinstance(value, ast.Name) and value.id in st:
+                    st[tgt.id] = st[value.id]  # alias
+                else:
+                    st.pop(tgt.id, None)
+            elif isinstance(tgt, ast.Tuple) and acquired is not None:
+                # fd, path = tempfile.mkstemp(): the first element is the handle
+                for elt in tgt.elts:
+                    if isinstance(elt, ast.Name):
+                        kind, via = acquired
+                        st[elt.id] = _Res(kind, elt.id, s.lineno, _expr_text(value), via)
+                        break
+            elif isinstance(tgt, (ast.Attribute, ast.Subscript, ast.Tuple, ast.Starred)):
+                # storing a handle anywhere non-local transfers ownership
+                if value is not None:
+                    self._escape_names(value, st)
+                # a store INTO a tracked handle's buffer may raise (and is a
+                # use-after-release once the handle is gone): seg.buf[:] = data
+                if isinstance(tgt, ast.Subscript):
+                    base = tgt.value
+                    if isinstance(base, ast.Attribute) and isinstance(
+                        base.value, ast.Name
+                    ):
+                        rec = st.get(base.value.id)
+                        if rec is not None:
+                            if (
+                                rec.status == RELEASED
+                                and base.attr in USE_ATTRS.get(rec.kind, ())
+                            ):
+                                self._emit(
+                                    f"uar|{rec.kind}|{rec.var}|{base.attr}",
+                                    s.lineno,
+                                    f"store into `{rec.var}.{base.attr}` after "
+                                    f"{_KIND_LABEL.get(rec.kind, rec.kind)} was "
+                                    f"released",
+                                )
+                            else:
+                                self.note_may_raise(
+                                    st, s.lineno,
+                                    f"{rec.var}.{base.attr}[...] = ... store",
+                                )
+        if acquired is not None and not targets:
+            pass
+        return st
+
+    def walk_expr_stmt(self, s, st):
+        # a bare acquire drops the handle on the floor
+        if isinstance(s.value, ast.Call):
+            acq = self._acquire_kind(s.value)
+            if acq is not None:
+                kind, _ = acq
+                if kind == "objectref":
+                    self._emit(
+                        f"dropped|{kind}|{_expr_text(s.value)[:60]}",
+                        s.lineno,
+                        f"ObjectRef from {_expr_text(s.value)} dropped "
+                        f"immediately — the stored object is reclaimed before "
+                        f"anyone can read it (dead put)",
+                    )
+                else:
+                    self._emit(
+                        f"dropped|{kind}|{_expr_text(s.value)[:60]}",
+                        s.lineno,
+                        f"{_KIND_LABEL.get(kind, kind)} handle from "
+                        f"{_expr_text(s.value)} discarded immediately — it "
+                        f"can never be released",
+                    )
+                # still scan args
+                for a in s.value.args:
+                    self.scan_expr(a, st)
+                for kw in s.value.keywords:
+                    self.scan_expr(kw.value, st)
+                return st
+        self.scan_expr(s.value, st)
+        return st
+
+    def walk_return(self, s, st):
+        if s.value is not None:
+            # scan first (a released handle used in the return expression is
+            # still a use-after-release), THEN hand ownership to the caller
+            self.scan_expr(s.value, st)
+            self._escape_names(s.value, st)
+        self.on_return(s, st)
+        return None
+
+    # -- with: context managers own their resource --------------------------
+
+    def on_with_enter(self, item, st):
+        expr = item.context_expr
+        bound = None
+        if isinstance(item.optional_vars, ast.Name):
+            bound = item.optional_vars.id
+        if isinstance(expr, ast.Call):
+            acq = self._acquire_kind(expr)
+            if acq is not None and bound is not None:
+                kind, via = acq
+                rec = _Res(kind, bound, expr.lineno, _expr_text(expr), via)
+                rec.protected = True
+                st = dict(st)
+                st[bound] = rec
+                self._with_bound(item, rec)
+                return st
+            # with closing(x): / with contextlib.suppress-wrapped handle
+            dotted = _dotted(expr.func, self.ctx.mod.imports)
+            if dotted in ("contextlib.closing", "closing") and expr.args:
+                a0 = expr.args[0]
+                if isinstance(a0, ast.Name) and a0.id in st:
+                    rec = st[a0.id]
+                    rec.protected = True
+                    self._with_bound(item, rec)
+        elif isinstance(expr, ast.Name) and expr.id in st:
+            rec = st[expr.id]
+            rec.protected = True
+            self._with_bound(item, rec)
+        return st
+
+    def _with_bound(self, item, rec):
+        if not hasattr(self, "_with_stack"):
+            self._with_stack = {}
+        self._with_stack.setdefault(id(item), []).append(rec)
+
+    def on_with_exit(self, s, entry, body_exit):
+        st = body_exit
+        stack = getattr(self, "_with_stack", {})
+        for item in s.items:
+            for rec in stack.pop(id(item), ()):
+                rec.status = RELEASED
+                if st is not None:
+                    # the exit releases every alias of the record
+                    for r in st.values():
+                        if r.var == rec.var and r.line == rec.line:
+                            r.status = RELEASED
+        return st
+
+
+def _args_preview(call: ast.Call) -> str:
+    if not call.args and not call.keywords:
+        return ""
+    return "..."
+
+
+_LEAK_CLASS = {
+    "call-raise": ("leak-raise", "leaks when {desc} raises (no enclosing "
+                   "handler or finally releases it)"),
+    "raise": ("leak-raise", "leaks at the raise on line {line}"),
+    "return": ("leak-return", "leaks on the early return at line {line}"),
+    "end": ("leak-end", "is never released on the fall-through path"),
+}
+
+
+def _finally_released_names(try_nodes, ctx: _Ctx, summaries: dict) -> set:
+    """Names actually RELEASED inside the finalbody of the given trys:
+    release-method calls (``x.close()``), known release helpers
+    (``os.close(x)``/``shutil.rmtree(d)``), and project functions whose
+    summary releases the argument (``_close_segment(seg)``). An arbitrary
+    call with the handle as an argument (``log(seg)``) credits nothing —
+    blanket crediting would mask real leak-on-raise findings."""
+    release_union = frozenset().union(*RELEASE_METHODS.values())
+    out = set()
+    for t in try_nodes:
+        for node in ast.walk(ast.Module(body=list(t.finalbody), type_ignores=[])):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.attr in release_union
+            ):
+                out.add(fn.value.id)
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Name)):
+                continue
+            dotted = _dotted(fn, ctx.mod.imports)
+            if dotted in RELEASE_HELPERS:
+                out.add(node.args[0].id)
+                continue
+            callee = ctx.resolve_callee(node)
+            cs = summaries.get(callee) if callee else None
+            if cs is not None and cs.releases:
+                cf = ctx.project.functions.get(callee)
+                skip = 1 if (cf is not None and cf.cls is not None) else 0
+                for ai, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Name) and (ai + skip) in cs.releases:
+                        out.add(arg.id)
+    return out
+
+
+def check_ref_lifecycle(project) -> list:
+    summaries = summarize(project)
+    findings = []
+    for func in project.functions.values():
+        if func.node is None:
+            continue
+        mod = project.modules.get(func.module)
+        if mod is None:
+            continue
+        cls = project.classes.get(func.cls) if func.cls else None
+        ctx = _Ctx(project, mod, cls, func)
+        w = _LifecycleWalker(ctx, summaries)
+        try:
+            w.run(func.node.body, {})
+        except RecursionError:
+            project.errors.append((func.file, f"lifecycle overflow in {func.qualname}"))
+            continue
+        findings.extend(w.findings)
+        reported = w._reported
+        for edge in w.escapes:
+            if edge.state is None:
+                continue
+            credited = (
+                _finally_released_names(edge.finallies, ctx, summaries)
+                if edge.finallies
+                else ()
+            )
+            seen_recs = set()
+            for name, rec in edge.state.items():
+                if id(rec) in seen_recs:
+                    continue
+                seen_recs.add(id(rec))
+                if rec.status != OPEN or rec.protected:
+                    continue
+                if rec.kind == "objectref":
+                    # refs are GC-managed: a stranded local is released by
+                    # __del__; only the dropped-ref case (walk_expr_stmt) is
+                    # a statically meaningful ObjectRef bug
+                    continue
+                if rec.var in credited or name in credited:
+                    continue
+                cls_key, msg_tpl = _LEAK_CLASS[edge.kind]
+                key = f"{cls_key}|{rec.kind}|{rec.var}"
+                if key in reported:
+                    continue
+                reported.add(key)
+                msg = msg_tpl.format(desc=edge.desc, line=edge.line)
+                findings.append(
+                    Finding(
+                        check="ref-lifecycle",
+                        file=func.file,
+                        line=edge.line,
+                        qualname=func.qualname,
+                        message=(
+                            f"{_KIND_LABEL.get(rec.kind, rec.kind)} `{rec.var}` "
+                            f"(acquired line {rec.line}: {rec.desc}) {msg}"
+                        ),
+                        key=key,
+                        path=list(rec.via)
+                        + [f"acquired at {func.file}:{rec.line}"],
+                    )
+                )
+    return findings

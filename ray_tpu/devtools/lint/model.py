@@ -41,6 +41,19 @@ CHECKS: dict[str, str] = {
         "a thread is started whose join/flush is not reachable from the "
         "owning object's shutdown path (leaked work at teardown)"
     ),
+    "collective-uniformity": (
+        "a collective operation (jax psum/all_gather/ppermute/shard_map "
+        "bodies, util/train collectives, gang step/broadcast-plan paths) is "
+        "reachable under rank-, host-, time-, or exception-dependent control "
+        "flow without a matching collective on the other arm — or two "
+        "collectives are issued in different orders on different arms; "
+        "either way the gang hangs at the next rendezvous"
+    ),
+    "ref-lifecycle": (
+        "a resource handle (shm segment, plasma client/arena mapping, "
+        "socket, tempfile, file, dropped ObjectRef) leaks on an exception "
+        "edge or early return, is released twice, or is used after release"
+    ),
 }
 
 # Method names treated as an object's shutdown path for shutdown-hygiene

@@ -46,9 +46,11 @@ class TrainWorker:
         from ray_tpu._private.protocol import routable_host
 
         s = socket.socket()
-        s.bind(("", 0))
-        port = s.getsockname()[1]
-        s.close()
+        try:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        finally:
+            s.close()
         return f"{routable_host()}:{port}"
 
     def setup(

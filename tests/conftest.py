@@ -74,10 +74,13 @@ def pytest_runtest_protocol(item, nextitem):
         return
 
     def _on_alarm(signum, frame):
-        # Triage dump BEFORE unwinding: every thread's stack plus the
-        # registered-lock owner table (ray_tpu._private.locktrace), so a
-        # deadlock is diagnosed from this log instead of a 300 s bisect
-        # (the PR 3 seal-through-own-pump hang took exactly that).
+        # Triage dump BEFORE unwinding: every thread's stack, the
+        # registered-lock owner table, AND the live-resource table (shm
+        # segments, plasma-client mapping counts, outstanding ObjectRef
+        # counts — ray_tpu._private.locktrace), so a deadlock OR a leaked
+        # segment is diagnosed from this log instead of a 300 s bisect
+        # (the PR 3 seal-through-own-pump hang took exactly that; the PR 4
+        # spilled-reply RSS leak was found by hand).
         import sys
 
         try:
@@ -93,8 +96,9 @@ def pytest_runtest_protocol(item, nextitem):
             traceback.print_exc(file=sys.stderr)
         raise _TestTimeout(
             f"test exceeded its {timeout:.0f}s watchdog "
-            f"(per-test timeout guard; thread stacks + lock owner table "
-            f"dumped to stderr; see tests/conftest.py)"
+            f"(per-test timeout guard; thread stacks + lock owner table + "
+            f"live shm/ref resource table dumped to stderr; see "
+            f"tests/conftest.py)"
         )
 
     old = signal.signal(signal.SIGALRM, _on_alarm)

@@ -1,3 +1,7 @@
 # Fixture package for tests/test_tpulint.py. These modules are ANALYZED by
 # tpulint, never imported by tests — each reproduces (or deliberately
-# avoids) a concurrency bug shape this repo has actually shipped.
+# avoids) a bug shape this repo has actually shipped: concurrency shapes
+# (seal-through-own-pump, proxy event-loop block), SPMD divergence shapes
+# (rank-divergent collective, cross-arm order mismatch), and resource
+# lifetime shapes (the PR 4 spilled-reply leak: leak-on-raise, early
+# return, double-unlink, use-after-release).

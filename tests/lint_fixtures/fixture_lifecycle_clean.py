@@ -1,0 +1,68 @@
+"""Self-detection fixture: correct lifecycle + uniform collectives.
+
+Every shape here is the RIGHT way to do what the other fixtures do wrong:
+try/finally release, with-statement ownership, detach-then-unlink,
+escape-by-store, and rank-uniform collectives. tpulint must report ZERO
+findings on this file.
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import socket
+from multiprocessing import shared_memory
+
+import jax
+
+
+def reserve_port() -> int:
+    """try/finally: the probe socket is released on every path."""
+    s = socket.socket()
+    try:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def read_segment(name: str, size: int) -> bytes:
+    """Exception path releases before propagating."""
+    seg = shared_memory.SharedMemory(name=name)
+    try:
+        data = bytes(seg.buf[:size])
+    except BaseException:
+        seg.close()
+        raise
+    seg.close()
+    seg.unlink()
+    return data
+
+
+def read_with(name: str, size: int) -> bytes:
+    """Context manager owns the handle."""
+    with shared_memory.SharedMemory(name=name) as seg:
+        return bytes(seg.buf[:size])
+
+
+class SegmentCache:
+    def __init__(self):
+        self._attached = {}
+
+    def attach(self, name: str):
+        """Escape-by-store: the cache owns the segment's lifetime now."""
+        seg = shared_memory.SharedMemory(name=name)
+        self._attached[name] = seg
+        return seg
+
+
+class UniformWorker:
+    """Rank checks that never guard a collective are fine."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+
+    def step(self, grads, tokens):
+        grads = jax.lax.psum(grads, "dp")
+        if self.rank == 0:
+            tokens = list(tokens)  # host-side report, no rendezvous
+        return grads, tokens

@@ -1,0 +1,37 @@
+"""Self-detection fixture: the collective-order-mismatch shape.
+
+Both arms of a rank-dependent branch issue the same two collectives but in
+opposite orders — rank 0 sits in the psum while everyone else sits in the
+all_gather (ABBA at gang scale). tpulint must flag the order mismatch
+(collective-uniformity).
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import jax
+
+
+class OrderMismatchWorker:
+    def __init__(self, rank: int):
+        self.is_coordinator = rank == 0
+
+    def bad_step(self, grads, acts):
+        if self.is_coordinator:
+            grads = jax.lax.psum(grads, "dp")
+            acts = jax.lax.all_gather(acts, "dp")
+        else:
+            acts = jax.lax.all_gather(acts, "dp")
+            grads = jax.lax.psum(grads, "dp")
+        return grads, acts
+
+    def good_step(self, grads, acts):
+        # same ops, same order on both arms — uniform even though the
+        # condition is rank-dependent
+        if self.is_coordinator:
+            grads = jax.lax.psum(grads, "dp")
+            acts = jax.lax.all_gather(acts, "dp")
+        else:
+            grads = jax.lax.psum(grads * 2, "dp")
+            acts = jax.lax.all_gather(acts * 2, "dp")
+        return grads, acts

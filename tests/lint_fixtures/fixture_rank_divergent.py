@@ -1,0 +1,45 @@
+"""Self-detection fixture: the rank-divergent-collective gang shape.
+
+A gang worker runs a psum only on rank 0 (directly, and through a helper) —
+every other worker never reaches the rendezvous and the gang hangs at the
+next barrier. tpulint must flag both the direct branch shape and the
+guard-return shape with the call chain (collective-uniformity).
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import jax
+
+
+class GangWorker:
+    """Minimal gang-step shape: rank-dependent control flow around psum."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+
+    def bad_step(self, grads):
+        # one arm reduces, the other doesn't: ranks != 0 hang the psum
+        if self.rank == 0:
+            grads = jax.lax.psum(grads, "dp")
+        return grads
+
+    def bad_guard_return(self, grads):
+        # the guard-return idiom: non-zero ranks never reach the collective
+        if self.rank != 0:
+            return grads
+        return jax.lax.psum(grads, "dp")
+
+    def bad_via_helper(self, grads):
+        # interprocedural: the divergent arm reaches the psum through a
+        # project helper — the chain must appear in the finding
+        if self.rank == 0:
+            grads = self._sync(grads)
+        return grads
+
+    def _sync(self, grads):
+        return jax.lax.psum(grads, "dp")
+
+    def good_step(self, grads):
+        # uniform: every rank reduces
+        return jax.lax.psum(grads, "dp")

@@ -1,0 +1,57 @@
+"""Self-detection fixture: the PR 4 spilled-reply leak shape.
+
+A direct-call reply spilled to a shared-memory segment is mapped by the
+caller; the exception path between attach and close/unlink strands the
+segment (and its pages) for the process lifetime — the RSS leak PR 4's
+review round found by hand. tpulint must flag the leak-on-raise, the early
+return variant, the double-unlink, and the use-after-release
+(ref-lifecycle).
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+from multiprocessing import shared_memory
+
+
+def read_spilled_reply(name: str, size: int) -> bytes:
+    """Leak-on-raise: validate() can raise while the segment is attached —
+    no handler, no finally, the mapping is stranded."""
+    seg = shared_memory.SharedMemory(name=name)
+    data = bytes(seg.buf[:size])
+    validate(data, size)
+    seg.close()
+    seg.unlink()
+    return data
+
+
+def read_spilled_reply_early_return(name: str, size: int):
+    """Early-return leak: the cached-hit path skips close/unlink."""
+    seg = shared_memory.SharedMemory(name=name)
+    if size == 0:
+        return b""
+    data = bytes(seg.buf[:size])
+    seg.close()
+    seg.unlink()
+    return data
+
+
+def double_unlink(name: str):
+    """unlink is not idempotent: the second call races a fresh segment
+    created under the recycled name."""
+    seg = shared_memory.SharedMemory(name=name)
+    seg.close()
+    seg.unlink()
+    seg.unlink()
+
+
+def use_after_release(name: str, size: int) -> bytes:
+    """Reading .buf after close dereferences a dead mapping."""
+    seg = shared_memory.SharedMemory(name=name)
+    seg.close()
+    return bytes(seg.buf[:size])
+
+
+def validate(data: bytes, size: int) -> None:
+    if len(data) != size:
+        raise ValueError("short read")

@@ -1,10 +1,12 @@
 """tpulint unit + integration tests.
 
 Per-check-family unit tests run the analyzer over small synthetic modules in
-tmp_path; the self-detection tests assert the two shipped bug shapes (PR 3
-seal-through-own-pump, PR 4 proxy blocking call) are flagged in the checked-in
-fixtures; the whole-tree test asserts the repo is clean modulo the baseline
-and that a full run stays under the 30 s budget.
+tmp_path; the self-detection tests assert the shipped bug shapes (PR 3
+seal-through-own-pump, PR 4 proxy blocking call, the rank-divergent gang
+shape, the collective-order mismatch, the PR 4 spilled-reply leak) are
+flagged in the checked-in fixtures; the whole-tree test asserts the repo is
+clean modulo the baseline with all seven families and that a full run stays
+under the 30 s budget.
 """
 
 import json
@@ -423,6 +425,405 @@ def test_baseline_roundtrip(tmp_path):
     assert len(stale) == 1
 
 
+# ------------------------------------------------- ref-lifecycle (units)
+
+
+def test_lifecycle_leak_on_exception_edge(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import socket
+
+        def bad():
+            s = socket.socket()
+            s.bind(("", 0))        # may raise: s leaks
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        def good():
+            s = socket.socket()
+            try:
+                s.bind(("", 0))
+                return s.getsockname()[1]
+            finally:
+                s.close()
+        """,
+    )
+    hits = _by_check(findings).get("ref-lifecycle", [])
+    assert len(hits) == 1
+    assert hits[0].qualname.endswith(".bad")
+    assert "leaks when" in hits[0].message
+
+
+def test_lifecycle_leak_on_early_return(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        from multiprocessing import shared_memory
+
+        def bad(name, n):
+            seg = shared_memory.SharedMemory(name=name)
+            if n == 0:
+                return None     # seg stranded
+            data = bytes(seg.buf[:n])
+            seg.close()
+            return data
+        """,
+    )
+    hits = _by_check(findings).get("ref-lifecycle", [])
+    assert len(hits) == 1
+    assert "early return" in hits[0].message
+
+
+def test_lifecycle_double_release_and_use_after_release(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        from multiprocessing import shared_memory
+
+        def double(name):
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+            seg.unlink()
+
+        def uar(name, n):
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            return bytes(seg.buf[:n])
+        """,
+    )
+    hits = _by_check(findings).get("ref-lifecycle", [])
+    msgs = " | ".join(h.message for h in hits)
+    assert "released twice" in msgs
+    assert "after" in msgs and any("buf" in h.message for h in hits)
+
+
+def test_lifecycle_escape_and_with_are_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import socket
+        from multiprocessing import shared_memory
+
+        class Cache:
+            def __init__(self):
+                self._segs = {}
+
+            def attach(self, name):
+                seg = shared_memory.SharedMemory(name=name)
+                self._segs[name] = seg       # ownership transferred
+                return seg
+
+        def factory():
+            return socket.socket()           # caller owns it
+
+        def managed(name, n):
+            with shared_memory.SharedMemory(name=name) as seg:
+                return bytes(seg.buf[:n])
+        """,
+    )
+    assert _by_check(findings).get("ref-lifecycle", []) == []
+
+
+def test_lifecycle_interprocedural_release_helper(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import socket
+
+        def _reap(sock):
+            sock.close()
+
+        def fine():
+            s = socket.socket()
+            try:
+                s.bind(("", 0))
+                return s.getsockname()[1]
+            finally:
+                _reap(s)
+        """,
+    )
+    assert _by_check(findings).get("ref-lifecycle", []) == []
+
+
+def test_lifecycle_dropped_objectref(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import ray_tpu
+
+        def bad(x):
+            ray_tpu.put(x)   # ref dropped: dead put
+
+        def good(x):
+            ref = ray_tpu.put(x)
+            return ref
+        """,
+    )
+    hits = _by_check(findings).get("ref-lifecycle", [])
+    assert len(hits) == 1 and "dropped" in hits[0].message
+
+
+def test_lifecycle_suppression_and_baseline_roundtrip(tmp_path):
+    src = """
+    import socket
+
+    def reviewed():
+        s = socket.socket()
+        s.bind(("", 0))  # tpulint: disable=ref-lifecycle
+        s.close()
+    """
+    assert _lint_src(tmp_path, src) == []
+    findings = _lint_src(
+        tmp_path,
+        src.replace("  # tpulint: disable=ref-lifecycle", ""),
+        name="mod_b.py",
+    )
+    assert len(findings) == 1
+    bpath = str(tmp_path / "lc_baseline.json")
+    baseline_mod.write(bpath, findings)
+    new, accepted, stale = baseline_mod.split(findings, baseline_mod.load(bpath))
+    assert new == [] and len(accepted) == 1 and stale == []
+
+
+def test_lifecycle_handler_access_with_own_finally_clean(tmp_path):
+    """The catching try's OWN finally runs AFTER its handler: handler-side
+    access to the handle is valid and must not be a use-after-release."""
+    findings = _lint_src(
+        tmp_path,
+        """
+        from multiprocessing import shared_memory
+
+        def f(name, n):
+            seg = shared_memory.SharedMemory(name=name)
+            try:
+                data = decode(n)
+            except Exception:
+                data = bytes(seg.buf[:1])
+            finally:
+                seg.close()
+            return data
+
+        def decode(n):
+            raise ValueError(n)
+        """,
+    )
+    assert _by_check(findings).get("ref-lifecycle", []) == [
+    ], [f.render() for f in findings]
+
+
+def test_lifecycle_nonrelease_call_in_finally_does_not_mask(tmp_path):
+    """`log(seg)` in a finally releases nothing — the leak must survive."""
+    findings = _lint_src(
+        tmp_path,
+        """
+        from multiprocessing import shared_memory
+
+        def f(name, n):
+            seg = shared_memory.SharedMemory(name=name)
+            try:
+                data = decode(n)
+            finally:
+                log(seg)
+            seg.close()
+            return data
+
+        def decode(n):
+            raise ValueError(n)
+
+        def log(x):
+            pass
+        """,
+    )
+    hits = _by_check(findings).get("ref-lifecycle", [])
+    assert len(hits) == 1 and "leaks when" in hits[0].message
+
+
+# ------------------------------------------- collective-uniformity (units)
+
+
+def test_collective_divergent_in_nested_uniform_branch(tmp_path):
+    """A collective on the ELSE arm of an inner uniform if must stay
+    visible to the outer rank-divergence check."""
+    findings = _lint_src(
+        tmp_path,
+        """
+        import jax
+
+        def bad(grads, rank, use_fast):
+            if rank == 0:
+                if use_fast:
+                    grads = grads * 2
+                else:
+                    grads = jax.lax.psum(grads, "dp")
+            return grads
+
+        def good(grads, rank, use_fast):
+            if rank == 0:
+                grads = jax.lax.psum(grads, "dp")
+            else:
+                if use_fast:
+                    grads = jax.lax.psum(grads * 2, "dp")
+                else:
+                    grads = jax.lax.psum(grads * 3, "dp")
+            return grads
+        """,
+    )
+    hits = _by_check(findings).get("collective-uniformity", [])
+    assert len(hits) == 1, [f.render() for f in findings]
+    assert hits[0].qualname.endswith(".bad")
+
+
+def test_collective_divergent_branch(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import jax
+
+        def bad(grads, rank):
+            if rank == 0:
+                grads = jax.lax.psum(grads, "dp")
+            return grads
+
+        def good(grads, rank):
+            grads = jax.lax.psum(grads, "dp")
+            if rank == 0:
+                print(grads)
+            return grads
+        """,
+    )
+    hits = _by_check(findings).get("collective-uniformity", [])
+    assert len(hits) == 1
+    assert hits[0].qualname.endswith(".bad")
+    assert "psum" in hits[0].message and "rank" in hits[0].message
+
+
+def test_collective_guard_return(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import jax
+
+        def bad(grads, rank):
+            if rank != 0:
+                return grads
+            return jax.lax.psum(grads, "dp")
+        """,
+    )
+    hits = _by_check(findings).get("collective-uniformity", [])
+    assert len(hits) == 1 and "guard" in hits[0].message
+
+
+def test_collective_order_mismatch(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import jax
+
+        def bad(g, a, is_coordinator):
+            if is_coordinator:
+                g = jax.lax.psum(g, "dp")
+                a = jax.lax.all_gather(a, "dp")
+            else:
+                a = jax.lax.all_gather(a, "dp")
+                g = jax.lax.psum(g, "dp")
+            return g, a
+
+        def good(g, a, is_coordinator):
+            if is_coordinator:
+                g = jax.lax.psum(g, "dp")
+                a = jax.lax.all_gather(a, "dp")
+            else:
+                g = jax.lax.psum(g * 2, "dp")
+                a = jax.lax.all_gather(a * 2, "dp")
+            return g, a
+        """,
+    )
+    hits = _by_check(findings).get("collective-uniformity", [])
+    assert len(hits) == 1
+    assert "different orders" in hits[0].message
+    assert hits[0].qualname.endswith(".bad")
+
+
+def test_collective_exception_dependent(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import jax
+
+        def bad(grads):
+            try:
+                grads = step(grads)
+            except Exception:
+                grads = jax.lax.psum(grads, "dp")   # only raising ranks
+            return grads
+
+        def step(grads):
+            return grads
+        """,
+    )
+    hits = _by_check(findings).get("collective-uniformity", [])
+    assert len(hits) == 1 and "except handler" in hits[0].message
+
+
+def test_collective_interprocedural_chain(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import jax
+
+        class W:
+            def __init__(self, rank):
+                self.rank = rank
+
+            def bad(self, grads):
+                if self.rank == 0:
+                    grads = self._sync(grads)
+                return grads
+
+            def _sync(self, grads):
+                return jax.lax.psum(grads, "dp")
+        """,
+    )
+    hits = _by_check(findings).get("collective-uniformity", [])
+    assert len(hits) == 1
+    assert any("_sync" in hop for hop in hits[0].path)
+
+
+def test_collective_time_divergent_loop(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import time
+        import jax
+
+        def bad(grads, deadline):
+            while time.monotonic() < deadline:
+                grads = jax.lax.psum(grads, "dp")
+            return grads
+        """,
+    )
+    hits = _by_check(findings).get("collective-uniformity", [])
+    assert len(hits) == 1 and "time" in hits[0].message
+
+
+def test_collective_suppression(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import jax
+
+        def reviewed(grads, rank):
+            if rank == 0:
+                grads = jax.lax.psum(grads, "dp")  # tpulint: disable=collective-uniformity
+            return grads
+        """,
+    )
+    assert _by_check(findings).get("collective-uniformity", []) == []
+
+
 # ------------------------------------------------- self-detection fixtures
 
 
@@ -447,8 +848,57 @@ def test_fixture_clean_has_zero_findings():
     assert findings == [], [f.render() for f in findings]
 
 
+def test_fixture_rank_divergent_flagged():
+    findings = lint_paths([os.path.join(FIXTURES, "fixture_rank_divergent.py")])
+    hits = _by_check(findings).get("collective-uniformity", [])
+    quals = {h.qualname.rsplit(".", 1)[1] for h in hits}
+    assert {"bad_step", "bad_guard_return", "bad_via_helper"} <= quals, [
+        f.render() for f in findings
+    ]
+    # the interprocedural shape reports the full chain down to the psum
+    chained = [h for h in hits if h.qualname.endswith("bad_via_helper")]
+    assert chained and any("_sync" in hop for hop in chained[0].path)
+    assert not any("good_step" in h.qualname for h in hits)
+
+
+def test_fixture_order_mismatch_flagged():
+    findings = lint_paths([os.path.join(FIXTURES, "fixture_order_mismatch.py")])
+    hits = _by_check(findings).get("collective-uniformity", [])
+    assert len(hits) == 1, [f.render() for f in findings]
+    assert "different orders" in hits[0].message
+    assert hits[0].qualname.endswith("bad_step")
+    # the path lists both arms' sequences
+    assert any("then-arm" in hop for hop in hits[0].path)
+    assert any("else-arm" in hop for hop in hits[0].path)
+
+
+def test_fixture_spilled_reply_leak_flagged():
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_spilled_reply_leak.py")]
+    )
+    hits = _by_check(findings).get("ref-lifecycle", [])
+    msgs = {h.qualname.rsplit(".", 1)[1]: h.message for h in hits}
+    assert "leaks when" in msgs.get("read_spilled_reply", ""), msgs
+    assert "early return" in msgs.get("read_spilled_reply_early_return", ""), msgs
+    assert "released twice" in msgs.get("double_unlink", ""), msgs
+    assert "after" in msgs.get("use_after_release", ""), msgs
+
+
+def test_fixture_lifecycle_clean_has_zero_findings():
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_lifecycle_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_cli_exits_nonzero_on_fixtures():
-    for fx in ("fixture_seal_through_pump.py", "fixture_proxy_block.py"):
+    for fx in (
+        "fixture_seal_through_pump.py",
+        "fixture_proxy_block.py",
+        "fixture_rank_divergent.py",
+        "fixture_order_mismatch.py",
+        "fixture_spilled_reply_leak.py",
+    ):
         proc = subprocess.run(
             [
                 sys.executable,
@@ -531,6 +981,68 @@ def test_cli_whole_tree_exit_zero():
     assert "0 new" in proc.stdout
 
 
+def test_cli_changed_only_shares_baseline():
+    """--changed-only lints only the diff vs merge-base(HEAD, main) but
+    matches findings against the SAME full-tree baseline (slice fingerprints
+    must equal full-tree fingerprints), and stays fast."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.lint", "--changed-only"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 30.0
+    # stale entries from out-of-slice files are reported, never fatal
+    assert "new" in proc.stdout or "no changed files" in proc.stdout
+
+
+def test_cli_write_baseline_refuses_slices(tmp_path):
+    """--write-baseline on a slice would truncate the shared full-tree
+    baseline (reviewed reasons included) — it must refuse."""
+    for argv in (
+        ["--changed-only", "--write-baseline"],
+        [os.path.join(FIXTURES, "fixture_clean.py"), "--write-baseline"],
+    ):
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.devtools.lint", *argv],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 2, (argv, proc.stdout, proc.stderr)
+        assert "full-tree" in proc.stderr
+    # an explicit standalone baseline file is still allowed
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "ray_tpu.devtools.lint",
+            os.path.join(FIXTURES, "fixture_clean.py"),
+            "--write-baseline", "--baseline", str(tmp_path / "b.json"),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_slice_fingerprints_match_full_tree():
+    """The module-naming rule makes a single-file slice produce the same
+    qualnames (hence fingerprints) as the full-tree run — the property
+    --changed-only's baseline sharing rests on."""
+    target = os.path.join(REPO, "ray_tpu", "_private", "worker_runtime.py")
+    slice_f = lint_paths([target], root=REPO)
+    base = baseline_mod.load(os.path.join(REPO, "tools", "tpulint_baseline.json"))
+    chaos = [f for f in slice_f if "_chaos_table" in f.message]
+    assert chaos, "expected the baselined chaos-table finding in the slice"
+    assert chaos[0].fingerprint in base
+
+
 def test_lint_sees_through_locktrace_registration():
     """register_lock() wrapping must not blind the analyzer to core locks."""
     from ray_tpu.devtools.lint import analyze, discover
@@ -595,17 +1107,27 @@ def test_locktrace_name_collision_suffixes():
 
 
 def test_watchdog_dumps_lock_owner_table(tmp_path):
-    """End-to-end: a hung test holding a registered lock times out AND the
-    watchdog prints the thread stacks + lock owner table to stderr."""
+    """End-to-end: a hung test holding a registered lock AND a live rt_*
+    shm segment times out, and the watchdog prints the thread stacks, the
+    lock owner table, and the live-resource table (the leaked segment by
+    name) to stderr."""
     test_src = textwrap.dedent(
         """
         import threading
+        from multiprocessing import shared_memory
         from ray_tpu._private import locktrace
 
         def test_hangs_holding_registered_lock():
             lock = locktrace.register_lock("wd.hung_lock", threading.Lock())
-            with lock:
-                threading.Event().wait(30)  # > the 2 s watchdog below
+            seg = shared_memory.SharedMemory(
+                create=True, size=64, name="rt_wd_leaked_segment"
+            )
+            try:
+                with lock:
+                    threading.Event().wait(30)  # > the 2 s watchdog below
+            finally:
+                seg.close()
+                seg.unlink()
         """
     )
     (tmp_path / "test_wd.py").write_text(test_src)
@@ -638,6 +1160,8 @@ def test_watchdog_dumps_lock_owner_table(tmp_path):
     assert "registered lock owners" in out, out[-2000:]
     assert "wd.hung_lock" in out, out[-2000:]
     assert "locked" in out, out[-2000:]
+    assert "live resources" in out, out[-2000:]
+    assert "rt_wd_leaked_segment" in out, out[-3000:]
 
 
 def test_every_baseline_entry_has_a_real_reason():
