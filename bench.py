@@ -764,6 +764,19 @@ def check_floor(max_regress: float = 0.25) -> int:
 if __name__ == "__main__":
     if "--check-floor" in sys.argv:
         sys.exit(check_floor())
+    if "--transfer" in sys.argv:
+        # object-transfer plane: windowed pull sweep + replica-aware
+        # broadcast, recorded into MICROBENCH.json["transfer"]
+        import os
+
+        from ray_tpu.scripts.transfer_bench import record as transfer_record
+
+        transfer_record(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "MICROBENCH.json"
+            )
+        )
+        sys.exit(0)
     try:
         main()
     except Exception as e:  # never leave the driver without a JSON line

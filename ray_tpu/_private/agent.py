@@ -39,6 +39,7 @@ import threading
 import time
 import traceback
 import zipfile
+from collections import defaultdict
 from io import BytesIO
 from multiprocessing.connection import Client, Listener
 from typing import Any, Optional
@@ -157,14 +158,42 @@ class NodeAgent:
         self._spilled: dict[bytes, tuple[str, int]] = {}
         self.spill_dir = os.path.join(self.base_dir, "spill")
 
-        # Peer data connections (agent/controller chunk pulls).
-        self._peers = P.ChunkConnPool(authkey)
-        # object-owner lookup cache: oid -> (data_address|None, expiry)
-        self._owner_cache: dict[bytes, tuple] = {}
+        # Peer data connections (agent/controller chunk pulls); per-peer
+        # conn cap matches the transfer window so one windowed pull can
+        # keep that many chunks in flight to a single source.
+        self._transfer_chunk_bytes = max(64 * 1024, cfg.object_transfer_chunk_bytes)
+        self._transfer_window = max(1, cfg.object_transfer_window)
+        self._peers = P.ChunkConnPool(
+            authkey, max_conns_per_peer=self._transfer_window
+        )
+        # replica-set lookup cache: oid -> (list[data_address], expiry).
+        # Entries are invalidated eagerly on FreeLocal and on per-source
+        # pull failures (a freed-then-recreated object id must not route
+        # pulls to the old node) — the TTL is only the staleness bound for
+        # the happy path.
+        self._location_cache: dict[bytes, tuple] = {}
+        # oids sealed locally as REPLICAS by pull-into-arena (vs primaries
+        # produced here): under arena pressure these are evicted outright
+        # (the primary serves re-pulls) instead of spilled to disk.
+        self._replica_resident: set[bytes] = set()
+        # per-object single-flight for pull-into-arena: concurrent readers
+        # on this node coalesce into one cross-node transfer
+        self._pulls: dict[bytes, threading.Event] = {}
+        self._pulls_lock = locktrace.register_lock(
+            "agent.pulls_lock", threading.Lock()
+        )
+        # transfer observability (peer vs head chunk counts, replica hits)
+        self.transfer_stats: dict[str, int] = defaultdict(int)
+        self._stats_lock = threading.Lock()
 
-        # Data listener: serve chunk reads of local objects to peers.
+        # Data listener: serve chunk reads of local objects to peers. The
+        # backlog must absorb a windowed burst of concurrent dials (every
+        # puller opens up to object_transfer_window connections at once;
+        # the multiprocessing default of 1 overflows the accept queue and
+        # the kernel's dropped-ACK recovery stalls the dialer for seconds).
         self._data_listener = Listener(
-            ("0.0.0.0", data_port), family="AF_INET", authkey=authkey
+            ("0.0.0.0", data_port), family="AF_INET", authkey=authkey,
+            backlog=max(64, 4 * self._transfer_window),
         )
         self.data_address = f"{self.node_ip}:{self._data_listener.address[1]}"
         threading.Thread(
@@ -368,7 +397,13 @@ class NodeAgent:
             except OSError:
                 pass
         self._spilled.clear()
-        self._owner_cache.clear()
+        self._location_cache.clear()
+        self._replica_resident.clear()
+        # wake pull-into-arena followers parked on the old incarnation
+        with self._pulls_lock:
+            pulls, self._pulls = self._pulls, {}
+        for ev in pulls.values():
+            ev.set()
         try:
             self.store.shutdown()
         except Exception:  # noqa: BLE001
@@ -416,6 +451,11 @@ class NodeAgent:
         elif isinstance(msg, P.FreeLocal):
             for oid in msg.object_ids:
                 key = oid.binary()
+                # eager invalidation (never wait out the TTL): a freed-
+                # then-recreated object id must not route pulls to the old
+                # holder, and this node stops advertising its dead replica
+                self._invalidate_location(oid)
+                self._replica_resident.discard(key)
                 with self._resident_lock:
                     if self._resident.pop(key, None) is not None:
                         try:
@@ -887,13 +927,34 @@ class NodeAgent:
             self._reply_worker(conn, worker_id, msg.req_id, self._shm_create, msg.payload)
             return
         if isinstance(msg, P.Request) and msg.op == "pull_object_chunk":
-            # Serve locally / pull from the owning peer — threaded so a slow
-            # remote pull can't stall this worker's other replies.
+            # Serve locally / pull from a replica-set peer — threaded so a
+            # slow remote pull can't stall this worker's other replies.
             threading.Thread(
                 target=self._reply_worker,
                 args=(conn, worker_id, msg.req_id, self._pull_chunk, msg.payload),
                 daemon=True,
             ).start()
+            return
+        if isinstance(msg, P.Request) and msg.op == "pull_into_arena":
+            # node-level materialization of a remote object into THIS arena
+            # (single-flight; the worker mmaps the result) — threaded: the
+            # transfer can take seconds and must not stall other replies
+            threading.Thread(
+                target=self._reply_worker,
+                args=(
+                    conn, worker_id, msg.req_id, self._pull_into_arena,
+                    msg.payload,
+                ),
+                daemon=True,
+            ).start()
+            return
+        if isinstance(msg, P.Request) and msg.op == "transfer_stats":
+            # node-local transfer counters (tests assert zero-re-transfer
+            # through these; the head has its own under the same op)
+            self._reply_worker(
+                conn, worker_id, msg.req_id,
+                lambda _p: self._snapshot_stats(), msg.payload,
+            )
             return
         if isinstance(msg, P.PutObject) and msg.kind == "plasma":
             # Seal locally before the head learns the location: a reader
@@ -971,6 +1032,32 @@ class NodeAgent:
                 continue
             object_id = ObjectID(key)
             name, size = entry
+            if key in self._replica_resident:
+                # replicas are redundant copies: evict outright (no disk
+                # write, no spill report — the primary serves re-pulls) and
+                # stop advertising this node in the directory. UNLESS the
+                # head answers "primary": the copy was promoted after its
+                # original primary died — it is the object's LAST copy, so
+                # fall through to the normal spill path below. On head
+                # unreachability, also spill: losing redundancy is cheap,
+                # losing the only copy is not.
+                self._replica_resident.discard(key)
+                try:
+                    verdict = self.call_controller(
+                        "unregister_replica", (object_id, self.arena_name)
+                    )
+                except Exception:  # noqa: BLE001 — can't tell: play safe
+                    verdict = "primary"
+                if verdict != "primary":
+                    try:
+                        self.store.delete(object_id)
+                    except Exception:  # noqa: BLE001
+                        continue
+                    freed += size
+                    logger.info(
+                        "evicted replica %s (%d bytes)", object_id.hex(), size
+                    )
+                    continue
             try:
                 total, data = self._read_local_chunk(object_id, entry, 0, size)
                 path = os.path.join(self.spill_dir, f"{object_id.hex()}.bin")
@@ -1003,28 +1090,66 @@ class NodeAgent:
 
     # ----------------------------------------------------------- data plane
 
+    def _bump_stat(self, name: str, n: int = 1):
+        with self._stats_lock:
+            self.transfer_stats[name] += n
+
+    def _snapshot_stats(self) -> dict:
+        with self._stats_lock:
+            return dict(self.transfer_stats)
+
+    def _make_fetcher(self, object_id: ObjectID) -> P.ReplicaFetcher:
+        """Per-chunk fetch over the object's replica set (owner + every
+        registered replica, self excluded), load-spread with mid-pull
+        failover; the head relay serves when no peer can (it re-resolves,
+        recovers, or raises ObjectLostError)."""
+        sources = [
+            a
+            for a in self._object_locations(object_id)
+            if a and a != self.data_address
+        ]
+
+        def head_fetch(offset: int, length: int):
+            return self.call_controller(
+                "pull_object_chunk", (object_id, offset, length)
+            )
+
+        def on_fail(address: str, _err):
+            # a dead/stale source must not eat the 30 s TTL: drop it from
+            # the cached set (and its pooled conns) immediately
+            self._invalidate_location(object_id, address)
+
+        return P.ReplicaFetcher(
+            self._peers,
+            object_id.binary(),
+            sources,
+            fallback=head_fetch,
+            on_source_fail=on_fail,
+        )
+
     def _pull_chunk(self, payload):
         """A local worker wants [offset, offset+length) of an object that is
         not in this node's arena (or was relocated). Resolution order:
-        local arena → owning peer agent (direct) → head relay."""
+        local arena/spill → any replica-set peer (direct) → head relay."""
         object_id, offset, length = payload
         local = self._serve_local(object_id, offset, length)
         if local is not None:
             return local
-        owner = self._object_owner(object_id)
-        if owner is not None and owner != self.data_address:
-            try:
-                return self._peers.pull_chunk(
-                    owner, object_id.binary(), offset, length
-                )
-            except (P.ChunkPullError, OSError, EOFError, ConnectionError):
-                # peer died or no longer has it: fall through to the head,
-                # which serves the recovered copy or raises ObjectLostError
-                self._owner_cache.pop(object_id.binary(), None)
-        return self.call_controller("pull_object_chunk", (object_id, offset, length))
+        fetcher = self._make_fetcher(object_id)
+        result = fetcher(offset, length)
+        if fetcher.peer_chunks:
+            self._bump_stat("peer_chunks_pulled", fetcher.peer_chunks)
+        if fetcher.fallback_chunks:
+            self._bump_stat("head_chunks_pulled", fetcher.fallback_chunks)
+        return result
 
-    def _serve_local(self, object_id: ObjectID, offset: int, length: int):
-        """Chunk of a locally resident object (arena or spill), else None."""
+    def _serve_local(
+        self, object_id: ObjectID, offset: int, length: int, spill_files=None
+    ):
+        """Chunk of a locally resident object (arena or spill), else None.
+        ``spill_files`` is an optional per-serve-connection handle cache so
+        a chunked read of one spilled object opens its file once, not once
+        per chunk (owned — and closed — by the connection loop)."""
         entry = self.store.lookup(object_id)
         if entry is not None:
             try:
@@ -1035,26 +1160,163 @@ class NodeAgent:
         if spilled is not None:
             path, size = spilled
             try:
-                with open(path, "rb") as f:
-                    f.seek(offset)
-                    return (size, f.read(min(length, size - offset)))
+                if spill_files is None:
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        return (size, f.read(min(length, size - offset)))
+                fh = spill_files.get(object_id.binary())
+                if fh is None:
+                    while len(spill_files) >= 32:  # bound the per-conn cache
+                        # evict the OLDEST handle (dict preserves insertion
+                        # order; popitem() would churn the newest slot)
+                        oldest = next(iter(spill_files))
+                        old = spill_files.pop(oldest)
+                        try:
+                            old.close()
+                        except OSError:
+                            pass
+                    fh = open(path, "rb")
+                    spill_files[object_id.binary()] = fh
+                fh.seek(offset)
+                return (size, fh.read(min(length, size - offset)))
             except OSError:
                 return None
         return None
 
-    def _object_owner(self, object_id: ObjectID) -> Optional[str]:
+    def _object_locations(self, object_id: ObjectID) -> list:
+        """Every data address serving this object (owner + replicas), via
+        the controller's location directory; cached with a short TTL and
+        invalidated eagerly on free/failure (see _location_cache)."""
         key = object_id.binary()
         now = time.monotonic()
-        hit = self._owner_cache.get(key)
+        hit = self._location_cache.get(key)
         if hit is not None and hit[1] > now:
-            return hit[0]
-        owner = self.call_controller("object_owner", object_id)
-        self._owner_cache[key] = (owner, now + 30.0)
-        if len(self._owner_cache) > 4096:
-            self._owner_cache = {
-                k: v for k, v in self._owner_cache.items() if v[1] > now
+            return list(hit[0])
+        locs = list(self.call_controller("object_locations", object_id) or [])
+        self._location_cache[key] = (locs, now + 30.0)
+        if len(self._location_cache) > 4096:
+            self._location_cache = {
+                k: v for k, v in self._location_cache.items() if v[1] > now
             }
-        return owner
+        return list(locs)
+
+    def _invalidate_location(self, object_id: ObjectID, address: Optional[str] = None):
+        """Eager cache invalidation: the whole entry (freed/lost object) or
+        one failing source (dead peer) — never wait out the TTL."""
+        key = object_id.binary()
+        if address is None:
+            self._location_cache.pop(key, None)
+            return
+        hit = self._location_cache.get(key)
+        if hit is not None and address in hit[0]:
+            try:
+                hit[0].remove(address)
+            except ValueError:
+                pass
+        self._peers.drop(address)
+
+    # ------------------------------------------------- pull-into-arena
+
+    def _serve_entry(self, object_id: ObjectID):
+        """The locally-materialized (kind, payload) entry for this object,
+        else None — what a same-host worker can read without any RPC."""
+        entry = self.store.lookup(object_id)
+        if entry is not None:
+            return ("plasma", (entry[0], entry[1]))
+        spilled = self._spilled.get(object_id.binary())
+        if spilled is not None:
+            return ("spilled", spilled)  # same-host readers open the path
+        return None
+
+    def _pull_into_arena(self, payload):
+        """Materialize a remote object into THIS node's arena and register
+        the node as a replica (reference: pulls land in the local plasma
+        store, ``pull_manager.h:49``; the directory registration makes this
+        node a broadcast source). Single-flight per object: concurrent
+        local readers coalesce into ONE cross-node transfer. Returns the
+        local (kind, payload) entry, or None when the caller should fall
+        back to a private direct pull."""
+        object_id, size = payload
+        key = object_id.binary()
+        entry = self._serve_entry(object_id)
+        if entry is not None:
+            self._bump_stat("arena_replica_hits")
+            return entry
+        with self._pulls_lock:
+            ev = self._pulls.get(key)
+            leader = ev is None
+            if leader:
+                ev = self._pulls[key] = threading.Event()
+        if not leader:
+            # bounded, liveness-aware wait for the in-flight transfer
+            deadline = time.monotonic() + 600.0
+            while not ev.wait(timeout=1.0):
+                if self.shutting_down or time.monotonic() > deadline:
+                    return None
+            entry = self._serve_entry(object_id)
+            if entry is not None:
+                self._bump_stat("arena_replica_hits")
+            return entry  # None → the leader failed; caller direct-pulls
+        try:
+            return self._pull_into_arena_leader(object_id, size)
+        finally:
+            with self._pulls_lock:
+                self._pulls.pop(key, None)
+            ev.set()
+
+    def _pull_into_arena_leader(self, object_id: ObjectID, size: int):
+        from ray_tpu._private.object_store import parse_arena_location
+
+        name = self._shm_create((object_id, size))
+        if isinstance(name, tuple) and name[0] == "exists":
+            return ("plasma", (name[1], name[2]))  # sealed concurrently
+        offset = parse_arena_location(name)[1]
+        view = self.store.arena.view(offset, size)
+        fetcher = self._make_fetcher(object_id)
+        try:
+            P.pull_windowed(
+                fetcher,
+                P._buffer_sink(view),
+                size,
+                self._transfer_chunk_bytes,
+                self._transfer_window,
+            )
+        except BaseException:
+            # reclaim the unsealed allocation — a failed pull must not pin
+            # arena space until the next alloc collides with the stale id
+            try:
+                self.store.arena.delete(object_id.binary())
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        self.store.seal(object_id, name, size)
+        self._track_seal(object_id, name, size)
+        self._replica_resident.add(object_id.binary())
+        self._bump_stat("peer_chunks_pulled", fetcher.peer_chunks)
+        self._bump_stat("head_chunks_pulled", fetcher.fallback_chunks)
+        self._bump_stat("arena_pulls")
+        try:
+            verdict = self.call_controller(
+                "register_replica", (object_id, name, size)
+            )
+        except Exception:  # noqa: BLE001 — head unreachable: serve locally;
+            verdict = None  # reconnect resets all local state anyway
+        if verdict == "freed":
+            # the object died while its bytes were in flight: a freed-then-
+            # recreated id must not find this stale copy
+            self._replica_resident.discard(object_id.binary())
+            with self._resident_lock:
+                if self._resident.pop(object_id.binary(), None) is not None:
+                    try:
+                        self._resident_order.remove(object_id.binary())
+                    except ValueError:
+                        pass
+            try:
+                self.store.delete(object_id)
+            except Exception:  # noqa: BLE001
+                pass
+            raise AgentError(f"object {object_id.hex()} freed during pull")
+        return ("plasma", (name, size))
 
     def _read_local_chunk(self, object_id: ObjectID, entry, offset: int, length: int):
         from ray_tpu._private.object_store import (
@@ -1088,30 +1350,52 @@ class NodeAgent:
             ).start()
 
     def _data_serve(self, conn):
-        """Serve chunk reads of locally resident objects to one peer."""
-        while not self.shutting_down:
+        """Serve chunk reads of locally resident objects to one peer.
+        Spilled-object reads keep an open file handle per (connection,
+        object) — a windowed pull of a spilled object costs one open, not
+        one per chunk — released with the connection."""
+        spill_files: dict[bytes, Any] = {}
+        try:
+            while not self.shutting_down:
+                try:
+                    req = conn.recv()
+                except (EOFError, OSError):
+                    return
+                try:
+                    kind, oid_bytes, offset, length = req
+                    assert kind == "chunk"
+                    object_id = ObjectID(oid_bytes)
+                    reply = self._serve_local(
+                        object_id, offset, length, spill_files=spill_files
+                    )
+                    if reply is None:
+                        reply = ("error", f"object {object_id.hex()} not resident")
+                except Exception as e:  # noqa: BLE001
+                    reply = ("error", f"{type(e).__name__}: {e}")
+                try:
+                    conn.send(reply)
+                except (EOFError, OSError):
+                    return
+        finally:
+            for fh in spill_files.values():
+                try:
+                    fh.close()
+                except OSError:
+                    pass
             try:
-                req = conn.recv()
-            except (EOFError, OSError):
-                return
-            try:
-                kind, oid_bytes, offset, length = req
-                assert kind == "chunk"
-                object_id = ObjectID(oid_bytes)
-                reply = self._serve_local(object_id, offset, length)
-                if reply is None:
-                    reply = ("error", f"object {object_id.hex()} not resident")
-            except Exception as e:  # noqa: BLE001
-                reply = ("error", f"{type(e).__name__}: {e}")
-            try:
-                conn.send(reply)
-            except (EOFError, OSError):
-                return
+                conn.close()
+            except OSError:
+                pass
 
     # -------------------------------------------------------------- lifecycle
 
     def shutdown(self):
         self.shutting_down = True
+        # release pull-into-arena followers before tearing the store down
+        with self._pulls_lock:
+            pulls, self._pulls = self._pulls, {}
+        for ev in pulls.values():
+            ev.set()
         with self.workers_lock:
             workers = list(self.workers.values())
             self.workers.clear()

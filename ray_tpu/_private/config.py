@@ -114,9 +114,22 @@ class Config:
     # format: "op1=prob1,op2=prob2" — controller ops fail with given
     # probability (tasks/retries exercise the recovery paths); empty = off
     testing_rpc_failure: str = ""
+    # Latency injection: artificial delay per served transfer chunk,
+    # modeling the cross-host RTT loopback cannot exhibit (bench/tests
+    # measure the transfer window's latency-hiding against it; 0 = off).
+    testing_chunk_delay_ms: float = 0.0
     object_store_full_delay_ms: int = 100
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_bytes: int = 8 * 1024**2
+    # In-flight chunk requests per pull/push stream (reference: the
+    # ObjectBufferPool keeps many chunks of one transfer in flight,
+    # object_buffer_pool.h). 1 restores stop-and-wait.
+    object_transfer_window: int = 8
+    # Cross-node pulls on an arena-backed node materialize the object into
+    # the local arena and register the node as a replica (subsequent local
+    # readers mmap it; other pullers may fetch from this node). Disable to
+    # force every reader through a private direct pull.
+    pull_into_arena: bool = True
     # TCP control-plane listener (multi-host attach; the DCN control plane
     # analog of the reference's gRPC server, src/ray/rpc/grpc_server.h).
     # None = unix socket only; 0 = ephemeral port; >0 = fixed port.

@@ -421,8 +421,31 @@ class Controller:
         # objects an agent spilled to ITS disk: oid -> AgentHandle (their
         # "spilled" entries hold agent-local paths the head cannot open)
         self._agent_spills: dict[ObjectID, AgentHandle] = {}
-        # pooled data-plane connections to agents' chunk listeners
-        self._data_pool = P.ChunkConnPool(self._authkey)
+        # Replica location directory (reference: ownership_object_directory
+        # — every node holding a copy can serve it): oid -> {arena_name ->
+        # (location, size)} for SECONDARY copies materialized by
+        # pull-into-arena; the sealed memory_store entry remains the
+        # primary. Invalidated on free / node removal / replica eviction.
+        # Guarded by self.lock, with a per-arena reverse index so node
+        # removal is O(node's replicas).
+        self._object_replicas: dict[ObjectID, dict[str, tuple[str, int]]] = {}
+        self._replicas_by_arena: dict[str, set[ObjectID]] = defaultdict(set)
+        # per-(arena, oid) single-flight for head-side pull-into-arena:
+        # concurrent readers on one node coalesce into a single transfer
+        self._arena_pulls: dict[tuple, threading.Event] = {}
+        self._arena_pulls_lock = locktrace.register_lock(
+            "controller.arena_pulls_lock", threading.Lock()
+        )
+        # transfer observability: tests assert the zero-re-transfer property
+        # through these counters instead of timing
+        self.transfer_stats: dict[str, int] = defaultdict(int)
+        # pooled data-plane connections to agents' chunk listeners; the
+        # per-peer connection cap matches the transfer window so one
+        # windowed pull can saturate a single source
+        self._data_pool = P.ChunkConnPool(
+            self._authkey,
+            max_conns_per_peer=max(1, config.object_transfer_window),
+        )
         self._hb_monitor_started = False
 
         # Internal KV (GCS KV analog).
@@ -1052,6 +1075,7 @@ class Controller:
             if agent.data_address:
                 self._data_pool.drop(agent.data_address)
         self.publish("nodes", {"node_id": node_id.hex(), "event": "removed"})
+        dead_arena = None
         with self.lock:
             victims = [w for w in self.workers.values() if w.node_id == node_id]
             # The node's data plane dies with it: every object resident in
@@ -1061,6 +1085,7 @@ class Controller:
             lost: list[ObjectID] = []
             if store is not None and store is not self.plasma:
                 arena = getattr(store, "arena_name", None)
+                dead_arena = arena
                 if arena is not None:
                     self._stores_by_arena.pop(arena, None)
                     if getattr(store, "is_remote", False):
@@ -1100,6 +1125,13 @@ class Controller:
             self._fail_task(
                 pt, WorkerCrashedError(f"node {node_id.hex()[:8]} removed")
             )
+        # replica directory upkeep: copies hosted ON the dead arena vanish
+        # (no loss — primaries live elsewhere); primaries lost WITH the
+        # node promote a surviving replica instead of re-running lineage
+        if dead_arena is not None:
+            self._drop_arena_replicas(dead_arena)
+        if lost:
+            lost = self._promote_replicas(lost)
         if lost:
             logger.warning(
                 "node %s removed: %d resident object(s) lost",
@@ -1450,9 +1482,15 @@ class Controller:
             freed = self._reclaim_trash_locked()
             if freed >= need_bytes:
                 return True
+            store = store or self.plasma
+            # 1.5) replicas resident in THIS arena are redundant copies —
+            # evict them outright (no disk write, no grace: the primary
+            # serves re-pulls) before spilling any primary
+            freed += self._evict_replicas_locked(store, need_bytes - freed)
+            if freed >= need_bytes:
+                return True
             # 2) spill just enough cold residents to cover the remainder —
             # only residents of the arena that is actually full
-            store = store or self.plasma
             with self.lock:
                 candidates = [
                     (oid, v)
@@ -1514,6 +1552,42 @@ class Controller:
                 self._reclaim_trash_locked()
             return True
 
+    def _evict_replicas_locked(self, store, need_bytes: int) -> int:
+        """Delete replica copies hosted in ``store``'s arena until
+        ``need_bytes`` is freed (caller holds ``_spill_lock``). Replica
+        eviction is instant — the directory entry is the only state."""
+        arena = getattr(store, "arena_name", None)
+        if arena is None or need_bytes <= 0:
+            return 0
+        freed = 0
+        with self.lock:
+            victims = [
+                (oid, self._object_replicas[oid][arena][1])
+                for oid in self._replicas_by_arena.get(arena, ())
+                if arena in self._object_replicas.get(oid, {})
+            ]
+        for oid, size in victims:
+            if freed >= need_bytes:
+                break
+            # atomic membership re-check + unregister: a concurrent
+            # promotion (primary's node died) may have turned this copy
+            # into THE primary — deleting it then would lose the object
+            with self.lock:
+                reps = self._object_replicas.get(oid)
+                if not reps or arena not in reps:
+                    continue  # promoted or freed since the snapshot
+                reps.pop(arena, None)
+                if not reps:
+                    del self._object_replicas[oid]
+                self._replicas_by_arena[arena].discard(oid)
+            try:
+                store.delete(oid)
+            except Exception:  # noqa: BLE001 — already relocated/raced
+                continue
+            freed += size
+            logger.info("evicted replica of %s (%d bytes)", oid.hex(), size)
+        return freed
+
     def _reclaim_trash_locked(self) -> int:
         """Delete matured trash blocks; returns bytes freed. Caller holds
         ``_spill_lock``."""
@@ -1527,28 +1601,91 @@ class Controller:
 
     # ------------------------------------------- agent data plane (pull side)
 
+    def _replica_addresses(self, object_id: ObjectID, exclude=None) -> list:
+        """Data addresses of agents holding a replica of ``object_id`` (the
+        location-directory read; reference: OwnershipObjectDirectory)."""
+        out = []
+        with self.lock:
+            reps = self._object_replicas.get(object_id)
+            if not reps:
+                return out
+            for arena in reps:
+                store = self._stores_by_arena.get(arena)
+                if store is None or not getattr(store, "is_remote", False):
+                    continue
+                addr = store.agent.data_address
+                if addr and addr != exclude:
+                    out.append(addr)
+        return out
+
+    def _primary_data_address(self, object_id: ObjectID):
+        """Data address of the agent holding the PRIMARY copy (None when
+        the primary is head-resident or inline — served via head relay)."""
+        entry = self.memory_store.get([object_id], timeout=10)[0]
+        if entry is None:
+            return None
+        if entry[0] == "spilled":
+            agent = self._agent_spills.get(object_id)
+            return agent.data_address if agent is not None else None
+        if entry[0] != "plasma":
+            return None
+        store = self._store_for_location(entry[1][0])
+        if getattr(store, "is_remote", False):
+            return store.agent.data_address
+        return None
+
+    def _on_source_failed(self, address: str, _err) -> None:
+        """A replica/owner stopped serving mid-pull: drop its pooled conns
+        so the next dial is fresh (node-death detection reaps the
+        directory entries; this just stops retrying a dead socket)."""
+        self._data_pool.drop(address)
+
     def _pull_chunk_from_agent(
-        self, address: str, object_id: ObjectID, offset: int, length: int
+        self, address: str, object_id: ObjectID, offset: int, length: int,
+        extra_addresses=(),
     ):
+        """One chunk from the owner or any replica, spread + failover."""
+        addrs = [address] + [a for a in extra_addresses if a != address]
+        fetcher = P.ReplicaFetcher(
+            self._data_pool, object_id.binary(), addrs,
+            on_source_fail=self._on_source_failed,
+        )
         try:
-            return self._data_pool.pull_chunk(
-                address, object_id.binary(), offset, length
-            )
+            return fetcher(offset, length)
         except P.ChunkPullError as e:
             raise ObjectLostError(f"agent pull failed: {e}") from e
 
     def _pull_whole_from_agent(
         self, address: str, object_id: ObjectID, size: int
-    ) -> bytes:
+    ) -> bytearray:
+        buf = bytearray(size)
+        self._pull_into_buffer(address, object_id, size, memoryview(buf))
+        return buf
+
+    def _pull_into_buffer(
+        self, address: str, object_id: ObjectID, size: int, mv
+    ) -> None:
+        """Windowed, replica-aware whole-object pull straight into ONE
+        preallocated buffer (caller-owned — a bytearray or an arena view):
+        chunks spread across every node that holds a copy, a dying source
+        fails over to the survivors mid-pull."""
+        addrs = [address] + self._replica_addresses(object_id, exclude=address)
+        fetcher = P.ReplicaFetcher(
+            self._data_pool, object_id.binary(), addrs,
+            on_source_fail=self._on_source_failed,
+        )
         try:
-            return self._data_pool.pull_whole(
-                address,
-                object_id.binary(),
+            P.pull_windowed(
+                fetcher,
+                P._buffer_sink(mv),
                 size,
-                chunk_bytes=self.config.object_transfer_chunk_bytes,
+                self.config.object_transfer_chunk_bytes,
+                self.config.object_transfer_window,
             )
         except P.ChunkPullError as e:
             raise ObjectLostError(f"agent pull failed: {e}") from e
+        with self.lock:
+            self.transfer_stats["head_peer_chunks_pulled"] += fetcher.peer_chunks
 
     def resolve_object(self, entry, object_id: ObjectID = None) -> SerializedObject:
         from ray_tpu._private.object_store import ObjectRelocatedError
@@ -1607,6 +1744,245 @@ class Controller:
     def get_entries(self, object_ids: list[ObjectID], timeout=None):
         self._maybe_recover(object_ids)
         return self.memory_store.get(object_ids, timeout=timeout)
+
+    # ------------------------------------------- replica location directory
+
+    def _register_replica_entry(
+        self, object_id: ObjectID, location: str, size: int
+    ) -> bool:
+        """Record a secondary copy in the location directory. False when the
+        object was freed while the replica materialized — the caller must
+        discard its copy instead of resurrecting a dead id."""
+        from ray_tpu._private.object_store import parse_arena_location
+
+        loc = parse_arena_location(location)
+        if loc is None:
+            return False
+        arena = loc[0]
+        with self.lock:
+            if not self.memory_store.contains(object_id):
+                return False
+            self._object_replicas.setdefault(object_id, {})[arena] = (
+                location,
+                size,
+            )
+            self._replicas_by_arena[arena].add(object_id)
+            self.transfer_stats["replicas_registered"] += 1
+        return True
+
+    def _unregister_replica(self, object_id: ObjectID, arena: str) -> None:
+        with self.lock:
+            reps = self._object_replicas.get(object_id)
+            if reps is not None:
+                reps.pop(arena, None)
+                if not reps:
+                    del self._object_replicas[object_id]
+            self._replicas_by_arena[arena].discard(object_id)
+
+    def _drop_replicas(self, object_id: ObjectID) -> None:
+        """Owner-driven invalidation (free / testing loss): every replica
+        copy is deleted from its hosting store and forgotten."""
+        with self.lock:
+            reps = self._object_replicas.pop(object_id, None)
+            if reps:
+                for arena in reps:
+                    self._replicas_by_arena[arena].discard(object_id)
+        if not reps:
+            return
+        for arena in reps:
+            store = self._stores_by_arena.get(arena)
+            if store is None:
+                continue
+            try:
+                # RemoteArenaProxy relays a FreeLocal to the hosting agent
+                store.delete(object_id)
+            except Exception:  # noqa: BLE001 — best-effort invalidation
+                pass
+
+    def _drop_arena_replicas(self, arena: str) -> None:
+        """A node's arena died (node removal): its replica entries vanish —
+        no data loss, the primaries live elsewhere."""
+        with self.lock:
+            for oid in self._replicas_by_arena.pop(arena, set()):
+                reps = self._object_replicas.get(oid)
+                if reps is not None:
+                    reps.pop(arena, None)
+                    if not reps:
+                        del self._object_replicas[oid]
+
+    def _promote_replicas(self, lost: list) -> list:
+        """A node died holding PRIMARY copies: repoint each lost entry at a
+        surviving replica instead of running lineage recovery (the copy
+        exists — promotion is free). Returns the ids that stay lost."""
+        still_lost = []
+        for oid in lost:
+            promoted = False
+            with self.lock:
+                reps = self._object_replicas.get(oid)
+                while reps:
+                    arena, (location, size) = next(iter(reps.items()))
+                    reps.pop(arena, None)
+                    self._replicas_by_arena[arena].discard(oid)
+                    store = self._stores_by_arena.get(arena)
+                    if store is None:
+                        continue  # that replica's node is gone too
+                    if not reps:
+                        self._object_replicas.pop(oid, None)
+                    self.memory_store.put(oid, ("plasma", (location, size)))
+                    if getattr(store, "is_remote", False):
+                        self._remote_resident[arena].add(oid)
+                    else:
+                        self.plasma_resident[oid] = (location, size)
+                    self.transfer_stats["replicas_promoted"] += 1
+                    promoted = True
+                    break
+                if not reps:
+                    self._object_replicas.pop(oid, None)
+            if promoted:
+                # dep-waiters that slipped into the delete→promote window
+                # must wake (same contract as a fresh seal)
+                self._on_object_sealed(oid)
+                logger.info("promoted replica of %s after node loss", oid.hex())
+            else:
+                still_lost.append(oid)
+        return still_lost
+
+    # ----------------------------------------------- pull-into-arena (head)
+
+    def pull_into_arena(self, node_id, object_id: ObjectID, size_hint: int = 0):
+        """Materialize a remote-resident object into ``node_id``'s arena and
+        register that node as a replica, so every subsequent reader on the
+        node mmaps the local copy (reference: pulls land in the local
+        plasma store, ``pull_manager.h:49``). Returns the local ``(kind,
+        payload)`` entry — or None when the node cannot host replicas (the
+        caller falls back to a private direct pull). Single-flight per
+        (arena, object): concurrent readers coalesce into one transfer."""
+        if not self.config.pull_into_arena or node_id is None:
+            return None
+        store = self._store_for_node(node_id)
+        if getattr(store, "is_remote", False) or not hasattr(store, "arena_name"):
+            return None  # agent nodes pull via their own agent; no arena = no replica
+        local = store.lookup(object_id)
+        if local is not None:
+            with self.lock:
+                self.transfer_stats["arena_replica_hits"] += 1
+            return ("plasma", local)
+        key = (store.arena_name, object_id)
+        with self._arena_pulls_lock:
+            ev = self._arena_pulls.get(key)
+            leader = ev is None
+            if leader:
+                ev = self._arena_pulls[key] = threading.Event()
+        if not leader:
+            # bounded, liveness-aware wait on the in-flight transfer
+            deadline = time.monotonic() + 600.0
+            while not ev.wait(timeout=1.0):
+                if self.shutting_down or time.monotonic() > deadline:
+                    return None
+            local = store.lookup(object_id)
+            if local is not None:
+                with self.lock:
+                    self.transfer_stats["arena_replica_hits"] += 1
+                return ("plasma", local)
+            return None  # the leader failed; let the caller direct-pull
+        try:
+            return self._pull_into_arena_leader(store, object_id)
+        finally:
+            with self._arena_pulls_lock:
+                self._arena_pulls.pop(key, None)
+            ev.set()
+
+    def _pull_into_arena_leader(self, store, object_id: ObjectID):
+        from ray_tpu._private.object_store import ObjectExistsError
+
+        self._maybe_recover([object_id])
+        entry = self.memory_store.get([object_id], timeout=30)[0]
+        if entry is None:
+            raise ObjectLostError(f"object {object_id.hex()} not found")
+        kind, payload = entry
+        if kind in ("inline", "error"):
+            return (kind, payload.to_bytes())
+        if kind == "spilled" and self._agent_spills.get(object_id) is None:
+            return entry  # head-local spill file: same-host readers open it
+        if kind == "plasma" and self._store_for_location(payload[0]) is store:
+            return entry  # raced with a concurrent seal: already local
+        size = payload[1]
+        try:
+            seg, name = self._create_with_spill_retry(
+                store.create, object_id, size, store=store
+            )
+        except ObjectExistsError:
+            local = store.lookup(object_id)
+            if local is not None:
+                return ("plasma", local)
+            raise
+        try:
+            # fill the arena allocation DIRECTLY — no staging buffer, no
+            # second full-object memcpy (it matters at multi-GB)
+            self._fill_from_entry(
+                memoryview(seg.buf)[:size], entry, object_id, size
+            )
+        except BaseException:
+            # reclaim the unsealed allocation — a failed pull must not pin
+            # arena space
+            try:
+                store.arena.delete(object_id.binary())
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        store.seal(object_id, name, size)
+        if not self._register_replica_entry(object_id, name, size):
+            # freed while the bytes were in flight: a freed-then-recreated
+            # id must not find a stale replica
+            try:
+                store.delete(object_id)
+            except Exception:  # noqa: BLE001
+                pass
+            raise ObjectLostError(f"object {object_id.hex()} freed during pull")
+        with self.lock:
+            self.transfer_stats["arena_pulls"] += 1
+        return ("plasma", (name, size))
+
+    def _fill_from_entry(self, mv, entry, object_id: ObjectID, size: int):
+        """Write the object's FLAT payload bytes into ``mv`` from wherever
+        the entry points (agent data plane / spill file / sibling arena) —
+        the zero-staging fill behind pull-into-arena."""
+        kind, payload = entry
+        if kind == "spilled":
+            path, _ = payload
+            agent = self._agent_spills.get(object_id)
+            if agent is not None:
+                self._pull_into_buffer(agent.data_address, object_id, size, mv)
+                return
+            with open(path, "rb") as f:
+                got = f.readinto(mv)
+            if got != size:
+                raise ObjectLostError(
+                    f"short spill read for {object_id.hex()}: {got}/{size}"
+                )
+            return
+        name, _ = payload
+        store = self._store_for_location(name)
+        if getattr(store, "is_remote", False):
+            self._pull_into_buffer(store.agent.data_address, object_id, size, mv)
+            return
+        # same-process arena (another head-side node): one validated copy of
+        # the raw flat buffer (seqlock protocol — see PlasmaClient.read)
+        from ray_tpu._private.object_store import (
+            ObjectRelocatedError,
+            parse_arena_location,
+        )
+
+        loc = parse_arena_location(name)
+        if loc is None or not hasattr(store, "arena"):
+            # legacy per-segment store: re-flatten (small objects only)
+            data = self.plasma_client.read(name, size).to_bytes()
+            mv[: len(data)] = data
+            return
+        mv[:] = store.arena.view(loc[1], size)
+        got = store.arena.lookup(object_id.binary())
+        if got is None or got[0] != loc[1]:
+            raise ObjectRelocatedError(name)
 
     def _on_object_sealed(self, object_id: ObjectID):
         with self.lock:
@@ -1741,6 +2117,9 @@ class Controller:
                     os.unlink(entry[1][0])
                 except OSError:
                     pass
+        # secondary copies die with the primary: a freed-then-recreated id
+        # must never be served from a stale replica
+        self._drop_replicas(object_id)
 
     # ------------------------------------------------------------- submission
 
@@ -2900,7 +3279,10 @@ class Controller:
                 # the agent's own control RPCs. object_owner/pull can block
                 # on a not-yet-sealed entry whose seal arrives on THIS
                 # thread — never handle them inline.
-                if msg.op in ("pull_object_chunk", "pubsub_poll", "object_owner"):
+                if msg.op in (
+                    "pull_object_chunk", "pubsub_poll", "object_owner",
+                    "object_locations",
+                ):
                     threading.Thread(
                         target=self._handle_request, args=(agent, msg), daemon=True
                     ).start()
@@ -2976,7 +3358,11 @@ class Controller:
         elif isinstance(msg, P.Request):
             if handle.is_driver and msg.op == "add_ref":
                 handle.held_refs.update(msg.payload)
-            if msg.op in ("wait", "pg_ready", "get_entries", "worker_stacks", "pubsub_poll", "pull_object_chunk"):
+            if msg.op in (
+                "wait", "pg_ready", "get_entries", "worker_stacks",
+                "pubsub_poll", "pull_object_chunk", "pull_into_arena",
+                "object_locations",
+            ):
                 threading.Thread(
                     target=self._handle_request, args=(handle, msg), daemon=True
                 ).start()
@@ -3271,6 +3657,9 @@ class Controller:
                     os.unlink(entry[1][0])
                 except OSError:
                     pass
+            # the hook simulates losing EVERY copy: replicas go too, or the
+            # "lost" object would keep serving from the directory
+            self._drop_replicas(object_id)
             return entry is not None
         if op == "pull_object_chunk":
             # chunked node-to-node transfer (reference: ObjectManager::Push
@@ -3284,14 +3673,22 @@ class Controller:
             entry = self.memory_store.get([object_id], timeout=30)[0]
             if entry is None:
                 raise ObjectLostError(f"object {object_id.hex()} not found")
+            with self.lock:
+                self.transfer_stats["chunks_served"] += 1
+            if self.config.testing_chunk_delay_ms:
+                # simulated cross-host RTT (runs on this op's dedicated
+                # handler thread; see _route_worker_msg threading)
+                time.sleep(self.config.testing_chunk_delay_ms / 1000.0)
             kind, p = entry
             if kind == "spilled":
                 path, size = p
                 agent = self._agent_spills.get(object_id)
                 if agent is not None:
-                    # spilled onto an AGENT's disk: its data listener serves
+                    # spilled onto an AGENT's disk: its data listener (or
+                    # any replica holder) serves
                     return self._pull_chunk_from_agent(
-                        agent.data_address, object_id, offset, length
+                        agent.data_address, object_id, offset, length,
+                        extra_addresses=self._replica_addresses(object_id),
                     )
                 with open(path, "rb") as f:
                     f.seek(offset)
@@ -3311,10 +3708,11 @@ class Controller:
                 store = self._store_for_location(name)
                 if getattr(store, "is_remote", False):
                     # resident on an agent: relay the chunk read to the
-                    # owner's data listener (client drivers and head-local
-                    # workers pull through here)
+                    # owner's data listener, spread across replica holders
+                    # (client drivers and head-local workers pull here)
                     return self._pull_chunk_from_agent(
-                        store.agent.data_address, object_id, offset, length
+                        store.agent.data_address, object_id, offset, length,
+                        extra_addresses=self._replica_addresses(object_id),
                     )
                 chunk = bytes(
                     store.arena.view(loc[1] + offset, min(length, size - offset))
@@ -3331,18 +3729,55 @@ class Controller:
             # Which agent (if any) serves this object's chunks directly —
             # agents use it for peer-to-peer pulls that bypass the head
             # (reference: OwnershipObjectDirectory location lookup).
-            entry = self.memory_store.get([payload], timeout=10)[0]
-            if entry is None:
+            return self._primary_data_address(payload)
+        if op == "object_locations":
+            # Full replica set: every data address that can serve this
+            # object's chunks — the owner plus registered replicas
+            # (reference: OwnershipObjectDirectory — any node holding a
+            # copy serves it). Pullers spread load across the set and fail
+            # over mid-pull when a source dies.
+            primary = self._primary_data_address(payload)
+            addrs = [primary] if primary else []
+            addrs += self._replica_addresses(payload, exclude=primary)
+            return addrs
+        if op == "register_replica":
+            # An arena node materialized a pulled object locally
+            # (pull-into-arena) and now serves it to peers. "freed" tells
+            # the caller the object died mid-pull: discard the copy.
+            object_id, shm_name, size = payload
+            if self._register_replica_entry(object_id, shm_name, size):
                 return None
-            if entry[0] == "spilled":
-                agent = self._agent_spills.get(payload)
-                return agent.data_address if agent is not None else None
-            if entry[0] != "plasma":
-                return None
-            store = self._store_for_location(entry[1][0])
-            if getattr(store, "is_remote", False):
-                return store.agent.data_address
+            return "freed"
+        if op == "unregister_replica":
+            # The holder wants to evict its copy (arena pressure / drain).
+            # "primary" tells it NOT to: the copy was since PROMOTED (its
+            # original primary died) — the holder must take the normal
+            # spill path, or the object's last copy dies with the eviction.
+            object_id, arena = payload
+            from ray_tpu._private.object_store import parse_arena_location
+
+            with self.lock:
+                reps = self._object_replicas.get(object_id)
+                if reps is not None and arena in reps:
+                    self._unregister_replica(object_id, arena)
+                    return None
+                entry = self.memory_store.peek(object_id)
+            if entry is not None and entry[0] == "plasma":
+                loc = parse_arena_location(entry[1][0])
+                if loc is not None and loc[0] == arena:
+                    return "primary"
             return None
+        if op == "pull_into_arena":
+            # A head-side worker asks for a remote object to be
+            # materialized into ITS node's arena (agent-host workers never
+            # reach here — their agent intercepts the op locally).
+            object_id, size_hint = payload
+            return self.pull_into_arena(
+                getattr(caller, "node_id", None), object_id, size_hint
+            )
+        if op == "transfer_stats":
+            with self.lock:
+                return dict(self.transfer_stats)
         if op == "report_agent_spill":
             # An agent moved a resident object to ITS disk; the entry now
             # points at an agent-local spill path (same-host workers open it

@@ -23,36 +23,127 @@ class ChunkPullError(RuntimeError):
 
 class ChunkConnPool:
     """Pooled, authenticated connections to chunk listeners (agents' data
-    plane). One connection per peer address, serialized per-connection; a
-    transport error drops the pooled conn and retries on a fresh one
-    (per-chunk retry, matching the worker-side pull loop)."""
+    plane). Up to ``max_conns_per_peer`` connections per peer address so a
+    windowed pull can keep several chunk round trips in flight to one
+    source (reference: ObjectBufferPool keeps many chunks of a transfer in
+    flight, ``object_buffer_pool.h``); a transport error drops that
+    connection and retries on a fresh one (per-chunk retry, matching the
+    worker-side pull loop). Connects happen OUTSIDE the pool lock, so one
+    unreachable peer (SYN-retry stall) cannot block pulls to healthy
+    peers."""
 
-    def __init__(self, authkey: bytes):
+    def __init__(self, authkey: bytes, max_conns_per_peer: int = 8):
         import threading
 
         self._authkey = authkey
-        # address -> [conn_or_None, per_address_lock]; connects happen under
-        # the PER-ADDRESS lock only, so one unreachable peer (SYN-retry
-        # stall) cannot block pulls to healthy peers
-        self._conns: dict[str, list] = {}
-        self._lock = threading.Lock()
+        self._max_per_peer = max(1, max_conns_per_peer)
+        # address -> {"idle": [conn, ...], "total": checked-out + idle}
+        self._peers: dict[str, dict] = {}
+        self._cv = threading.Condition(threading.Lock())
 
-    def _entry(self, address: str) -> list:
-        import threading
+    def _dial(self, address: str, timeout: float = 10.0):
+        """Authenticated data connection with BOUNDED dial + handshake.
 
-        with self._lock:
-            entry = self._conns.get(address)
-            if entry is None:
-                entry = [None, threading.Lock()]
-                self._conns[address] = entry
-            return entry
+        ``multiprocessing.connection.Client`` blocks forever in the auth
+        challenge when a half-open peer (SYN-proxied address, dying host)
+        accepts the TCP connection but never answers — hanging the chunk
+        thread and with it the whole pull. Here the connect and every
+        handshake syscall carry an OS-level deadline (``SO_RCVTIMEO`` /
+        ``SO_SNDTIMEO``), so a dead source surfaces as OSError and the
+        fetcher fails over to another replica or the head. The per-syscall
+        deadline stays on the bulk phase too: it bounds stall, not
+        throughput (each 64 KiB read just has to make progress)."""
+        import socket as _socket
+        import struct as _struct
+        from multiprocessing.connection import (
+            Connection,
+            answer_challenge,
+            deliver_challenge,
+        )
+
+        host, _, port = address.rpartition(":")
+        sock = _socket.create_connection((host, int(port)), timeout=timeout)
+        try:
+            sock.setblocking(True)
+            tv = _struct.pack("ll", int(timeout), int((timeout % 1) * 1e6))
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVTIMEO, tv)
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDTIMEO, tv)
+            conn = Connection(sock.detach())
+        except BaseException:
+            sock.close()
+            raise
+        try:
+            answer_challenge(conn, self._authkey)
+            deliver_challenge(conn, self._authkey)
+        except BaseException:
+            conn.close()
+            raise
+        return conn
+
+    def _checkout(self, address: str, timeout: float = 60.0):
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._cv:
+            while True:
+                entry = self._peers.get(address)
+                if entry is None:
+                    entry = {"idle": [], "total": 0}
+                    self._peers[address] = entry
+                if entry["idle"]:
+                    return entry["idle"].pop()
+                if entry["total"] < self._max_per_peer:
+                    entry["total"] += 1
+                    break
+                # every checked-out conn is checked back in via a finally
+                # in pull_chunk, so this wait is bounded by a chunk round
+                # trip; the re-check guards against a dropped peer
+                if not self._cv.wait(timeout=min(1.0, max(0.0, deadline - _time.monotonic()))):
+                    if _time.monotonic() >= deadline:
+                        raise OSError(f"no free data connection to {address}")
+        try:
+            return self._dial(address)
+        except BaseException:
+            # the reserved slot must be released, or the peer's pool shrinks
+            # permanently with every failed dial
+            with self._cv:
+                entry = self._peers.get(address)
+                if entry is not None and entry["total"] > 0:
+                    entry["total"] -= 1
+                self._cv.notify_all()
+            raise
+
+    def _checkin(self, address: str, conn, broken: bool = False):
+        with self._cv:
+            entry = self._peers.get(address)
+            if broken or entry is None:
+                if entry is not None and entry["total"] > 0:
+                    entry["total"] -= 1
+                self._cv.notify_all()
+            else:
+                entry["idle"].append(conn)
+                self._cv.notify_all()
+                return
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def drop(self, address: str):
-        with self._lock:
-            entry = self._conns.pop(address, None)
-        if entry is not None and entry[0] is not None:
+        """Forget pooled connections to a dead/stale peer. In-flight
+        checkouts fail on their own and release their slots at checkin."""
+        with self._cv:
+            entry = self._peers.get(address)
+            if entry is None:
+                return
+            idle, entry["idle"] = entry["idle"], []
+            entry["total"] = max(0, entry["total"] - len(idle))
+            if entry["total"] == 0:
+                self._peers.pop(address, None)
+            self._cv.notify_all()
+        for conn in idle:
             try:
-                entry[0].close()
+                conn.close()
             except OSError:
                 pass
 
@@ -63,22 +154,25 @@ class ChunkConnPool:
         """Returns (total_size, chunk_bytes). Raises ChunkPullError when the
         owner does not have the object; OSError after transport retries."""
         import time as _time
-        from multiprocessing.connection import Client
 
         last_err: Optional[BaseException] = None
         for attempt in range(retries):
-            entry = self._entry(address)
             try:
-                with entry[1]:
-                    if entry[0] is None:
-                        host, _, port = address.rpartition(":")
-                        entry[0] = Client((host, int(port)), authkey=self._authkey)
-                    conn = entry[0]
-                    conn.send(("chunk", oid_bytes, offset, length))
-                    result = conn.recv()
-            except (OSError, EOFError, ConnectionError) as e:
-                self.drop(address)
+                conn = self._checkout(address)
+            except (OSError, ConnectionError) as e:
                 last_err = e
+                _time.sleep(0.05 * (attempt + 1))
+                continue
+            ok = False
+            try:
+                conn.send(("chunk", oid_bytes, offset, length))
+                result = conn.recv()
+                ok = True
+            except (OSError, EOFError, ConnectionError) as e:
+                last_err = e
+            finally:
+                self._checkin(address, conn, broken=not ok)
+            if not ok:
                 _time.sleep(0.05 * (attempt + 1))
                 continue
             if isinstance(result, tuple) and result and result[0] == "error":
@@ -86,31 +180,147 @@ class ChunkConnPool:
             return result
         raise last_err  # type: ignore[misc]
 
-    def pull_whole(
-        self, address: str, oid_bytes: bytes, size: int,
-        chunk_bytes: int = 8 * 1024**2,
-    ) -> bytes:
-        buf = bytearray()
-        offset = 0
-        while offset < size:
-            _, chunk = self.pull_chunk(
-                address, oid_bytes, offset, min(chunk_bytes, size - offset)
-            )
-            if not chunk:
-                raise ChunkPullError(f"empty chunk at {offset}/{size}")
-            buf.extend(chunk)
-            offset += len(chunk)
-        return bytes(buf)
-
     def close(self):
+        with self._cv:
+            conns = [c for e in self._peers.values() for c in e["idle"]]
+            self._peers.clear()
+            self._cv.notify_all()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def _buffer_sink(buf):
+    """Chunk sink writing into a preallocated buffer; disjoint-range writes
+    are thread-safe (each chunk owns its slice)."""
+    mv = memoryview(buf)
+
+    def sink(offset: int, data):
+        mv[offset : offset + len(data)] = data
+
+    return sink
+
+
+def pull_windowed(fetch, sink, size: int, chunk_bytes: int, window: int):
+    """Pull ``[0, size)`` in ``chunk_bytes`` pieces keeping up to ``window``
+    chunk fetches in flight, writing each completed chunk through
+    ``sink(offset, bytes)``.
+
+    ``fetch(offset, length) -> (total_size, bytes)`` owns per-chunk retry /
+    source failover and may return SHORT chunks (a server caps lengths at
+    its own chunk config) — the remainder is re-requested. The first chunk
+    error propagates after the in-flight window drains (workers are joined
+    before return; a failed transfer leaks no thread)."""
+    import threading
+
+    def pull_one(off: int):
+        ln = min(chunk_bytes, size - off)
+        got = 0
+        while got < ln:
+            _, data = fetch(off + got, ln - got)
+            if not data:
+                raise ChunkPullError(f"empty chunk at {off + got}/{size}")
+            sink(off + got, data)
+            got += len(data)
+
+    offsets = list(range(0, size, chunk_bytes))
+    if window <= 1 or len(offsets) <= 1:
+        for off in offsets:
+            pull_one(off)
+        return
+
+    it = iter(offsets)
+    lock = threading.Lock()
+    errors: list = []
+
+    def worker():
+        while True:
+            with lock:
+                if errors:
+                    return
+                off = next(it, None)
+            if off is None:
+                return
+            try:
+                pull_one(off)
+            except BaseException as e:  # noqa: BLE001 — re-raised by caller
+                with lock:
+                    errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=worker, daemon=True, name="chunk-pull")
+        for _ in range(min(window, len(offsets)))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+class ReplicaFetcher:
+    """Per-chunk fetch over a replica set with load spreading + failover
+    (reference: the PullManager picks among known locations,
+    ``pull_manager.h:49``; ownership directory supplies the set).
+
+    Thread-safe: chunk fetches spread round-robin from a random start
+    across ``sources``; a source that fails is dropped for the REST of the
+    pull (and reported through ``on_source_fail`` so callers can invalidate
+    their location caches). When every source is gone, ``fallback(offset,
+    length)`` — typically the head relay — serves the chunk; with no
+    fallback the pull fails."""
+
+    def __init__(
+        self, pool: "ChunkConnPool", oid_bytes: bytes, sources,
+        fallback=None, on_source_fail=None,
+    ):
+        import itertools as _it
+        import random as _random
+        import threading
+
+        self._pool = pool
+        self._oid = oid_bytes
+        self._sources = list(sources)
+        self._rr = _it.count(
+            _random.randrange(len(self._sources)) if self._sources else 0
+        )
+        self._lock = threading.Lock()
+        self._fallback = fallback
+        self._on_fail = on_source_fail
+        self.peer_chunks = 0
+        self.fallback_chunks = 0
+
+    def __call__(self, offset: int, length: int):
+        while True:
+            with self._lock:
+                srcs = list(self._sources)
+            if not srcs:
+                break
+            addr = srcs[next(self._rr) % len(srcs)]
+            try:
+                result = self._pool.pull_chunk(addr, self._oid, offset, length)
+            except (ChunkPullError, OSError, EOFError, ConnectionError) as e:
+                with self._lock:
+                    if addr in self._sources:
+                        self._sources.remove(addr)
+                if self._on_fail is not None:
+                    self._on_fail(addr, e)
+                continue
+            with self._lock:
+                self.peer_chunks += 1
+            return result
+        if self._fallback is None:
+            raise ChunkPullError(
+                f"no live source for chunk at offset {offset}"
+            )
+        result = self._fallback(offset, length)
         with self._lock:
-            for entry in self._conns.values():
-                if entry[0] is not None:
-                    try:
-                        entry[0].close()
-                    except OSError:
-                        pass
-            self._conns.clear()
+            self.fallback_chunks += 1
+        return result
 
 
 def token_to_authkey(token: str) -> bytes:

@@ -498,6 +498,12 @@ def record(path: str = "MICROBENCH.json") -> None:
     out["envelope"] = envelope()
     out["serve_proxy_keepalive_req_per_s"] = serve_proxy_bench()
     out["env_stepping"] = env_stepping_bench()
+    try:
+        from ray_tpu.scripts.transfer_bench import transfer_bench
+
+        out["transfer"] = transfer_bench()
+    except Exception as e:  # noqa: BLE001 — transfer rows are additive
+        out["transfer"] = {"error": repr(e)}
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {path}")

@@ -119,6 +119,61 @@ def test_client_auto_address(tmp_path):
         ray_tpu.shutdown()
 
 
+def test_client_windowed_push_under_chunk_chaos(tmp_path):
+    """An arena-less client pushes a large put through the chunked push
+    protocol with the in-flight window open and 20% injected chunk
+    failure: per-chunk retry completes the object intact (out-of-order
+    windowed chunks + idempotent retried writes)."""
+    ray_tpu.init(
+        num_cpus=2,
+        mode="process",
+        config={"testing_rpc_failure": "push_object_chunk=0.2"},
+    )
+    try:
+        addr = ray_tpu.cluster_address()
+        code = textwrap.dedent(
+            """
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import numpy as np
+            import ray_tpu
+
+            ray_tpu.init(address={addr!r})
+            # drop the probed arena: force the chunked push protocol the
+            # way a cross-host client would use it
+            os.environ.pop("RAY_TPU_ARENA", None)
+            big = np.arange(100_000, dtype=np.float64)  # ~13 chunks
+            ref = ray_tpu.put(big)
+
+            @ray_tpu.remote
+            def total(x):
+                return float(x.sum())
+
+            assert ray_tpu.get(total.remote(ref), timeout=120) == float(big.sum())
+            ray_tpu.shutdown()
+            print("PUSH-OK")
+            """.replace("{addr!r}", repr(addr))
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=240,
+            env={
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "PYTHONPATH": "/root/repo",
+                "JAX_PLATFORMS": "cpu",
+                "HOME": "/root",
+                "RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES": "65536",
+                "RAY_TPU_OBJECT_TRANSFER_WINDOW": "4",
+            },
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "PUSH-OK" in r.stdout
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_client_same_host_arena_probe(tmp_path):
     """A same-host client (launched WITHOUT the inherited arena env) probes
     and attaches the head's native arena, so its large puts ride shared

@@ -220,6 +220,36 @@ def test_agent_to_agent_transfer(agent_cluster):
     assert ray_tpu.get(consume.remote(ref), timeout=180) == 7.0 * 250_000
 
 
+def test_pull_fails_over_to_replica_after_source_agent_death(agent_cluster):
+    """A cross-node consume registers the consumer agent as a replica in
+    the head's location directory; when the OWNER agent is then killed, a
+    driver pull of the object fails over mid-resolution to the surviving
+    replica instead of erroring (reference: multi-location pulls via the
+    ownership directory)."""
+    a1 = agent_cluster.add_agent("a1", {"CPU": 2, "node_a": 1})
+    agent_cluster.add_agent("a2", {"CPU": 2, "node_b": 1})
+    controller = agent_cluster.controller
+
+    @ray_tpu.remote(resources={"node_a": 1})
+    def produce():
+        return np.arange(250_000, dtype=np.float64)
+
+    @ray_tpu.remote(resources={"node_b": 1})
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    expected = np.arange(250_000, dtype=np.float64)
+    assert ray_tpu.get(consume.remote(ref), timeout=180) == float(expected.sum())
+    # the consume pulled-into-arena on a2 and registered the replica
+    reps = controller._object_replicas.get(ref.id(), {})
+    assert reps, "consumer agent did not register a replica"
+
+    a1.kill()  # SIGKILL the owner: its data listener dies instantly
+    arr = ray_tpu.get(ref, timeout=60)
+    np.testing.assert_array_equal(arr, expected)
+
+
 def test_actor_on_remote_node_restarts_after_agent_kill(agent_cluster):
     """Kill -9 the agent hosting an actor; the actor restarts once capacity
     reappears (a fresh agent) and keeps serving."""

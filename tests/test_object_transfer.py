@@ -21,8 +21,13 @@ needs_native = pytest.mark.skipif(
 
 
 @pytest.fixture
-def transfer_cluster(request):
-    extra_cfg = getattr(request, "param", {})
+def transfer_cluster(request, monkeypatch):
+    extra_cfg = dict(getattr(request, "param", {}))
+    # worker processes read transfer knobs from their environment — the
+    # "env" key reaches them through spawn inheritance
+    for k, v in extra_cfg.pop("env", {}).items():
+        monkeypatch.setenv(k, v)
+    node_b_cpus = extra_cfg.pop("node_b_cpus", 1.0)
     ray_tpu.init(
         num_cpus=1,
         resources={"nodeA": 1.0},
@@ -32,7 +37,7 @@ def transfer_cluster(request):
     from ray_tpu._private.worker import global_worker
 
     controller = global_worker().controller
-    node_b = controller.add_node({"CPU": 1.0, "nodeB": 1.0})
+    node_b = controller.add_node({"CPU": node_b_cpus, "nodeB": node_b_cpus})
     yield controller, node_b
     ray_tpu.shutdown()
 
@@ -94,6 +99,187 @@ def test_pull_retries_chunk_failures(transfer_cluster):
 
 
 @needs_native
+@pytest.mark.parametrize(
+    "transfer_cluster",
+    [
+        {
+            "testing_rpc_failure": "pull_object_chunk=0.3",
+            "env": {
+                "RAY_TPU_PULL_INTO_ARENA": "0",
+                "RAY_TPU_OBJECT_TRANSFER_WINDOW": "4",
+                "RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES": str(256 * 1024),
+            },
+        }
+    ],
+    indirect=True,
+)
+def test_windowed_pull_chunk_failure_retries_without_restart(transfer_cluster):
+    """With the in-flight window open and 30% injected chunk failure, a
+    failed chunk costs ONE retransmit — the object transfer never restarts
+    from offset 0. chunks_served counts successful serves only (chaos
+    injects before the serve), so an exact count proves each offset was
+    served exactly once."""
+    import math
+
+    controller, node_b = transfer_cluster
+
+    @ray_tpu.remote(resources={"nodeA": 1})
+    def produce():
+        rng = np.random.default_rng(11)
+        return rng.normal(size=250_000)  # 2 MB -> 8 chunks at 256 KiB
+
+    @ray_tpu.remote(resources={"nodeB": 1})
+    def digest(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    ray_tpu.get(ref, timeout=120)  # sealed; the driver read serves locally
+    entry = controller.memory_store.get([ref.id()], timeout=10)[0]
+    size = entry[1][1]
+    before = dict(controller.transfer_stats)
+    got = ray_tpu.get(digest.remote(ref), timeout=120)
+    expected = float(np.random.default_rng(11).normal(size=250_000).sum())
+    assert abs(got - expected) < 1e-6
+    served = controller.transfer_stats["chunks_served"] - before.get(
+        "chunks_served", 0
+    )
+    assert served == math.ceil(size / (256 * 1024)), (served, size)
+
+
+@needs_native
+def test_pull_into_arena_second_reader_zero_transfer(transfer_cluster):
+    """A pulled object materializes into the consumer node's arena; the
+    SECOND same-node reader mmaps the replica — zero cross-node chunk RPCs,
+    asserted via the transfer counters (not timing)."""
+    controller, node_b = transfer_cluster
+
+    @ray_tpu.remote(resources={"nodeA": 1})
+    def produce():
+        return np.arange(400_000, dtype=np.float64)  # 3.2 MB -> plasma
+
+    @ray_tpu.remote(resources={"nodeB": 1})
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    expected = float(np.arange(400_000, dtype=np.float64).sum())
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == expected
+    stats1 = dict(controller.transfer_stats)
+    assert stats1.get("arena_pulls", 0) == 1
+    # the replica is registered in the head's location directory under the
+    # consumer node's arena
+    store_b = controller._store_for_node(node_b)
+    reps = controller._object_replicas.get(ref.id())
+    assert reps is not None and store_b.arena_name in reps
+    assert store_b.lookup(ref.id()) is not None
+
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == expected
+    stats2 = dict(controller.transfer_stats)
+    assert stats2.get("arena_pulls", 0) == 1, stats2  # no re-transfer
+    assert stats2.get("chunks_served", 0) == stats1.get("chunks_served", 0)
+    assert stats2.get("arena_replica_hits", 0) >= 1
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "transfer_cluster", [{"node_b_cpus": 2.0}], indirect=True
+)
+def test_concurrent_same_node_pulls_coalesce(transfer_cluster):
+    """Two concurrent readers of one remote object on one node trigger ONE
+    cross-node transfer (single-flight pull-into-arena), whichever
+    interleaving the scheduler produces."""
+    controller, node_b = transfer_cluster
+
+    @ray_tpu.remote(resources={"nodeA": 1})
+    def produce():
+        return np.ones(400_000, dtype=np.float64)
+
+    @ray_tpu.remote(resources={"nodeB": 1})
+    def consume(x, tag):
+        return (tag, float(x.sum()))
+
+    ref = produce.remote()
+    ray_tpu.get(ref, timeout=120)
+    r1 = consume.remote(ref, 1)
+    r2 = consume.remote(ref, 2)
+    out = dict(ray_tpu.get([r1, r2], timeout=120))
+    assert out == {1: 400_000.0, 2: 400_000.0}
+    assert controller.transfer_stats.get("arena_pulls", 0) == 1
+
+
+@needs_native
+def test_replica_invalidated_on_free(transfer_cluster):
+    """free() kills replicas with the primary: the directory entry drops
+    and the consumer node's arena copy is deleted — a freed-then-recreated
+    object id can never be served from the stale copy."""
+    controller, node_b = transfer_cluster
+
+    @ray_tpu.remote(resources={"nodeA": 1})
+    def produce():
+        return np.ones(300_000, dtype=np.float64)
+
+    @ray_tpu.remote(resources={"nodeB": 1})
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 300_000.0
+    oid = ref.id()
+    store_b = controller._store_for_node(node_b)
+    assert oid in controller._object_replicas
+    assert store_b.lookup(oid) is not None
+
+    del ref
+    import gc
+
+    gc.collect()
+    deadline = 10.0
+    import time as _time
+
+    t0 = _time.monotonic()
+    while _time.monotonic() - t0 < deadline and oid in controller._object_replicas:
+        _time.sleep(0.05)
+    assert oid not in controller._object_replicas
+    assert store_b.lookup(oid) is None
+    assert not controller._replicas_by_arena.get(store_b.arena_name)
+
+
+@needs_native
+def test_replica_promoted_when_primary_node_dies(transfer_cluster):
+    """The primary's node dies but a replica survives elsewhere: the entry
+    repoints at the replica (promotion) instead of running lineage
+    recovery — the object stays readable."""
+    controller, node_b = transfer_cluster
+    node_c = controller.add_node({"CPU": 1.0, "nodeC": 1.0})
+
+    @ray_tpu.remote(resources={"nodeC": 1})
+    def produce():
+        return np.full(300_000, 3.0)
+
+    @ray_tpu.remote(resources={"nodeB": 1})
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 900_000.0
+    store_b = controller._store_for_node(node_b)
+    assert store_b.arena_name in controller._object_replicas.get(ref.id(), {})
+
+    controller.remove_node(node_c)
+    assert controller.transfer_stats.get("replicas_promoted", 0) == 1
+    entry = controller.memory_store.get([ref.id()], timeout=10)[0]
+    assert entry[0] == "plasma" and store_b.arena_name in entry[1][0]
+    got = ray_tpu.get(ref, timeout=120)
+    np.testing.assert_array_equal(got, np.full(300_000, 3.0))
+    # a holder asking to evict the PROMOTED copy must be refused — it is
+    # the object's last copy now (the agent spills it instead)
+    verdict = controller._dispatch_request(
+        "unregister_replica", (ref.id(), store_b.arena_name)
+    )
+    assert verdict == "primary"
+
+
+@needs_native
 def test_cross_node_roundtrip_both_directions(transfer_cluster):
     controller, node_b = transfer_cluster
 
@@ -109,3 +295,201 @@ def test_cross_node_roundtrip_both_directions(transfer_cluster):
     assert ray_tpu.get(
         consume_a.remote(produce_b.remote()), timeout=120
     ) == 300_000.0
+
+
+# --------------------------------------------------------------------------
+# Unit level: the windowed multi-source pull machinery against fake chunk
+# servers (no cluster, no native store) — source death mid-pull fails over
+# to another replica or the fallback (head relay).
+
+_AUTHKEY = b"transfer-test"
+
+
+class _FakeChunkServer:
+    """Minimal agent-data-listener stand-in serving the chunk protocol from
+    an in-memory buffer. ``die_after`` chunks makes it drop connections —
+    the mid-pull source-death fault."""
+
+    def __init__(self, data: bytes, die_after=None):
+        import threading
+        from multiprocessing.connection import Listener
+
+        self.data = data
+        self.die_after = die_after
+        self.served = 0
+        self._lock = threading.Lock()
+        self._listener = Listener(("127.0.0.1", 0), authkey=_AUTHKEY)
+        self.address = f"127.0.0.1:{self._listener.address[1]}"
+        self._conns = []
+        self._stop = False
+        self._accepter = threading.Thread(target=self._accept, daemon=True)
+        self._accepter.start()
+
+    def _accept(self):
+        import threading
+
+        while not self._stop:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            except Exception:  # noqa: BLE001 — failed handshake
+                continue
+            self._conns.append(conn)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        try:
+            while not self._stop:
+                try:
+                    req = conn.recv()
+                except (EOFError, OSError):
+                    return
+                _, oid, offset, length = req
+                with self._lock:
+                    if self.die_after is not None and self.served >= self.die_after:
+                        return  # connection drops mid-pull
+                    self.served += 1
+                conn.send(
+                    (len(self.data), self.data[offset : offset + length])
+                )
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def kill(self):
+        self._stop = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+@pytest.fixture
+def chunk_pool():
+    from ray_tpu._private import protocol as P
+
+    pool = P.ChunkConnPool(_AUTHKEY, max_conns_per_peer=4)
+    yield pool
+    pool.close()
+
+
+def _windowed_pull(pool, sources, data_len, fallback=None, window=4,
+                   chunk=64 * 1024, on_fail=None):
+    from ray_tpu._private import protocol as P
+
+    fetcher = P.ReplicaFetcher(
+        pool, b"oid", sources, fallback=fallback, on_source_fail=on_fail
+    )
+    buf = bytearray(data_len)
+    P.pull_windowed(fetcher, P._buffer_sink(buf), data_len, chunk, window)
+    return buf, fetcher
+
+
+def test_windowed_pull_source_death_fails_over_to_replica(chunk_pool):
+    data = bytes(np.random.default_rng(3).bytes(1024 * 1024))
+    dying = _FakeChunkServer(data, die_after=2)
+    healthy = _FakeChunkServer(data)
+    failed = []
+    try:
+        buf, fetcher = _windowed_pull(
+            chunk_pool,
+            [dying.address, healthy.address],
+            len(data),
+            on_fail=lambda addr, e: failed.append(addr),
+        )
+        assert bytes(buf) == data
+        # the dying source was dropped mid-pull; the survivor finished
+        assert healthy.served >= 1
+        assert fetcher.peer_chunks == 16  # 1 MiB / 64 KiB
+        assert dying.address in failed or dying.served == 2
+    finally:
+        dying.kill()
+        healthy.kill()
+
+
+def test_windowed_pull_all_sources_dead_uses_fallback(chunk_pool):
+    data = bytes(np.random.default_rng(5).bytes(256 * 1024))
+    dead = _FakeChunkServer(data, die_after=0)
+    fallback_calls = []
+
+    def head_relay(offset, length):
+        fallback_calls.append(offset)
+        return (len(data), data[offset : offset + length])
+
+    try:
+        buf, fetcher = _windowed_pull(
+            chunk_pool, [dead.address], len(data), fallback=head_relay
+        )
+        assert bytes(buf) == data
+        assert fetcher.fallback_chunks == len(fallback_calls) == 4
+    finally:
+        dead.kill()
+
+
+def test_windowed_pull_no_sources_no_fallback_raises(chunk_pool):
+    from ray_tpu._private import protocol as P
+
+    with pytest.raises(P.ChunkPullError):
+        _windowed_pull(chunk_pool, [], 1024)
+
+
+def test_windowed_pull_handles_short_server_chunks(chunk_pool):
+    """A server that caps chunk length below the request (its own transfer
+    config) forces remainder re-requests — the buffer still fills exactly."""
+    data = bytes(np.random.default_rng(7).bytes(300 * 1024))
+
+    class _Short(_FakeChunkServer):
+        def _serve(self, conn):
+            try:
+                while not self._stop:
+                    try:
+                        req = conn.recv()
+                    except (EOFError, OSError):
+                        return
+                    _, oid, offset, length = req
+                    with self._lock:
+                        self.served += 1
+                    conn.send(
+                        (
+                            len(self.data),
+                            self.data[offset : offset + min(length, 10_000)],
+                        )
+                    )
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    srv = _Short(data)
+    try:
+        buf, _ = _windowed_pull(chunk_pool, [srv.address], len(data))
+        assert bytes(buf) == data
+    finally:
+        srv.kill()
+
+
+def test_conn_pool_grows_to_cap_and_reuses(chunk_pool):
+    data = b"z" * 4096
+    srv = _FakeChunkServer(data)
+    try:
+        buf, _ = _windowed_pull(
+            chunk_pool, [srv.address], len(data), chunk=256, window=4
+        )
+        assert bytes(buf) == data
+        with chunk_pool._cv:
+            entry = chunk_pool._peers[srv.address]
+            assert 1 <= entry["total"] <= 4
+            assert len(entry["idle"]) == entry["total"]  # all returned
+    finally:
+        srv.kill()
