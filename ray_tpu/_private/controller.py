@@ -456,8 +456,10 @@ class Controller:
         self._kv_dirty = threading.Event()
         self._kv_flusher: Optional[threading.Thread] = None
         # chaos: parse "op=prob,op=prob" once (rpc_chaos analog). Malformed
-        # entries raise: a typo silently disabling fault injection would make
-        # chaos tests pass vacuously.
+        # entries AND unknown op names raise: a typo silently disabling
+        # fault injection would make chaos tests pass vacuously. The op
+        # catalog is P.CONTROLLER_OPS, which tpulint's wire-conformance
+        # family keeps in sync with the actual dispatch branches.
         import random
 
         self._rpc_chaos: dict[str, float] = {}
@@ -471,6 +473,15 @@ class Controller:
                     f"testing_rpc_failure entry {part!r} is not 'op=prob'"
                 )
             self._rpc_chaos[op_name.strip()] = float(p)
+        unknown_chaos = set(self._rpc_chaos) - P.CONTROLLER_OPS
+        if unknown_chaos:
+            raise ValueError(
+                f"testing_rpc_failure names unknown op(s) "
+                f"{sorted(unknown_chaos)}: a typo'd op never injects, so the "
+                f"fault-injection tests relying on it pass vacuously "
+                f"(known ops: see ray_tpu._private.protocol.CONTROLLER_OPS "
+                f"/ docs/PROTOCOL.md)"
+            )
         # serializes snapshot+rename: without it an in-flight background
         # write (stale snapshot) can land AFTER the shutdown flush
         self._kv_write_lock = locktrace.register_lock(
@@ -3276,12 +3287,11 @@ class Controller:
                     waiter[1].append(msg.text)
                     waiter[0].set()
             elif isinstance(msg, P.Request):
-                # the agent's own control RPCs. object_owner/pull can block
-                # on a not-yet-sealed entry whose seal arrives on THIS
-                # thread — never handle them inline.
+                # the agent's own control RPCs. A chunk pull can block on a
+                # not-yet-sealed entry whose seal arrives on THIS thread —
+                # never handle those inline.
                 if msg.op in (
-                    "pull_object_chunk", "pubsub_poll", "object_owner",
-                    "object_locations",
+                    "pull_object_chunk", "pubsub_poll", "object_locations",
                 ):
                     threading.Thread(
                         target=self._handle_request, args=(agent, msg), daemon=True
@@ -3725,11 +3735,6 @@ class Controller:
             # inline/error entries are small: serve from their bytes
             data = p.to_bytes()
             return (len(data), data[offset : offset + length])
-        if op == "object_owner":
-            # Which agent (if any) serves this object's chunks directly —
-            # agents use it for peer-to-peer pulls that bypass the head
-            # (reference: OwnershipObjectDirectory location lookup).
-            return self._primary_data_address(payload)
         if op == "object_locations":
             # Full replica set: every data address that can serve this
             # object's chunks — the owner plus registered replicas

@@ -346,6 +346,87 @@ def routable_host() -> str:
         return "127.0.0.1"
 
 
+# ---- op catalog -----------------------------------------------------------
+#
+# The string-keyed request surface (``Request.op``). These sets are the
+# RUNTIME half of the wire contract: the controller and worker validate
+# chaos-injection config keys against them at parse time (a typo'd op name
+# would otherwise never inject and every fault-injection test relying on it
+# passes vacuously). The STATIC half is tpulint's ``wire-conformance``
+# family, which extracts the real dispatch branches and send sites from the
+# AST and fails the lint gate when these literals drift from the code —
+# see ``ray_tpu/devtools/lint/wire.py`` and ``docs/PROTOCOL.md``.
+
+# Every op `Controller._dispatch_request` handles.
+CONTROLLER_OPS = frozenset(
+    {
+        "actor_direct_endpoint",
+        "actor_state",
+        "add_node",
+        "add_ref",
+        "autoscaler_state",
+        "available_resources",
+        "cancel",
+        "cluster_resources",
+        "debug_worker_msg_count",
+        "drain_node",
+        "drain_status",
+        "get_named_actor",
+        "head_arena",
+        "kill_actor",
+        "kv_del",
+        "kv_get",
+        "kv_keys",
+        "kv_put",
+        "list_actors",
+        "list_objects",
+        "list_placement_groups",
+        "list_tasks",
+        "list_workers",
+        "log_get",
+        "log_list",
+        "log_tail_buffer",
+        "nodes",
+        "object_locations",
+        "pg_create",
+        "pg_ready",
+        "pg_remove",
+        "pg_table",
+        "pubsub_poll",
+        "pubsub_publish",
+        "pull_into_arena",
+        "pull_object_chunk",
+        "push_object_chunk",
+        "register_replica",
+        "remove_node",
+        "report_agent_spill",
+        "shm_create",
+        "stream_abandoned",
+        "stream_consumed_get",
+        "stream_consumed_report",
+        "submit_task",
+        "task_events",
+        "tasks_pending",
+        "testing_lose_object",
+        "transfer_stats",
+        "unregister_replica",
+        "wait",
+        "worker_stacks",
+    }
+)
+
+# Ops a node agent intercepts for its local workers (node-local data plane).
+# Must stay a subset of CONTROLLER_OPS: head-side workers have no agent, so
+# an agent-only op would work on agent nodes and break on the head node.
+AGENT_LOCAL_OPS = frozenset(
+    {"pull_into_arena", "pull_object_chunk", "shm_create", "transfer_stats"}
+)
+
+# Worker-side chaos channel names that are not request ops (the plasma /
+# object-channel analogs injected by RAY_TPU_WORKER_RPC_FAILURE).
+WORKER_CHANNEL_OPS = frozenset({"get_objects", "plasma_read", "put_object"})
+
+
 # ---- worker -> controller ----
 
 @dataclasses.dataclass

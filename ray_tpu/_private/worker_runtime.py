@@ -267,6 +267,15 @@ class WorkerRuntime:
             for part in spec.split(","):
                 name, _, prob = part.partition("=")
                 table[name.strip()] = float(prob)
+            # a typo'd channel/op name silently never injects — fail loud
+            # (valid keys: every controller request op + the worker-local
+            # object channels; kept code-true by tpulint wire-conformance)
+            unknown = set(table) - P.CONTROLLER_OPS - P.WORKER_CHANNEL_OPS
+            if unknown:
+                raise ValueError(
+                    f"RAY_TPU_WORKER_RPC_FAILURE names unknown op(s) "
+                    f"{sorted(unknown)} (see docs/PROTOCOL.md)"
+                )
             self._chaos_table = table
         prob = self._chaos_table.get(op)
         if prob and self._chaos_rng.random() < prob:
@@ -949,6 +958,7 @@ class WorkerRuntime:
         return self.call_controller(op, payload)
 
     def put_serialized(self, object_id: ObjectID, sobj: SerializedObject):
+        self._maybe_inject_failure("put_object")
         ctrl = self._inproc_controller()
         if ctrl is not None:
             if sobj.total_bytes() <= self.max_inline:

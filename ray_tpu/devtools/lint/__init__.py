@@ -21,7 +21,14 @@ al. 2018) specialised to the bug shapes this codebase has actually shipped:
 - ``ref-lifecycle`` — Pulse-style lifetime tracking: shm segments, plasma
   client/arena mappings, sockets, tempfiles, and dropped ObjectRef puts
   that leak on exception edges or early returns, double-releases, and
-  use-after-release (the PR 4 spilled-reply RSS-leak shape).
+  use-after-release (the PR 4 spilled-reply RSS-leak shape);
+- ``wire-conformance`` — static op-catalog cross-checking of the
+  hand-rolled RPC surface: handler dispatch ladders and send sites are
+  extracted and matched (unknown/typo'd ops, payload-arity mismatches,
+  unguarded use of maybe-``None`` replies, agent-only ops, raise-without-
+  error-reply dispatch sites, unbounded request waits, op-catalog and
+  ``docs/PROTOCOL.md`` drift — the doc is generated from the catalog via
+  ``--write-protocol-doc``).
 
 Programmatic use::
 
@@ -41,13 +48,17 @@ from .model import CHECKS, Finding
 __all__ = ["CHECKS", "Finding", "lint_paths", "discover", "analyze", "run_checks"]
 
 
-def lint_paths(paths, checks=None, root=None, config=None):
+def lint_paths(paths, checks=None, root=None, config=None, full_tree=False):
     """Index, analyze, and run checks over `paths`; returns list[Finding].
 
     ``config`` is an optional ``[tool.tpulint]``-shaped dict (e.g.
-    ``collective_functions``) consumed by the check families."""
+    ``collective_functions``, ``protocol_doc``) consumed by the check
+    families. ``full_tree=True`` marks the run as covering the whole
+    configured surface, enabling whole-surface checks (the wire family's
+    protocol-doc drift gate)."""
     project = discover(list(paths), root=root)
     if config:
         project.config = dict(config)
+    project.full_tree = full_tree
     analyze(project)
     return run_checks(project, checks)

@@ -1,10 +1,11 @@
 """tpulint check families: turn engine facts into findings.
 
-Seven families (see ``model.CHECKS``): the five concurrency families from
+Eight families (see ``model.CHECKS``): the five concurrency families from
 PR 5/6 (blocking-under-lock, lock-order, async-stall,
-unguarded-shared-state, shutdown-hygiene) plus the two SPMD/lifetime
+unguarded-shared-state, shutdown-hygiene), the two SPMD/lifetime
 families built on the pluggable flow lattice (collective-uniformity,
-ref-lifecycle — see :mod:`.collective` and :mod:`.lifecycle`). Every
+ref-lifecycle — see :mod:`.collective` and :mod:`.lifecycle`), and the
+RPC-surface cross-checker (wire-conformance — see :mod:`.wire`). Every
 finding carries a stable line-free ``key`` (for baseline fingerprints that
 survive code motion) and a human call path down to the offending primitive.
 """
@@ -17,6 +18,7 @@ from .collective import check_collective_uniformity
 from .discovery import Project
 from .lifecycle import check_ref_lifecycle
 from .model import CHECKS, Finding, SHUTDOWN_METHOD_NAMES
+from .wire import check_wire_conformance
 
 
 def _fmt_chain(witness) -> list:
@@ -460,6 +462,7 @@ _ALL = {
     "shutdown-hygiene": check_shutdown_hygiene,
     "collective-uniformity": check_collective_uniformity,
     "ref-lifecycle": check_ref_lifecycle,
+    "wire-conformance": check_wire_conformance,
 }
 
 assert set(_ALL) == set(CHECKS)

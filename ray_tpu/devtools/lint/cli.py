@@ -120,10 +120,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m ray_tpu.devtools.lint",
         description=(
-            "tpulint: concurrency + SPMD + resource-lifecycle static "
-            "analysis for ray_tpu (lock-order, blocking-under-lock, "
-            "async-stall, unguarded-shared-state, shutdown-hygiene, "
-            "collective-uniformity, ref-lifecycle)"
+            "tpulint: concurrency + SPMD + resource-lifecycle + wire-"
+            "protocol static analysis for ray_tpu (lock-order, "
+            "blocking-under-lock, async-stall, unguarded-shared-state, "
+            "shutdown-hygiene, collective-uniformity, ref-lifecycle, "
+            "wire-conformance)"
         ),
     )
     ap.add_argument("paths", nargs="*", help="files/trees to lint (default: config paths, else the ray_tpu package)")
@@ -131,6 +132,15 @@ def main(argv=None) -> int:
     ap.add_argument("--no-baseline", action="store_true", help="ignore any baseline: report every finding as new")
     ap.add_argument("--write-baseline", action="store_true", help="accept current findings into the baseline (reasons preserved by fingerprint)")
     ap.add_argument("--checks", help="comma-separated check ids to run (default: all)")
+    ap.add_argument(
+        "--write-protocol-doc",
+        action="store_true",
+        help=(
+            "regenerate the wire-protocol document (default docs/PROTOCOL.md, "
+            "config key protocol_doc) from the extracted op catalog and exit; "
+            "full-tree lint runs fail when the checked-in doc has drifted"
+        ),
+    )
     ap.add_argument(
         "--changed-only",
         action="store_true",
@@ -165,6 +175,17 @@ def main(argv=None) -> int:
         if not os.path.exists(p):
             print(f"tpulint: no such path: {p}", file=sys.stderr)
             return 2
+
+    if args.write_protocol_doc and (args.paths or args.changed_only):
+        # a slice sees only part of the handler/send surface — writing the
+        # doc from it would silently drop every out-of-slice op (and a
+        # clean --changed-only run would otherwise exit 0 without writing)
+        print(
+            "tpulint: --write-protocol-doc requires a full-tree run "
+            "(drop --changed-only/path args)",
+            file=sys.stderr,
+        )
+        return 2
 
     changed_slice = False
     if args.changed_only:
@@ -211,7 +232,23 @@ def main(argv=None) -> int:
     # line up with the (full-tree) baseline
     project = discover(paths, root=cfg_root if changed_slice else None)
     project.config = cfg
+    # wire-conformance runs its protocol-doc drift check on full runs only
+    # (a slice's partial catalog would always "drift")
+    project.full_tree = not args.paths and not changed_slice
+    doc_rel = cfg.get("protocol_doc", os.path.join("docs", "PROTOCOL.md"))
+    cfg.setdefault("protocol_doc", doc_rel)
     analyze(project)
+
+    if args.write_protocol_doc:
+        from .wire import write_protocol_doc
+
+        doc_path = (
+            doc_rel if os.path.isabs(doc_rel) else os.path.join(cfg_root, doc_rel)
+        )
+        write_protocol_doc(project, doc_path)
+        print(f"tpulint: wrote protocol doc to {doc_path}")
+        return 0
+
     findings = run_checks(project, enabled)
     # config-level excludes (path prefixes relative to the report root)
     for pat in cfg.get("exclude", []):
@@ -283,6 +320,13 @@ def main(argv=None) -> int:
         )
         print(("\n" if new else "") + summary)
         if args.stats:
+            cat = getattr(project, "_wire_catalog", None)
+            if cat is not None and cat.dead_ops:
+                print(
+                    f"tpulint: wire: {len(cat.dead_ops)} handler op(s) with "
+                    f"no in-tree sender (report-only): "
+                    f"{', '.join(cat.dead_ops)}"
+                )
             nfuncs = len(project.functions)
             nlocks = len(getattr(project, "locks", {}))
             nblocks = sum(len(f.block_sites) for f in project.functions.values())

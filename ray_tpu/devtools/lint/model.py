@@ -54,6 +54,16 @@ CHECKS: dict[str, str] = {
         "socket, tempfile, file, dropped ObjectRef) leaks on an exception "
         "edge or early return, is released twice, or is used after release"
     ),
+    "wire-conformance": (
+        "the hand-rolled RPC surface drifted: a send site names an op no "
+        "dispatch surface handles, payload tuple arity mismatches the "
+        "handler's unpack, a reply that can be None/shorter is unpacked or "
+        "subscripted unguarded, an agent-intercepted op is unknown to the "
+        "controller, a dispatch site can drop an uncaught handler raise "
+        "(hanging the requester), a request helper waits unbounded, the "
+        "declared op catalog (CONTROLLER_OPS/AGENT_LOCAL_OPS) or "
+        "docs/PROTOCOL.md is stale"
+    ),
 }
 
 # Method names treated as an object's shutdown path for shutdown-hygiene

@@ -1,0 +1,1376 @@
+"""wire-conformance: static op-catalog cross-checking of the RPC surface.
+
+The reference Ray types its control plane through ``.proto`` files, so an
+op-name typo or a payload-arity mismatch is a compile error. This rebuild
+speaks a hand-rolled pickle protocol: ``Controller._dispatch_request`` is a
+ladder of ``if op == "...":`` branches unpacking positional tuples, the
+agent intercepts a few ops node-locally, and send sites are scattered
+across a dozen modules — where the same mistakes surface only as a runtime
+``KeyError``, a silent ``None`` reply, or a hung connection reader. This
+family rebuilds the missing schema statically, in the spirit of the
+MPI-Checker-style matching PR 7 applied to collectives:
+
+**Phase 1 — catalog extraction.** Handler dispatch surfaces are discovered
+structurally (a function with >= 2 ``if op == "lit"`` / ``msg.op == "lit"``
+branches); per op it records the payload unpack shape (tuple arity + field
+names), every return-path reply shape (``None``, tuple arity, string
+constants, dict/list/opaque), and whether an uncaught handler raise is
+converted into an error reply by the dispatching site. Send helpers are
+discovered the same way (``call_controller``/``controller_call`` seeds plus
+a fixed point over ``op``-forwarding wrappers); per send site it records
+the op literal, the payload expression shape, how the reply is consumed
+(unpacked, subscripted, truth-tested, guarded), and whether the helper's
+reply wait is bounded.
+
+**Phase 2 — cross-checks.** Findings: unknown/typo'd op at a send site;
+payload arity mismatch; reply misuse (sender unpacks or subscripts a reply
+some handler path makes ``None``/shorter); an op the agent intercepts that
+the controller does not handle (head-side workers would break); a dispatch
+site that can drop an uncaught handler raise on the floor (the peer's
+reader hangs); an unbounded request wait in a send helper; drift between
+the extracted catalog and the declared ``CONTROLLER_OPS`` /
+``AGENT_LOCAL_OPS`` literals (which the runtime uses to validate chaos
+keys). Dead handlers (op never sent in-tree) are report-only: they are
+listed in the protocol doc and ``--stats``, not as findings.
+
+**Phase 3 — the catalog as an artifact.** ``--write-protocol-doc`` renders
+``docs/PROTOCOL.md`` from the catalog; full-tree lint runs re-render and
+fail on drift, so the doc cannot rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .engine import _Ctx, _expr_text
+from .model import Finding
+
+# Functions with these names are request-send helpers wherever they appear
+# (name-matched so receiver expressions like `global_worker().controller_call`
+# resolve); `op`-forwarding wrappers around them are discovered by fixed point.
+SEND_HELPER_NAMES = frozenset(
+    {"call_controller", "controller_call", "_call_controller_inproc_safe"}
+)
+
+# Handler ops with these prefixes are test/debug hooks: invoked from the test
+# suite (outside the lint paths), so "never sent in-tree" is expected.
+TEST_HOOK_PREFIXES = ("testing_", "debug_")
+
+# Module-level frozenset literals cross-checked against the extracted catalog
+# (declared-set name -> which surface style it must mirror).
+DECLARED_OP_SETS = {"CONTROLLER_OPS": "param", "AGENT_LOCAL_OPS": "msg"}
+
+
+# --------------------------------------------------------------------------
+# catalog data model
+
+
+@dataclass
+class OpHandler:
+    op: str
+    surface: str  # dispatch-surface function qualname
+    style: str  # "param" (op is a parameter) | "msg" (msg.op attribute)
+    file: str
+    line: int
+    payload_arity: int | None = None  # tuple-unpack arity, if unpacked
+    payload_fields: tuple = ()  # unpacked field names
+    payload_used: bool = False  # payload referenced at all
+    reply_shapes: tuple = ()  # of (kind, detail); kinds: none/tuple/const/
+    #                           scalar/dict/list/opaque
+    delegate: str | None = None  # payload-handler qualname (msg style)
+    converted: bool = True  # raises become error replies on reply paths
+
+
+@dataclass
+class SendSite:
+    op: str
+    file: str
+    line: int
+    qualname: str  # function containing the send
+    payload: tuple = ("none",)  # ("none",) | ("tuple", N, fields) |
+    #                             ("list",) | ("opaque", text)
+    consume: tuple = ("opaque",)  # ("unpack", N) | ("subscript",) |
+    #                               ("guarded",) | ("truth",) | ("ignored",)
+    #                             | ("opaque",)
+
+
+@dataclass
+class Surface:
+    qualname: str
+    style: str
+    file: str
+    line: int
+    ops: dict = field(default_factory=dict)  # op -> OpHandler
+    unconverted_sites: list = field(default_factory=list)  # (file, line, qual)
+
+
+@dataclass
+class WireCatalog:
+    surfaces: list = field(default_factory=list)
+    handlers: dict = field(default_factory=dict)  # op -> [OpHandler]
+    sends: dict = field(default_factory=dict)  # op -> [SendSite]
+    helpers: dict = field(default_factory=dict)  # qualname -> FuncInfo
+    unbounded_helpers: list = field(default_factory=list)  # (qualname, witness)
+    declared_sets: dict = field(default_factory=dict)  # name -> (set, file, line)
+    dead_ops: list = field(default_factory=list)
+    data_plane: dict = field(default_factory=dict)  # "servers"/"clients" quals
+    message_classes: dict = field(default_factory=dict)  # cls -> info dict
+
+    def all_ops(self) -> set:
+        return set(self.handlers)
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+
+
+def _iter_stmts(stmts, *, into_defs=False):
+    """Every statement in `stmts`, recursing into compound statements (but
+    not nested function/class definitions unless asked)."""
+    for s in stmts:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if into_defs:
+                yield from _iter_stmts(s.body, into_defs=into_defs)
+            continue
+        yield s
+        for name in ("body", "orelse", "finalbody"):
+            yield from _iter_stmts(getattr(s, name, []) or [], into_defs=into_defs)
+        for h in getattr(s, "handlers", []) or []:
+            yield from _iter_stmts(h.body, into_defs=into_defs)
+
+
+def _walk_no_defs(node):
+    """ast.walk that does not descend into nested function/class defs
+    (lambdas are descended — they execute in the enclosing call)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def _func_params(node) -> list:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    return names
+
+
+def _op_compare(test, params):
+    """``op == "lit"`` / ``msg.op == "lit"`` (possibly inside an `and`)
+    -> (style, op literal) or None."""
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        for v in test.values:
+            r = _op_compare(v, params)
+            if r is not None:
+                return r
+        return None
+    if (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], ast.Eq)
+    ):
+        for a, b in (
+            (test.left, test.comparators[0]),
+            (test.comparators[0], test.left),
+        ):
+            if isinstance(b, ast.Constant) and isinstance(b.value, str):
+                if isinstance(a, ast.Name) and a.id == "op" and "op" in params:
+                    return ("param", b.value)
+                if isinstance(a, ast.Attribute) and a.attr == "op":
+                    return ("msg", b.value)
+    return None
+
+
+def _reply_shape(expr):
+    """Classify one return expression -> (kind, detail)."""
+    if expr is None:
+        return ("none", None)
+    if isinstance(expr, ast.Constant):
+        if expr.value is None:
+            return ("none", None)
+        if isinstance(expr.value, str):
+            return ("const", expr.value)
+        return ("scalar", repr(expr.value))
+    if isinstance(expr, ast.Tuple):
+        return ("tuple", len(expr.elts))
+    if isinstance(expr, (ast.Dict, ast.DictComp)):
+        return ("dict", None)
+    if isinstance(expr, (ast.List, ast.ListComp)):
+        return ("list", None)
+    return ("opaque", _expr_text(expr)[:60])
+
+
+def _payload_load(node, style):
+    """Is `node` a read of the payload (Name 'payload' / `msg.payload`)?"""
+    if style == "param":
+        return isinstance(node, ast.Name) and node.id == "payload"
+    return isinstance(node, ast.Attribute) and node.attr == "payload"
+
+
+def _scan_payload_and_returns(stmts, style):
+    """(arity, fields, used, reply_shapes) extracted from handler stmts."""
+    arity = None
+    fields: tuple = ()
+    used = False
+    shapes: list = []
+    for node in _walk_no_defs(ast.Module(body=list(stmts), type_ignores=[])):
+        if isinstance(node, ast.Assign) and _payload_load(node.value, style):
+            used = True
+            if (
+                len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Tuple)
+                and arity is None
+            ):
+                elts = node.targets[0].elts
+                arity = len(elts)
+                fields = tuple(
+                    e.id if isinstance(e, ast.Name) else _expr_text(e) for e in elts
+                )
+        elif _payload_load(node, style):
+            used = True
+        if isinstance(node, ast.Return):
+            shapes.append(_reply_shape(node.value))
+    seen, uniq = set(), []
+    for sh in shapes:
+        if sh not in seen:
+            seen.add(sh)
+            uniq.append(sh)
+    return arity, fields, used, tuple(uniq)
+
+
+def _has_error_reply_construction(stmts) -> bool:
+    """Does this block build an error reply (a call with an ``error=``
+    keyword, or an ``("error", ...)`` tuple)?"""
+    for node in _walk_no_defs(ast.Module(body=list(stmts), type_ignores=[])):
+        if isinstance(node, ast.Call) and any(
+            kw.arg == "error" for kw in node.keywords
+        ):
+            return True
+        if (
+            isinstance(node, ast.Tuple)
+            and node.elts
+            and isinstance(node.elts[0], ast.Constant)
+            and node.elts[0].value == "error"
+        ):
+            return True
+    return False
+
+
+def _contains_node(stmts, target) -> bool:
+    for s in stmts:
+        for n in ast.walk(s):
+            if n is target:
+                return True
+    return False
+
+
+def _call_in_converting_try(func_node, call) -> bool:
+    """Is `call` inside a try whose except handlers build an error reply?"""
+    for node in ast.walk(func_node):
+        if not isinstance(node, ast.Try):
+            continue
+        if not _contains_node(node.body, call):
+            continue
+        for h in node.handlers:
+            if _has_error_reply_construction(h.body):
+                return True
+    return False
+
+
+def _has_send_call(func_node) -> bool:
+    for node in _walk_no_defs(func_node):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "send"
+        ):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# phase 1a: handler surfaces
+
+
+def _is_converting_replier(func) -> bool:
+    """A function that calls one of its (callable) parameters inside a try
+    whose except builds an error reply — e.g. the agent's ``_reply_worker``:
+    handler raises become error replies for every op routed through it."""
+    if func.node is None:
+        return False
+    params = set(_func_params(func.node))
+    for node in ast.walk(func.node):
+        if not isinstance(node, ast.Try):
+            continue
+        calls_param = any(
+            isinstance(c, ast.Call)
+            and isinstance(c.func, ast.Name)
+            and c.func.id in params
+            for s in node.body
+            for c in ast.walk(s)
+        )
+        if calls_param and any(
+            _has_error_reply_construction(h.body) for h in node.handlers
+        ):
+            return True
+    return False
+
+
+def _discover_surfaces(project) -> list:
+    surfaces = []
+    for func in project.functions.values():
+        if func.node is None or ".devtools.lint" in func.module:
+            continue
+        params = _func_params(func.node)
+        branches = []  # (style, op, If node)
+        for node in _walk_no_defs(func.node):
+            if isinstance(node, ast.If):
+                r = _op_compare(node.test, params)
+                if r is not None:
+                    branches.append((r[0], r[1], node))
+        by_style: dict[str, list] = {}
+        for style, op, node in branches:
+            by_style.setdefault(style, []).append((op, node))
+        for style, brs in by_style.items():
+            if len(brs) < 2:
+                continue  # a single comparison is not a dispatch ladder
+            surf = Surface(
+                qualname=func.qualname,
+                style=style,
+                file=func.file,
+                line=func.line,
+            )
+            cls = project.classes.get(func.cls) if func.cls else None
+            repliers = set()
+            if cls is not None:
+                repliers = {
+                    n for n, m in cls.methods.items() if _is_converting_replier(m)
+                }
+            for op, ifnode in brs:
+                surf.ops[op] = _extract_handler(
+                    project, func, cls, style, op, ifnode, repliers
+                )
+            surfaces.append(surf)
+    return surfaces
+
+
+def _extract_handler(project, func, cls, style, op, ifnode, repliers) -> OpHandler:
+    h = OpHandler(
+        op=op,
+        surface=func.qualname,
+        style=style,
+        file=func.file,
+        line=ifnode.lineno,
+    )
+    if style == "param":
+        (
+            h.payload_arity,
+            h.payload_fields,
+            h.payload_used,
+            h.reply_shapes,
+        ) = _scan_payload_and_returns(ifnode.body, style)
+        return h
+    # msg style: the branch routes msg.payload to a delegate method (via a
+    # converting replier, a thread target, ...). Find the first referenced
+    # self-method with a `payload` parameter and read its shape instead.
+    delegate = None
+    referenced = []
+    for node in _walk_no_defs(ast.Module(body=list(ifnode.body), type_ignores=[])):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and cls is not None
+        ):
+            m = project.mro_method(cls, node.attr)
+            if m is not None and m.node is not None:
+                referenced.append(m)
+                if (
+                    delegate is None
+                    and m.name not in repliers  # the replier routes, not handles
+                    and "payload" in _func_params(m.node)
+                ):
+                    delegate = m
+    if delegate is not None:
+        h.delegate = delegate.qualname
+        (
+            h.payload_arity,
+            h.payload_fields,
+            h.payload_used,
+            h.reply_shapes,
+        ) = _scan_payload_and_returns(delegate.node.body, "param")
+    else:
+        # fall back to any non-replier referenced method for the reply shape
+        for m in referenced:
+            if m.name not in repliers:
+                _, _, _, h.reply_shapes = _scan_payload_and_returns(
+                    m.node.body, "param"
+                )
+                break
+    # raise conversion: ok when the branch routes through a converting
+    # replier; a branch that sends replies itself must convert inline
+    names_in_branch = {
+        n.attr
+        for s in ifnode.body
+        for n in ast.walk(s)
+        if isinstance(n, ast.Attribute)
+        and isinstance(n.value, ast.Name)
+        and n.value.id == "self"
+    }
+    if names_in_branch & repliers:
+        h.converted = True
+    elif any(
+        isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "send"
+        for s in ifnode.body
+        for n in ast.walk(s)
+    ):
+        h.converted = any(
+            isinstance(s, ast.Try)
+            and any(_has_error_reply_construction(x.body) for x in s.handlers)
+            for s in _iter_stmts(ifnode.body)
+        )
+    return h
+
+
+def _check_dispatch_sites(project, surface: Surface):
+    """For a param-style surface: every caller that also sends replies must
+    convert a handler raise into an error reply (else the requester's
+    reader waits forever for a reply that never comes)."""
+    fname = surface.qualname.rsplit(".", 1)[1]
+    for func in project.functions.values():
+        if func.node is None or func.qualname == surface.qualname:
+            continue
+        calls = [
+            n
+            for n in _walk_no_defs(func.node)
+            if isinstance(n, ast.Call)
+            and (
+                (isinstance(n.func, ast.Attribute) and n.func.attr == fname)
+                or (isinstance(n.func, ast.Name) and n.func.id == fname)
+            )
+        ]
+        if not calls or not _has_send_call(func.node):
+            continue
+        for call in calls:
+            if not _call_in_converting_try(func.node, call):
+                surface.unconverted_sites.append(
+                    (func.file, call.lineno, func.qualname)
+                )
+
+
+# --------------------------------------------------------------------------
+# phase 1b: send helpers and send sites
+
+
+def _discover_helpers(project) -> dict:
+    helpers = {
+        q: f for q, f in project.functions.items() if f.name in SEND_HELPER_NAMES
+    }
+    # fixed point: wrappers forwarding their `op` parameter to a helper
+    for _ in range(5):
+        changed = False
+        for q, f in project.functions.items():
+            if q in helpers or f.node is None:
+                continue
+            if "op" not in _func_params(f.node):
+                continue
+            mod = project.modules.get(f.module)
+            if mod is None:
+                continue
+            cls = project.classes.get(f.cls) if f.cls else None
+            ctx = _Ctx(project, mod, cls, f)
+            for node in _walk_no_defs(f.node):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                first = node.args[0]
+                if not (isinstance(first, ast.Name) and first.id == "op"):
+                    continue
+                callee = ctx.resolve_callee(node)
+                target_name = (
+                    node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else node.func.id
+                    if isinstance(node.func, ast.Name)
+                    else None
+                )
+                if (callee in helpers) or (target_name in SEND_HELPER_NAMES):
+                    helpers[q] = f
+                    changed = True
+                    break
+        if not changed:
+            break
+    return helpers
+
+
+def _payload_shape(expr) -> tuple:
+    if expr is None:
+        return ("none",)
+    if isinstance(expr, ast.Constant) and expr.value is None:
+        return ("none",)
+    if isinstance(expr, ast.Tuple):
+        return (
+            "tuple",
+            len(expr.elts),
+            tuple(_expr_text(e)[:40] for e in expr.elts),
+        )
+    if isinstance(expr, (ast.List, ast.ListComp)):
+        return ("list",)
+    return ("opaque", _expr_text(expr)[:60])
+
+
+def _name_guard_stmt(stmt, var: str) -> bool:
+    """Does this statement truth-/None-/isinstance-test `var` (a guard)?"""
+    test = None
+    if isinstance(stmt, (ast.If, ast.While)):
+        test = stmt.test
+    elif isinstance(stmt, ast.Assert):
+        test = stmt.test
+    if test is None:
+        return False
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id == var:
+            return True
+    return False
+
+
+def _first_var_use(stmt, var: str):
+    """First consumption of `var` inside `stmt`: ("unpack", N) |
+    ("subscript",) | ("opaque",) | None (not used)."""
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        if (
+            isinstance(stmt.targets[0], ast.Tuple)
+            and isinstance(stmt.value, ast.Name)
+            and stmt.value.id == var
+        ):
+            return ("unpack", len(stmt.targets[0].elts))
+    for n in ast.walk(stmt):
+        if (
+            isinstance(n, ast.Subscript)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == var
+        ):
+            return ("subscript",)
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Name) and n.id == var:
+            return ("opaque",)
+    return None
+
+
+def _classify_consumption(func_node, call) -> tuple:
+    """How the reply of a send-site call is consumed (see SendSite.consume)."""
+
+    def scan_block(stmts):
+        for i, s in enumerate(stmts):
+            # recurse into compound statements first (call may sit deeper)
+            for name in ("body", "orelse", "finalbody"):
+                sub = getattr(s, name, None)
+                if sub and _contains_node(sub, call):
+                    return scan_block(sub)
+            for h in getattr(s, "handlers", []) or []:
+                if _contains_node(h.body, call):
+                    return scan_block(h.body)
+            if not _contains_node([s], call):
+                continue
+            return classify_stmt(s, stmts[i + 1 :])
+        return ("opaque",)
+
+    def classify_stmt(s, following):
+        # direct syntactic contexts within the statement
+        for n in ast.walk(s):
+            if isinstance(n, ast.Subscript) and n.value is call:
+                return ("subscript",)
+            if isinstance(n, ast.BoolOp) and call in n.values:
+                return ("guarded",)
+            if isinstance(n, ast.Compare) and (
+                n.left is call or call in n.comparators
+            ):
+                return ("truth",)
+            if isinstance(n, ast.Starred) and n.value is call:
+                return ("opaque",)
+        if isinstance(s, (ast.If, ast.While)) and _contains_node_expr(s.test, call):
+            return ("truth",)
+        if isinstance(s, ast.Assign) and s.value is call and len(s.targets) == 1:
+            tgt = s.targets[0]
+            if isinstance(tgt, ast.Tuple):
+                return ("unpack", len(tgt.elts))
+            if isinstance(tgt, ast.Name):
+                return track_var(tgt.id, following)
+        if isinstance(s, ast.Expr) and s.value is call:
+            return ("ignored",)
+        return ("opaque",)
+
+    def track_var(var, following):
+        for s2 in following:
+            if _name_guard_stmt(s2, var):
+                return ("guarded",)
+            if isinstance(s2, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == var for t in s2.targets
+            ):
+                return ("opaque",)  # reassigned before any risky use
+            use = _first_var_use(s2, var)
+            if use is not None:
+                return use if use[0] in ("unpack", "subscript") else ("opaque",)
+        return ("opaque",)
+
+    def _contains_node_expr(expr, target):
+        return any(n is target for n in ast.walk(expr))
+
+    return scan_block(func_node.body)
+
+
+def _request_class_call(call) -> bool:
+    """``Request(req_id, "op", payload)`` / ``P.Request(...)`` constructor."""
+    fn = call.func
+    name = None
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    if name != "Request" or len(call.args) < 2:
+        return False
+    return isinstance(call.args[1], ast.Constant) and isinstance(
+        call.args[1].value, str
+    )
+
+
+def _discover_sends(project, helpers) -> list:
+    sends = []
+    for func in project.functions.values():
+        if func.node is None or func.qualname in helpers:
+            continue
+        if ".devtools.lint" in func.module:
+            continue
+        mod = project.modules.get(func.module)
+        if mod is None:
+            continue
+        cls = project.classes.get(func.cls) if func.cls else None
+        ctx = _Ctx(project, mod, cls, func)
+        # full walk INCLUDING nested defs: send sites often live in closures
+        # (chunk-window send_chunk, fetcher head_fetch, finalize watchers)
+        for call in ast.walk(func.node):
+            if not isinstance(call, ast.Call):
+                continue
+            if _request_class_call(call):
+                # raw `Request(req_id, "op", payload)` construction: the
+                # reply is consumed through the window machinery — opaque
+                sends.append(
+                    SendSite(
+                        op=call.args[1].value,
+                        file=func.file,
+                        line=call.lineno,
+                        qualname=func.qualname,
+                        payload=_payload_shape(
+                            call.args[2] if len(call.args) > 2 else None
+                        ),
+                        consume=("opaque",),
+                    )
+                )
+                continue
+            target_name = (
+                call.func.attr
+                if isinstance(call.func, ast.Attribute)
+                else call.func.id
+                if isinstance(call.func, ast.Name)
+                else None
+            )
+            callee = ctx.resolve_callee(call)
+            if not (
+                target_name in SEND_HELPER_NAMES
+                or (callee is not None and callee in helpers)
+            ):
+                continue
+            if not call.args or not (
+                isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)
+            ):
+                continue  # dynamic/forwarded op: not a literal send site
+            payload_expr = call.args[1] if len(call.args) > 1 else None
+            if payload_expr is None:
+                for kw in call.keywords:
+                    if kw.arg == "payload":
+                        payload_expr = kw.value
+            sends.append(
+                SendSite(
+                    op=call.args[0].value,
+                    file=func.file,
+                    line=call.lineno,
+                    qualname=func.qualname,
+                    payload=_payload_shape(payload_expr),
+                    consume=_classify_consumption(func.node, call),
+                )
+            )
+    return sends
+
+
+def _check_helper_waits(project, helpers) -> list:
+    """Helpers whose reply wait is unbounded: an untimed blocking primitive
+    in the helper body, or in a reply-wait callee (``_await*``)."""
+    out = []
+    for q, f in helpers.items():
+        candidates = [f]
+        for cs in f.call_sites:
+            callee = project.functions.get(cs.callee)
+            if callee is not None and callee.name.startswith("_await"):
+                candidates.append(callee)
+        for cand in candidates:
+            for bs in cand.block_sites:
+                if not bs.timed:
+                    out.append((q, f, bs))
+                    break
+            else:
+                continue
+            break
+    return out
+
+
+# --------------------------------------------------------------------------
+# phase 1c: declared op sets, data plane, message classes (doc inputs)
+
+
+def _declared_op_sets(project) -> dict:
+    """Module-level ``NAME = frozenset({"a", ...})`` literals from
+    DECLARED_OP_SETS -> name -> (set, file, line)."""
+    out = {}
+    for mod in project.modules.values():
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name) or tgt.id not in DECLARED_OP_SETS:
+                continue
+            values = set()
+            ok = False
+            for n in ast.walk(node.value):
+                if isinstance(n, (ast.Set, ast.Tuple, ast.List)):
+                    ok = True
+                    for e in n.elts:
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                            values.add(e.value)
+            if ok:
+                out[tgt.id] = (values, mod.file, node.lineno)
+    return out
+
+
+def _scan_data_plane(project) -> dict:
+    """Functions speaking the raw chunk tuple protocol: senders put the
+    ``"chunk"`` literal inside a ``.send(...)`` call; servers compare/assert
+    against it."""
+    servers, clients = [], []
+    for func in project.functions.values():
+        if func.node is None or ".devtools.lint" in func.module:
+            continue  # the analyzer's own sources mention the literals
+        sends_chunk = compares_chunk = False
+        for node in _walk_no_defs(func.node):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "send"
+            ):
+                for a in node.args:
+                    for n in ast.walk(a):
+                        if isinstance(n, ast.Constant) and n.value == "chunk":
+                            sends_chunk = True
+            if isinstance(node, (ast.Compare, ast.Assert)):
+                for n in ast.walk(node):
+                    if isinstance(n, ast.Constant) and n.value == "chunk":
+                        compares_chunk = True
+        if sends_chunk and not compares_chunk:
+            clients.append(func.qualname)
+        elif compares_chunk and not sends_chunk:
+            servers.append(func.qualname)
+        elif compares_chunk and sends_chunk:
+            servers.append(func.qualname)
+    return {"servers": sorted(set(servers)), "clients": sorted(set(clients))}
+
+
+def _scan_message_classes(project) -> dict:
+    """Typed message classes (protocol dataclasses): which modules construct
+    them and which modules isinstance-dispatch on them. Doc-only."""
+    proto_mod = None
+    for mod in project.modules.values():
+        if "Request" in mod.classes and "Reply" in mod.classes:
+            proto_mod = mod
+            break
+    if proto_mod is None:
+        return {}
+    names = set(proto_mod.classes)
+    out: dict[str, dict] = {}
+
+    def note(cls_name, kind, module):
+        if cls_name not in names:
+            return
+        rec = out.setdefault(cls_name, {"sent_by": set(), "handled_by": set()})
+        rec[kind].add(module.rsplit(".", 1)[-1])
+
+    for mod in project.modules.values():
+        if mod is proto_mod:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Name)
+                    and fn.id == "isinstance"
+                    and len(node.args) == 2
+                ):
+                    spec = node.args[1]
+                    refs = spec.elts if isinstance(spec, ast.Tuple) else [spec]
+                    for r in refs:
+                        if isinstance(r, ast.Attribute):
+                            note(r.attr, "handled_by", mod.name)
+                        elif isinstance(r, ast.Name):
+                            note(r.id, "handled_by", mod.name)
+                elif isinstance(fn, ast.Attribute) and isinstance(
+                    fn.value, ast.Name
+                ):
+                    if mod.imports.get(fn.value.id, "").endswith("protocol"):
+                        note(fn.attr, "sent_by", mod.name)
+                elif isinstance(fn, ast.Name) and mod.imports.get(
+                    fn.id, ""
+                ).endswith(f"protocol.{fn.id}"):
+                    note(fn.id, "sent_by", mod.name)
+    # constructor-only hits (helper classes like ChunkConnPool) are not
+    # wire messages: keep classes some endpoint isinstance-dispatches on
+    return {k: v for k, v in out.items() if v["handled_by"]}
+
+
+# --------------------------------------------------------------------------
+# catalog assembly
+
+
+def build_catalog(project) -> WireCatalog:
+    cached = getattr(project, "_wire_catalog", None)
+    if cached is not None:
+        return cached
+    cat = WireCatalog()
+    cat.surfaces = _discover_surfaces(project)
+    for surf in cat.surfaces:
+        if surf.style == "param":
+            _check_dispatch_sites(project, surf)
+        for op, h in surf.ops.items():
+            cat.handlers.setdefault(op, []).append(h)
+    cat.helpers = _discover_helpers(project)
+    for site in _discover_sends(project, cat.helpers):
+        cat.sends.setdefault(site.op, []).append(site)
+    cat.unbounded_helpers = _check_helper_waits(project, cat.helpers)
+    cat.declared_sets = _declared_op_sets(project)
+    cat.data_plane = _scan_data_plane(project)
+    cat.message_classes = _scan_message_classes(project)
+    cat.dead_ops = sorted(
+        op
+        for op in cat.handlers
+        if op not in cat.sends and not op.startswith(TEST_HOOK_PREFIXES)
+    )
+    project._wire_catalog = cat
+    return cat
+
+
+# --------------------------------------------------------------------------
+# phase 2: cross-checks
+
+
+def _shape_str(shapes) -> str:
+    parts = []
+    for kind, detail in shapes:
+        if kind == "none":
+            p = "None"
+        elif kind == "tuple":
+            p = f"tuple[{detail}]"
+        elif kind == "const":
+            p = f'"{detail}"'
+        else:
+            p = kind
+        if p not in parts:
+            parts.append(p)
+    return " | ".join(parts) if parts else "(no return)"
+
+
+def check_wire_conformance(project) -> list:
+    findings: list = []
+    cat = build_catalog(project)
+    have_param = any(s.style == "param" for s in cat.surfaces)
+    have_msg = any(s.style == "msg" for s in cat.surfaces)
+
+    # -- send-site checks (need a primary catalog to check against) --------
+    if have_param:
+        for op, sites in sorted(cat.sends.items()):
+            handlers = cat.handlers.get(op)
+            if not handlers:
+                close = _closest_op(op, cat.all_ops())
+                for site in sites:
+                    findings.append(
+                        Finding(
+                            check="wire-conformance",
+                            file=site.file,
+                            line=site.line,
+                            qualname=site.qualname,
+                            message=(
+                                f'op "{op}" is not handled by any dispatch '
+                                f"surface — the request dies with "
+                                f'"unknown op"'
+                                + (f' (did you mean "{close}"?)' if close else "")
+                            ),
+                            key=f"unknown|{op}",
+                        )
+                    )
+                continue
+            for site in sites:
+                findings.extend(_check_site_against(site, handlers))
+
+        # dispatch sites that can drop an uncaught raise
+        for surf in cat.surfaces:
+            for file, line, qual in surf.unconverted_sites:
+                findings.append(
+                    Finding(
+                        check="wire-conformance",
+                        file=file,
+                        line=line,
+                        qualname=qual,
+                        message=(
+                            f"dispatch of {surf.qualname.rsplit('.', 1)[1]}() "
+                            f"feeds a reply channel but is not wrapped in an "
+                            f"error-reply conversion — an uncaught handler "
+                            f"raise leaves the requester waiting forever"
+                        ),
+                        key=f"noconvert|{surf.qualname}",
+                    )
+                )
+    # -- msg-style branches that reply without raise conversion ------------
+    # (not gated on have_param: an agent-only slice must flag these too)
+    for surf in cat.surfaces:
+        if surf.style != "msg":
+            continue
+        for op, h in sorted(surf.ops.items()):
+            if not h.converted:
+                findings.append(
+                    Finding(
+                        check="wire-conformance",
+                        file=h.file,
+                        line=h.line,
+                        qualname=surf.qualname,
+                        message=(
+                            f'handler branch for op "{op}" replies '
+                            f"without converting raises into an error "
+                            f"reply — an uncaught raise hangs the "
+                            f"requester"
+                        ),
+                        key=f"noconvert-branch|{op}",
+                    )
+                )
+
+    # -- agent-only ops (both surface styles required) ---------------------
+    if have_param and have_msg:
+        param_ops = set()
+        for s in cat.surfaces:
+            if s.style == "param":
+                param_ops |= set(s.ops)
+        for s in cat.surfaces:
+            if s.style != "msg":
+                continue
+            for op, h in sorted(s.ops.items()):
+                if op not in param_ops:
+                    findings.append(
+                        Finding(
+                            check="wire-conformance",
+                            file=h.file,
+                            line=h.line,
+                            qualname=s.qualname,
+                            message=(
+                                f'op "{op}" is intercepted node-locally but '
+                                f"no primary dispatch surface handles it — "
+                                f"head-side workers (which have no agent) "
+                                f"would get an unknown-op error"
+                            ),
+                            key=f"agentonly|{op}",
+                        )
+                    )
+
+    # -- unbounded request waits ------------------------------------------
+    for qual, f, bs in cat.unbounded_helpers:
+        findings.append(
+            Finding(
+                check="wire-conformance",
+                file=f.file,
+                line=bs.line,
+                qualname=qual,
+                message=(
+                    f"request helper waits for the reply with an untimed "
+                    f"{bs.witness.kind} ({bs.witness.desc}) — a dead peer "
+                    f"hangs every caller; bound the wait and re-check "
+                    f"liveness"
+                ),
+                key=f"unbounded|{bs.witness.kind}",
+            )
+        )
+
+    # -- declared op-set drift --------------------------------------------
+    for name, (declared, file, line) in sorted(cat.declared_sets.items()):
+        style = DECLARED_OP_SETS[name]
+        actual = set()
+        relevant = [s for s in cat.surfaces if s.style == style]
+        if not relevant:
+            continue
+        for s in relevant:
+            actual |= set(s.ops)
+        missing = sorted(actual - declared)
+        extra = sorted(declared - actual)
+        if missing or extra:
+            detail = []
+            if missing:
+                detail.append(f"missing {missing}")
+            if extra:
+                detail.append(f"stale {extra}")
+            findings.append(
+                Finding(
+                    check="wire-conformance",
+                    file=file,
+                    line=line,
+                    qualname=name,
+                    message=(
+                        f"{name} has drifted from the dispatch branches: "
+                        + "; ".join(detail)
+                        + " — runtime chaos-key validation no longer "
+                        "matches the real op surface"
+                    ),
+                    key=f"opset|{name}",
+                )
+            )
+
+    # -- protocol doc drift (full-tree runs only) --------------------------
+    if getattr(project, "full_tree", False) and have_param:
+        rel = (project.config or {}).get("protocol_doc")
+        if rel:
+            doc_path = rel if os.path.isabs(rel) else os.path.join(project.root, rel)
+            rendered = render_protocol_doc(cat)
+            rel_report = (
+                os.path.relpath(doc_path, project.root).replace(os.sep, "/")
+                if not os.path.isabs(rel)
+                else rel
+            )
+            try:
+                with open(doc_path, encoding="utf-8") as fh:
+                    current = fh.read()
+            except OSError:
+                current = None
+            if current is None:
+                findings.append(
+                    Finding(
+                        check="wire-conformance",
+                        file=rel_report,
+                        line=1,
+                        qualname="protocol-doc",
+                        message=(
+                            f"{rel_report} is missing — generate it with "
+                            f"`python -m ray_tpu.devtools.lint "
+                            f"--write-protocol-doc`"
+                        ),
+                        key="doc-missing",
+                    )
+                )
+            elif current != rendered:
+                findings.append(
+                    Finding(
+                        check="wire-conformance",
+                        file=rel_report,
+                        line=1,
+                        qualname="protocol-doc",
+                        message=(
+                            f"{rel_report} is stale (the wire surface "
+                            f"changed) — regenerate with `python -m "
+                            f"ray_tpu.devtools.lint --write-protocol-doc`"
+                        ),
+                        key="doc-drift",
+                    )
+                )
+    return findings
+
+
+def _check_site_against(site: SendSite, handlers: list) -> list:
+    findings = []
+    # payload arity vs handler unpack
+    for h in handlers:
+        if h.payload_arity is None:
+            continue
+        where = f"{h.surface.rsplit('.', 1)[1]} ({h.file}:{h.line})"
+        if site.payload[0] == "tuple" and site.payload[1] != h.payload_arity:
+            findings.append(
+                Finding(
+                    check="wire-conformance",
+                    file=site.file,
+                    line=site.line,
+                    qualname=site.qualname,
+                    message=(
+                        f'op "{site.op}" sends a {site.payload[1]}-tuple '
+                        f"payload but the handler unpacks "
+                        f"{h.payload_arity} fields "
+                        f"({', '.join(h.payload_fields)}) — ValueError at "
+                        f"the peer"
+                    ),
+                    key=f"arity|{site.op}|{site.payload[1]}|{h.payload_arity}",
+                    path=[f"handler: {where}"],
+                )
+            )
+        elif site.payload[0] == "none":
+            findings.append(
+                Finding(
+                    check="wire-conformance",
+                    file=site.file,
+                    line=site.line,
+                    qualname=site.qualname,
+                    message=(
+                        f'op "{site.op}" sends no payload but the handler '
+                        f"unpacks {h.payload_arity} fields "
+                        f"({', '.join(h.payload_fields)}) — TypeError at "
+                        f"the peer"
+                    ),
+                    key=f"arity|{site.op}|none|{h.payload_arity}",
+                    path=[f"handler: {where}"],
+                )
+            )
+    # reply misuse
+    shapes = []
+    for h in handlers:
+        shapes.extend(h.reply_shapes)
+    risky_none = any(k == "none" for k, _ in shapes)
+    consts = [d for k, d in shapes if k == "const"]
+    tuple_arities = {d for k, d in shapes if k == "tuple"}
+    if site.consume[0] == "unpack":
+        n = site.consume[1]
+        bad_tuple = tuple_arities and any(a != n for a in tuple_arities)
+        if risky_none or consts or bad_tuple:
+            reasons = []
+            if risky_none:
+                reasons.append("None")
+            reasons += [f'"{c}"' for c in consts[:2]]
+            reasons += [f"tuple[{a}]" for a in sorted(tuple_arities) if a != n]
+            findings.append(
+                Finding(
+                    check="wire-conformance",
+                    file=site.file,
+                    line=site.line,
+                    qualname=site.qualname,
+                    message=(
+                        f'reply of op "{site.op}" is unpacked into {n} '
+                        f"names, but a handler return path yields "
+                        f"{' | '.join(reasons)} — TypeError/ValueError on "
+                        f"that path; guard the reply first"
+                    ),
+                    key=f"reply|{site.op}|unpack{n}",
+                    path=[
+                        f"handler replies: {_shape_str(h.reply_shapes)} "
+                        f"({h.file}:{h.line})"
+                        for h in handlers
+                    ],
+                )
+            )
+    elif site.consume[0] == "subscript" and risky_none:
+        findings.append(
+            Finding(
+                check="wire-conformance",
+                file=site.file,
+                line=site.line,
+                qualname=site.qualname,
+                message=(
+                    f'reply of op "{site.op}" is subscripted, but a handler '
+                    f"return path yields None — TypeError on that path; "
+                    f"guard the reply first"
+                ),
+                key=f"reply|{site.op}|subscript",
+                path=[
+                    f"handler replies: {_shape_str(h.reply_shapes)} "
+                    f"({h.file}:{h.line})"
+                    for h in handlers
+                ],
+            )
+        )
+    return findings
+
+
+def _closest_op(op: str, known: set) -> str | None:
+    """Cheap nearest-neighbour for typo hints (edit distance <= 2)."""
+    best, best_d = None, 3
+    for cand in known:
+        d = _edit_distance(op, cand, cap=best_d)
+        if d < best_d:
+            best, best_d = cand, d
+    return best
+
+
+def _edit_distance(a: str, b: str, cap: int = 3) -> int:
+    if abs(len(a) - len(b)) >= cap:
+        return cap
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(
+                min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            )
+        if min(cur) >= cap:
+            return cap
+        prev = cur
+    return min(prev[-1], cap)
+
+
+# --------------------------------------------------------------------------
+# phase 3: the protocol document
+
+
+def _surface_label(surf_qual: str) -> str:
+    parts = surf_qual.split(".")
+    if len(parts) >= 2 and parts[-2][:1].isupper():
+        return parts[-2]
+    return parts[-1]
+
+
+def render_protocol_doc(cat: WireCatalog) -> str:
+    """Deterministic markdown for docs/PROTOCOL.md (no timestamps — the
+    full-tree lint run diffs this byte-for-byte against the checked-in
+    file)."""
+    lines = [
+        "# ray_tpu wire protocol",
+        "",
+        "<!-- GENERATED by `python -m ray_tpu.devtools.lint"
+        " --write-protocol-doc`. -->",
+        "<!-- Do not edit by hand: the full-tree lint run fails on drift. -->",
+        "",
+        "Extracted from the dispatch branches and send sites by tpulint's",
+        "`wire-conformance` family. The control plane is length-delimited",
+        "pickled messages (`ray_tpu/_private/protocol.py`); string-keyed",
+        "`Request(req_id, op, payload)` RPCs get `Reply(req_id, payload,",
+        "error)` answers — a handler raise is converted into `Reply.error`",
+        "at the dispatch site and re-raised at the caller.",
+        "",
+        "## Request ops",
+        "",
+        "Payload fields come from the handler's tuple unpack; reply shapes",
+        "are every return path the handler has. `(test hook)` ops are",
+        "invoked by the test suite only.",
+        "",
+        "| op | handled by | payload | reply | senders |",
+        "|---|---|---|---|---|",
+    ]
+    for op in sorted(cat.handlers):
+        handlers = cat.handlers[op]
+        labels = []
+        for h in sorted(handlers, key=lambda h: h.surface):
+            label = _surface_label(h.surface)
+            if h.delegate:  # msg-style intercept: name the payload handler
+                label += f" (via {h.delegate.rsplit('.', 1)[1]})"
+            if label not in labels:
+                labels.append(label)
+        handled = " + ".join(sorted(labels))
+        h0 = next((h for h in handlers if h.style == "param"), handlers[0])
+        if h0.payload_fields:
+            payload = "(" + ", ".join(h0.payload_fields) + ")"
+        elif h0.payload_arity:
+            payload = f"tuple[{h0.payload_arity}]"
+        elif h0.payload_used:
+            payload = "payload (opaque)"
+        else:
+            payload = "—"
+        reply = _shape_str(h0.reply_shapes)
+        sites = cat.sends.get(op, [])
+        senders = sorted({s.qualname.rsplit(".", 1)[-1] + "()" for s in sites})
+        if senders:
+            sender_s = ", ".join(senders[:3]) + (
+                f" +{len(senders) - 3}" if len(senders) > 3 else ""
+            )
+        elif op.startswith(TEST_HOOK_PREFIXES):
+            sender_s = "(test hook)"
+        else:
+            sender_s = "(none in tree)"
+        lines.append(f"| `{op}` | {handled} | `{payload}` | `{reply}` | {sender_s} |")
+    if cat.dead_ops:
+        lines += [
+            "",
+            "Ops with no in-tree sender (report-only): "
+            + ", ".join(f"`{o}`" for o in cat.dead_ops)
+            + ".",
+        ]
+
+    # declared sets
+    if cat.declared_sets:
+        lines += [""]
+        for name, (vals, file, line) in sorted(cat.declared_sets.items()):
+            lines.append(
+                f"`{name}` ({file}:{line}) declares {len(vals)} ops; the "
+                f"lint gate keeps it in sync with the dispatch branches "
+                f"above."
+            )
+
+    # send helpers
+    if cat.helpers:
+        lines += [
+            "",
+            "## Request transports",
+            "",
+            "| helper | wait |",
+            "|---|---|",
+        ]
+        unbounded = {q for q, _, _ in cat.unbounded_helpers}
+        for q in sorted(cat.helpers):
+            wait = "UNBOUNDED" if q in unbounded else "bounded / liveness-aware"
+            lines.append(f"| `{q}` | {wait} |")
+
+    # data plane
+    if cat.data_plane.get("servers") or cat.data_plane.get("clients"):
+        lines += [
+            "",
+            "## Data plane (chunk transfers)",
+            "",
+            "Bulk object bytes bypass the control channel: a peer dials an",
+            "agent's data listener and speaks raw 4-tuples —",
+            '`("chunk", object_id_bytes, offset, length)` requests answered',
+            "by `(total_size, chunk_bytes)` or `(\"error\", detail)`. Dial +",
+            "handshake + reads carry OS-level deadlines (SO_RCVTIMEO), so a",
+            "half-open peer fails over instead of hanging the pull.",
+            "",
+        ]
+        if cat.data_plane.get("servers"):
+            lines.append(
+                "Servers: "
+                + ", ".join(f"`{q}`" for q in cat.data_plane["servers"])
+                + "."
+            )
+        if cat.data_plane.get("clients"):
+            lines.append(
+                "Clients: "
+                + ", ".join(f"`{q}`" for q in cat.data_plane["clients"])
+                + "."
+            )
+
+    # typed message classes
+    if cat.message_classes:
+        lines += [
+            "",
+            "## Typed messages (isinstance-dispatched)",
+            "",
+            "| class | constructed in | dispatched in |",
+            "|---|---|---|",
+        ]
+        for cls_name in sorted(cat.message_classes):
+            rec = cat.message_classes[cls_name]
+            sent = ", ".join(sorted(rec["sent_by"])) or "—"
+            handled = ", ".join(sorted(rec["handled_by"])) or "—"
+            lines.append(f"| `{cls_name}` | {sent} | {handled} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write_protocol_doc(project, path: str) -> str:
+    cat = build_catalog(project)
+    text = render_protocol_doc(cat)
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(text)
+    return text

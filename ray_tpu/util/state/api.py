@@ -59,6 +59,14 @@ def drain_status(node_id: Optional[str] = None):
     return _call("drain_status", node_id)
 
 
+def transfer_stats() -> dict:
+    """Cross-node object-transfer counters from the head (chunks served,
+    arena pulls, replica registrations/promotions/evictions; reference:
+    the object manager's ``GetObjectStoreStats``). Per-node counters are
+    served by each agent under the same op on its local channel."""
+    return _call("transfer_stats")
+
+
 def summarize_tasks() -> dict:
     """Event counts per task name (``ray summary tasks`` analog)."""
     events = _call("task_events")

@@ -4,4 +4,6 @@
 # (seal-through-own-pump, proxy event-loop block), SPMD divergence shapes
 # (rank-divergent collective, cross-arm order mismatch), and resource
 # lifetime shapes (the PR 4 spilled-reply leak: leak-on-raise, early
-# return, double-unlink, use-after-release).
+# return, double-unlink, use-after-release), and wire-protocol shapes
+# (typo'd op at a send site, payload-arity mismatch, unguarded unpack of a
+# maybe-None reply, plus the fully-conformant clean counterpart).

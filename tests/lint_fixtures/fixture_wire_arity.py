@@ -1,0 +1,60 @@
+"""Self-detection fixture: payload tuple arity mismatch.
+
+The sender ships a 2-tuple; the handler unpacks 3 fields — a runtime
+ValueError inside the dispatch (surfaced as an opaque error reply) on a
+path no unit test may ever hit. wire-conformance must flag the send site
+against the handler's unpack shape.
+"""
+
+import threading
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    def __init__(self):
+        self._replicas = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "register_replica":
+            object_id, shm_name, size = payload
+            self._replicas[object_id] = (shm_name, size)
+            return None
+        if op == "unregister_replica":
+            object_id, arena = payload
+            self._replicas.pop(object_id, None)
+            return None
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class Agent:
+    def __init__(self, conn):
+        self._conn = conn
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def register(self, object_id, shm_name):
+        # BUG: 2-tuple sent, handler unpacks (object_id, shm_name, size)
+        return self.call_controller("register_replica", (object_id, shm_name))
+
+    def unregister(self, object_id, arena):
+        return self.call_controller("unregister_replica", (object_id, arena))

@@ -1,0 +1,66 @@
+"""Self-detection fixture: unguarded unpack of a maybe-None reply.
+
+One handler return path yields ``None`` (named actor not found); the
+sender unpacks the reply unconditionally — a ``TypeError: cannot unpack
+non-iterable NoneType`` on the rarely-hit path. The guarded variant in
+the same module must stay clean.
+"""
+
+import threading
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    def __init__(self):
+        self._actors = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "get_named_actor":
+            actor = self._actors.get(payload)
+            if actor is None:
+                return None
+            return (actor, 1)
+        if op == "actor_count":
+            return len(self._actors)
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class Driver:
+    def __init__(self, conn):
+        self._conn = conn
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def get_actor(self, name):
+        # BUG: the "not found" path returns None — unguarded unpack
+        actor_id, max_concurrency = self.call_controller(
+            "get_named_actor", name
+        )
+        return actor_id, max_concurrency
+
+    def get_actor_safe(self, name):
+        result = self.call_controller("get_named_actor", name)
+        if result is None:
+            raise ValueError(f"no actor named {name!r}")
+        actor_id, max_concurrency = result
+        return actor_id, max_concurrency

@@ -1,0 +1,62 @@
+"""Self-detection fixture: a send site misspells a handler op.
+
+The PR 8 growth shape — the op ladder and the senders live in different
+modules, so a typo'd op string ("object_locatons") ships clean and only
+surfaces at runtime as an "unknown op" error reply (or a vacuously-passing
+chaos test). wire-conformance must flag the send site, with a
+did-you-mean hint.
+"""
+
+import threading
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    """Dispatch surface: >= 2 `if op == "..."` branches."""
+
+    def __init__(self):
+        self._locations = {}
+        self._kv = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "object_locations":
+            return list(self._locations.get(payload, ()))
+        if op == "kv_put":
+            ns, key, value = payload
+            self._kv[(ns, key)] = value
+            return None
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class Runtime:
+    def __init__(self, conn):
+        self._conn = conn
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def locations(self, object_id):
+        # BUG: "object_locatons" — no handler branch matches
+        return self.call_controller("object_locatons", object_id)
+
+    def put_meta(self, ns, key, value):
+        return self.call_controller("kv_put", (ns, key, value))

@@ -166,6 +166,53 @@ def test_rpc_chaos_injection():
         ray_tpu.shutdown()
 
 
+def test_rpc_chaos_typo_rejected():
+    """A typo'd chaos op name used to silently never inject — every
+    fault-injection test relying on it passed vacuously. Config parse now
+    validates keys against the op catalog (protocol.CONTROLLER_OPS, kept
+    code-true by tpulint wire-conformance) and fails init loudly."""
+    with pytest.raises(Exception, match="unknown op"):
+        ray_tpu.init(
+            num_cpus=1,
+            mode="thread",
+            config={"testing_rpc_failure": "kv_putt=1.0"},
+        )
+    ray_tpu.shutdown()
+    # and a valid key still parses + injects (guards against an over-strict
+    # validator breaking the chaos path)
+    ray_tpu.init(
+        num_cpus=1, mode="thread",
+        config={"testing_rpc_failure": "kv_del=1.0"},
+    )
+    try:
+        from ray_tpu.experimental import internal_kv
+
+        with pytest.raises(Exception, match="injected rpc failure"):
+            internal_kv.kv_del("k")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_worker_rpc_chaos_typo_rejected(monkeypatch):
+    """Same contract for the worker-side channel chaos table."""
+    from ray_tpu._private.worker_runtime import WorkerRuntime
+
+    rt = object.__new__(WorkerRuntime)
+    rt._chaos_table = None
+    import random
+
+    rt._chaos_rng = random.Random(0)
+    monkeypatch.setenv("RAY_TPU_WORKER_RPC_FAILURE", "plasma_red=1.0")
+    with pytest.raises(ValueError, match="unknown op"):
+        rt._maybe_inject_failure("plasma_read")
+    # valid channel + controller-op keys parse fine
+    rt._chaos_table = None
+    monkeypatch.setenv(
+        "RAY_TPU_WORKER_RPC_FAILURE", "plasma_read=0.0,kv_put=0.0"
+    )
+    rt._maybe_inject_failure("plasma_read")
+
+
 def test_kv_persistence_across_restart(tmp_path):
     """KV survives controller restart (GCS Redis fault-tolerance analog)."""
     from ray_tpu.experimental import internal_kv
@@ -281,6 +328,26 @@ def test_worker_rpc_chaos_injection(ray_start_process):
         for i in range(6)
     ]
     assert ray_tpu.get(refs, timeout=300) == [i * 2 + 1 for i in range(6)]
+
+
+def test_worker_put_chaos_injects(ray_start_process):
+    """The put channel is a real injection point (wire-conformance review
+    caught WORKER_CHANNEL_OPS declaring a key with no injection site)."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def do_put():
+        return ray_tpu.put(np.arange(50_000, dtype=np.float64))
+
+    with pytest.raises(Exception, match="injected worker rpc failure"):
+        ray_tpu.get(
+            do_put.options(
+                runtime_env={
+                    "env_vars": {"RAY_TPU_WORKER_RPC_FAILURE": "put_object=1.0"}
+                }
+            ).remote(),
+            timeout=120,
+        )
 
 
 def test_worker_plasma_chaos_falls_back_to_pull(ray_start_process):

@@ -71,6 +71,27 @@ def test_cross_node_get_via_pull(transfer_cluster):
     assert total == float(expected.sum())
     assert tuple(shape) == expected.shape
 
+    # the transfer counters are observable over the wire, not just via the
+    # in-process controller handle (the `transfer_stats` op used to be a
+    # handler with no sender — now it's part of the state API)
+    from ray_tpu.util.state import api as state_api
+
+    stats = state_api.transfer_stats()
+    assert isinstance(stats, dict)
+    # the cross-node consume above moved bytes: some transfer counter ticked
+    assert stats and any(v >= 1 for v in stats.values()), stats
+
+    # the legacy single-address `object_owner` op (superseded by the PR 8
+    # replica-set `object_locations`) is gone from the dispatch surface
+    from ray_tpu._private.worker import global_worker
+
+    with pytest.raises(Exception, match="unknown controller op"):
+        global_worker().controller_call("object_owner", ref.id())
+    # the replacement op answers (empty here: same-host fake nodes have no
+    # data listener — the entry itself is the local serve path)
+    locs = global_worker().controller_call("object_locations", ref.id())
+    assert isinstance(locs, list)
+
 
 @needs_native
 @pytest.mark.parametrize(

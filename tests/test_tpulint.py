@@ -5,7 +5,7 @@ tmp_path; the self-detection tests assert the shipped bug shapes (PR 3
 seal-through-own-pump, PR 4 proxy blocking call, the rank-divergent gang
 shape, the collective-order mismatch, the PR 4 spilled-reply leak) are
 flagged in the checked-in fixtures; the whole-tree test asserts the repo is
-clean modulo the baseline with all seven families and that a full run stays
+clean modulo the baseline with all eight families and that a full run stays
 under the 30 s budget.
 """
 
@@ -824,6 +824,391 @@ def test_collective_suppression(tmp_path):
     assert _by_check(findings).get("collective-uniformity", []) == []
 
 
+# ------------------------------------------------ wire-conformance (units)
+
+_WIRE_COMMON = """
+    import threading
+
+    class Reply:
+        def __init__(self, req_id, payload, error=None):
+            self.req_id = req_id
+            self.payload = payload
+            self.error = error
+
+    class Head:
+        def __init__(self):
+            self._kv = {}
+            self._actors = {}
+
+        def _dispatch_request(self, op, payload):
+            if op == "kv_put":
+                ns, key, value = payload
+                self._kv[(ns, key)] = value
+                return None
+            if op == "get_named_actor":
+                actor = self._actors.get(payload)
+                if actor is None:
+                    return None
+                return (actor, 1)
+            raise ValueError(op)
+
+        def _handle_request(self, handle, msg):
+            try:
+                reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+            except Exception as e:
+                reply = Reply(msg.req_id, None, error=str(e))
+            handle.send(reply)
+
+    class Runtime:
+        def __init__(self, conn):
+            self._conn = conn
+            self._ready = threading.Event()
+            self._replies = {}
+            self._req_id = 0
+
+        def call_controller(self, op, payload=None):
+            self._req_id += 1
+            self._conn.send((self._req_id, op, payload))
+            self._ready.wait(timeout=30.0)
+            return self._replies.pop(self._req_id)
+"""
+
+
+def test_wire_catalog_extraction(tmp_path):
+    """Phase 1: handler ops, payload shapes, reply shapes, send sites, and
+    forwarding-wrapper helpers are all extracted from the AST."""
+    import textwrap as _tw
+
+    from ray_tpu.devtools.lint import analyze, discover
+    from ray_tpu.devtools.lint.wire import build_catalog
+
+    p = tmp_path / "wire_mod.py"
+    p.write_text(
+        _tw.dedent(_WIRE_COMMON)
+        + _tw.dedent(
+            """
+            def _call(op, payload=None):
+                rt = Runtime(None)
+                return rt.call_controller(op, payload)
+
+            def put_meta(ns, key, value):
+                return _call("kv_put", (ns, key, value))
+            """
+        )
+    )
+    project = discover([str(p)])
+    analyze(project)
+    cat = build_catalog(project)
+    assert set(cat.handlers) == {"kv_put", "get_named_actor"}
+    h = cat.handlers["kv_put"][0]
+    assert h.payload_arity == 3 and h.payload_fields == ("ns", "key", "value")
+    assert ("none", None) in h.reply_shapes
+    h2 = cat.handlers["get_named_actor"][0]
+    assert ("none", None) in h2.reply_shapes and ("tuple", 2) in h2.reply_shapes
+    # the wrapper `_call` is discovered by the op-forwarding fixed point,
+    # so put_meta's literal registers as a send site
+    assert any(q.endswith("._call") for q in cat.helpers)
+    assert [s.qualname for s in cat.sends["kv_put"]][0].endswith("put_meta")
+    # get_named_actor has a handler but no sender -> report-only dead op
+    assert cat.dead_ops == ["get_named_actor"]
+
+
+def test_wire_raise_without_error_reply(tmp_path):
+    """A dispatch site that feeds a reply channel without converting raises
+    leaves the requester's reader waiting forever — flagged; the converting
+    shape in _WIRE_COMMON stays clean."""
+    findings = _lint_src(
+        tmp_path,
+        """
+        class Reply:
+            def __init__(self, req_id, payload, error=None):
+                self.req_id = req_id
+                self.payload = payload
+                self.error = error
+
+        class Head:
+            def _dispatch_request(self, op, payload):
+                if op == "ping":
+                    return "pong"
+                if op == "boom":
+                    raise RuntimeError("x")
+                raise ValueError(op)
+
+            def _handle_request(self, handle, msg):
+                reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+                handle.send(reply)
+        """,
+        checks=["wire-conformance"],
+    )
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "error-reply conversion" in findings[0].message
+    assert findings[0].qualname.endswith("_handle_request")
+    clean = _lint_src(tmp_path, _WIRE_COMMON, name="wire_ok.py", checks=["wire-conformance"])
+    assert clean == [], [f.render() for f in clean]
+
+
+def test_wire_unbounded_request_wait(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        """
+        import threading
+
+        class R:
+            def __init__(self):
+                self._ev = threading.Event()
+
+            def call_controller(self, op, payload=None):
+                self._ev.wait()
+                return None
+
+            def go(self):
+                return self.call_controller("ping")
+        """,
+        checks=["wire-conformance"],
+    )
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "untimed" in findings[0].message
+    assert findings[0].qualname.endswith("call_controller")
+
+
+def test_wire_declared_opset_drift(tmp_path):
+    import textwrap as _tw
+
+    findings = _lint_src(
+        tmp_path,
+        'CONTROLLER_OPS = frozenset({"kv_put"})\n' + _tw.dedent(_WIRE_COMMON),
+        checks=["wire-conformance"],
+    )
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "drifted" in findings[0].message
+    assert "get_named_actor" in findings[0].message
+    clean = _lint_src(
+        tmp_path,
+        'CONTROLLER_OPS = frozenset({"kv_put", "get_named_actor"})\n'
+        + _tw.dedent(_WIRE_COMMON),
+        name="wire_set_ok.py",
+        checks=["wire-conformance"],
+    )
+    assert clean == [], [f.render() for f in clean]
+
+
+def test_wire_agent_only_op(tmp_path):
+    """An op the node agent intercepts but the controller does not handle
+    breaks head-side workers (they have no agent) — flagged."""
+    import textwrap as _tw
+
+    findings = _lint_src(
+        tmp_path,
+        _tw.dedent(_WIRE_COMMON)
+        + _tw.dedent(
+            """
+            class Agent:
+                def _route_worker_msg(self, msg):
+                    if msg.op == "kv_put":
+                        self._reply_worker(msg, self._kv_put_local, msg.payload)
+                        return
+                    if msg.op == "node_only_op":
+                        self._reply_worker(msg, self._node_thing, msg.payload)
+                        return
+
+                def _reply_worker(self, msg, fn, payload):
+                    try:
+                        reply = Reply(msg.req_id, fn(payload))
+                    except Exception as e:
+                        reply = Reply(msg.req_id, None, error=str(e))
+                    msg.conn.send(reply)
+
+                def _kv_put_local(self, payload):
+                    ns, key, value = payload
+                    return None
+
+                def _node_thing(self, payload):
+                    return None
+            """
+        ),
+        checks=["wire-conformance"],
+    )
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "node_only_op" in findings[0].message
+    assert "head-side workers" in findings[0].message
+
+
+def test_wire_msg_branch_without_conversion_flagged_standalone(tmp_path):
+    """An agent-style (msg.op) branch that sends replies without converting
+    raises is flagged even when no param-style surface is in the slice —
+    the --changed-only agent-only slice must not go blind."""
+    findings = _lint_src(
+        tmp_path,
+        """
+        class Reply:
+            def __init__(self, req_id, payload, error=None):
+                self.req_id = req_id
+                self.payload = payload
+                self.error = error
+
+        class Agent:
+            def _route_worker_msg(self, conn, msg):
+                if msg.op == "shm_create":
+                    conn.send(Reply(msg.req_id, self._shm_create(msg.payload)))
+                    return
+                if msg.op == "pull_chunk":
+                    conn.send(Reply(msg.req_id, self._pull_chunk(msg.payload)))
+                    return
+
+            def _shm_create(self, payload):
+                object_id, size = payload
+                return object_id
+
+            def _pull_chunk(self, payload):
+                return None
+        """,
+        checks=["wire-conformance"],
+    )
+    hits = [f for f in findings if "without converting raises" in f.message]
+    assert len(hits) == 2, [f.render() for f in findings]
+
+
+def test_wire_suppression_and_baseline_roundtrip(tmp_path):
+    import textwrap as _tw
+
+    src = _tw.dedent(_WIRE_COMMON) + _tw.dedent(
+        """
+        def bad(rt):
+            return rt.call_controller("kv_putt", ("ns", "k", "v"))  # tpulint: disable=wire-conformance
+        """
+    )
+    assert _lint_src(tmp_path, src, checks=["wire-conformance"]) == []
+    findings = _lint_src(
+        tmp_path,
+        src.replace("  # tpulint: disable=wire-conformance", ""),
+        name="wire_b.py",
+        checks=["wire-conformance"],
+    )
+    assert len(findings) == 1 and "kv_putt" in findings[0].message
+    bpath = str(tmp_path / "wire_baseline.json")
+    baseline_mod.write(bpath, findings)
+    new, accepted, stale = baseline_mod.split(findings, baseline_mod.load(bpath))
+    assert new == [] and len(accepted) == 1 and stale == []
+
+
+def test_fixture_wire_typo_flagged():
+    findings = lint_paths([os.path.join(FIXTURES, "fixture_wire_typo.py")])
+    hits = _by_check(findings).get("wire-conformance", [])
+    assert len(hits) == 1, [f.render() for f in findings]
+    assert "object_locatons" in hits[0].message
+    assert "did you mean" in hits[0].message
+
+
+def test_fixture_wire_arity_flagged():
+    findings = lint_paths([os.path.join(FIXTURES, "fixture_wire_arity.py")])
+    hits = _by_check(findings).get("wire-conformance", [])
+    assert len(hits) == 1, [f.render() for f in findings]
+    assert "2-tuple" in hits[0].message and "3 fields" in hits[0].message
+    assert hits[0].qualname.endswith("Agent.register")
+
+
+def test_fixture_wire_none_reply_flagged():
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_wire_none_reply.py")]
+    )
+    hits = _by_check(findings).get("wire-conformance", [])
+    assert len(hits) == 1, [f.render() for f in findings]
+    assert "None" in hits[0].message
+    assert hits[0].qualname.endswith("Driver.get_actor")
+    assert not any("get_actor_safe" in h.qualname for h in hits)
+
+
+def test_fixture_wire_clean_has_zero_findings():
+    findings = lint_paths([os.path.join(FIXTURES, "fixture_wire_clean.py")])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_protocol_doc_is_current_and_covers_controller_ops():
+    """docs/PROTOCOL.md matches a fresh render of the extracted catalog and
+    names every controller op + the agent data-plane surface."""
+    from ray_tpu._private import protocol as P
+    from ray_tpu.devtools.lint import analyze, discover
+    from ray_tpu.devtools.lint.wire import build_catalog, render_protocol_doc
+
+    project = discover([os.path.join(REPO, "ray_tpu")], root=REPO)
+    analyze(project)
+    rendered = render_protocol_doc(build_catalog(project))
+    with open(os.path.join(REPO, "docs", "PROTOCOL.md")) as f:
+        checked_in = f.read()
+    assert checked_in == rendered, (
+        "docs/PROTOCOL.md is stale — regenerate with "
+        "`python -m ray_tpu.devtools.lint --write-protocol-doc`"
+    )
+    for op in sorted(P.CONTROLLER_OPS):
+        assert f"`{op}`" in checked_in, f"op {op} missing from PROTOCOL.md"
+    for op in sorted(P.AGENT_LOCAL_OPS):
+        assert f"| `{op}` | Controller + NodeAgent" in checked_in, op
+    assert '`("chunk", object_id_bytes, offset, length)`' in checked_in
+    assert "_data_serve" in checked_in
+
+
+def test_wire_doc_drift_fails_full_tree_runs(tmp_path):
+    """A stale protocol doc fails full-tree runs (and only full-tree runs:
+    slices see a partial catalog and must not false-positive)."""
+    stale = tmp_path / "PROTOCOL.md"
+    stale.write_text("# stale\n")
+    findings = lint_paths(
+        [os.path.join(REPO, "ray_tpu")],
+        root=REPO,
+        checks=["wire-conformance"],
+        config={"protocol_doc": str(stale)},
+        full_tree=True,
+    )
+    assert any("stale" in f.message for f in findings), [
+        f.render() for f in findings
+    ]
+    # same stale doc, but not marked full-tree -> no drift finding
+    findings = lint_paths(
+        [os.path.join(REPO, "ray_tpu")],
+        root=REPO,
+        checks=["wire-conformance"],
+        config={"protocol_doc": str(stale)},
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_wire_slice_fingerprints_match_full_dir():
+    """Wire findings keep the PR 7 property --changed-only rests on: a
+    single-file slice yields the same qualnames (hence fingerprints) as a
+    directory run over the same root."""
+    target = os.path.join(FIXTURES, "fixture_wire_typo.py")
+    slice_f = [
+        f
+        for f in lint_paths([target], root=REPO)
+        if f.check == "wire-conformance"
+    ]
+    full_f = [
+        f
+        for f in lint_paths([FIXTURES], root=REPO)
+        if f.check == "wire-conformance" and "typo" in f.file
+    ]
+    assert slice_f and full_f
+    assert {f.fingerprint for f in slice_f} == {f.fingerprint for f in full_f}
+
+
+def test_cli_write_protocol_doc_refuses_slices(tmp_path):
+    # path slices AND --changed-only (even a clean one, which short-circuits
+    # before the doc could be written) must refuse up front
+    for argv in (
+        [os.path.join(FIXTURES, "fixture_wire_clean.py"), "--write-protocol-doc"],
+        ["--changed-only", "--write-protocol-doc"],
+    ):
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.devtools.lint", *argv],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 2, (argv, proc.stdout, proc.stderr)
+        assert "full-tree" in proc.stderr
+
+
 # ------------------------------------------------- self-detection fixtures
 
 
@@ -898,6 +1283,9 @@ def test_cli_exits_nonzero_on_fixtures():
         "fixture_rank_divergent.py",
         "fixture_order_mismatch.py",
         "fixture_spilled_reply_leak.py",
+        "fixture_wire_typo.py",
+        "fixture_wire_arity.py",
+        "fixture_wire_none_reply.py",
     ):
         proc = subprocess.run(
             [
@@ -921,7 +1309,12 @@ def test_cli_exits_nonzero_on_fixtures():
 def test_whole_tree_zero_nonbaselined_and_fast():
     """The repo lints clean modulo the checked-in baseline, in < 30 s."""
     t0 = time.monotonic()
-    findings = lint_paths([os.path.join(REPO, "ray_tpu")], root=REPO)
+    findings = lint_paths(
+        [os.path.join(REPO, "ray_tpu")],
+        root=REPO,
+        config={"protocol_doc": "docs/PROTOCOL.md"},
+        full_tree=True,
+    )
     elapsed = time.monotonic() - t0
     base = baseline_mod.load(os.path.join(REPO, "tools", "tpulint_baseline.json"))
     new, accepted, stale = baseline_mod.split(findings, base)
