@@ -764,6 +764,21 @@ def check_floor(max_regress: float = 0.25) -> int:
 if __name__ == "__main__":
     if "--check-floor" in sys.argv:
         sys.exit(check_floor())
+    if "--actor-creation" in sys.argv:
+        # agent-owned creation leases: cold/warm latency + N-way parallel
+        # creation throughput, recorded into MICROBENCH.json["actor_creation"]
+        import os
+
+        from ray_tpu.scripts.actor_creation_bench import (
+            record as actor_creation_record,
+        )
+
+        actor_creation_record(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "MICROBENCH.json"
+            )
+        )
+        sys.exit(0)
     if "--transfer" in sys.argv:
         # object-transfer plane: windowed pull sweep + replica-aware
         # broadcast, recorded into MICROBENCH.json["transfer"]

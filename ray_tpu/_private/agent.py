@@ -106,6 +106,23 @@ class NodeAgent:
         )
         # kills that arrived before their spawn finished
         self._pending_kills: set[WorkerID] = set()
+        # agent-side rpc chaos for our own controller calls (the lease
+        # report channel rides these) — lazily parsed from
+        # RAY_TPU_WORKER_RPC_FAILURE, catalog-validated like the worker's
+        self._chaos_table: Optional[dict] = None
+        import random as _random
+
+        self._chaos_rng = _random.Random(
+            int.from_bytes(self.node_id.binary()[:4], "little")
+        )
+
+        # Actor creation leases (reference: the raylet side of
+        # GcsActorScheduler's lease protocol): the spawner owns worker
+        # acquisition, the registration handshake, creation dispatch, and
+        # the actor_placed / actor_creation_failed report back to the head.
+        from ray_tpu._private.actor_spawner import ActorSpawner
+
+        self.actor_spawner = ActorSpawner(self)
 
         # ---- local task dispatch (LocalTaskManager analog) ----
         # The head leases normal tasks to this node; the agent owns worker
@@ -288,7 +305,26 @@ class NodeAgent:
         with self._send_lock:
             self.conn.send(msg)
 
+    def _maybe_inject_failure(self, op: str):
+        """Agent-side RPC chaos for our own controller calls (the same env
+        table the worker runtime reads, ``RAY_TPU_WORKER_RPC_FAILURE`` —
+        keys are catalog-validated so a typo fails loud, per PR 9). The
+        lease report ops (``actor_placed``/``actor_creation_failed``) ride
+        this channel; injections exercise the spawner's retry path."""
+        spec = os.environ.get("RAY_TPU_WORKER_RPC_FAILURE")
+        if not spec:
+            return
+        if self._chaos_table is None:
+            self._chaos_table = P.parse_worker_chaos_table(spec)
+        prob = self._chaos_table.get(op)
+        if prob and self._chaos_rng.random() < prob:
+            raise OSError(
+                f"injected agent rpc failure for {op!r} "
+                f"(RAY_TPU_WORKER_RPC_FAILURE)"
+            )
+
     def call_controller(self, op: str, payload=None, timeout: float = 60.0):
+        self._maybe_inject_failure(op)
         req_id = next(self._req_counter)
         self._send(P.Request(req_id, op, payload))
         deadline = time.monotonic() + timeout
@@ -369,6 +405,9 @@ class NodeAgent:
 
         self.draining = False  # fresh incarnation accepts leases again
 
+        # head-side lease state died with the old head: no stale report
+        # must reach the new incarnation (it re-places restorable actors)
+        self.actor_spawner.reset()
         with self.workers_lock:
             workers = list(self.workers.values())
             self.workers.clear()
@@ -432,6 +471,11 @@ class NodeAgent:
             ).start()
         elif isinstance(msg, P.LeaseTask):
             self._on_lease_task(msg)
+        elif isinstance(msg, P.LeaseActor):
+            # actor creation lease: the spawner owns the whole local
+            # lifecycle (runs on its own thread — never block this loop,
+            # which also delivers our call_controller replies)
+            self.actor_spawner.on_lease(msg)
         elif isinstance(msg, P.FetchLogs):
             threading.Thread(
                 target=self._handle_fetch_logs, args=(msg,), daemon=True
@@ -498,6 +542,7 @@ class NodeAgent:
         while not self.shutting_down:
             with self._lease_lock:
                 remaining = len(self._leased) + len(self._local_queue)
+            remaining += self.actor_spawner.outstanding()
             if remaining == 0 or time.monotonic() > deadline:
                 break
             time.sleep(0.1)
@@ -630,6 +675,36 @@ class NodeAgent:
                 idle.remove(wid)
         self._busy.pop(wid, None)
 
+    def pop_idle_worker(self, fp: tuple) -> Optional[WorkerID]:
+        """Dedicate an idle agent-owned pool worker to an actor (the
+        spawner's pool-pop path): removed from EVERY pool map so local task
+        dispatch never reuses it — it belongs to the actor now."""
+        with self._lease_lock:
+            idle = self._fp_idle.get(fp)
+            while idle:
+                wid = idle.pop()
+                if wid not in self._wid_fp:
+                    continue  # retired
+                del self._wid_fp[wid]
+                self._agent_owned.pop(wid, None)
+                self._busy.pop(wid, None)
+                return wid
+        return None
+
+    def adopt_idle_worker(self, wid: WorkerID, fp: tuple):
+        """A creation worker that survived a raising ``__init__`` joins the
+        local task pool (parity with the head, which returns such workers
+        to its pool instead of leaking the slot)."""
+        with self.workers_lock:
+            w = self.workers.get(wid)
+        if w is None or w.get("conn") is None:
+            return  # died meanwhile: the reader teardown owns cleanup
+        with self._lease_lock:
+            self._agent_owned[wid] = fp
+            self._wid_fp[wid] = fp
+            self._fp_idle.setdefault(fp, []).append(wid)
+            self._pump_local_locked()
+
     def _on_local_worker_ready(self, wid: WorkerID, fp: tuple):
         """An agent-owned worker finished handshaking: join the pool and
         drain the local queue."""
@@ -700,7 +775,10 @@ class NodeAgent:
 
     # --------------------------------------------------------- worker plane
 
-    def _spawn_worker(self, msg: P.SpawnWorker):
+    def _spawn_worker(self, msg: P.SpawnWorker) -> Optional[str]:
+        """Start one worker process. Returns None on success, else the
+        failure reason (the actor spawner turns it into a lease report;
+        pool spawns also notify the head via WorkerDied)."""
         env = dict(os.environ)
         env["RAY_TPU_WORKER"] = "1"
         env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
@@ -758,7 +836,7 @@ class NodeAgent:
                 self._send(
                     P.WorkerDied(msg.worker_id, f"pip env failed: {e}")
                 )
-                return
+                return f"pip env failed: {e}"
         # per-worker log capture (tailed to the head by the log monitor)
         env["PYTHONUNBUFFERED"] = "1"
         out_path = os.path.join(self.log_dir, f"worker-{msg.worker_id.hex()}.out")
@@ -788,7 +866,7 @@ class NodeAgent:
         except OSError as e:
             self._on_local_worker_death(msg.worker_id)
             self._send(P.WorkerDied(msg.worker_id, f"spawn failed: {e}"))
-            return
+            return f"spawn failed: {e}"
         finally:
             for fh in (stdout, stderr):
                 if fh is not None:
@@ -806,9 +884,10 @@ class NodeAgent:
                 proc.terminate()
             except OSError:
                 pass
-            return
+            return "killed before spawn completed"
         if msg.worker_id in self._agent_owned:
             self._watch_agent_spawn(msg.worker_id, proc)
+        return None
 
     def _watch_agent_spawn(self, wid: WorkerID, proc):
         """Reap an agent-owned worker that dies (or hangs) before its
@@ -886,11 +965,16 @@ class NodeAgent:
             w["conn"] = conn
         # register with the head either way: the head tracks identity (for
         # the worker's own control-plane ops) even when the AGENT schedules
-        # onto it (agent-owned pool workers)
+        # onto it (agent-owned pool workers). The relay MUST precede any
+        # actor_placed report on this FIFO connection — the head learns the
+        # worker's identity + direct-call address before binding an actor.
         self._send(P.FromWorker(msg.worker_id, msg))
         fp = self._agent_owned.get(msg.worker_id)
         if fp is not None:
             self._on_local_worker_ready(msg.worker_id, fp)
+        self.actor_spawner.on_worker_ready(
+            msg.worker_id, getattr(msg, "direct_address", None)
+        )
         self._worker_reader(msg.worker_id, conn)
 
     def _worker_reader(self, worker_id: WorkerID, conn):
@@ -909,6 +993,9 @@ class NodeAgent:
         with self.workers_lock:
             w = self.workers.pop(worker_id, None)
         self._on_local_worker_death(worker_id)
+        # an unfinished creation lease backed by this worker re-places via
+        # a retryable actor_creation_failed report
+        self.actor_spawner.on_worker_death(worker_id)
         reason = "connection closed"
         if w is not None and w.get("proc") is not None:
             rc = w["proc"].poll()
@@ -969,6 +1056,8 @@ class NodeAgent:
                     self._track_seal(oid, payload[0], payload[1])
             if self._on_leased_task_done(worker_id, msg):
                 return  # reported as AgentTaskDone; head never saw a dispatch
+            if self.actor_spawner.on_creation_done(worker_id, msg):
+                return  # reported as actor_placed / actor_creation_failed
         self._send(P.FromWorker(worker_id, msg))
 
     def _track_seal(self, object_id: ObjectID, name: str, size: int):
@@ -1391,6 +1480,8 @@ class NodeAgent:
 
     def shutdown(self):
         self.shutting_down = True
+        # wake lease-spawn waiters; in-flight creations die with the agent
+        self.actor_spawner.reset()
         # release pull-into-arena followers before tearing the store down
         with self._pulls_lock:
             pulls, self._pulls = self._pulls, {}

@@ -89,6 +89,15 @@ class NodeState:
         # (two-level scheduling): task_id binary -> PendingTask. The head
         # holds the resource charge; the agent owns worker pop/queueing.
         self.leased: dict[bytes, "PendingTask"] = {}
+        # Actor CREATION leases granted to this node's agent (reference:
+        # GcsActorScheduler leasing creation to the raylet,
+        # gcs_actor_scheduler.cc:55): creation task_id binary ->
+        # PendingTask. Resources are charged at grant; the agent owns the
+        # whole local lifecycle (spawn, handshake, creation dispatch) and
+        # reports back via the actor_placed / actor_creation_failed ops.
+        # A node dying mid-lease requeues these WITHOUT charging the
+        # actor's restart budget (see remove_node).
+        self.actor_leases: dict[bytes, "PendingTask"] = {}
 
     @property
     def schedulable(self) -> bool:
@@ -439,6 +448,16 @@ class Controller:
         # transfer observability: tests assert the zero-re-transfer property
         # through these counters instead of timing
         self.transfer_stats: dict[str, int] = defaultdict(int)
+        # actor-creation observability (the agent-owned lease protocol):
+        # tests pin "the head never runs a spawn thread for an agent-node
+        # actor" through these counters instead of timing/threads
+        self.actor_creation_stats: dict[str, int] = defaultdict(int)
+        # worker ids that died recently: an actor_placed report racing the
+        # worker's own death notification must not bind the actor to a
+        # corpse (bounded ring; see the actor_placed handler)
+        self._recently_dead_workers: "OrderedDict[WorkerID, None]" = (
+            OrderedDict()
+        )
         # pooled data-plane connections to agents' chunk listeners; the
         # per-peer connection cap matches the transfer window so one
         # windowed pull can saturate a single source
@@ -473,14 +492,16 @@ class Controller:
                     f"testing_rpc_failure entry {part!r} is not 'op=prob'"
                 )
             self._rpc_chaos[op_name.strip()] = float(p)
-        unknown_chaos = set(self._rpc_chaos) - P.CONTROLLER_OPS
+        unknown_chaos = (
+            set(self._rpc_chaos) - P.CONTROLLER_OPS - P.AGENT_PUSH_OPS
+        )
         if unknown_chaos:
             raise ValueError(
                 f"testing_rpc_failure names unknown op(s) "
                 f"{sorted(unknown_chaos)}: a typo'd op never injects, so the "
                 f"fault-injection tests relying on it pass vacuously "
                 f"(known ops: see ray_tpu._private.protocol.CONTROLLER_OPS "
-                f"/ docs/PROTOCOL.md)"
+                f"/ AGENT_PUSH_OPS / docs/PROTOCOL.md)"
             )
         # serializes snapshot+rename: without it an in-flight background
         # write (stale snapshot) can land AFTER the shutdown flush
@@ -1131,6 +1152,17 @@ class Controller:
                 else:
                     failed_leased.append(pt)
             node.leased.clear()
+            # actor CREATION leases mid-flight on the dead node: re-place
+            # elsewhere WITHOUT charging the restart budget or the task
+            # retry count — the node died, not the actor (reference: GCS
+            # rescheduling a creation whose raylet died,
+            # gcs_actor_scheduler.cc lease failure path)
+            for pt in node.actor_leases.values():
+                self._release_task_resources(pt)
+                pt._avoid_node = node_id  # type: ignore[attr-defined]
+                self._enqueue_ready(pt)
+                self.actor_creation_stats["lease_retries"] += 1
+            node.actor_leases.clear()
             self.sched_cv.notify_all()
         for pt in failed_leased:
             self._fail_task(
@@ -1314,6 +1346,12 @@ class Controller:
                             actor._drain_migrating = True  # noqa: SLF001
                             break
                         waiting = True  # in-flight calls still draining
+                if node.actor_leases:
+                    # a creation lease granted before the drain is still
+                    # placing: wait for it to go ALIVE here, then migrate
+                    # it like the rest (the scheduler already stopped
+                    # granting this node new leases)
+                    waiting = True
             if candidate is None:
                 if not waiting:
                     return migrated
@@ -1344,19 +1382,27 @@ class Controller:
         agent leases). Returns False when the deadline lapsed first."""
         while time.time() < deadline and not self.shutting_down:
             with self.lock:
-                busy = bool(node.leased) or any(
-                    w.running
-                    for w in self.workers.values()
-                    if w.node_id == node.node_id and not w.dead
+                busy = (
+                    bool(node.leased)
+                    or bool(node.actor_leases)
+                    or any(
+                        w.running
+                        for w in self.workers.values()
+                        if w.node_id == node.node_id and not w.dead
+                    )
                 )
             if not busy:
                 return True
             time.sleep(0.05)
         with self.lock:
-            return not node.leased and not any(
-                w.running
-                for w in self.workers.values()
-                if w.node_id == node.node_id and not w.dead
+            return (
+                not node.leased
+                and not node.actor_leases
+                and not any(
+                    w.running
+                    for w in self.workers.values()
+                    if w.node_id == node.node_id and not w.dead
+                )
             )
 
     def _migrate_node_objects(self, node: NodeState, deadline: float) -> int:
@@ -2532,10 +2578,82 @@ class Controller:
         )
         return True
 
+    def _lease_actor_to_agent(self, node: NodeState, pt: PendingTask) -> bool:
+        """Grant a CREATION LEASE for this actor to the node's agent
+        (reference: GcsActorScheduler::Schedule leasing creation to the
+        raylet, ``gcs_actor_scheduler.cc:55``). Resources are charged at
+        grant — exactly as for task leases — and held until the agent
+        reports ``actor_placed`` (charge transfers to ``actor.held``) or
+        ``actor_creation_failed`` / node death (charge released). The agent
+        owns the whole local lifecycle: pool pop or fresh spawn,
+        runtime-env staging, creation dispatch, registration handshake."""
+        spec = pt.spec
+        try:
+            self._maybe_inject_rpc_failure("lease_actor")
+        except WorkerCrashedError:
+            # chaos: the grant is "lost" before it reaches the wire — the
+            # task stays queued and the next scheduling round retries
+            # (no double-spawn: the agent never saw this grant)
+            self.actor_creation_stats["lease_grant_injected_failures"] += 1
+            return False
+        resolved_args, _lost = self._resolve_args(pt)
+        if resolved_args is None:
+            self._fail_task(pt, ObjectLostError(_lost.hex()))
+            return True  # consumed (failed), not requeued
+        rt = spec.runtime_env or {}
+        packages, extra_env = self._runtime_packages(rt)
+        # env_vars ship RAW (str-coerced only at spawn, like LeaseTask):
+        # the agent's warm pool is keyed on (tpu, env_vars) and task leases
+        # ship raw values — coercing here would make every non-str value
+        # miss the pool and silently defeat the warm pop path
+        env_vars = dict(rt.get("env_vars") or {})
+        env_vars.update(extra_env)
+        try:
+            node.agent.send(
+                P.LeaseActor(
+                    spec,
+                    resolved_args,
+                    bool(spec.resources.get("TPU")),
+                    env_vars,
+                    self._env_fingerprint(spec),
+                    packages,
+                )
+            )
+        except (OSError, EOFError):
+            return False  # agent gone; heartbeat monitor will remove the node
+        demand = spec.resources
+        pg_bundle = getattr(pt, "_pg_bundle", None)
+        if pg_bundle is not None:
+            pg, i = pg_bundle
+            for k, v in demand.items():
+                pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) - v
+        else:
+            node.allocate(demand)
+            pt._node = node  # type: ignore[attr-defined]
+        node.actor_leases[spec.task_id.binary()] = pt
+        pt.dispatch_t = time.time()
+        self.pending_demand.pop(tuple(sorted(demand.items())), None)
+        self.actor_creation_stats["leases_granted"] += 1
+        self.task_events.append(
+            {"task_id": spec.task_id.hex(), "name": spec.name,
+             "event": "ACTOR_LEASED", "node": node.node_id.hex(),
+             "t": pt.dispatch_t}
+        )
+        return True
+
     def _try_place(self, pt: PendingTask) -> bool:
         spec = pt.spec
         node = self._pick_node(pt)
         if node is not None:
+            if (
+                node.agent is not None
+                and spec.task_type == TaskType.ACTOR_CREATION_TASK
+            ):
+                # agent-node actor creation is ALWAYS a lease: the head
+                # never spawns a worker or runs a registration handshake
+                # for it (send-failure leaves the task queued for the next
+                # round — no fallback to head-managed dispatch)
+                return self._lease_actor_to_agent(node, pt)
             if node.agent is not None and self._leasable(spec):
                 # terminal: backlog-full/send-failure leaves the task queued
                 # for the next round (no fallback to head-managed dispatch —
@@ -2768,6 +2886,18 @@ class Controller:
                     return None
         self.starting_workers += 1
         node.starting_workers += 1
+        # Pinned by tests: agent-node actors NEVER take a head-side spawn
+        # thread (creation is leased end-to-end to the agent); head spawn
+        # threads remain for the head's own node, fake test nodes, and
+        # non-leasable normal tasks.
+        if pt.spec.is_actor_creation():
+            key = (
+                "agent_actor_spawn_threads"
+                if node.agent is not None
+                else "head_actor_spawn_threads"
+            )
+            self.actor_creation_stats[key] += 1
+        self.actor_creation_stats["spawn_threads_total"] += 1
         threading.Thread(
             target=self._start_worker, args=(node.node_id, pt.spec), daemon=True
         ).start()
@@ -2959,32 +3089,9 @@ class Controller:
         packaging through the GCS KV, _private/runtime_env/packaging.py)."""
         worker_id = WorkerID.from_random()
         rt = spec_hint.runtime_env or {}
-        packages: list[tuple] = []
-        working_dir = rt.get("working_dir")
-        if working_dir:
-            path = os.path.abspath(os.path.expanduser(working_dir))
-            packages.append(("working_dir", *self._package_cached(path)))
-        for mod in rt.get("py_modules") or ():
-            path = os.path.abspath(os.path.expanduser(str(mod)))
-            packages.append(("py_module", *self._package_cached(path)))
+        packages, extra_env = self._runtime_packages(rt)
         env_vars = {k: str(v) for k, v in (rt.get("env_vars") or {}).items()}
-        # runtime_env pip across hosts: ship the wheel cache by value
-        # (content-cached zip) and carry the spec in the env; the agent
-        # builds the venv against its own staged copy
-        from ray_tpu._private.runtime_env_pip import normalize_pip_spec
-
-        pip_spec = normalize_pip_spec(rt)
-        if pip_spec:
-            if pip_spec["find_links"]:
-                packages.append(
-                    ("pip_wheels", *self._package_cached(pip_spec["find_links"]))
-                )
-            env_vars["RAY_TPU_PIP_SPEC"] = json.dumps(
-                {
-                    "packages": pip_spec["packages"],
-                    "tool": pip_spec.get("tool", "pip"),
-                }
-            )
+        env_vars.update(extra_env)
         handle = WorkerHandle(
             worker_id, node_id, proc=None, conn=_RelayConn(agent, worker_id)
         )
@@ -3004,6 +3111,37 @@ class Controller:
             )
         )
         return handle
+
+    def _runtime_packages(self, rt: dict) -> tuple[list, dict]:
+        """Runtime-env payloads for shipment to an agent host (no shared
+        filesystem): ``(packages, extra_env_vars)``. Shared by the
+        head-managed SpawnWorker path and the actor creation-lease grant —
+        working_dir/py_modules travel as content-cached zips, pip as the
+        wheel-cache zip plus a spec env var the agent's venv builder reads."""
+        packages: list[tuple] = []
+        extra_env: dict[str, str] = {}
+        working_dir = rt.get("working_dir")
+        if working_dir:
+            path = os.path.abspath(os.path.expanduser(working_dir))
+            packages.append(("working_dir", *self._package_cached(path)))
+        for mod in rt.get("py_modules") or ():
+            path = os.path.abspath(os.path.expanduser(str(mod)))
+            packages.append(("py_module", *self._package_cached(path)))
+        from ray_tpu._private.runtime_env_pip import normalize_pip_spec
+
+        pip_spec = normalize_pip_spec(rt)
+        if pip_spec:
+            if pip_spec["find_links"]:
+                packages.append(
+                    ("pip_wheels", *self._package_cached(pip_spec["find_links"]))
+                )
+            extra_env["RAY_TPU_PIP_SPEC"] = json.dumps(
+                {
+                    "packages": pip_spec["packages"],
+                    "tool": pip_spec.get("tool", "pip"),
+                }
+            )
+        return packages, extra_env
 
     def _package_cached(self, path: str) -> tuple[str, bytes]:
         """Zip a runtime-env path for shipment, cached by content
@@ -3799,6 +3937,34 @@ class Controller:
                 self._agent_spills[object_id] = caller
                 self.memory_store.put(object_id, ("spilled", (path, size)))
             return None
+        if op == "actor_placed":
+            # The agent completed a creation lease end-to-end (spawn,
+            # registration handshake, creation task): bind the actor to its
+            # worker and go ALIVE. Verdicts: "ok" (bound; idempotent on a
+            # duplicate report) or "dead" (the actor was killed/superseded
+            # meanwhile, or the worker already died — the agent must reap
+            # the worker / the lease was re-placed).
+            actor_id, worker_id, direct_address, results, exec_ms = payload
+            if not isinstance(caller, AgentHandle):
+                raise ValueError("actor_placed requires an agent caller")
+            return self._on_actor_placed(
+                caller, actor_id, worker_id, direct_address, results, exec_ms
+            )
+        if op == "actor_creation_failed":
+            # The agent could not place the leased actor. retryable=True →
+            # infra failure (worker/spawn/handshake death, drain race):
+            # re-place per the budget policy; retryable=False → the
+            # creation task itself failed (raising __init__): terminal.
+            actor_id, reason, retryable, results, exec_ms = payload
+            if not isinstance(caller, AgentHandle):
+                raise ValueError("actor_creation_failed requires an agent caller")
+            self._on_actor_creation_failed(
+                caller, actor_id, reason, retryable, results, exec_ms
+            )
+            return None
+        if op == "actor_creation_stats":
+            with self.lock:
+                return dict(self.actor_creation_stats)
         if op == "kill_actor":
             actor_id, no_restart = payload
             self.kill_actor(actor_id, no_restart)
@@ -3971,7 +4137,7 @@ class Controller:
                         "total": dict(n.total),
                         "available": dict(n.available),
                         "labels": dict(n.labels),
-                        "idle": not n.leased and all(
+                        "idle": not n.leased and not n.actor_leases and all(
                             abs(n.available.get(k, 0) - v) < 1e-9
                             for k, v in n.total.items()
                         ),
@@ -4047,6 +4213,20 @@ class Controller:
         except (OSError, EOFError):
             self._on_worker_death(worker, reason="send failed")
 
+    def _seal_results(self, results):
+        """Seal a completed task's result list (``[(oid, kind, payload)]``)
+        into the store — the one sealing loop every completion path shares
+        (call OUTSIDE self.lock; store ops take their own locks and
+        _on_object_sealed wakes dep-waiters)."""
+        for oid, kind, payload in results:
+            if kind == "plasma":
+                self._seal_plasma(oid, payload[0], payload[1])
+            else:
+                self.memory_store.put(
+                    oid, (kind, SerializedObject.from_buffer(payload))
+                )
+            self._on_object_sealed(oid)
+
     def _on_agent_task_done(self, agent: AgentHandle, msg: P.AgentTaskDone):
         """Completion of a task the node's agent dispatched locally (the
         head only did placement — two-level scheduling)."""
@@ -4068,12 +4248,7 @@ class Controller:
                 self._enqueue_ready(pt)
                 self.sched_cv.notify_all()
             return
-        for oid, kind, payload in msg.results:
-            if kind == "plasma":
-                self._seal_plasma(oid, payload[0], payload[1])
-            else:
-                self.memory_store.put(oid, (kind, SerializedObject.from_buffer(payload)))
-            self._on_object_sealed(oid)
+        self._seal_results(msg.results)
         self.task_events.append(
             {"task_id": spec.task_id.hex(), "name": spec.name,
              "event": "FAILED" if failed else "FINISHED",
@@ -4133,13 +4308,7 @@ class Controller:
             # let blocked getters keep waiting on the same return ids
             self._retry_failed_task(worker, pt, msg)
             return
-        for oid, kind, payload in msg.results:
-            if kind == "plasma":
-                shm_name, size = payload
-                self._seal_plasma(oid, shm_name, size)
-            else:
-                self.memory_store.put(oid, (kind, SerializedObject.from_buffer(payload)))
-            self._on_object_sealed(oid)
+        self._seal_results(msg.results)
         self.task_events.append(
             {
                 "task_id": spec.task_id.hex(),
@@ -4254,6 +4423,11 @@ class Controller:
                 return
             worker.dead = True
             self.workers.pop(worker.worker_id, None)
+            # an actor_placed report racing behind this death must not bind
+            # an actor to the corpse (bounded ring; see _on_actor_placed)
+            self._recently_dead_workers[worker.worker_id] = None
+            while len(self._recently_dead_workers) > 512:
+                self._recently_dead_workers.popitem(last=False)
             self._uncount_pooled(worker)
             self._end_lease(worker)
             pool = self.idle_workers.get(worker.node_id)
@@ -4417,6 +4591,187 @@ class Controller:
 
     # ----------------------------------------------------------------- actors
 
+    def _on_actor_placed(
+        self, agent: AgentHandle, actor_id: ActorID, worker_id: WorkerID,
+        direct_address, results, exec_ms,
+    ):
+        """An agent finished a creation lease: the worker spawned,
+        registered (its RegisterWorker relay precedes this report on the
+        agent's FIFO connection, so the head already tracks its identity +
+        direct-call address), and ran the creation task successfully. The
+        lease's resource charge transfers to ``actor.held``."""
+        tid = TaskID.for_actor_creation(actor_id)
+        with self.lock:
+            node = self.nodes.get(agent.node_id)
+            actor = self.actors.get(actor_id)
+            pt = node.actor_leases.pop(tid.binary(), None) if node else None
+            if actor is None or actor.state == "DEAD":
+                # killed mid-creation: reclaim the grant charge; the agent
+                # reaps the just-created worker
+                if pt is not None:
+                    self._release_task_resources(pt)
+                    self.pending_by_id.pop(tid, None)
+                    self._unpin_task_deps(pt)
+                return "dead"
+            if pt is None:
+                # duplicate report (the agent retried after a transport
+                # error that lost only our reply): idempotent
+                w = actor.worker
+                if (
+                    actor.state == "ALIVE"
+                    and w is not None
+                    and w.worker_id == worker_id
+                ):
+                    return "ok"
+                return "dead"  # superseded: the lease was re-placed
+            if worker_id in self._recently_dead_workers:
+                # the worker died before this report was processed: the
+                # actor never went ALIVE, so re-place WITHOUT charging the
+                # restart budget
+                self._release_task_resources(pt)
+                pt._avoid_node = agent.node_id  # type: ignore[attr-defined]
+                self._enqueue_ready(pt)
+                self.actor_creation_stats["lease_retries"] += 1
+                self.sched_cv.notify_all()
+                return "dead"
+            handle = self.workers.get(worker_id)
+            if handle is None:
+                # registration relay raced behind / handle already reaped:
+                # recreate the identity-tracking handle (relay transport)
+                handle = WorkerHandle(
+                    worker_id, agent.node_id,
+                    conn=_RelayConn(agent, worker_id),
+                )
+                handle.agent = agent
+                handle.agent_owned = True
+                handle.registered.set()
+                self.workers[worker_id] = handle
+            if direct_address and not handle.direct_address:
+                handle.direct_address = direct_address
+        # seal the creation task's results outside the lock (store ops take
+        # their own locks; mirrors _on_agent_task_done)
+        self._seal_results(results)
+        spec = pt.spec
+        self.task_events.append(
+            {"task_id": spec.task_id.hex(), "name": spec.name,
+             "event": "FINISHED", "exec_ms": exec_ms, "t": time.time()}
+        )
+        with self.lock:
+            # re-validate: a kill or the worker's death may have landed in
+            # the unlocked sealing window — binding ALIVE over either would
+            # resurrect a killed actor or marry it to a corpse forever
+            if actor.state == "DEAD":
+                self._release_task_resources(pt)
+                self.pending_by_id.pop(spec.task_id, None)
+                self._unpin_task_deps(pt)
+                return "dead"
+            if handle.dead or worker_id in self._recently_dead_workers:
+                # worker died before the bind: re-place, budget untouched
+                self._release_task_resources(pt)
+                pt._avoid_node = agent.node_id  # type: ignore[attr-defined]
+                self._enqueue_ready(pt)
+                self.actor_creation_stats["lease_retries"] += 1
+                self.sched_cv.notify_all()
+                return "dead"
+            self.pending_by_id.pop(spec.task_id, None)
+            self._unpin_task_deps(pt)
+            actor.state = "ALIVE"
+            actor.worker = handle
+            handle.actor_id = actor_id
+            # the charge made at grant time is now held for the actor's
+            # lifetime (released by _release_actor_resources on death)
+            actor.held = (
+                getattr(pt, "_node", None),
+                getattr(pt, "_pg_bundle", None),
+                dict(spec.resources),
+            )
+            pt._node = None  # type: ignore[attr-defined]
+            pt._pg_bundle = None  # type: ignore[attr-defined]
+            self.actor_creation_stats["placed"] += 1
+            self.publish(
+                "actors", {"actor_id": actor_id.hex(), "state": "ALIVE"}
+            )
+            self._register_log_meta(
+                worker_id, label=(spec.name or "").rsplit(".", 1)[0] or None
+            )
+            self._pump_actor(actor)
+            self.sched_cv.notify_all()
+        self._persist_state()
+        return "ok"
+
+    def _on_actor_creation_failed(
+        self, agent: AgentHandle, actor_id: ActorID, reason: str,
+        retryable: bool, results, exec_ms,
+    ):
+        """An agent could not place a leased actor. Budget policy:
+
+        - drain race (``reason == "draining"``): free re-place — a
+          controlled migration, never charged;
+        - other retryable infra failures (worker died mid-creation, spawn
+          or registration failed): consume the restart budget like any
+          post-ALIVE death, then re-place; budget exhausted → DEAD;
+        - non-retryable (the creation task itself raised): terminal — the
+          error seals into the creation returns and the actor dies.
+        """
+        tid = TaskID.for_actor_creation(actor_id)
+        with self.lock:
+            node = self.nodes.get(agent.node_id)
+            actor = self.actors.get(actor_id)
+            pt = node.actor_leases.pop(tid.binary(), None) if node else None
+            if pt is None:
+                return  # duplicate, or the lease was reclaimed (kill/node death)
+            self._release_task_resources(pt)
+            if actor is None or actor.state == "DEAD":
+                self.pending_by_id.pop(tid, None)
+                self._unpin_task_deps(pt)
+                return
+            requeue = retryable and (
+                reason == "draining" or actor.restarts_left != 0
+            )
+            if requeue:
+                if reason != "draining" and actor.restarts_left > 0:
+                    actor.restarts_left -= 1
+                pt._avoid_node = agent.node_id  # type: ignore[attr-defined]
+                self._enqueue_ready(pt)
+                self.actor_creation_stats["lease_retries"] += 1
+                self.task_events.append(
+                    {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
+                     "event": "RETRY", "exec_ms": exec_ms, "t": time.time()}
+                )
+                self.sched_cv.notify_all()
+                return
+        # terminal: seal the failure into the creation returns (the agent
+        # forwards the raising __init__'s error payloads when it has them)
+        if results:
+            self._seal_results(results)
+        else:
+            err = self.serialization.serialize(
+                TaskError(
+                    pt.spec.name, ActorDiedError(actor_id.hex(), reason)
+                )
+            )
+            for oid in pt.spec.return_ids():
+                self.memory_store.put(oid, ("error", err))
+                self._on_object_sealed(oid)
+        self.task_events.append(
+            {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
+             "event": "FAILED", "exec_ms": exec_ms, "t": time.time()}
+        )
+        with self.lock:
+            self.pending_by_id.pop(tid, None)
+            self._unpin_task_deps(pt)
+            actor.state = "DEAD"
+            actor.death_cause = reason
+            self.actor_creation_stats["failed"] += 1
+            self.publish(
+                "actors",
+                {"actor_id": actor_id.hex(), "state": "DEAD",
+                 "reason": reason},
+            )
+            self._drain_actor_queue(actor)
+            self.sched_cv.notify_all()
+        self._persist_state()
+
     def register_actor(self, spec: TaskSpec, name: Optional[str] = None) -> ActorState:
         self._validate_runtime_env(spec)
         with self.lock:
@@ -4467,6 +4822,16 @@ class Controller:
                     self._drain_actor_queue(actor)
                     if actor.name:
                         self.named_actors.pop(actor.name, None)
+                    # a creation lease still in flight holds the grant
+                    # charge: reclaim it now; when the agent's report
+                    # arrives the "dead" verdict reaps the orphan worker
+                    tid_b = TaskID.for_actor_creation(actor_id).binary()
+                    for n in self.nodes.values():
+                        pt = n.actor_leases.pop(tid_b, None)
+                        if pt is not None:
+                            self._release_task_resources(pt)
+                            self.pending_by_id.pop(pt.spec.task_id, None)
+                            self._unpin_task_deps(pt)
         self._persist_state()
 
     def cancel_task(self, object_id: ObjectID):

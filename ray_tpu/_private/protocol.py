@@ -360,7 +360,10 @@ def routable_host() -> str:
 # Every op `Controller._dispatch_request` handles.
 CONTROLLER_OPS = frozenset(
     {
+        "actor_creation_failed",
+        "actor_creation_stats",
         "actor_direct_endpoint",
+        "actor_placed",
         "actor_state",
         "add_node",
         "add_ref",
@@ -425,6 +428,34 @@ AGENT_LOCAL_OPS = frozenset(
 # Worker-side chaos channel names that are not request ops (the plasma /
 # object-channel analogs injected by RAY_TPU_WORKER_RPC_FAILURE).
 WORKER_CHANNEL_OPS = frozenset({"get_objects", "plasma_read", "put_object"})
+
+def parse_worker_chaos_table(spec: str) -> dict:
+    """Parse ``RAY_TPU_WORKER_RPC_FAILURE`` (``"op=prob,op=prob"``),
+    validating keys against the op catalog — a typo'd channel/op name
+    silently never injects, so every chaos test relying on it would pass
+    vacuously. Shared by the worker runtime and the node agent (the
+    agent's own controller calls — the lease report channel — ride the
+    same table)."""
+    table: dict = {}
+    for part in spec.split(","):
+        name, _, prob = part.partition("=")
+        table[name.strip()] = float(prob)
+    unknown = set(table) - CONTROLLER_OPS - WORKER_CHANNEL_OPS
+    if unknown:
+        raise ValueError(
+            f"RAY_TPU_WORKER_RPC_FAILURE names unknown op(s) "
+            f"{sorted(unknown)} (see docs/PROTOCOL.md)"
+        )
+    return table
+
+
+# Controller→agent PUSH messages (typed dataclasses, not Request ops) with a
+# chaos-injection channel: `RAY_testing_rpc_failure` keys naming one of these
+# fail the SEND (the grant never reaches the agent), exercising the
+# retry/re-place path without a receiver-side hook. Kept separate from
+# CONTROLLER_OPS so the wire-conformance declared-set check (which mirrors
+# the `_dispatch_request` branch ladder) stays exact.
+AGENT_PUSH_OPS = frozenset({"lease_actor"})
 
 
 # ---- worker -> controller ----
@@ -661,6 +692,26 @@ class LeaseTask:
     resolved_args: list
     needs_tpu: bool
     env_vars: dict
+
+
+@dataclasses.dataclass
+class LeaseActor:
+    """Controller → agent: a CREATION LEASE — the head picked this node for
+    the actor and charged its resources at grant; the agent owns the entire
+    local lifecycle from here (worker pool-pop or fresh spawn, runtime-env
+    build, creation-task dispatch, readiness/registration handshake,
+    direct-call listener advertisement) and reports back with the
+    ``actor_placed`` / ``actor_creation_failed`` request ops (reference:
+    GcsActorScheduler leasing creation to the raylet end-to-end,
+    ``gcs_actor_scheduler.cc:55``)."""
+
+    spec: Any  # TaskSpec (ACTOR_CREATION_TASK)
+    resolved_args: list
+    needs_tpu: bool
+    env_vars: dict
+    fingerprint: tuple
+    # runtime-env payloads shipped by value, same shape as SpawnWorker's
+    packages: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

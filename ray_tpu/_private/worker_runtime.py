@@ -263,20 +263,10 @@ class WorkerRuntime:
         if not spec:
             return
         if self._chaos_table is None:
-            table = {}
-            for part in spec.split(","):
-                name, _, prob = part.partition("=")
-                table[name.strip()] = float(prob)
             # a typo'd channel/op name silently never injects — fail loud
             # (valid keys: every controller request op + the worker-local
             # object channels; kept code-true by tpulint wire-conformance)
-            unknown = set(table) - P.CONTROLLER_OPS - P.WORKER_CHANNEL_OPS
-            if unknown:
-                raise ValueError(
-                    f"RAY_TPU_WORKER_RPC_FAILURE names unknown op(s) "
-                    f"{sorted(unknown)} (see docs/PROTOCOL.md)"
-                )
-            self._chaos_table = table
+            self._chaos_table = P.parse_worker_chaos_table(spec)
         prob = self._chaos_table.get(op)
         if prob and self._chaos_rng.random() < prob:
             raise OSError(

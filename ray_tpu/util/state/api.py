@@ -67,6 +67,15 @@ def transfer_stats() -> dict:
     return _call("transfer_stats")
 
 
+def actor_creation_stats() -> dict:
+    """Counters for the agent-owned actor-creation lease protocol
+    (reference: GcsActorScheduler leasing creation to the raylet): leases
+    granted / placed / failed / re-placed, plus head-side spawn-thread
+    counts — tests pin "zero head spawn threads for agent-node actors"
+    through ``agent_actor_spawn_threads``."""
+    return _call("actor_creation_stats") or {}
+
+
 def summarize_tasks() -> dict:
     """Event counts per task name (``ray summary tasks`` analog)."""
     events = _call("task_events")

@@ -1,0 +1,95 @@
+"""Self-detection fixture: the actor creation-lease protocol done WRONG.
+
+The PR 10 growth shape — the lease grant/report ops live on the agent
+while the dispatch ladder lives on the head, so a typo'd report op or a
+payload-arity drift ships clean and only surfaces as a runtime error reply
+(a stuck lease); and the agent's spawn path stages lease-scoped resources
+that an exception strands. tpulint must flag:
+
+- wire-conformance: the misspelled ``actor_placd`` report (did-you-mean)
+  and the 4-tuple ``actor_creation_failed`` payload against the handler's
+  5-field unpack;
+- ref-lifecycle: the lease log handle leaked when creation dispatch
+  raises (leak-on-raise in the spawn path).
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import threading
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    """Dispatch surface for the lease report ops."""
+
+    def __init__(self):
+        self._actors = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "actor_placed":
+            actor_id, worker_id, direct_address, results, exec_ms = payload
+            self._actors[actor_id] = (worker_id, direct_address, results)
+            return "ok"
+        if op == "actor_creation_failed":
+            actor_id, reason, retryable, results, exec_ms = payload
+            self._actors.pop(actor_id, None)
+            return None
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class Spawner:
+    """Agent-side lease owner with the protocol bugs under test."""
+
+    def __init__(self, conn):
+        self._conn = conn
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def report_placed(self, actor_id, worker_id, results):
+        # BUG: "actor_placd" — no handler branch matches; the lease report
+        # dies as an unknown-op error and the head never binds the actor
+        return self.call_controller(
+            "actor_placd", (actor_id, worker_id, None, results, 0.0)
+        )
+
+    def report_failed(self, actor_id, reason):
+        # BUG: 4-tuple payload vs the handler's 5-field unpack (exec_ms
+        # missing) — ValueError at dispatch, the lease never resolves
+        return self.call_controller(
+            "actor_creation_failed", (actor_id, reason, True, [])
+        )
+
+    def run_lease(self, lease):
+        """Leak-on-raise in the spawn path: the per-lease spawn log is open
+        while dispatch_creation() can raise — no handler, no finally, the
+        handle (and its fd) strands with the failed lease."""
+        log = open(lease.log_path, "ab")  # noqa: SIM115 — fixture shape
+        log.write(b"lease granted\n")
+        dispatch_creation(lease)
+        log.close()
+
+
+def dispatch_creation(lease) -> None:
+    if lease.spec is None:
+        raise RuntimeError("empty creation lease")

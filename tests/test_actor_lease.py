@@ -1,0 +1,528 @@
+"""Agent-owned actor creation: the creation-lease protocol, head side.
+
+The controller's placement decision for an agent-node actor is a CREATION
+LEASE granted to the node's agent (resources charged at grant); the agent
+owns spawn + registration + creation dispatch and reports back with the
+``actor_placed`` / ``actor_creation_failed`` ops (reference:
+``gcs_actor_scheduler.cc:55`` — GCS leases creation to the raylet
+end-to-end). These tests drive the head half against a scripted in-process
+fake agent speaking the real wire protocol, so every budget/retry/race rule
+is pinned without process spawns; the end-to-end half (real agents, real
+workers) lives in ``test_node_agent.py``.
+"""
+
+import itertools
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol as P
+from ray_tpu._private.ids import NodeID, TaskID, WorkerID
+from ray_tpu._private.serialization import SerializationContext
+
+
+def _controller():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class FakeAgent:
+    """In-process scripted node agent: registers over the real TCP control
+    plane, records creation leases, and answers exactly what the test
+    scripts — the controller cannot tell it from a real agent."""
+
+    def __init__(self, controller, resources):
+        from multiprocessing.connection import Client
+
+        host, _, port = controller.tcp_address.rpartition(":")
+        self.node_id = NodeID.from_random()
+        self.conn = Client((host, int(port)), authkey=controller._authkey)
+        self._send_lock = threading.Lock()
+        self._send(
+            P.RegisterAgent(
+                self.node_id, dict(resources), {}, None, None,
+                pid=os.getpid(), hostname="fake-agent",
+            )
+        )
+        ack = self.conn.recv()
+        assert isinstance(ack, P.AgentAck)
+        self.leases: list = []  # received P.LeaseActor messages
+        self.worker_msgs: list = []  # (worker_id, msg) from ToWorker
+        self.echo_tasks = True  # auto-answer relayed ExecuteTask
+        self.closed = False
+        self._ser = SerializationContext()
+        self._req = itertools.count(1)
+        self._replies: dict = {}
+        self._reply_cv = threading.Condition()
+        threading.Thread(target=self._read_loop, daemon=True).start()
+        threading.Thread(target=self._hb_loop, daemon=True).start()
+
+    def _send(self, msg):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def _read_loop(self):
+        while not self.closed:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                return
+            except TypeError:
+                return  # close() raced this recv (handle now None)
+            if isinstance(msg, P.Reply):
+                with self._reply_cv:
+                    self._replies[msg.req_id] = msg
+                    self._reply_cv.notify_all()
+            elif isinstance(msg, P.LeaseActor):
+                self.leases.append(msg)
+            elif isinstance(msg, P.ToWorker):
+                self.worker_msgs.append((msg.worker_id, msg.msg))
+                if self.echo_tasks and isinstance(msg.msg, P.ExecuteTask):
+                    # the scripted "worker" answers every actor call with
+                    # an inline "pong" result
+                    spec = msg.msg.spec
+                    blob = self._ser.serialize("pong").to_bytes()
+                    results = [
+                        (oid, "inline", blob) for oid in spec.return_ids()
+                    ]
+                    self._send(
+                        P.FromWorker(
+                            msg.worker_id,
+                            P.TaskDone(
+                                spec.task_id, results,
+                                actor_id=spec.actor_id, exec_ms=0.1,
+                            ),
+                        )
+                    )
+
+    def _hb_loop(self):
+        while not self.closed:
+            try:
+                self._send(P.Heartbeat(self.node_id, {}))
+            except (OSError, EOFError):
+                return
+            time.sleep(1.0)
+
+    def _none_results(self, spec):
+        blob = self._ser.serialize(None).to_bytes()
+        return [(oid, "inline", blob) for oid in spec.return_ids()]
+
+    def call(self, op, payload, timeout=15.0):
+        """A Request on the agent channel; returns the raw P.Reply."""
+        req_id = next(self._req)
+        self._send(P.Request(req_id, op, payload))
+        deadline = time.monotonic() + timeout
+        with self._reply_cv:
+            while req_id not in self._replies:
+                remaining = deadline - time.monotonic()
+                assert remaining > 0, f"no reply to {op}"
+                self._reply_cv.wait(remaining)
+            return self._replies.pop(req_id)
+
+    def register_worker(self, worker_id, direct_address=None):
+        self._send(
+            P.FromWorker(
+                worker_id,
+                P.RegisterWorker(worker_id, pid=0,
+                                 direct_address=direct_address),
+            )
+        )
+
+    def place(self, lease, worker_id=None, register=True):
+        """Complete a creation lease the way a real agent would: register
+        the (scripted) worker, then report actor_placed. Returns
+        (worker_id, verdict)."""
+        wid = worker_id or WorkerID.from_random()
+        if register:
+            self.register_worker(wid)
+        reply = self.call(
+            "actor_placed",
+            (lease.spec.actor_id, wid, None,
+             self._none_results(lease.spec), 1.0),
+        )
+        assert reply.error is None, reply.error
+        return wid, reply.payload
+
+    def fail(self, lease, reason, retryable, results=()):
+        reply = self.call(
+            "actor_creation_failed",
+            (lease.spec.actor_id, reason, retryable, list(results), 0.0),
+        )
+        assert reply.error is None, reply.error
+        return reply.payload
+
+    def close(self):
+        self.closed = True
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture
+def lease_cluster():
+    ray_tpu.init(num_cpus=1, mode="process", config={"tcp_port": 0})
+    agents: list = []
+
+    def add(resources):
+        agent = FakeAgent(_controller(), resources)
+        agents.append(agent)
+        _wait(
+            lambda: agent.node_id in _controller().agents,
+            msg="fake agent registration",
+        )
+        return agent
+
+    yield add
+    for a in agents:
+        a.close()
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(resources={"slot": 1}, max_restarts=1)
+class _Slot:
+    def ping(self):
+        return "pong"
+
+
+def _creation_events(ctrl, task_id_hex):
+    return {
+        e["event"] for e in ctrl.task_events if e["task_id"] == task_id_hex
+    }
+
+
+def test_creation_lease_places_actor_and_charges_at_grant(lease_cluster):
+    """The grant charges the node, the head runs no spawn thread for the
+    agent-node actor, and the placed report binds the actor + transfers
+    the charge to the actor's lifetime hold."""
+    agent = lease_cluster({"CPU": 1, "slot": 1})
+    ctrl = _controller()
+
+    a = _Slot.remote()
+    _wait(lambda: agent.leases, msg="creation lease grant")
+    lease = agent.leases[0]
+    node = ctrl.nodes[agent.node_id]
+    # resources charged AT GRANT — before any placement report
+    assert node.available.get("slot") == 0.0
+    assert ctrl.actors[a._actor_id].state == "PENDING"
+    # the lease carried the creation spec + pre-resolved args
+    assert lease.spec.actor_id == a._actor_id
+    assert lease.spec.is_actor_creation()
+
+    wid, verdict = agent.place(lease)
+    assert verdict == "ok"
+    _wait(lambda: ctrl.actors[a._actor_id].state == "ALIVE", msg="ALIVE")
+    actor = ctrl.actors[a._actor_id]
+    assert actor.worker is not None and actor.worker.worker_id == wid
+    assert actor.held is not None and actor.held[2].get("slot") == 1.0
+    assert node.available.get("slot") == 0.0  # charge now held by the actor
+
+    # the bound relay transport serves real method calls
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+
+    # pinned: zero head-side spawn threads / DISPATCHED events for the
+    # agent-node creation — the lease owned it end-to-end
+    from ray_tpu.util.state.api import actor_creation_stats
+
+    stats = actor_creation_stats()
+    assert stats["leases_granted"] == 1 and stats["placed"] == 1
+    assert stats.get("agent_actor_spawn_threads", 0) == 0
+    events = _creation_events(ctrl, lease.spec.task_id.hex())
+    assert "ACTOR_LEASED" in events and "DISPATCHED" not in events
+
+    ray_tpu.kill(a)
+
+
+def test_duplicate_placed_report_is_idempotent(lease_cluster):
+    """The agent retries its report when only the REPLY was lost: a
+    duplicate actor_placed must answer "ok" without a second bind."""
+    agent = lease_cluster({"CPU": 1, "slot": 1})
+    ctrl = _controller()
+    a = _Slot.remote()
+    _wait(lambda: agent.leases, msg="lease")
+    wid, verdict = agent.place(agent.leases[0])
+    assert verdict == "ok"
+    # duplicate report, same worker: idempotent ok
+    reply = agent.call(
+        "actor_placed",
+        (a._actor_id, wid, None,
+         agent._none_results(agent.leases[0].spec), 1.0),
+    )
+    assert reply.error is None and reply.payload == "ok"
+    from ray_tpu.util.state.api import actor_creation_stats
+
+    assert actor_creation_stats()["placed"] == 1
+    assert ctrl.actors[a._actor_id].state == "ALIVE"
+    ray_tpu.kill(a)
+
+
+def test_retryable_failure_charges_budget_and_replaces(lease_cluster):
+    """Worker death mid-creation consumes the restart budget (like any
+    post-ALIVE death) and the lease re-places on another node."""
+    agent_a = lease_cluster({"CPU": 1, "slot": 1})
+    agent_b = lease_cluster({"CPU": 1, "slot": 1})
+    ctrl = _controller()
+    a = _Slot.remote()  # max_restarts=1
+    _wait(lambda: agent_a.leases or agent_b.leases, msg="first lease")
+    first = agent_a if agent_a.leases else agent_b
+    other = agent_b if first is agent_a else agent_a
+
+    first.fail(first.leases[0], "worker died during actor creation", True)
+    _wait(lambda: other.leases, msg="re-placed lease on the other node")
+    assert ctrl.actors[a._actor_id].restarts_left == 0  # budget charged
+    # the failed node's grant charge was released
+    assert ctrl.nodes[first.node_id].available.get("slot") == 1.0
+
+    other.place(other.leases[0])
+    _wait(lambda: ctrl.actors[a._actor_id].state == "ALIVE", msg="ALIVE")
+    ray_tpu.kill(a)
+
+
+def test_draining_rejection_replaces_without_budget_charge(lease_cluster):
+    """The drain-window race (grant crosses the agent's quiesce) is a
+    controlled migration: re-placed for free."""
+    agent_a = lease_cluster({"CPU": 1, "slot": 1})
+    agent_b = lease_cluster({"CPU": 1, "slot": 1})
+    ctrl = _controller()
+    a = _Slot.remote()
+    _wait(lambda: agent_a.leases or agent_b.leases, msg="first lease")
+    first = agent_a if agent_a.leases else agent_b
+    other = agent_b if first is agent_a else agent_a
+
+    first.fail(first.leases[0], "draining", True)
+    _wait(lambda: other.leases, msg="re-placed lease")
+    assert ctrl.actors[a._actor_id].restarts_left == 1  # NOT charged
+
+    other.place(other.leases[0])
+    _wait(lambda: ctrl.actors[a._actor_id].state == "ALIVE", msg="ALIVE")
+    ray_tpu.kill(a)
+
+
+def test_terminal_creation_failure_kills_actor_and_releases(lease_cluster):
+    """A non-retryable failure (raising __init__) is terminal: the error
+    seals into the creation returns, queued calls fail, resources free."""
+    agent = lease_cluster({"CPU": 1, "slot": 1})
+    ctrl = _controller()
+    a = _Slot.remote()
+    ref = a.ping.remote()  # queued behind the creation
+    _wait(lambda: agent.leases, msg="lease")
+    agent.fail(agent.leases[0], "creation task failed", False)
+    _wait(
+        lambda: ctrl.actors[a._actor_id].state == "DEAD", msg="DEAD actor"
+    )
+    with pytest.raises(Exception, match="creation task failed"):
+        ray_tpu.get(ref, timeout=30)
+    assert ctrl.nodes[agent.node_id].available.get("slot") == 1.0
+    from ray_tpu.util.state.api import actor_creation_stats
+
+    assert actor_creation_stats()["failed"] == 1
+
+
+def test_node_death_mid_lease_replaces_without_budget_charge(lease_cluster):
+    """SIGKILL-the-agent analog at the protocol layer: the node dies with
+    the lease outstanding → re-placed on a survivor, restart budget NOT
+    charged (the node failed, not the actor)."""
+    agent_a = lease_cluster({"CPU": 1, "slot": 1})
+    ctrl = _controller()
+
+    @ray_tpu.remote(resources={"slot": 1}, max_restarts=2)
+    class Budget:
+        def ping(self):
+            return "pong"
+
+    a = Budget.remote()
+    _wait(lambda: agent_a.leases, msg="lease on doomed node")
+    agent_a.close()  # connection EOF → node removal with the lease open
+    _wait(
+        lambda: agent_a.node_id not in ctrl.agents, msg="node removal"
+    )
+    # re-placed onto a later-joining survivor
+    agent_b = lease_cluster({"CPU": 1, "slot": 1})
+    _wait(lambda: agent_b.leases, timeout=60, msg="re-placed lease")
+    assert ctrl.actors[a._actor_id].restarts_left == 2  # untouched
+    agent_b.place(agent_b.leases[0])
+    _wait(lambda: ctrl.actors[a._actor_id].state == "ALIVE", msg="ALIVE")
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    from ray_tpu.util.state.api import actor_creation_stats
+
+    assert actor_creation_stats()["lease_retries"] >= 1
+    ray_tpu.kill(a)
+
+
+def test_kill_mid_lease_reclaims_charge_and_reaps_worker(lease_cluster):
+    """ray.kill during creation: the lease charge is reclaimed immediately
+    and the agent's late placed report draws the "dead" verdict (it must
+    terminate the orphan worker)."""
+    agent = lease_cluster({"CPU": 1, "slot": 1})
+    ctrl = _controller()
+    a = _Slot.remote()
+    _wait(lambda: agent.leases, msg="lease")
+    ray_tpu.kill(a)
+    _wait(
+        lambda: ctrl.nodes[agent.node_id].available.get("slot") == 1.0,
+        msg="lease charge reclaimed",
+    )
+    wid, verdict = agent.place(agent.leases[0])
+    assert verdict == "dead"
+    assert ctrl.actors[a._actor_id].state == "DEAD"
+
+
+def test_placed_report_racing_worker_death_replaces(lease_cluster):
+    """actor_placed for a worker the head already declared dead must not
+    bind the actor to the corpse — the lease re-places instead."""
+    agent_a = lease_cluster({"CPU": 1, "slot": 1})
+    agent_b = lease_cluster({"CPU": 1, "slot": 1})
+    ctrl = _controller()
+    a = _Slot.remote()
+    _wait(lambda: agent_a.leases or agent_b.leases, msg="first lease")
+    first = agent_a if agent_a.leases else agent_b
+    other = agent_b if first is agent_a else agent_a
+    lease = first.leases[0]
+
+    wid = WorkerID.from_random()
+    first.register_worker(wid)
+    _wait(lambda: wid in ctrl.workers, msg="worker identity relay")
+    # the worker dies... and the placed report arrives AFTER the death
+    first._send(P.WorkerDied(wid, "simulated crash"))
+    _wait(lambda: wid not in ctrl.workers, msg="death processed")
+    reply = first.call(
+        "actor_placed",
+        (a._actor_id, wid, None, first._none_results(lease.spec), 1.0),
+    )
+    assert reply.error is None and reply.payload == "dead"
+    _wait(lambda: other.leases, msg="re-placed lease")
+    other.place(other.leases[0])
+    _wait(lambda: ctrl.actors[a._actor_id].state == "ALIVE", msg="ALIVE")
+    ray_tpu.kill(a)
+
+
+def test_lease_grant_chaos_drop_retries_without_double_spawn():
+    """Chaos on the GRANT (testing_rpc_failure=lease_actor): the creation
+    retries next scheduling round; once injection lifts, exactly ONE lease
+    reaches the agent — no double-spawn."""
+    ray_tpu.init(
+        num_cpus=1,
+        mode="process",
+        config={"tcp_port": 0, "testing_rpc_failure": "lease_actor=1.0"},
+    )
+    agent = None
+    try:
+        ctrl = _controller()
+        agent = FakeAgent(ctrl, {"CPU": 1, "slot": 1})
+        _wait(lambda: agent.node_id in ctrl.agents, msg="registration")
+        a = _Slot.remote()
+        _wait(
+            lambda: ctrl.actor_creation_stats.get(
+                "lease_grant_injected_failures", 0
+            ) >= 2,
+            msg="injected grant drops",
+        )
+        assert not agent.leases  # nothing reached the wire
+        ctrl._rpc_chaos["lease_actor"] = 0.0  # lift the chaos
+        _wait(lambda: agent.leases, msg="lease after chaos lifted")
+        agent.place(agent.leases[0])
+        _wait(lambda: ctrl.actors[a._actor_id].state == "ALIVE", msg="ALIVE")
+        assert len(agent.leases) == 1  # exactly one grant: no double-spawn
+        assert ctrl.actor_creation_stats["leases_granted"] == 1
+    finally:
+        if agent is not None:
+            agent.close()
+        ray_tpu.shutdown()
+
+
+def test_actor_placed_report_chaos_retry_is_idempotent():
+    """Chaos on the REPORT (testing_rpc_failure=actor_placed): the agent's
+    retry reaches an idempotent handler — one placement, no double-bind."""
+    ray_tpu.init(
+        num_cpus=1,
+        mode="process",
+        config={"tcp_port": 0, "testing_rpc_failure": "actor_placed=1.0"},
+    )
+    agent = None
+    try:
+        ctrl = _controller()
+        agent = FakeAgent(ctrl, {"CPU": 1, "slot": 1})
+        _wait(lambda: agent.node_id in ctrl.agents, msg="registration")
+        a = _Slot.remote()
+        _wait(lambda: agent.leases, msg="lease")
+        lease = agent.leases[0]
+        wid = WorkerID.from_random()
+        agent.register_worker(wid)
+        results = agent._none_results(lease.spec)
+        reply = agent.call("actor_placed", (a._actor_id, wid, None, results, 1.0))
+        assert reply.error and "injected rpc failure" in reply.error
+        assert ctrl.actors[a._actor_id].state == "PENDING"  # untouched
+        ctrl._rpc_chaos["actor_placed"] = 0.0
+        # the retry (same payload) lands and binds exactly once
+        for _ in range(2):  # and a further duplicate stays idempotent
+            reply = agent.call(
+                "actor_placed", (a._actor_id, wid, None, results, 1.0)
+            )
+            assert reply.error is None and reply.payload == "ok"
+        assert ctrl.actors[a._actor_id].state == "ALIVE"
+        assert ctrl.actor_creation_stats["placed"] == 1
+        assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+        ray_tpu.kill(a)
+    finally:
+        if agent is not None:
+            agent.close()
+        ray_tpu.shutdown()
+
+
+def test_head_restart_replacement_rides_lease_path(tmp_path):
+    """Named-actor re-placement after a head restart goes through the same
+    creation-lease path (the restored controller re-creates restorable
+    actors via submit_task → lease grant)."""
+    snap = str(tmp_path / "gcs.snapshot")
+    ray_tpu.init(
+        num_cpus=1, mode="process",
+        config={"tcp_port": 0, "gcs_snapshot_path": snap},
+    )
+    agent = None
+    try:
+        ctrl = _controller()
+        agent = FakeAgent(ctrl, {"CPU": 1, "slot": 1})
+        _wait(lambda: agent.node_id in ctrl.agents, msg="registration")
+        a = _Slot.options(name="survivor", max_restarts=1).remote()
+        _wait(lambda: agent.leases, msg="lease")
+        agent.place(agent.leases[0])
+        _wait(lambda: ctrl.actors[a._actor_id].state == "ALIVE", msg="ALIVE")
+        ctrl.flush_kv_now()
+    finally:
+        if agent is not None:
+            agent.close()
+        ray_tpu.shutdown()
+
+    ray_tpu.init(
+        num_cpus=1, mode="process",
+        config={"tcp_port": 0, "gcs_snapshot_path": snap},
+    )
+    agent = None
+    try:
+        ctrl = _controller()
+        # the restored creation waits as pending demand until capacity joins
+        agent = FakeAgent(ctrl, {"CPU": 1, "slot": 1})
+        _wait(lambda: agent.node_id in ctrl.agents, msg="registration")
+        _wait(lambda: agent.leases, timeout=60, msg="restored lease")
+        agent.place(agent.leases[0])
+        aid = ctrl.named_actors["survivor"]
+        _wait(lambda: ctrl.actors[aid].state == "ALIVE", msg="restored ALIVE")
+        assert ctrl.actor_creation_stats["placed"] == 1
+        assert ctrl.actor_creation_stats.get("agent_actor_spawn_threads", 0) == 0
+    finally:
+        if agent is not None:
+            agent.close()
+        ray_tpu.shutdown()

@@ -193,6 +193,33 @@ def test_rpc_chaos_typo_rejected():
         ray_tpu.shutdown()
 
 
+def test_lease_actor_chaos_key_accepted():
+    """The actor-creation lease GRANT is a push message (not a Request
+    op), injectable through its own catalog entry
+    (protocol.AGENT_PUSH_OPS) — the key must parse, and the report ops
+    must be valid worker-channel chaos keys too."""
+    ray_tpu.init(
+        num_cpus=1,
+        mode="thread",
+        config={"testing_rpc_failure": "lease_actor=0.0,actor_placed=0.0"},
+    )
+    ray_tpu.shutdown()
+    from ray_tpu._private.worker_runtime import WorkerRuntime
+
+    rt = object.__new__(WorkerRuntime)
+    rt._chaos_table = None
+    import random
+
+    rt._chaos_rng = random.Random(0)
+    os.environ["RAY_TPU_WORKER_RPC_FAILURE"] = (
+        "actor_placed=0.0,actor_creation_failed=0.0"
+    )
+    try:
+        rt._maybe_inject_failure("actor_placed")  # parses, never injects
+    finally:
+        del os.environ["RAY_TPU_WORKER_RPC_FAILURE"]
+
+
 def test_worker_rpc_chaos_typo_rejected(monkeypatch):
     """Same contract for the worker-side channel chaos table."""
     from ray_tpu._private.worker_runtime import WorkerRuntime

@@ -33,12 +33,13 @@ pytestmark = [
 
 
 def _start_agent(tcp_address, authkey_hex, base_dir, resources,
-                 store_bytes=256 * 1024**2):
+                 store_bytes=256 * 1024**2, extra_env=None):
     env = dict(os.environ)
     env["RAY_TPU_AUTHKEY"] = authkey_hex
     # the agent must NOT inherit the head's data plane or worker role
     env.pop("RAY_TPU_ARENA", None)
     env.pop("RAY_TPU_WORKER", None)
+    env.update(extra_env or {})
     return subprocess.Popen(
         [
             sys.executable,
@@ -66,12 +67,13 @@ class _AgentCluster:
         self.controller = global_worker().controller
         assert self.controller.tcp_address is not None
 
-    def add_agent(self, name, resources):
+    def add_agent(self, name, resources, extra_env=None):
         proc = _start_agent(
             self.controller.tcp_address,
             self.controller._authkey.hex(),
             self.tmp_path / name,
             resources,
+            extra_env=extra_env,
         )
         self.procs.append(proc)
         deadline = time.monotonic() + 30
@@ -399,6 +401,179 @@ def test_two_level_scheduling_head_places_only(agent_cluster):
     assert not ctrl.idle_workers.get(node_id)
     agent_owned = [w for w in ctrl.workers.values() if w.agent_owned]
     assert agent_owned, "agent spawned no local pool workers"
+
+
+def test_actor_creation_pipelines_across_agents(agent_cluster, tmp_path):
+    """Agent-owned creation leases pipeline N×: four actors whose __init__
+    is a BARRIER (each blocks until all four are inside __init__) can only
+    come up if both agents run two creations CONCURRENTLY — serialized
+    creation (head spawn threads, or one-at-a-time agents) deadlocks and
+    times out. Pinned alongside: zero head-side spawn threads / DISPATCHED
+    events for agent-node creations."""
+    agent_cluster.add_agent("a1", {"CPU": 2, "slot": 2})
+    agent_cluster.add_agent("a2", {"CPU": 2, "slot": 2})
+    barrier_dir = tmp_path / "barrier"
+    barrier_dir.mkdir()
+
+    @ray_tpu.remote(resources={"slot": 1})
+    class Gate:
+        def __init__(self, path, n):
+            import os as _os
+            import time as _time
+
+            with open(f"{path}/{_os.getpid()}.tok", "w"):
+                pass
+            deadline = _time.time() + 90
+            while True:
+                toks = [
+                    f for f in _os.listdir(path) if f.endswith(".tok")
+                ]
+                if len(toks) >= n:
+                    return
+                if _time.time() > deadline:
+                    raise RuntimeError(
+                        f"creation barrier stuck at {len(toks)}/{n}: "
+                        "creations did not pipeline"
+                    )
+                _time.sleep(0.05)
+
+        def arena(self):
+            import os as _os
+
+            return _os.environ.get("RAY_TPU_ARENA")
+
+    gates = [Gate.remote(str(barrier_dir), 4) for _ in range(4)]
+    arenas = ray_tpu.get([g.arena.remote() for g in gates], timeout=180)
+    assert len(set(arenas)) == 2  # two per agent node
+
+    from ray_tpu.util.state.api import actor_creation_stats
+
+    ctrl = agent_cluster.controller
+    stats = actor_creation_stats()
+    assert stats["leases_granted"] >= 4 and stats["placed"] >= 4
+    # the pinned invariant: the head ran NO spawn thread for any
+    # agent-node actor; head-thread workers remain only for its own node
+    assert stats.get("agent_actor_spawn_threads", 0) == 0
+    creation_tids = {
+        ctrl.actors[g._actor_id].creation_spec.task_id.hex() for g in gates
+    }
+    for ev in ctrl.task_events:
+        if ev["task_id"] in creation_tids:
+            assert ev["event"] in ("ACTOR_LEASED", "FINISHED", "RETRY")
+    for g in gates:
+        ray_tpu.kill(g)
+
+
+def test_warm_actor_creation_pops_pool_worker(agent_cluster):
+    """An idle agent pool worker (left by a leased task) is POPPED and
+    dedicated to a new actor with a compatible env — the actor binds to a
+    worker the head already knew BEFORE the lease (no fresh spawn, no new
+    registration), pinned by worker identity rather than pid (the agent's
+    blocked-growth pump may have started more than one pool worker)."""
+    agent_cluster.add_agent("a1", {"CPU": 2, "slot": 2})
+    ctrl = agent_cluster.controller
+
+    @ray_tpu.remote(resources={"slot": 0.1})
+    def warm():
+        return os.getpid()
+
+    task_pid = ray_tpu.get(warm.remote(), timeout=120)
+    assert task_pid != os.getpid()
+    time.sleep(0.3)  # the finished worker reaches the agent's idle pool
+    pre_lease_workers = set(ctrl.workers)
+
+    @ray_tpu.remote(resources={"slot": 1})
+    class Pin:
+        def pid(self):
+            return os.getpid()
+
+    p = Pin.remote()
+    assert isinstance(ray_tpu.get(p.pid.remote(), timeout=60), int)
+    astate = ctrl.actors[p._actor_id]
+    assert astate.state == "ALIVE"
+    # pool pop: the bound worker registered BEFORE the creation lease —
+    # a cold spawn would have introduced a brand-new worker id
+    assert astate.worker.worker_id in pre_lease_workers
+    ray_tpu.kill(p)
+
+
+def test_agent_sigkill_mid_creation_lease_replaces_without_budget(
+    agent_cluster, tmp_path
+):
+    """SIGKILL the agent while a creation lease is in flight: the actor is
+    re-placed on a surviving node and the restart budget is NOT charged
+    (the node died, not the actor)."""
+    proc = agent_cluster.add_agent("a1", {"CPU": 2, "slot": 1})
+    ctrl = agent_cluster.controller
+    marker = str(tmp_path / "first-attempt")
+
+    @ray_tpu.remote(resources={"slot": 1}, max_restarts=2)
+    class Slow:
+        def __init__(self, path):
+            import os as _os
+            import time as _time
+
+            if not _os.path.exists(path):
+                with open(path, "w"):
+                    pass
+                _time.sleep(300)  # killed with its agent
+
+        def ping(self):
+            return "pong"
+
+    a = Slow.remote(marker)
+    node_a = next(iter(ctrl.agents))
+    deadline = time.monotonic() + 60
+    # the lease must be granted AND the first __init__ attempt running
+    while time.monotonic() < deadline and not (
+        ctrl.nodes[node_a].actor_leases and os.path.exists(marker)
+    ):
+        time.sleep(0.1)
+    assert ctrl.nodes[node_a].actor_leases, "creation lease never granted"
+    assert os.path.exists(marker), "creation never started on the agent"
+
+    proc.kill()  # SIGKILL mid-lease
+    proc.wait()
+    agent_cluster.procs.remove(proc)
+
+    agent_cluster.add_agent("a2", {"CPU": 2, "slot": 1})
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if ctrl.actors[a._actor_id].state == "ALIVE":
+            break
+        time.sleep(0.2)
+    assert ctrl.actors[a._actor_id].state == "ALIVE"
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    # the pinned budget rule: a node death mid-lease is free
+    assert ctrl.actors[a._actor_id].restarts_left == 2
+    from ray_tpu.util.state.api import actor_creation_stats
+
+    assert actor_creation_stats()["lease_retries"] >= 1
+    ray_tpu.kill(a)
+
+
+def test_actor_placed_chaos_on_agent_report_no_double_spawn(agent_cluster):
+    """Chaos on the agent's actor_placed REPORT channel
+    (RAY_TPU_WORKER_RPC_FAILURE): the spawner retries into the idempotent
+    handler — the actor comes up exactly once, no double spawn."""
+    agent_cluster.add_agent(
+        "a1",
+        {"CPU": 2, "slot": 1},
+        extra_env={"RAY_TPU_WORKER_RPC_FAILURE": "actor_placed=0.5"},
+    )
+
+    @ray_tpu.remote(resources={"slot": 1})
+    class Pin:
+        def pid(self):
+            return os.getpid()
+
+    p = Pin.remote()
+    assert isinstance(ray_tpu.get(p.pid.remote(), timeout=120), int)
+    from ray_tpu.util.state.api import actor_creation_stats
+
+    stats = actor_creation_stats()
+    assert stats["leases_granted"] == 1 and stats["placed"] == 1
+    ray_tpu.kill(p)
 
 
 def test_leased_task_spillback_on_worker_death(agent_cluster):

@@ -308,6 +308,108 @@ def test_drain_real_agent_quiesce_handshake(tmp_path):
         ray_tpu.shutdown()
 
 
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not _native_available(), reason="node agents require the native store"
+)
+def test_drain_migration_rides_creation_lease(tmp_path):
+    """Drain migration of a real-agent actor re-enters through the SAME
+    agent-owned creation-lease path as first placement: the migrated
+    incarnation is leased to the surviving agent (zero head spawn threads),
+    and the controlled migration does not charge the restart budget."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from ray_tpu.util.state.api import actor_creation_stats
+
+    ray_tpu.init(num_cpus=2, mode="process", config={"tcp_port": 0})
+    procs = []
+
+    def start_agent(name, resources):
+        ctrl = _controller()
+        env = dict(os.environ)
+        env["RAY_TPU_AUTHKEY"] = ctrl._authkey.hex()
+        env.pop("RAY_TPU_ARENA", None)
+        env.pop("RAY_TPU_WORKER", None)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu._private.agent",
+                "--address", ctrl.tcp_address,
+                "--resources", json.dumps(resources),
+                "--base-dir", str(tmp_path / name),
+                "--object-store-memory", str(128 * 1024**2),
+            ],
+            env=env,
+        )
+        procs.append(proc)
+        deadline = time.monotonic() + 60
+        while len(ctrl.agents) < len(procs):
+            assert time.monotonic() < deadline, "agent never registered"
+            time.sleep(0.2)
+        return proc
+
+    try:
+        ctrl = _controller()
+        start_agent("a1", {"CPU": 2, "dslot": 1})
+        node_a = next(iter(ctrl.agents))
+
+        @ray_tpu.remote(resources={"dslot": 1}, max_restarts=2)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=120) == 1
+        astate = ctrl.actors[c._actor_id]
+        assert astate.worker is not None and astate.worker.node_id == node_a
+        stats_before = actor_creation_stats()
+        assert stats_before["placed"] == 1  # first placement was leased
+
+        start_agent("a2", {"CPU": 2, "dslot": 1})
+        node_b = next(n for n in ctrl.agents if n != node_a)
+
+        rec = drain_node(node_a.hex(), deadline_s=90.0, reason="lease test")
+        assert rec["state"] in ("draining", "drained")
+        rec = _wait_drained(node_a.hex(), timeout=120)
+        assert rec["state"] == "drained", rec
+        assert rec["migrated_actors"] >= 1
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if (
+                astate.state == "ALIVE"
+                and astate.worker is not None
+                and astate.worker.node_id == node_b
+            ):
+                break
+            time.sleep(0.2)
+        assert astate.state == "ALIVE"
+        assert astate.worker.node_id == node_b
+        assert ray_tpu.get(c.incr.remote(), timeout=120) == 1  # fresh state
+        # controlled migration: budget untouched
+        assert astate.restarts_left == 2
+        # the migrated incarnation re-entered via the lease path
+        stats = actor_creation_stats()
+        assert stats["placed"] >= 2
+        assert stats["leases_granted"] >= 2
+        assert stats.get("agent_actor_spawn_threads", 0) == 0
+        ray_tpu.kill(c)
+    finally:
+        for proc in procs:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        ray_tpu.shutdown()
+
+
 def test_drain_head_node_rejected():
     ray_tpu.init(num_cpus=2, mode="thread")
     try:

@@ -1123,6 +1123,36 @@ def test_fixture_wire_clean_has_zero_findings():
     assert findings == [], [f.render() for f in findings]
 
 
+def test_fixture_actor_lease_leak_flagged():
+    """The PR 10 lease-protocol shape done wrong: a typo'd actor_placed
+    report, an actor_creation_failed payload one field short of the
+    handler unpack, and the spawn path stranding the per-lease log handle
+    when creation dispatch raises."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_actor_lease_leak.py")]
+    )
+    wire = _by_check(findings).get("wire-conformance", [])
+    assert len(wire) == 2, [f.render() for f in findings]
+    typo = next(h for h in wire if "actor_placd" in h.message)
+    assert 'did you mean "actor_placed"' in typo.message
+    arity = next(h for h in wire if "actor_creation_failed" in h.message)
+    assert "4-tuple" in arity.message and "5 fields" in arity.message
+    life = _by_check(findings).get("ref-lifecycle", [])
+    assert len(life) == 1, [f.render() for f in findings]
+    assert life[0].qualname.endswith("Spawner.run_lease")
+    assert "leaks when" in life[0].message
+
+
+def test_fixture_actor_lease_clean_has_zero_findings():
+    """Same protocol shapes done right (matching ops/arities, guarded
+    verdict, finally-credited lease log, declared op set in sync): zero
+    findings across every family."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_actor_lease_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_protocol_doc_is_current_and_covers_controller_ops():
     """docs/PROTOCOL.md matches a fresh render of the extracted catalog and
     names every controller op + the agent data-plane surface."""
@@ -1286,6 +1316,7 @@ def test_cli_exits_nonzero_on_fixtures():
         "fixture_wire_typo.py",
         "fixture_wire_arity.py",
         "fixture_wire_none_reply.py",
+        "fixture_actor_lease_leak.py",
     ):
         proc = subprocess.run(
             [
