@@ -779,6 +779,19 @@ if __name__ == "__main__":
             )
         )
         sys.exit(0)
+    if "--fairshare" in sys.argv:
+        # multi-tenant scheduling: weighted DRR throughput split +
+        # preemption latency, recorded into MICROBENCH.json["fairshare"]
+        import os
+
+        from ray_tpu.scripts.fairshare_bench import record as fairshare_record
+
+        fairshare_record(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "MICROBENCH.json"
+            )
+        )
+        sys.exit(0)
     if "--transfer" in sys.argv:
         # object-transfer plane: windowed pull sweep + replica-aware
         # broadcast, recorded into MICROBENCH.json["transfer"]

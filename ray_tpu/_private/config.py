@@ -73,6 +73,17 @@ class Config:
     # the WorkerPool soft limit keyed to num_cpus, worker_pool.h:283).
     worker_pool_soft_limit: int = 0
     worker_pool_growth_idle_s: float = 0.25
+    # --- multi-tenancy (see ray_tpu/_private/tenants.py) ---
+    # How long a higher-priority tenant's queue head must fail placement
+    # before the controller drains lower-priority restartable actors to
+    # reclaim capacity (priority preemption via drain-migration; budget
+    # uncharged, zero failed tasks). Preemption never fires while every
+    # queued head shares one priority tier.
+    preemption_wait_s: float = 2.0
+    # Per-victim bound on waiting for its in-flight calls to finish before
+    # the controlled kill; a victim that cannot quiesce in time is left
+    # alone (preemption is drain, never mid-call kill).
+    preemption_drain_timeout_s: float = 30.0
     # Task-pipelining depth per leased worker: when every worker of a shape
     # is busy and the pool can't grow, up to this many same-shape normal
     # tasks are dispatched to one worker's FIFO queue, amortizing the

@@ -36,6 +36,7 @@ from typing import Any, Optional
 
 from ray_tpu._private import locktrace
 from ray_tpu._private import protocol as P
+from ray_tpu._private import tenants as tenants_mod
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import (
     ActorID,
@@ -349,13 +350,20 @@ class Controller:
             self._stores_by_arena[self.plasma.arena_name] = self.plasma
 
         # Scheduling state.
-        # shape-keyed ready queues: (resources, strategy, env fingerprint)
-        # -> FIFO of placeable tasks (see _try_dispatch_locked). Dispatch
-        # order across shapes follows each head task's global submission
-        # seq, preserving the global-FIFO fairness a single queue had —
-        # shapes competing for the same slots (nested submits!) interleave
-        # by arrival instead of starving each other.
-        self.ready_queues: dict[tuple, deque] = {}
+        # Per-TENANT queue groups (the multi-tenant refactor of the old
+        # single global shape-queue table): each tenant holds shape-keyed
+        # ready queues — (tenant, resources, strategy, env fingerprint) ->
+        # FIFO of placeable tasks. WITHIN a tenant, dispatch order across
+        # shapes follows each head task's global submission seq (the
+        # nested-submit interleave guarantee the single table had); ACROSS
+        # tenants, a weighted deficit-round-robin pop bounds skew to the
+        # configured shares, quotas park over-cap work at grant, and
+        # priority tiers + drain-preemption serve urgent tenants first
+        # (see _try_dispatch_locked / _maybe_preempt_locked and
+        # ray_tpu/_private/tenants.py).
+        self.tenants: dict[str, "tenants_mod.TenantState"] = {}
+        # DRR rotation order over tenant names (rotated as credit tops up).
+        self._tenant_ring: deque[str] = deque()
         # shape -> leased workers currently running that shape (pipelining
         # candidates for saturated shapes; see _try_pipeline)
         self.lease_index: dict[tuple, set] = defaultdict(set)
@@ -582,7 +590,10 @@ class Controller:
             config.spill_directory or "/tmp",
             f"ray_tpu_spill_{os.getpid()}",
         )
-        # resource-shape -> last-seen timestamp of unfulfilled demand
+        # (tenant, resource-shape) -> last-seen timestamp of unfulfilled
+        # demand: the autoscaler sees WHICH tenant drives each scale-up
+        # (over-quota parked work never lands here — a tenant at its cap
+        # must not grow the cluster)
         self.pending_demand: dict[tuple, float] = {}
 
         self.serialization = SerializationContext()
@@ -900,12 +911,26 @@ class Controller:
                 for pg_id, pg in self.placement_groups.items()
                 if not pg.removed
             ]
+            # tenant arbitration policy: only explicitly-configured tenants
+            # persist (auto-created per-driver tenants carry no policy;
+            # usage/deficit rebuild as the restored work re-places)
+            tenant_rows = [
+                {
+                    "name": ts.name,
+                    "weight": ts.weight,
+                    "priority": ts.priority,
+                    "quota": dict(ts.quota) if ts.quota else None,
+                }
+                for ts in self.tenants.values()
+                if ts.configured
+            ]
             return {
                 "version": 2,
                 "kv": dict(self.kv),
                 "actors": actors,
                 "placement_groups": pgs,
                 "pending_tasks": pending,
+                "tenants": tenant_rows,
             }
 
     def _write_snapshot(self, suffix: str):
@@ -948,6 +973,21 @@ class Controller:
         (their processes died with the old head/agents — reference restarts
         them through GcsActorManager the same way); pending tasks resubmit;
         placement groups re-place as capacity registers."""
+        # tenant policy FIRST: restored work must route into queue groups
+        # with the configured weights/quotas/priorities already in force
+        for entry in snap.get("tenants", ()):
+            try:
+                self.set_tenant_quota(
+                    entry["name"],
+                    quota=entry.get("quota") or {},
+                    weight=entry.get("weight"),
+                    priority=entry.get("priority"),
+                )
+            except Exception:
+                logger.warning(
+                    "could not restore tenant %s", entry.get("name"),
+                    exc_info=True,
+                )
         for entry in snap.get("placement_groups", ()):
             pg = PlacementGroupState(
                 entry["pg_id"], entry["bundles"], entry["strategy"]
@@ -2314,8 +2354,14 @@ class Controller:
             self.submit_task(spec)
 
     def _shape_key(self, spec: TaskSpec) -> tuple:
+        """Queue/lease key. The TENANT leads the tuple so lease pipelining
+        and work stealing (keyed on whole shapes) never mix tenants — a
+        saturated tenant cannot ride another tenant's leased workers past
+        the fair-share pop. The env fingerprint stays LAST (steal-matching
+        reads shape[-1])."""
         s = spec.strategy
         return (
+            self._tenant_for(spec),
             tuple(sorted(spec.resources.items())),
             s.kind,
             getattr(s, "node_id", None),
@@ -2324,17 +2370,119 @@ class Controller:
             self._env_fingerprint(spec),
         )
 
+    # ------------------------------------------------------------- tenants
+
+    @staticmethod
+    def _tenant_for(spec: TaskSpec) -> str:
+        """The tenant a spec bills to (the submitting API always stamps
+        one; internal/legacy specs fall back to the shared default)."""
+        return getattr(spec, "tenant", None) or tenants_mod.DEFAULT_TENANT
+
+    def _tenant_state(self, name: str) -> "tenants_mod.TenantState":
+        """Get-or-create a tenant's scheduling state (call under lock)."""
+        ts = self.tenants.get(name)
+        if ts is None:
+            ts = self.tenants[name] = tenants_mod.TenantState(name)
+            self._tenant_ring.append(name)
+        return ts
+
+    def _effective_priority(self, spec: TaskSpec) -> int:
+        """Per-spec priority, falling back to the tenant's configured
+        default tier."""
+        p = getattr(spec, "priority", None)
+        if p is not None:
+            return int(p)
+        ts = self.tenants.get(self._tenant_for(spec))
+        return ts.priority if ts is not None else 0
+
+    def _tenant_charge(self, tenant: str, demand: dict) -> None:
+        """Mirror of a node/bundle debit made for this tenant's work (call
+        under lock, exactly where the node charge happens)."""
+        self._tenant_state(tenant).charge(demand)
+
+    def _tenant_credit(self, tenant: str, demand: dict) -> None:
+        ts = self.tenants.get(tenant)
+        if ts is not None:
+            ts.credit(demand)
+
+    @staticmethod
+    def _tenant_contending(
+        ts: "tenants_mod.TenantState", against: dict
+    ) -> bool:
+        """Does this tenant have queued work that could take the capacity
+        an ``against``-shaped lease holds RIGHT NOW? A shape contends only
+        when (a) its demand overlaps the lease's resource keys (yielding
+        CPU slots frees nothing for a TPU-only backlog), (b) it demands
+        anything at all (zero-resource work always places), and (c) that
+        demand clears the tenant's own quota. (Call under lock. Each shape
+        key carries its resource tuple at index 1, and every task in a
+        shape queue shares it, so no task access is needed.)"""
+        for shape in ts.queues:
+            demand = dict(shape[1])
+            if not demand:
+                continue
+            if against and not (demand.keys() & against.keys()):
+                continue
+            if ts.quota and ts.over_quota(demand):
+                continue
+            return True
+        return False
+
+    def set_tenant_quota(
+        self,
+        tenant: str,
+        quota: Optional[dict] = None,
+        weight: Optional[float] = None,
+        priority: Optional[int] = None,
+    ) -> dict:
+        """Configure a tenant's arbitration policy (the ``set_tenant_quota``
+        op): resource caps, fair-share weight, default priority tier.
+        ``quota=None`` leaves the current quota, ``{}`` clears it. Raising a
+        quota wakes the scheduler so parked work resumes immediately."""
+        with self.lock:
+            ts = self._tenant_state(tenant)
+            if quota is not None:
+                ts.quota = (
+                    {k: float(v) for k, v in quota.items()} if quota else None
+                )
+            if weight is not None:
+                ts.weight = max(float(weight), tenants_mod.MIN_WEIGHT)
+            if priority is not None:
+                ts.priority = int(priority)
+            ts.configured = True
+            snap = ts.snapshot()
+            self.sched_cv.notify_all()
+        self._persist_state()
+        return snap
+
+    def tenant_stats(self) -> list[dict]:
+        """Per-tenant shares/quota/usage/queue-depth/preemption counters
+        (the ``tenant_stats`` op), plus which tenant drives each pending
+        autoscale demand shape."""
+        now = time.time()
+        with self.lock:
+            rows = [ts.snapshot() for ts in self.tenants.values()]
+            for row in rows:
+                row["pending_demand"] = [
+                    dict(shape)
+                    for (t, shape), at in self.pending_demand.items()
+                    if t == row["tenant"] and now - at < 60
+                ]
+        return rows
+
     def _enqueue_ready(self, pt: PendingTask):
         pt.seq = next(self._enqueue_seq)
         shape = self._shape_key(pt.spec)
-        q = self.ready_queues.get(shape)
+        ts = self._tenant_state(shape[0])
+        q = ts.queues.get(shape)
         if q is None:
-            q = self.ready_queues[shape] = deque()
+            q = ts.queues[shape] = deque()
         q.append(pt)
 
     def _iter_ready(self):
-        for q in self.ready_queues.values():
-            yield from q
+        for ts in self.tenants.values():
+            for q in ts.queues.values():
+                yield from q
 
     def _submit_actor_task(self, pt: PendingTask):
         actor = self.actors.get(pt.spec.actor_id)
@@ -2355,6 +2503,13 @@ class Controller:
             return
         maxc = actor.creation_spec.max_concurrency
         while actor.queue and actor.inflight < maxc:
+            if actor.state != "ALIVE" or actor.worker is None:
+                # the dispatch below can kill the worker REENTRANTLY
+                # (send failure → _on_worker_death under this same RLock
+                # nulls actor.worker and requeues); without this re-check
+                # the next iteration dispatches into None, strands
+                # inflight at 1, and wedges a maxc=1 actor forever
+                return
             pt = actor.queue[0]
             unresolved = {d for d in pt.unresolved if not self.memory_store.contains(d)}
             if unresolved:
@@ -2384,6 +2539,11 @@ class Controller:
                         if not pg.removed and not pg.ready.is_set():
                             if self._try_place_pg(pg):
                                 progressed = True
+                    # Priority preemption: a higher-priority tenant starved
+                    # past the bounded wait drains lower-priority
+                    # restartable actors (checked every round — other
+                    # tenants progressing must not mask the starvation).
+                    self._maybe_preempt_locked()
                 except Exception:
                     # The scheduler thread must never die; a scheduling bug on
                     # one task must not freeze the cluster.
@@ -2398,54 +2558,172 @@ class Controller:
                     self.sched_cv.wait(timeout=0.5)
 
     def _try_dispatch_locked(self) -> bool:
-        """One scheduling round over the shape-indexed ready queues.
+        """One scheduling round over the per-tenant queue groups.
 
-        Tasks with the same (resources, strategy, env) shape are scheduled
-        FIFO from one queue; the first head-of-queue that cannot place
-        blocks ONLY its shape for this round. A round therefore costs
-        O(shapes + dispatched), not O(queued) — with 100k+ queued tasks of
-        one shape and busy workers, a flat scan per completion would be
-        O(n²) over the drain (reference: the scheduling-class queues in
-        ``cluster_task_manager.h:44``, keyed the same way)."""
+        WITHIN a tenant, tasks with the same (resources, strategy, env)
+        shape are scheduled FIFO from one queue, and the tenant's head is
+        the oldest seq across its unblocked shapes — exactly the global
+        FIFO the single table had, scoped per tenant (nested submits still
+        interleave by arrival). A head that cannot place blocks ONLY its
+        (tenant, shape) for this round, so a round stays O(shapes +
+        dispatched), not O(queued).
+
+        ACROSS tenants, a strict priority tier then a weighted
+        deficit-round-robin pop picks whose head goes next: only tenants
+        whose head sits in the highest priority tier compete; each DRR
+        visit tops a tenant's deficit up by its weight and each dispatch
+        costs 1.0, so steady-state dispatch shares converge to the
+        configured weights (reference shape: scheduling-class queues of
+        ``cluster_task_manager.h:44`` + the job manager's per-job
+        arbitration, PAPER.md L5). Over-QUOTA heads park (blocked without
+        an autoscale hint or starvation clock); heads that fail placement
+        start the starvation clock priority preemption reads."""
         progressed = False
-        blocked: set = set()
+        blocked: set = set()  # (tenant, shape) held out for this round
         while True:
-            # oldest head task across unblocked shapes — global FIFO order
-            best_shape = None
-            best_seq = None
-            emptied = []
-            for shape, q in self.ready_queues.items():
-                if shape in blocked:
-                    continue
-                while q and q[0].cancelled:
-                    q.popleft()
-                if not q:
-                    emptied.append(shape)  # cancelled-out: reap the key
-                    continue
-                seq = q[0].seq
-                if best_seq is None or seq < best_seq:
-                    best_seq, best_shape = seq, shape
-            for shape in emptied:
-                del self.ready_queues[shape]
-            if best_shape is None:
+            picked = self._drr_next_locked(blocked)
+            if picked is None:
                 break
-            q = self.ready_queues[best_shape]
-            pt = q[0]
+            ts, shape, pt = picked
+            q = ts.queues[shape]
             if pt.spec.task_type == TaskType.ACTOR_TASK:
                 q.popleft()
+                ts.reap_queue(shape)
                 actor = self.actors.get(pt.spec.actor_id)
                 if actor is not None:
                     actor.queue.appendleft(pt)
                     self._pump_actor(actor)
                 progressed = True
-            elif self._try_place(pt):
+                continue
+            if ts.over_quota(pt.spec.resources):
+                # park at grant: stays queued, resumes on usage drop /
+                # quota raise; deliberately NO autoscale hint (a capped
+                # tenant must not grow the cluster) and NO starvation
+                # clock (being over your own cap is not starvation — a
+                # clock started when the head merely lacked capacity is
+                # cleared too, or preemption would drain victims for a
+                # head its own quota blocks)
+                if not getattr(pt, "_park_counted", False):
+                    # count TASKS that parked, not scheduler wakeups
+                    pt._park_counted = True  # type: ignore[attr-defined]
+                    ts.stats["quota_parked"] += 1
+                if ts.starved_head is pt:
+                    # only the clock THIS head started — an older head of
+                    # another shape may be genuinely capacity-starved,
+                    # and its preemption claim must survive a sibling
+                    # shape parking behind the tenant's own cap
+                    ts.starved_since = None
+                    ts.starved_head = None
+                blocked.add((ts.name, shape))
+                continue
+            if self._try_place(pt):
                 q.popleft()
+                ts.reap_queue(shape)
+                ts.deficit -= tenants_mod.TASK_COST
+                # count each TASK once: a steal/retry re-enqueue re-pops
+                # the same task, and share accounting (tenant_stats, the
+                # fairness bench) must not read re-dispatch churn as
+                # throughput
+                if not getattr(pt, "_drr_counted", False):
+                    pt._drr_counted = True  # type: ignore[attr-defined]
+                    ts.stats["dispatched"] += 1
+                if ts.starved_head is pt:
+                    # only the head that STARTED the clock clears it — a
+                    # sibling CPU shape dispatching every round must not
+                    # keep resetting a TPU head's preemption claim
+                    ts.starved_since = None
+                    ts.starved_head = None
                 progressed = True
             else:
-                blocked.add(best_shape)
-            if not q:
-                del self.ready_queues[best_shape]
+                blocked.add((ts.name, shape))
+                if ts.starved_since is None:
+                    # clock and head bind together: a LATER failing
+                    # sibling must not retarget the elapsed clock at its
+                    # own (different) demand
+                    ts.starved_since = time.monotonic()
+                    ts.starved_head = pt
         return progressed
+
+    def _drr_next_locked(self, blocked: set):
+        """Pick the next (tenant, shape, head task) to try, or None.
+
+        1. Per tenant: oldest-seq head across unblocked shapes (cancelled
+           heads reaped, emptied shape keys deleted; a tenant with no
+           queued work at all forfeits its banked deficit — classic DRR).
+        2. Priority tier: only tenants whose head has the maximum
+           effective priority stay eligible.
+        3. Weighted DRR over the eligible set: rotate the tenant ring,
+           topping up ``deficit += weight`` per visit, until a tenant can
+           afford one task. Bounded: a full eligible pass adds at least
+           MIN_WEIGHT everywhere, so at most ~1/MIN_WEIGHT passes."""
+        heads: dict[str, tuple] = {}  # name -> (seq, shape, pt)
+        reapable: list[str] = []
+        for name, ts in self.tenants.items():
+            best = None
+            for shape in list(ts.queues):
+                if (name, shape) in blocked:
+                    continue
+                q = ts.queues[shape]
+                while q and q[0].cancelled:
+                    q.popleft()
+                if not q:
+                    del ts.queues[shape]
+                    continue
+                if best is None or q[0].seq < best[0]:
+                    best = (q[0].seq, shape, q[0])
+            if best is not None:
+                heads[name] = best
+            elif not ts.queues:
+                ts.deficit = 0.0  # empty tenant banks no credit
+                if not ts.usage and not ts.configured:
+                    # auto-created (per-driver/per-job) tenant gone idle:
+                    # nothing queued, nothing charged, no policy to keep —
+                    # reap it, or a long-lived head's scheduler rounds
+                    # degrade O(total tenants ever seen) and the registry
+                    # leaks one entry per job forever. Resubmission
+                    # recreates it on demand (stats restart from zero);
+                    # configured tenants always persist.
+                    reapable.append(name)
+        for name in reapable:
+            del self.tenants[name]
+            try:
+                self._tenant_ring.remove(name)
+            except ValueError:
+                pass
+        if not heads:
+            return None
+        top = max(
+            self._effective_priority(h[2].spec) for h in heads.values()
+        )
+        eligible = {
+            n
+            for n, h in heads.items()
+            if self._effective_priority(h[2].spec) == top
+        }
+        ring = self._tenant_ring
+        # prune ring entries whose tenant vanished (defensive; tenants are
+        # currently never deleted) and bound the top-up spin
+        max_spins = len(ring) * (int(1.0 / tenants_mod.MIN_WEIGHT) + 2)
+        for _ in range(max(max_spins, 1)):
+            name = ring[0]
+            if name not in self.tenants:
+                ring.popleft()
+                if not ring:
+                    return None
+                continue
+            if name not in eligible:
+                ring.rotate(-1)
+                continue
+            ts = self.tenants[name]
+            if ts.deficit >= tenants_mod.TASK_COST:
+                seq, shape, pt = heads[name]
+                return ts, shape, pt
+            ts.deficit += ts.weight
+            ring.rotate(-1)
+        # unreachable with MIN_WEIGHT-clamped weights; fail open to FIFO
+        name = min(eligible, key=lambda n: heads[n][0])
+        ts = self.tenants[name]
+        return ts, heads[name][1], heads[name][2]
 
     def _pick_node(self, pt: PendingTask) -> Optional[NodeState]:
         """Scheduling policies (reference: ``raylet/scheduling/policy/``)."""
@@ -2569,9 +2847,13 @@ class Controller:
         else:
             node.allocate(demand)
             pt._node = node  # type: ignore[attr-defined]
+        tenant = self._tenant_for(spec)
+        self._tenant_charge(tenant, demand)
         node.leased[spec.task_id.binary()] = pt
         pt.dispatch_t = time.time()
-        self.pending_demand.pop(tuple(sorted(demand.items())), None)
+        self.pending_demand.pop(
+            (tenant, tuple(sorted(demand.items()))), None
+        )
         self.task_events.append(
             {"task_id": spec.task_id.hex(), "name": spec.name,
              "event": "LEASED", "node": node.node_id.hex(), "t": pt.dispatch_t}
@@ -2630,9 +2912,13 @@ class Controller:
         else:
             node.allocate(demand)
             pt._node = node  # type: ignore[attr-defined]
+        tenant = self._tenant_for(spec)
+        self._tenant_charge(tenant, demand)
         node.actor_leases[spec.task_id.binary()] = pt
         pt.dispatch_t = time.time()
-        self.pending_demand.pop(tuple(sorted(demand.items())), None)
+        self.pending_demand.pop(
+            (tenant, tuple(sorted(demand.items()))), None
+        )
         self.actor_creation_stats["leases_granted"] += 1
         self.task_events.append(
             {"task_id": spec.task_id.hex(), "name": spec.name,
@@ -2672,10 +2958,14 @@ class Controller:
                         pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) - v
                 else:
                     node.allocate(demand)
+                tenant = self._tenant_for(spec)
+                self._tenant_charge(tenant, demand)
                 # demand satisfied: stop advertising this shape to the
                 # autoscaler (otherwise a scaled-down group relaunches for
                 # stale demand)
-                self.pending_demand.pop(tuple(sorted(demand.items())), None)
+                self.pending_demand.pop(
+                    (tenant, tuple(sorted(demand.items()))), None
+                )
                 if spec.task_type == TaskType.NORMAL_TASK:
                     # the LEASE holds the charge; the task carries none, so
                     # same-shape followers can pipeline behind it
@@ -2703,7 +2993,23 @@ class Controller:
         depth = self.config.max_tasks_in_flight_per_worker
         if depth <= 1:
             return False
-        cands = self.lease_index.get(self._shape_key(pt.spec))
+        shape = self._shape_key(pt.spec)
+        # Cross-tenant fairness gate: a pipelined dispatch rides the
+        # worker's EXISTING lease, so it bypasses capacity acquisition —
+        # alone, that is pure throughput (the lease rotates as soon as the
+        # queue drains), but under cross-tenant contention it would let
+        # one tenant hold its slots for whole queue lifetimes and the DRR
+        # pop would arbitrate nothing. With any OTHER tenant CONTENDING
+        # for the resources this lease holds, every dispatch must win
+        # capacity the weighted way — a tenant parked behind its own
+        # quota, or backlogged on disjoint resources (a TPU queue cannot
+        # use CPU slots), contends for nothing here and must not cost
+        # everyone else the pipeline path.
+        held = dict(shape[1])
+        for name, ts in self.tenants.items():
+            if name != shape[0] and self._tenant_contending(ts, held):
+                return False
+        cands = self.lease_index.get(shape)
         if not cands:
             return False
         best, best_n = None, depth
@@ -2735,7 +3041,8 @@ class Controller:
         if self.config.max_tasks_in_flight_per_worker <= 1:
             return
         for shape, workers in list(self.lease_index.items()):
-            if self.ready_queues.get(shape):
+            owner = self.tenants.get(shape[0])  # shape[0] is the tenant
+            if owner is not None and owner.queues.get(shape):
                 continue  # undispatched work exists; idle workers take that
             victim = None
             for w in workers:
@@ -2771,6 +3078,212 @@ class Controller:
             except (OSError, EOFError):
                 victim.steal_pending = False
 
+    # -------------------------------------------------- priority preemption
+
+    def _maybe_preempt_locked(self):
+        """Serve starved higher-priority tenants by drain-migrating
+        lower-priority restartable actors (call under self.lock).
+
+        A tenant is STARVED when its queue head has failed placement
+        continuously for ``Config.preemption_wait_s`` (the clock starts in
+        _try_dispatch_locked; quota-parked heads never start it — being at
+        your own cap is not starvation). Preemption is the node-drain
+        migration, not a kill: the victim's in-flight calls finish, its
+        queued calls hold and replay on the migrated incarnation, the
+        restart budget is NOT charged, and the victim re-places through
+        the normal (lease) path — behind the higher-priority work, queued,
+        never failed. Non-restartable actors, bundle-held actors, and
+        anything at or above the starved priority are never victims."""
+        wait = self.config.preemption_wait_s
+        if wait <= 0 or not self.tenants:
+            return
+        now = time.monotonic()
+        # snapshot: charging a victim's (possibly reaped) tenant below
+        # inserts into self.tenants — mutating mid-iteration raises
+        for ts in list(self.tenants.values()):
+            if ts.starved_since is None or now - ts.starved_since < wait:
+                continue
+            pt = ts.starved_head
+            if (
+                pt is None
+                or pt.cancelled
+                or pt.spec.task_id not in self.pending_by_id
+            ):
+                # head was cancelled/failed out of band: not starvation
+                ts.starved_since = None
+                ts.starved_head = None
+                continue
+            spec = pt.spec
+            if spec.strategy.kind == "placement_group":
+                continue  # bundle demand is the PG's to serve, not ours
+            if ts.over_quota(spec.resources):
+                # the head is blocked by its OWN cap (usage changed since
+                # the clock started): draining victims cannot help it
+                ts.starved_since = None
+                ts.starved_head = None
+                continue
+            if any(
+                getattr(a, "_preempting", False)
+                and getattr(a, "_preempt_for", None) == ts.name
+                for a in self.actors.values()
+            ):
+                # a victim set for this tenant is still draining: its
+                # capacity has not freed yet — selecting MORE victims
+                # every wait interval would over-preempt across the
+                # cluster for one starved head
+                ts.starved_since = now
+                continue
+            prio = self._effective_priority(spec)
+            victims = self._select_preemption_victims(spec, prio)
+            if not victims:
+                continue
+            ts.starved_since = now  # clock restarts while victims drain
+            ts.stats["preemptions"] += len(victims)
+            for actor in victims:
+                actor._preempting = True  # noqa: SLF001
+                actor._preempt_for = ts.name  # noqa: SLF001
+                vts = self._tenant_state(
+                    self._tenant_for(actor.creation_spec)
+                )
+                vts.stats["preempted"] += 1
+                self.task_events.append(
+                    {"task_id": actor.creation_spec.task_id.hex(),
+                     "name": actor.creation_spec.name, "event": "PREEMPTED",
+                     "for_tenant": ts.name, "t": time.time()}
+                )
+                logger.info(
+                    "preempting actor %s (tenant %s, prio %d) for starved "
+                    "tenant %s (prio %d)",
+                    actor.actor_id.hex()[:8],
+                    self._tenant_for(actor.creation_spec),
+                    self._effective_priority(actor.creation_spec),
+                    ts.name, prio,
+                )
+                threading.Thread(
+                    target=self._preempt_actor, args=(actor,), daemon=True,
+                    name=f"preempt-{actor.actor_id.hex()[:8]}",
+                ).start()
+
+    def _select_preemption_victims(self, spec: TaskSpec, prio: int) -> list:
+        """The smallest set of strictly-lower-priority restartable actors
+        on ONE schedulable node whose release lets ``spec`` fit there
+        (call under self.lock). Bundle-held actors are exempt — their
+        reservation belongs to the placement group, which preemption never
+        revokes."""
+        demand = spec.resources
+        strat = spec.strategy
+
+        def fits(avail):
+            return all(
+                avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()
+            )
+
+        by_node: dict[NodeID, list] = defaultdict(list)
+        for actor in self.actors.values():
+            if (
+                actor.state != "ALIVE"
+                or actor.worker is None
+                or actor.held is None
+                or actor.restarts_left == 0
+                or getattr(actor, "_preempting", False)
+                or getattr(actor, "_drain_migrating", False)
+                or getattr(actor, "_drain_hold", False)
+            ):
+                continue
+            node, pg_bundle, _resources = actor.held
+            if node is None or pg_bundle is not None:
+                continue
+            if self._effective_priority(actor.creation_spec) >= prio:
+                continue
+            by_node[node.node_id].append(actor)
+        best: Optional[list] = None
+        for node_id, actors in by_node.items():
+            node = self.nodes.get(node_id)
+            if node is None or not node.schedulable:
+                continue
+            if (
+                strat.kind == "node_affinity"
+                and not strat.soft
+                and node_id != strat.node_id
+            ):
+                continue
+            # cheapest victims first: lowest priority, then smallest hold
+            actors.sort(
+                key=lambda a: (
+                    self._effective_priority(a.creation_spec),
+                    sum(a.held[2].values()),
+                )
+            )
+            avail = dict(node.available)
+            chosen: list = []
+            for a in actors:
+                if fits(avail):
+                    break
+                # a victim must CONTRIBUTE to some still-unmet dimension
+                # of the demand: draining CPU-only actors frees nothing
+                # for a TPU-starved head — skip them or the "smallest
+                # set" degenerates into migrating every cheap bystander
+                if not any(
+                    v > 0
+                    and avail.get(k, 0.0) + 1e-9 < demand.get(k, 0.0)
+                    for k, v in a.held[2].items()
+                ):
+                    continue
+                for k, v in a.held[2].items():
+                    avail[k] = avail.get(k, 0.0) + v
+                chosen.append(a)
+            if chosen and fits(avail):
+                if best is None or len(chosen) < len(best):
+                    best = chosen
+        return best or []
+
+    def _preempt_actor(self, actor: ActorState):
+        """Drain-migrate one preemption victim (dedicated thread; the same
+        controlled-respawn shape as ``_drain_migrate_actors``): hold its
+        queue, wait — bounded — for in-flight calls to finish, mark the
+        respawn budget-free, then retire its worker. A victim that cannot
+        quiesce within ``preemption_drain_timeout_s`` is released
+        untouched (preemption is drain, never a mid-call kill)."""
+        deadline = (
+            time.monotonic() + self.config.preemption_drain_timeout_s
+        )
+        worker = None
+        while time.monotonic() < deadline and not self.shutting_down:
+            with self.lock:
+                if actor.state != "ALIVE" or actor.worker is None:
+                    # died/killed/migrated concurrently: nothing to preempt
+                    actor._preempting = False  # noqa: SLF001
+                    return
+                actor._drain_hold = True  # noqa: SLF001
+                if actor.inflight == 0:
+                    actor._drain_migrating = True  # noqa: SLF001
+                    worker = actor.worker
+                    break
+            time.sleep(0.02)
+        if worker is None:
+            with self.lock:
+                actor._preempting = False  # noqa: SLF001
+                if actor.state == "ALIVE":
+                    actor._drain_hold = False  # noqa: SLF001
+                    self._pump_actor(actor)
+            return
+        try:
+            worker.send(P.KillActor(actor.actor_id))
+        except (OSError, EOFError):
+            pass
+        if worker.proc is not None:
+            try:
+                worker.proc.terminate()
+            except OSError:
+                pass
+        elif worker.agent is not None:
+            try:
+                worker.agent.send(P.KillWorker(worker.worker_id))
+            except (OSError, EOFError):
+                pass
+        with self.lock:
+            self.actor_creation_stats["preempt_migrations"] += 1
+
     def _on_tasks_stolen(self, worker: WorkerHandle, msg: P.TasksStolen):
         with self.lock:
             worker.steal_pending = False
@@ -2804,6 +3317,8 @@ class Controller:
                     pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) + v
         elif node is not None:
             node.release(demand)
+        # the lease's charge was billed to its tenant (shape[0]) at grant
+        self._tenant_credit(shape[0], demand)
 
     def _maybe_end_lease_and_idle(self, worker: WorkerHandle):
         """After a normal task left ``worker.running``: if the pipeline
@@ -2820,10 +3335,11 @@ class Controller:
                 self._pool_worker_freed(worker)
 
     def _maybe_autoscale_hint(self, pt: PendingTask):
-        """Record unfulfilled demand for the autoscaler (reference:
-        GcsAutoscalerStateManager fed by scheduler backlog)."""
+        """Record unfulfilled demand for the autoscaler, attributed to the
+        demanding tenant (reference: GcsAutoscalerStateManager fed by
+        scheduler backlog, per-job demand accounting)."""
         shape = tuple(sorted(pt.spec.resources.items()))
-        self.pending_demand[shape] = time.time()
+        self.pending_demand[(self._tenant_for(pt.spec), shape)] = time.time()
 
     @staticmethod
     def _env_fingerprint(spec: TaskSpec):
@@ -4124,13 +4640,18 @@ class Controller:
         if op == "task_events":
             return list(self.task_events)
         if op == "autoscaler_state":
-            # demand younger than 60s + per-node utilization snapshot
+            # demand younger than 60s + per-node utilization snapshot; each
+            # demand entry names the tenant driving it (per-tenant scale-up
+            # attribution — the 60s TTL sweep is per (tenant, shape) key)
             now = time.time()
             with self.lock:
                 self.pending_demand = {
                     k: t for k, t in self.pending_demand.items() if now - t < 60
                 }
-                demand = [dict(shape) for shape in self.pending_demand]
+                demand = [
+                    {"resources": dict(shape), "tenant": tenant}
+                    for (tenant, shape) in self.pending_demand
+                ]
                 nodes = [
                     {
                         "node_id": n.node_id.hex(),
@@ -4166,6 +4687,13 @@ class Controller:
             )
         if op == "drain_status":
             return self.drain_status(payload)
+        if op == "set_tenant_quota":
+            tenant, quota, weight, priority = payload
+            return self.set_tenant_quota(
+                tenant, quota=quota, weight=weight, priority=priority
+            )
+        if op == "tenant_stats":
+            return self.tenant_stats()
         raise ValueError(f"unknown controller op: {op}")
 
     # ------------------------------------------------------------ dispatching
@@ -4405,9 +4933,11 @@ class Controller:
                 pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) + v
             pt._pg_bundle = None
             pt._node = None
+            self._tenant_credit(self._tenant_for(pt.spec), pt.spec.resources)
         elif node is not None:
             node.release(pt.spec.resources)
             pt._node = None
+            self._tenant_credit(self._tenant_for(pt.spec), pt.spec.resources)
 
     def _unpin(self, object_id: ObjectID):
         self.ref_counts[object_id] -= 1
@@ -4495,6 +5025,7 @@ class Controller:
             migrating = getattr(actor, "_drain_migrating", False)
             actor._drain_migrating = False
             actor._drain_hold = False
+            actor._preempting = False  # a preemption victim completed its kill
             if actor.restarts_left != 0:
                 if actor.restarts_left > 0 and not migrating:
                     # a drain-driven migration is a controlled respawn, not a
@@ -4536,6 +5067,9 @@ class Controller:
                 pg.bundle_available[i][k] = pg.bundle_available[i].get(k, 0.0) + v
         elif node is not None:
             node.release(resources)
+        self._tenant_credit(
+            self._tenant_for(actor.creation_spec), resources
+        )
 
     def _drain_actor_queue(self, actor: ActorState):
         while actor.queue:

@@ -403,6 +403,7 @@ CONTROLLER_OPS = frozenset(
         "register_replica",
         "remove_node",
         "report_agent_spill",
+        "set_tenant_quota",
         "shm_create",
         "stream_abandoned",
         "stream_consumed_get",
@@ -410,6 +411,7 @@ CONTROLLER_OPS = frozenset(
         "submit_task",
         "task_events",
         "tasks_pending",
+        "tenant_stats",
         "testing_lose_object",
         "transfer_stats",
         "unregister_replica",

@@ -63,6 +63,16 @@ class TaskSpec:
     seq_no: int = 0
     # Runtime env (env vars for now; full runtime-env plugins later).
     runtime_env: Optional[dict] = None
+    # Multi-tenancy (reference: the job-scoped demand accounting the GCS job
+    # manager + autoscaler keep per submitter). ``tenant`` is filled by the
+    # submitting API from the driver's identity (RAY_TPU_TENANT env, the
+    # submitted job id, or a per-driver default) and propagated to nested
+    # submits; the controller routes the task into that tenant's fair-share
+    # queue group and charges its quota at lease grant. ``priority`` is the
+    # cross-tenant preemption tier (higher wins; None inherits the tenant's
+    # configured default) — intra-tenant order stays FIFO regardless.
+    tenant: Optional[str] = None
+    priority: Optional[int] = None
     # Streaming generators: max yielded-but-unconsumed items before the
     # producer blocks; 0 = unbounded (reference:
     # _generator_backpressure_num_objects, python/ray/remote_function.py).

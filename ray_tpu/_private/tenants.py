@@ -1,0 +1,144 @@
+"""Tenant arbitration state: quotas, fair-share weights, preemption tiers.
+
+The controller's scheduling core arbitrates between TENANTS — one per
+submitted job / driver by default — instead of running one global
+submission order (reference shape: the GCS job manager plus the
+autoscaler's per-job demand accounting, PAPER.md L5; the per-scheduling-
+class queues of ``cluster_task_manager.h:44`` generalized with a
+per-tenant deficit-round-robin pop). Each tenant owns:
+
+- a **queue group**: the shape-keyed ready queues (same key layout as the
+  old global table, with the tenant name prepended) holding its placeable
+  tasks in global-submission-``seq`` FIFO order — nested submits of one
+  tenant interleave by arrival exactly as before;
+- a **resource quota**: optional per-resource caps enforced at lease
+  grant — over-quota work PARKS in the queue group (no autoscale hint, no
+  starvation clock) and resumes when usage drops or the quota is raised;
+- a **fair-share weight** driving the deficit-round-robin pop in
+  ``Controller._try_dispatch_locked``: each visit tops the tenant's
+  deficit up by its weight, each dispatched task costs 1.0, so
+  steady-state dispatch shares converge to the configured weights with
+  bounded cross-tenant skew;
+- a **priority** (default tier for specs that carry none): the dispatch
+  loop serves the highest-priority queue heads first, and a head starved
+  past ``Config.preemption_wait_s`` may drain-migrate lower-priority
+  restartable actors to reclaim capacity (see
+  ``Controller._maybe_preempt_locked``).
+
+All mutation happens under ``Controller.lock``; this module holds plain
+state + small pure helpers so the scheduler hot path stays in one place.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict, deque
+from typing import Optional
+
+# Tenant name used when a spec reaches the controller without one (internal
+# submissions, legacy pickles). API-side submission always stamps a tenant.
+DEFAULT_TENANT = "default"
+
+# Fair-share weights below this floor are clamped: the DRR top-up loop adds
+# ``weight`` per visit, so a zero/negative weight would never accumulate a
+# full task credit and starve the tenant forever (weights are shares, not
+# switches — use a quota of zero to fence a tenant off).
+MIN_WEIGHT = 0.01
+
+# One dispatched task costs this much deficit. Count-based DRR: shares are
+# measured in tasks, matching the throughput artifacts the fairness tests
+# and bench assert on.
+TASK_COST = 1.0
+
+
+class TenantState:
+    """Per-tenant scheduling state (guarded by the controller lock)."""
+
+    def __init__(self, name: str, weight: float = 1.0):
+        self.name = name
+        self.weight = max(float(weight), MIN_WEIGHT)
+        # Default priority tier for this tenant's specs (spec.priority
+        # overrides per task). Higher = served first + may preempt lower.
+        self.priority = 0
+        # Optional per-resource caps, e.g. {"CPU": 8, "TPU": 4}; None =
+        # unlimited. Checked against ``usage`` at lease grant.
+        self.quota: Optional[dict] = None
+        # Resources currently charged to this tenant: mirrors every node /
+        # placement-group-bundle debit made for its tasks and actors
+        # (charged at grant, credited exactly where the node charge is).
+        self.usage: dict[str, float] = {}
+        # Deficit-round-robin credit (task units).
+        self.deficit = 0.0
+        # shape key -> deque[PendingTask]; shape[0] is this tenant's name
+        # (see Controller._shape_key), so lease pipelining and work
+        # stealing never cross tenants.
+        self.queues: dict[tuple, deque] = {}
+        # Observability counters (tenant_stats op): dispatched, quota_parked,
+        # preemptions (initiated for this tenant), preempted (suffered).
+        self.stats: dict[str, int] = defaultdict(int)
+        # True once set_tenant_quota configured this tenant explicitly —
+        # only configured tenants persist into the head-state snapshot
+        # (auto-created per-driver tenants carry no policy worth restoring).
+        self.configured = False
+        # Starvation clock for priority preemption: monotonic time when
+        # this tenant's head task first failed placement, and that task.
+        # Cleared on any successful dispatch.
+        self.starved_since: Optional[float] = None
+        self.starved_head = None
+        self.created_t = time.time()
+
+    # -- queue group --------------------------------------------------------
+
+    def queued(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    def reap_queue(self, shape: tuple) -> None:
+        """Drop an emptied shape queue (keys must not accumulate forever)."""
+        q = self.queues.get(shape)
+        if q is not None and not q:
+            del self.queues[shape]
+
+    # -- quota --------------------------------------------------------------
+
+    def over_quota(self, demand: dict[str, float]) -> bool:
+        """Would granting ``demand`` exceed any configured cap?"""
+        if not self.quota:
+            return False
+        for k, cap in self.quota.items():
+            if self.usage.get(k, 0.0) + demand.get(k, 0.0) > cap + 1e-9:
+                return True
+        return False
+
+    def charge(self, demand: dict[str, float]) -> None:
+        for k, v in demand.items():
+            if v:
+                self.usage[k] = self.usage.get(k, 0.0) + v
+
+    def credit(self, demand: dict[str, float]) -> None:
+        for k, v in demand.items():
+            if not v:
+                continue
+            left = self.usage.get(k, 0.0) - v
+            if left > 1e-9:
+                self.usage[k] = left
+            else:
+                self.usage.pop(k, None)
+
+    # -- introspection ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Public stats record (tenant_stats op / CLI / dashboard)."""
+        return {
+            "tenant": self.name,
+            "weight": self.weight,
+            "priority": self.priority,
+            "quota": dict(self.quota) if self.quota else None,
+            "usage": dict(self.usage),
+            "queued": self.queued(),
+            "deficit": round(self.deficit, 3),
+            "configured": self.configured,
+            "dispatched": self.stats.get("dispatched", 0),
+            "quota_parked": self.stats.get("quota_parked", 0),
+            "preemptions": self.stats.get("preemptions", 0),
+            "preempted": self.stats.get("preempted", 0),
+        }

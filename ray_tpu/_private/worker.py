@@ -54,6 +54,22 @@ class WorkerAPI:
     def __init__(self):
         self.job_id = JobID.next()
         self.worker_id = WorkerID.from_random()
+        # Tenant identity this process submits under (reference shape: the
+        # job-scoped accounting of the GCS job manager). Derivation order:
+        # explicit RAY_TPU_TENANT, the submitted job's id (the job manager
+        # exports RAY_TPU_JOB_ID into entrypoint subprocesses), else a
+        # per-driver default — every driver is its own tenant until someone
+        # configures shares. Tasks executing on a worker propagate THEIR
+        # spec's tenant to nested submits instead (see _current_tenant).
+        self.tenant = (
+            os.environ.get("RAY_TPU_TENANT")
+            or (
+                "job-" + os.environ["RAY_TPU_JOB_ID"]
+                if os.environ.get("RAY_TPU_JOB_ID")
+                else None
+            )
+            or f"driver-{self.job_id.hex()[:8]}"
+        )
         self._submit_counter = 0
         self._put_counter = 0
         self._counter_lock = threading.Lock()
@@ -74,6 +90,25 @@ class WorkerAPI:
             ref_serializer=self._on_ref_serialized,
             ref_deserializer=self._on_ref_deserialized,
         )
+
+    def _current_tenant(self, override=None) -> str:
+        """Tenant to stamp on a submission: explicit option > the executing
+        task's tenant (nested submits stay in the parent's queue group) >
+        this process's identity."""
+        if override:
+            return str(override)
+        from ray_tpu._private.worker_runtime import current_exec_tenant
+
+        return current_exec_tenant() or self.tenant
+
+    def _current_priority(self, override=None):
+        """Priority to stamp (same inheritance chain as the tenant); None
+        lets the controller apply the tenant's configured default tier."""
+        if override is not None:
+            return int(override)
+        from ray_tpu._private.worker_runtime import current_exec_priority
+
+        return current_exec_priority()
 
     def _next_submit_index(self) -> int:
         """Submission index salted with this worker's identity so concurrent
@@ -169,6 +204,8 @@ class WorkerAPI:
         runtime_env: dict | None = None,
         function_blob: bytes | None = None,
         generator_backpressure: int = 0,
+        tenant: str | None = None,
+        priority: int | None = None,
     ) -> list[ObjectRef]:
         idx = self._next_submit_index()
         task_id = TaskID.for_task(self.job_id, None, idx)
@@ -190,6 +227,8 @@ class WorkerAPI:
             strategy=strategy or SchedulingStrategy(),
             runtime_env=runtime_env,
             generator_backpressure=generator_backpressure,
+            tenant=self._current_tenant(tenant),
+            priority=self._current_priority(priority),
         )
         return_ids = spec.return_ids()
         self.add_refs(return_ids)
@@ -223,6 +262,8 @@ class WorkerAPI:
         is_async: bool,
         strategy: SchedulingStrategy | None = None,
         runtime_env: dict | None = None,
+        tenant: str | None = None,
+        priority: int | None = None,
     ) -> ActorID:
         actor_id = ActorID.from_random()
         task_id = TaskID.for_actor_creation(actor_id)
@@ -242,6 +283,8 @@ class WorkerAPI:
             is_async_actor=is_async,
             strategy=strategy or SchedulingStrategy(),
             runtime_env=runtime_env,
+            tenant=self._current_tenant(tenant),
+            priority=self._current_priority(priority),
         )
         self.add_refs(spec.return_ids())
         self._promote_ref_args(spec)
@@ -279,6 +322,8 @@ class WorkerAPI:
             max_retries=max_retries,
             retry_exceptions=retry_exceptions,
             generator_backpressure=generator_backpressure,
+            tenant=self._current_tenant(),
+            priority=self._current_priority(),
         )
         return_ids = spec.return_ids()
         refs = [ObjectRef(oid) for oid in return_ids]

@@ -55,6 +55,20 @@ def current_actor_id() -> Optional[bytes]:
     return getattr(_exec_ctx, "actor_id", None)
 
 
+def current_exec_tenant() -> Optional[str]:
+    """Tenant of the task executing on THIS thread (None outside task
+    execution). Nested submits inherit it, so a tenant's whole task tree
+    bills to one fair-share queue group — the intra-tenant FIFO interleave
+    the scheduler preserves is meaningless if children land elsewhere."""
+    return getattr(_exec_ctx, "tenant", None)
+
+
+def current_exec_priority() -> Optional[int]:
+    """Priority of the task executing on THIS thread (inherited by nested
+    submits the same way as the tenant)."""
+    return getattr(_exec_ctx, "priority", None)
+
+
 # Actors hosted in THIS process that are eligible for same-process inline
 # execution (sync, max_concurrency=1): actor_id binary -> hosting runtime.
 # The inline fast path (WorkerAPI submit) executes eligible calls on the
@@ -1136,6 +1150,8 @@ class WorkerRuntime:
         prev_name = self.current_task_name
         prev_actor = getattr(_exec_ctx, "actor_id", None)
         prev_mkey = getattr(_exec_ctx, "method_key", None)
+        prev_tenant = getattr(_exec_ctx, "tenant", None)
+        prev_prio = getattr(_exec_ctx, "priority", None)
         try:
             if abin not in self.actors:
                 return None
@@ -1156,6 +1172,8 @@ class WorkerRuntime:
             self.current_task_name = prev_name
             _exec_ctx.actor_id = prev_actor
             _exec_ctx.method_key = prev_mkey
+            _exec_ctx.tenant = prev_tenant
+            _exec_ctx.priority = prev_prio
             lock.release()
 
     def _execute_task(self, msg: P.ExecuteTask):
@@ -1250,6 +1268,9 @@ class WorkerRuntime:
 
     def _invoke(self, spec: TaskSpec, args, kwargs):
         self.current_task_name = spec.name
+        # nested submits from this task inherit its tenant + priority
+        _exec_ctx.tenant = getattr(spec, "tenant", None)
+        _exec_ctx.priority = getattr(spec, "priority", None)
         _exec_ctx.actor_id = (
             spec.actor_id.binary()
             if spec.task_type != TaskType.NORMAL_TASK and spec.actor_id

@@ -183,6 +183,8 @@ class ActorClass:
             is_async=is_async,
             strategy=_strategy_from_options(opts),
             runtime_env=opts.get("runtime_env"),
+            tenant=opts.get("tenant"),
+            priority=opts.get("priority"),
         )
         return ActorHandle(actor_id, self.__name__, self._method_names())
 

@@ -241,8 +241,12 @@ class Autoscaler:
                 self._record_launch(g, self.provider.create_node_group(g))
                 actions["scaled_up"].append(g.name)
 
-        # scale up for unfulfilled demand
-        for shape in state["pending_demand"]:
+        # scale up for unfulfilled demand. Entries are per-tenant
+        # attributed ({"resources": {...}, "tenant": name}) so scale-up
+        # decisions — and the dashboard — can name who is driving them;
+        # the bin-packing itself only consumes the resource shape.
+        for entry in state["pending_demand"]:
+            shape = entry["resources"] if "resources" in entry else entry
             if self._satisfiable(shape, nodes_by_id):
                 continue
             for g in self.config.node_groups:

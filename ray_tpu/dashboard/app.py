@@ -11,6 +11,7 @@ Endpoints:
   /                     the UI
   /api/overview         cluster + store + autoscaler summary
   /api/nodes            node table (incl. Draining/DrainState)
+  /api/tenants          per-tenant shares/quota/usage + demand attribution
   /api/drains           node drain records (graceful downscale status)
   /api/actors           actor table
   /api/workers          worker table
@@ -59,6 +60,7 @@ _PAGE = """<!doctype html>
 <main>
  <div class="cards" id="cards"></div>
  <h2>Nodes</h2><table id="nodes"></table>
+ <h2>Tenants</h2><table id="tenants"></table>
  <h2>Actors</h2><table id="actors"></table>
  <h2>Workers</h2><table id="workers"></table>
  <h2>Task states</h2><table id="tasks"></table>
@@ -90,6 +92,11 @@ async function refresh(){
   cards.push(`<div class="card"><h3>objects</h3><div class="v">${o.store.num_objects??''}</div></div>`);
   document.getElementById('cards').innerHTML=cards.join('');
   table('nodes',await j('/api/nodes'));
+  table('tenants',(await j('/api/tenants')).map(t=>({tenant:t.tenant,
+    weight:t.weight,priority:t.priority,queued:t.queued,
+    quota:JSON.stringify(t.quota||{}),usage:JSON.stringify(t.usage||{}),
+    dispatched:t.dispatched,preempted:t.preempted,
+    demand:(t.pending_demand||[]).map(d=>JSON.stringify(d)).join(' ')})));
   table('actors',(await j('/api/actors')).slice(0,50));
   table('workers',(await j('/api/workers')).slice(0,50));
   const ts=await j('/api/tasks');
@@ -138,6 +145,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(_overview())
             elif path == "/api/nodes":
                 self._json(st.list_nodes())
+            elif path == "/api/tenants":
+                # who holds what and who is driving scale-up demand (the
+                # per-tenant view of the autoscaler's pending_demand)
+                self._json(st.tenant_stats())
             elif path == "/api/drains":
                 # node drain records (the `ray-tpu drain-node` status view);
                 # the node table's Draining/DrainState columns summarize this

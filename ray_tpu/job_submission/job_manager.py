@@ -132,10 +132,13 @@ class JobManager:
         submission_id: Optional[str] = None,
         runtime_env: Optional[dict] = None,
         metadata: Optional[dict] = None,
+        tenant: Optional[str] = None,
     ) -> str:
         job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
         info = JobInfo(job_id, entrypoint)
         info.metadata = metadata or {}
+        if tenant:
+            info.metadata.setdefault("tenant", tenant)
         info.log_path = os.path.join(self._log_dir, f"{job_id}.log")
         with self._lock:
             if job_id in self._jobs:
@@ -143,6 +146,12 @@ class JobManager:
             self._jobs[job_id] = info
         env = dict(os.environ)
         env["RAY_TPU_JOB_ID"] = job_id
+        # tenant identity for everything the entrypoint submits: explicit
+        # tenant wins; otherwise the driver derives "job-<id>" from
+        # RAY_TPU_JOB_ID (see WorkerAPI.__init__) — either way the job's
+        # whole task tree bills to one fair-share queue group
+        if tenant:
+            env["RAY_TPU_TENANT"] = tenant
         rt = runtime_env or {}
         env.update({k: str(v) for k, v in (rt.get("env_vars") or {}).items()})
         cwd = rt.get("working_dir") or os.getcwd()
@@ -303,12 +312,13 @@ class JobSubmissionClient:
         self._manager = _get_manager()
 
     def submit_job(self, *, entrypoint: str, submission_id=None,
-                   runtime_env=None, metadata=None) -> str:
+                   runtime_env=None, metadata=None, tenant=None) -> str:
         return self._manager.submit_job(
             entrypoint=entrypoint,
             submission_id=submission_id,
             runtime_env=runtime_env,
             metadata=metadata,
+            tenant=tenant,
         )
 
     def get_job_status(self, job_id: str) -> JobStatus:

@@ -85,6 +85,8 @@ class RemoteFunction:
             generator_backpressure=opts.get(
                 "_generator_backpressure_num_objects", 0
             ),
+            tenant=opts.get("tenant"),
+            priority=opts.get("priority"),
         )
         if num_returns == "streaming":
             from ray_tpu.object_ref import ObjectRefGenerator

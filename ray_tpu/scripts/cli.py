@@ -2,7 +2,8 @@
 
 Usage: ``python -m ray_tpu.scripts.cli <command> ...``
 
-Commands: status, microbenchmark, timeline, job {submit,list,status,logs,stop}.
+Commands: status, tenants, microbenchmark, timeline,
+job {submit,list,status,logs,stop}.
 Cluster-attached subcommands (status/timeline) start an ephemeral local
 instance when none is running in this process — the CLI is a driver, matching
 how our control plane is driver-hosted.
@@ -131,6 +132,48 @@ def cmd_drain_node(args):
     print(json.dumps(rec, indent=1, default=str))
     if not args.no_wait and rec.get("state") != "drained":
         sys.exit(1)
+
+
+def cmd_tenants(args):
+    """``ray-tpu tenants [set <name> ...]``: show (or configure) the
+    multi-tenant scheduler — fair-share weights, quotas, usage, queue
+    depth, and preemption counters per tenant."""
+    from ray_tpu.util.state.api import set_tenant_quota, tenant_stats
+
+    _ensure_init(args)
+    if args.tenants_cmd == "set":
+        quota = json.loads(args.quota) if args.quota is not None else None
+        rec = set_tenant_quota(
+            args.name, quota=quota, weight=args.weight, priority=args.priority
+        )
+        print(json.dumps(rec, indent=1, default=str))
+        return
+    rows = tenant_stats()
+    if not rows:
+        print("no tenants (nothing submitted yet)")
+        return
+    header = (
+        f"{'TENANT':<24} {'WEIGHT':>6} {'PRIO':>4} {'QUEUED':>6} "
+        f"{'PREEMPT':>8} {'QUOTA':<20} USAGE"
+    )
+    print(header)
+    for r in sorted(rows, key=lambda r: r["tenant"]):
+        quota = (
+            ",".join(f"{k}={v:g}" for k, v in (r["quota"] or {}).items())
+            or "-"
+        )
+        usage = (
+            ",".join(f"{k}={v:g}" for k, v in (r["usage"] or {}).items())
+            or "-"
+        )
+        preempt = f"{r.get('preemptions', 0)}/{r.get('preempted', 0)}"
+        print(
+            f"{r['tenant']:<24} {r['weight']:>6g} {r['priority']:>4} "
+            f"{r['queued']:>6} {preempt:>8} {quota:<20} {usage}"
+        )
+        for d in r.get("pending_demand", ()):
+            shape = ",".join(f"{k}={v:g}" for k, v in d.items())
+            print(f"  demand: {shape}")
 
 
 def cmd_microbenchmark(args):
@@ -379,6 +422,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="initiate and return without polling completion")
     s.add_argument("--num-cpus", type=int, default=4)
     s.set_defaults(fn=cmd_drain_node)
+
+    s = sub.add_parser(
+        "tenants", help="multi-tenant shares/quotas/usage (and `set`)"
+    )
+    tsub = s.add_subparsers(dest="tenants_cmd")
+    tset = tsub.add_parser("set", help="configure one tenant's policy")
+    tset.add_argument("name")
+    tset.add_argument("--weight", type=float, default=None,
+                      help="fair-share weight (DRR)")
+    tset.add_argument("--priority", type=int, default=None,
+                      help="default priority tier (higher may preempt)")
+    tset.add_argument("--quota", default=None,
+                      help='JSON resource caps, e.g. \'{"CPU": 8}\' '
+                           "('{}' clears)")
+    s.add_argument("--num-cpus", type=int, default=4)
+    s.set_defaults(fn=cmd_tenants)
 
     s = sub.add_parser("microbenchmark", help="core throughput suite")
     s.add_argument("--mode", default="thread", choices=["thread", "process"])

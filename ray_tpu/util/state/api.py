@@ -59,6 +59,30 @@ def drain_status(node_id: Optional[str] = None):
     return _call("drain_status", node_id)
 
 
+def tenant_stats() -> list[dict]:
+    """Per-tenant arbitration state from the controller's scheduling core:
+    fair-share weight, priority tier, quota + current usage, queue depth,
+    DRR deficit, dispatch/park/preemption counters, and the pending
+    autoscale demand shapes the tenant is driving (reference shape: the
+    job manager + autoscaler demand accounting, per job)."""
+    return _call("tenant_stats") or []
+
+
+def set_tenant_quota(
+    tenant: str,
+    quota: Optional[dict] = None,
+    weight: Optional[float] = None,
+    priority: Optional[int] = None,
+) -> dict:
+    """Configure a tenant's quotas/shares/priority. ``quota`` is a
+    per-resource cap dict (``{}`` clears, None leaves unchanged) enforced
+    at lease grant — over-quota work parks and resumes when the cap is
+    raised; ``weight`` is the fair-share weight of the deficit-round-robin
+    pop; ``priority`` is the default preemption tier for the tenant's
+    specs. Returns the tenant's updated stats record."""
+    return _call("set_tenant_quota", (tenant, quota, weight, priority))
+
+
 def transfer_stats() -> dict:
     """Cross-node object-transfer counters from the head (chunks served,
     arena pulls, replica registrations/promotions/evictions; reference:

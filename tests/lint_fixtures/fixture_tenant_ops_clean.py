@@ -1,0 +1,84 @@
+"""Clean fixture: the tenant arbitration protocol done right.
+
+Correct op names, a ``set_tenant_quota`` payload matching the handler's
+4-field unpack, a guarded use of the maybe-empty ``tenant_stats`` reply
+(never an unguarded subscript), a bounded reply wait, raise→error-reply
+conversion at the dispatch site, a declared op catalog matching the
+ladder, and the audit log credited through try/finally — zero findings
+across every family.
+"""
+
+import threading
+
+# mirrors the dispatch ladder below; wire-conformance cross-checks it
+CONTROLLER_OPS = frozenset({"set_tenant_quota", "tenant_stats"})
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    def __init__(self):
+        self._tenants = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "set_tenant_quota":
+            tenant, quota, weight, priority = payload
+            self._tenants[tenant] = (quota, weight, priority)
+            return dict(quota or {})
+        if op == "tenant_stats":
+            return [
+                {"tenant": t, "quota": q, "weight": w, "priority": p}
+                for t, (q, w, p) in self._tenants.items()
+            ]
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class Admin:
+    def __init__(self, conn):
+        self._conn = conn
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def stats(self):
+        rows = self.call_controller("tenant_stats")
+        # guarded consumption: the reply may be an empty list
+        return {row["tenant"]: row for row in rows} if rows else {}
+
+    def set_quota(self, tenant, quota, weight, priority):
+        return self.call_controller(
+            "set_tenant_quota", (tenant, quota, weight, priority)
+        )
+
+    def apply_policy(self, change):
+        """The per-change audit log is released on EVERY path — a raising
+        quota validation unwinds through the finally."""
+        log = open(change.audit_path, "ab")  # noqa: SIM115 — fixture shape
+        try:
+            log.write(b"quota change requested\n")
+            validate_quota(change)
+        finally:
+            log.close()
+
+
+def validate_quota(change) -> None:
+    if any(v < 0 for v in change.quota.values()):
+        raise ValueError("negative resource cap")

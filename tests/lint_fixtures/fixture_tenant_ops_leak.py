@@ -1,0 +1,95 @@
+"""Self-detection fixture: the tenant arbitration protocol done WRONG.
+
+The PR 11 growth shape — the tenant ops (``set_tenant_quota`` /
+``tenant_stats``) are sent from admin tooling modules far from the
+controller's dispatch ladder, so a typo'd op or a payload-arity drift
+ships clean and only surfaces as a runtime error reply (a quota that
+silently never applies); and the quota-audit path stages a per-change log
+that an exception strands. tpulint must flag:
+
+- wire-conformance: the misspelled ``tenant_statz`` query (did-you-mean)
+  and the 3-tuple ``set_tenant_quota`` payload against the handler's
+  4-field unpack (priority missing);
+- ref-lifecycle: the audit log handle leaked when quota validation
+  raises (leak-on-raise in the admin path).
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import threading
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    """Dispatch surface for the tenant arbitration ops."""
+
+    def __init__(self):
+        self._tenants = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "set_tenant_quota":
+            tenant, quota, weight, priority = payload
+            self._tenants[tenant] = (quota, weight, priority)
+            return dict(quota or {})
+        if op == "tenant_stats":
+            return [
+                {"tenant": t, "quota": q, "weight": w, "priority": p}
+                for t, (q, w, p) in self._tenants.items()
+            ]
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class Admin:
+    """Tenant-policy client with the protocol bugs under test."""
+
+    def __init__(self, conn):
+        self._conn = conn
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def stats(self):
+        # BUG: "tenant_statz" — no handler branch matches; the dashboard's
+        # tenant table dies as an unknown-op error reply
+        return self.call_controller("tenant_statz")
+
+    def set_quota(self, tenant, quota, weight):
+        # BUG: 3-tuple payload vs the handler's 4-field unpack (priority
+        # missing) — ValueError at dispatch, the quota silently never lands
+        return self.call_controller(
+            "set_tenant_quota", (tenant, quota, weight)
+        )
+
+    def apply_policy(self, change):
+        """Leak-on-raise in the admin path: the per-change audit log is
+        open while validate_quota() can raise — no handler, no finally,
+        the handle (and its fd) strands with the rejected change."""
+        log = open(change.audit_path, "ab")  # noqa: SIM115 — fixture shape
+        log.write(b"quota change requested\n")
+        validate_quota(change)
+        log.close()
+
+
+def validate_quota(change) -> None:
+    if any(v < 0 for v in change.quota.values()):
+        raise ValueError("negative resource cap")

@@ -60,7 +60,9 @@ class FakeAgent:
         ack = self.conn.recv()
         assert isinstance(ack, P.AgentAck)
         self.leases: list = []  # received P.LeaseActor messages
+        self.task_leases: list = []  # received P.LeaseTask messages
         self.worker_msgs: list = []  # (worker_id, msg) from ToWorker
+        self.killed: list = []  # worker ids from KillWorker requests
         self.echo_tasks = True  # auto-answer relayed ExecuteTask
         self.closed = False
         self._ser = SerializationContext()
@@ -88,6 +90,26 @@ class FakeAgent:
                     self._reply_cv.notify_all()
             elif isinstance(msg, P.LeaseActor):
                 self.leases.append(msg)
+            elif isinstance(msg, P.LeaseTask):
+                # a real agent runs the leased task and reports done; the
+                # scripted agent completes it instantly with None results
+                self.task_leases.append(msg)
+                if self.echo_tasks:
+                    self._send(
+                        P.AgentTaskDone(
+                            msg.spec.task_id,
+                            self._none_results(msg.spec),
+                            exec_ms=0.1,
+                        )
+                    )
+            elif isinstance(msg, P.KillWorker):
+                # a real agent kills the process and reports the death —
+                # the scripted worker "dies" instantly (drain migration and
+                # preemption both complete through this notification)
+                self.killed.append(msg.worker_id)
+                self._send(
+                    P.WorkerDied(msg.worker_id, "killed by agent")
+                )
             elif isinstance(msg, P.ToWorker):
                 self.worker_msgs.append((msg.worker_id, msg.msg))
                 if self.echo_tasks and isinstance(msg.msg, P.ExecuteTask):

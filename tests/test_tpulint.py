@@ -1153,6 +1153,37 @@ def test_fixture_actor_lease_clean_has_zero_findings():
     assert findings == [], [f.render() for f in findings]
 
 
+def test_fixture_tenant_ops_leak_flagged():
+    """The PR 11 tenant-protocol shape done wrong: a typo'd tenant_stats
+    query, a set_tenant_quota payload one field short of the handler
+    unpack, and the admin path stranding the quota-audit log handle when
+    validation raises."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_tenant_ops_leak.py")]
+    )
+    wire = _by_check(findings).get("wire-conformance", [])
+    assert len(wire) == 2, [f.render() for f in findings]
+    typo = next(h for h in wire if "tenant_statz" in h.message)
+    assert 'did you mean "tenant_stats"' in typo.message
+    arity = next(h for h in wire if "set_tenant_quota" in h.message)
+    assert "3-tuple" in arity.message and "4 fields" in arity.message
+    assert arity.qualname.endswith("Admin.set_quota")
+    life = _by_check(findings).get("ref-lifecycle", [])
+    assert len(life) == 1, [f.render() for f in findings]
+    assert life[0].qualname.endswith("Admin.apply_policy")
+    assert "leaks when" in life[0].message
+
+
+def test_fixture_tenant_ops_clean_has_zero_findings():
+    """Same tenant-protocol shapes done right (matching ops/arities,
+    guarded maybe-empty stats reply, finally-credited audit log, declared
+    op set in sync): zero findings across every family."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_tenant_ops_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_protocol_doc_is_current_and_covers_controller_ops():
     """docs/PROTOCOL.md matches a fresh render of the extracted catalog and
     names every controller op + the agent data-plane surface."""
@@ -1317,6 +1348,7 @@ def test_cli_exits_nonzero_on_fixtures():
         "fixture_wire_arity.py",
         "fixture_wire_none_reply.py",
         "fixture_actor_lease_leak.py",
+        "fixture_tenant_ops_leak.py",
     ):
         proc = subprocess.run(
             [
