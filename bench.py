@@ -737,6 +737,7 @@ def check_floor(max_regress: float = 0.25) -> int:
 
     failures = []
     out = {}
+    load_scales = {}
     for mode in ("thread", "process"):
         ray_tpu.init(num_cpus=4, mode=mode)
         a = warm_sync_actor()
@@ -747,6 +748,7 @@ def check_floor(max_regress: float = 0.25) -> int:
         put_rate = timed_call_rate(lambda: ray_tpu.put(payload), secs=0.5)
         ray_tpu.shutdown()
         load_scale = min(1.0, put_rate / recorded_rate(mode, "single client put (small)"))
+        load_scales[mode] = load_scale
         floor = recorded_rate(mode) * (1.0 - max_regress) * load_scale
         out[mode] = {
             "rate_per_s": round(rate, 1),
@@ -757,6 +759,63 @@ def check_floor(max_regress: float = 0.25) -> int:
         }
         if rate < floor:
             failures.append(mode)
+
+    # --- scalability-envelope floor (ISSUE 12 satellite): a future PR
+    # regressing control-plane submit or actor-creation throughput fails
+    # HERE, load-calibrated by the same put-rate scale as the call floors.
+    # Quick probes (5k submits, 200 actors), compared against the recorded
+    # envelope rows with an extra 2x allowance for the probe being smaller
+    # and colder than the recorded full runs.
+    env_rows = {r["name"]: r for r in recorded.get("envelope", [])}
+    rec_submit = env_rows.get("queued tasks depth 5000", {}).get("submit_per_s")
+    rec_actors = next(
+        (r["actors_per_s"] for r in recorded.get("envelope", [])
+         if r["name"].endswith("actors create+call")),
+        None,
+    )
+    if rec_submit and rec_actors:
+        import time as _time
+
+        load_scale = load_scales.get("thread", 1.0)
+        ray_tpu.init(num_cpus=8, mode="thread")
+
+        @ray_tpu.remote(num_cpus=0)
+        def _tick(i):
+            return i
+
+        ray_tpu.get([_tick.remote(i) for i in range(200)], timeout=120)  # warm
+        t0 = _time.perf_counter()
+        refs = [_tick.remote(i) for i in range(5_000)]
+        submit_rate = 5_000 / (_time.perf_counter() - t0)
+        ray_tpu.get(refs, timeout=600)
+
+        @ray_tpu.remote(num_cpus=0)
+        class _Unit:
+            def ping(self):
+                return 1
+
+        n_act = 200
+        t0 = _time.perf_counter()
+        actors = [_Unit.remote() for _ in range(n_act)]
+        arefs = [a.ping.remote() for a in actors]
+        assert sum(ray_tpu.get(arefs, timeout=600)) == n_act
+        actor_rate = n_act / (_time.perf_counter() - t0)
+        ray_tpu.shutdown()
+
+        for name, rate, rec in (
+            ("envelope_submit", submit_rate, rec_submit),
+            ("envelope_actors", actor_rate, rec_actors),
+        ):
+            floor = rec * (1.0 - max_regress) * load_scale / 2.0
+            out[name] = {
+                "rate_per_s": round(rate, 1),
+                "recorded_per_s": round(rec, 1),
+                "load_scale": round(load_scale, 3),
+                "floor_per_s": round(floor, 1),
+                "ok": rate >= floor,
+            }
+            if rate < floor:
+                failures.append(name)
     print(json.dumps({"check_floor": out, "failed": failures}))
     return 1 if failures else 0
 

@@ -116,6 +116,29 @@ class NodeAgent:
             int.from_bytes(self.node_id.binary()[:4], "little")
         )
 
+        # Batched completion reports (PR 12): AgentTaskDone frames queue
+        # here and coalesce per flush tick into ONE AgentReportBatch — a
+        # steady-state node completing hundreds of short leases per second
+        # pays one wire frame per tick, not one per task. Window knob:
+        # RAY_TPU_AGENT_REPORT_FLUSH_MS (config agent_report_flush_ms);
+        # 0 restores a frame per completion. Resolved BEFORE the spawner
+        # (its actor_placed_batch coalescer shares the window).
+        from ray_tpu._private.config import get_config as _get_config
+
+        try:
+            _report_ms = float(
+                os.environ.get(
+                    "RAY_TPU_AGENT_REPORT_FLUSH_MS",
+                    _get_config().agent_report_flush_ms,
+                )
+            )
+        except (TypeError, ValueError):
+            _report_ms = 2.0
+        self._report_window_s = max(0.0, _report_ms) / 1000.0
+        self._report_queue: list = []
+        self._report_lock = threading.Lock()
+        self._report_wake = threading.Event()
+
         # Actor creation leases (reference: the raylet side of
         # GcsActorScheduler's lease protocol): the spawner owns worker
         # acquisition, the registration handshake, creation dispatch, and
@@ -162,6 +185,7 @@ class NodeAgent:
         self._reply_cv = locktrace.register_lock(
             "agent.reply_cv", threading.Condition()
         )
+
 
         # Node-local object lifecycle: seal order for LRU spilling when the
         # arena fills (the agent owns its data plane's spilling the way the
@@ -254,6 +278,9 @@ class NodeAgent:
         ).start()
         threading.Thread(
             target=self._pump_loop, daemon=True, name="agent-pump"
+        ).start()
+        threading.Thread(
+            target=self._report_flush_loop, daemon=True, name="agent-report"
         ).start()
         # Worker log capture: spawned workers write per-worker files under
         # logs/; this monitor tails them and streams new lines to the head,
@@ -399,6 +426,13 @@ class NodeAgent:
             time.sleep(1.0)
         return False
 
+    def _drop_queued_reports(self):
+        """Reconnect reset: queued reports reference the old head's lease
+        state — the new incarnation re-places everything, so they must not
+        be delivered."""
+        with self._report_lock:
+            self._report_queue.clear()
+
     def _reset_local_state(self):
         """Tear down workers + data plane for a clean re-registration."""
         from ray_tpu._private.object_store import NativePlasmaStore
@@ -408,6 +442,7 @@ class NodeAgent:
         # head-side lease state died with the old head: no stale report
         # must reach the new incarnation (it re-places restorable actors)
         self.actor_spawner.reset()
+        self._drop_queued_reports()
         with self.workers_lock:
             workers = list(self.workers.values())
             self.workers.clear()
@@ -471,6 +506,14 @@ class NodeAgent:
             ).start()
         elif isinstance(msg, P.LeaseTask):
             self._on_lease_task(msg)
+        elif isinstance(msg, P.LeaseBatch):
+            # one frame, N grants (the head's per-round outbox): unpack
+            # FIFO so per-agent grant ordering matches N single pushes
+            for lease in msg.leases:
+                if isinstance(lease, P.LeaseActor):
+                    self.actor_spawner.on_lease(lease)
+                else:
+                    self._on_lease_task(lease)
         elif isinstance(msg, P.LeaseActor):
             # actor creation lease: the spawner owns the whole local
             # lifecycle (runs on its own thread — never block this loop,
@@ -546,7 +589,9 @@ class NodeAgent:
             if remaining == 0 or time.monotonic() > deadline:
                 break
             time.sleep(0.1)
-        # flush: captured worker output must reach the head before release
+        # flush: coalesced completion reports and captured worker output
+        # must reach the head before release
+        self._flush_reports()
         try:
             self._log_monitor_scan()
         except Exception:  # noqa: BLE001
@@ -748,11 +793,48 @@ class NodeAgent:
             if fp is not None:
                 self._fp_idle.setdefault(fp, []).append(wid)
                 self._pump_local_locked()
-        try:
-            self._send(P.AgentTaskDone(msg.task_id, msg.results, msg.exec_ms))
-        except (OSError, EOFError):
-            pass
+        self._queue_report(P.AgentTaskDone(msg.task_id, msg.results, msg.exec_ms))
         return True
+
+    def _queue_report(self, report: "P.AgentTaskDone") -> None:
+        """Coalesce a completion report into the per-tick batch (0-window
+        config sends it immediately — the pre-batching behavior)."""
+        if self._report_window_s <= 0:
+            try:
+                self._send(report)
+            except (OSError, EOFError):
+                pass
+            return
+        with self._report_lock:
+            self._report_queue.append(report)
+        self._report_wake.set()
+
+    def _flush_reports(self) -> None:
+        with self._report_lock:
+            batch, self._report_queue = self._report_queue, []
+        if not batch:
+            return
+        try:
+            if len(batch) == 1:
+                self._send(batch[0])
+            else:
+                self._send(P.AgentReportBatch(batch))
+        except (OSError, EOFError):
+            # conn mid-reconnect: these reports reference the OLD head
+            # incarnation's lease state — the reconnect reset re-places
+            # everything, so dropping them is the correct outcome
+            pass
+
+    def _report_flush_loop(self):
+        while not self.shutting_down:
+            self._report_wake.wait(timeout=0.5)
+            self._report_wake.clear()
+            if self._report_window_s:
+                # coalescing beat: completions arrive in bursts on busy
+                # nodes; one breath batches the burst into a single frame
+                time.sleep(self._report_window_s)
+            self._flush_reports()
+        self._flush_reports()
 
     def _on_local_worker_death(self, wid: WorkerID):
         """Spill this worker's in-flight leased tasks back to the head."""
@@ -1482,6 +1564,7 @@ class NodeAgent:
         self.shutting_down = True
         # wake lease-spawn waiters; in-flight creations die with the agent
         self.actor_spawner.reset()
+        self.actor_spawner.close()
         # release pull-into-arena followers before tearing the store down
         with self._pulls_lock:
             pulls, self._pulls = self._pulls, {}

@@ -90,6 +90,26 @@ class Config:
     # per-dispatch round trip (reference: max_tasks_in_flight_per_worker in
     # the direct task submitter, normal_task_submitter.h:79). 1 disables.
     max_tasks_in_flight_per_worker: int = 4
+    # --- control-plane batching (PR 12: batched wire ops) ---
+    # Client-side submit coalescer: task submissions (and the add_ref/free
+    # traffic that used to cost one fire-and-forget request each) buffer for
+    # up to this many milliseconds — or until ``submit_batch_max`` items —
+    # then ride ONE ``submit_batch`` request. Any synchronous controller
+    # call flushes the buffer first, so program-order visibility and get()
+    # latency are preserved. 0 disables coalescing (every submit is its own
+    # request, the pre-batching wire behavior).
+    submit_batch_window_ms: float = 2.0
+    submit_batch_max: int = 256
+    # Agent-side lease caching: a node's done-report may immediately re-arm
+    # it with the next queued spec of the same (tenant, shape), skipping the
+    # scheduler-wake grant round trip. The head still enforces quotas and
+    # cross-tenant fairness at re-arm (a re-arm is refused like an
+    # over-quota grant).
+    agent_lease_cache: bool = True
+    # Agent completion reports coalesce for up to this many milliseconds
+    # into one AgentReportBatch frame (0 = report per task, pre-batching
+    # behavior).
+    agent_report_flush_ms: float = 2.0
     # --- object store ---
     # Objects <= this many bytes are returned inline through the control plane
     # (reference: max_direct_call_object_size, ray_config_def.h).

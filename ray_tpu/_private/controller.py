@@ -59,6 +59,42 @@ from ray_tpu.exceptions import (
 
 logger = logging.getLogger(__name__)
 
+# ---- dispatch shard tables -------------------------------------------------
+#
+# Which subsystem shard handles each request op (see
+# ``Controller._dispatch_request``). The union MUST equal
+# ``protocol.CONTROLLER_OPS`` — asserted at controller init; the lint gate's
+# wire-conformance family separately keeps CONTROLLER_OPS in sync with the
+# shard ladders themselves.
+
+TASK_SHARD_OPS = frozenset({
+    "submit_task", "submit_batch", "cancel", "tasks_pending", "task_events",
+    "list_tasks", "debug_worker_msg_count",
+})
+ACTOR_SHARD_OPS = frozenset({
+    "actor_direct_endpoint", "get_named_actor", "actor_state", "kill_actor",
+    "list_actors", "actor_placed", "actor_placed_batch",
+    "actor_creation_failed", "actor_creation_stats",
+})
+OBJECT_SHARD_OPS = frozenset({
+    "add_ref", "wait", "shm_create", "push_object_chunk",
+    "pull_object_chunk", "pull_into_arena", "object_locations",
+    "register_replica", "unregister_replica", "transfer_stats",
+    "report_agent_spill", "testing_lose_object", "stream_consumed_report",
+    "stream_abandoned", "stream_consumed_get", "list_objects", "head_arena",
+})
+NODE_SHARD_OPS = frozenset({
+    "add_node", "remove_node", "drain_node", "drain_status", "nodes",
+    "cluster_resources", "available_resources", "autoscaler_state",
+    "list_workers", "pg_create", "pg_ready", "pg_remove", "pg_table",
+    "list_placement_groups", "set_tenant_quota", "tenant_stats",
+})
+KV_SHARD_OPS = frozenset({"kv_put", "kv_get", "kv_del", "kv_keys"})
+OBSERVE_SHARD_OPS = frozenset({
+    "log_get", "log_list", "log_tail_buffer", "pubsub_poll",
+    "pubsub_publish", "worker_stacks",
+})
+
 
 class NodeState:
     def __init__(self, node_id: NodeID, resources: dict[str, float], labels=None):
@@ -297,7 +333,12 @@ class Controller:
     def __init__(self, config: Config, head_resources: dict[str, float], mode: str = "process"):
         self.config = config
         self.mode = mode
-        self.lock = locktrace.register_lock("controller.lock", threading.RLock())
+        # Core scheduler/cluster-state lock. Registered as a SUBSYSTEM lock:
+        # the sharded dispatch tables give some subsystems (KV) their own
+        # lock, and locktrace asserts at runtime that no thread ever holds
+        # two subsystem locks at once — the invariant that keeps the split
+        # deadlock-free (cross-subsystem work sequences, never nests).
+        self.lock = locktrace.subsystem_lock("controller.lock", threading.RLock())
         self.shutting_down = False
         # A shared cluster token derives a stable authkey so agents/drivers
         # on other hosts can join without the head's session file.
@@ -460,6 +501,16 @@ class Controller:
         # tests pin "the head never runs a spawn thread for an agent-node
         # actor" through these counters instead of timing/threads
         self.actor_creation_stats: dict[str, int] = defaultdict(int)
+        # Batched lease-grant outbox (guarded by self.lock): grants queued
+        # during one scheduling round coalesce into ONE LeaseBatch push per
+        # agent at round end instead of a wire frame per lease. Flush
+        # failure (conn death / injected "lease_batch" chaos) requeues
+        # every lease the batch carried — grants are idempotent leases, so
+        # re-granting later is safe.
+        self._lease_outbox: dict[NodeID, tuple] = {}  # nid -> (agent, [msgs])
+        # lease-cache / batching observability: rearm_grants,
+        # rearm_refused_{quota,fairness}, lease_batches, leases_batched
+        self.lease_stats: dict[str, int] = defaultdict(int)
         # worker ids that died recently: an actor_placed report racing the
         # worker's own death notification must not bind the actor to a
         # corpse (bounded ring; see the actor_placed handler)
@@ -516,6 +567,17 @@ class Controller:
         self._kv_write_lock = locktrace.register_lock(
             "controller.kv_write_lock", threading.Lock()
         )
+        # KV subsystem lock: the KV table is self-contained state, so its
+        # ops no longer serialize behind the scheduler/object-ref churn on
+        # the core lock (sharded dispatch). Subsystem-registered: holding it
+        # together with controller.lock raises (see locktrace.subsystem_lock).
+        self._kv_lock = locktrace.subsystem_lock(
+            "controller.kv", threading.RLock()
+        )
+        # guards only the lazy flusher-thread start (deliberately NOT a
+        # subsystem lock: _persist_kv runs both under the core lock and
+        # under the KV lock)
+        self._kv_flusher_start_lock = threading.Lock()
         self._boot_snapshot = None
         if self._kv_snapshot_path and os.path.exists(self._kv_snapshot_path):
             try:
@@ -598,6 +660,29 @@ class Controller:
 
         self.serialization = SerializationContext()
         self._reply_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="ctrl-reply")
+
+        # Sharded request dispatch: op -> bound subsystem shard (see
+        # _dispatch_request). Built once; the init-time assert catches an op
+        # added to a shard ladder + CONTROLLER_OPS but forgotten here (the
+        # lint gate covers ladder<->CONTROLLER_OPS drift, this covers
+        # table<->ladder drift).
+        self._dispatch_table: dict[str, Any] = {}
+        for shard_ops, shard_fn in (
+            (TASK_SHARD_OPS, self._dispatch_task_ops),
+            (ACTOR_SHARD_OPS, self._dispatch_actor_ops),
+            (OBJECT_SHARD_OPS, self._dispatch_object_ops),
+            (NODE_SHARD_OPS, self._dispatch_node_ops),
+            (KV_SHARD_OPS, self._dispatch_kv_ops),
+            (OBSERVE_SHARD_OPS, self._dispatch_observe_ops),
+        ):
+            for op_name in shard_ops:
+                self._dispatch_table[op_name] = shard_fn
+        if set(self._dispatch_table) != set(P.CONTROLLER_OPS):
+            raise AssertionError(
+                "dispatch shard tables drifted from protocol.CONTROLLER_OPS: "
+                f"missing={sorted(set(P.CONTROLLER_OPS) - set(self._dispatch_table))} "
+                f"extra={sorted(set(self._dispatch_table) - set(P.CONTROLLER_OPS))}"
+            )
 
         # OOM protection (reference: memory_monitor.h + worker_killing_policy)
         self.memory_monitor = None
@@ -849,11 +934,14 @@ class Controller:
     def _persist_kv(self):
         """Mark controller state dirty; a background flusher writes the
         snapshot (inline per-put writes would be O(table) on every
-        connection thread and racy on the shared tmp path)."""
+        connection thread and racy on the shared tmp path). The flusher
+        start is guarded by its own tiny lock — callers arrive holding the
+        core lock OR the KV subsystem lock, and this path must not nest a
+        second subsystem lock."""
         if not self._kv_snapshot_path:
             return
         self._kv_dirty.set()
-        with self.lock:
+        with self._kv_flusher_start_lock:
             if self._kv_flusher is None:
                 self._kv_flusher = threading.Thread(
                     target=self._kv_flush_loop, daemon=True, name="gcs-flusher"
@@ -873,7 +961,12 @@ class Controller:
           population; anonymous actors fate-share with their owner
         - placement groups (bundles + strategy; placement is recomputed)
         - pending normal-task specs (queued work drains after a restart)
+
+        The KV table copies under ITS subsystem lock first — the core lock
+        and the KV lock must never be held together (locktrace asserts it).
         """
+        with self._kv_lock:
+            kv_copy = dict(self.kv)
         with self.lock:
             actors = [
                 {
@@ -926,7 +1019,7 @@ class Controller:
             ]
             return {
                 "version": 2,
-                "kv": dict(self.kv),
+                "kv": kv_copy,
                 "actors": actors,
                 "placement_groups": pgs,
                 "pending_tasks": pending,
@@ -2270,28 +2363,121 @@ class Controller:
 
     def submit_task(self, spec: TaskSpec):
         self._validate_runtime_env(spec)
-        deps = {a[1] for a in spec.args if a[0] == "ref"}
-        pt = PendingTask(spec, deps)
         self._record_lineage(spec)
         with self.lock:
-            self.pending_by_id[spec.task_id] = pt
-            # Pin deps for the task's lifetime.
-            for d in pt.all_deps:
-                self.ref_counts[d] += 1
-            if spec.task_type == TaskType.ACTOR_TASK:
-                self._submit_actor_task(pt)
-                self._persist_state()
-                return
-            unresolved = {d for d in pt.unresolved if not self.memory_store.contains(d)}
-            pt.unresolved = unresolved
-            if unresolved:
-                for d in unresolved:
-                    self.waiting_on_deps[d].append(pt)
-                # a dep may be LOST (not merely pending) — kick recovery
-                self._maybe_recover(unresolved)
-            else:
-                self._enqueue_ready(pt)
+            self._submit_one_locked(spec)
             self.sched_cv.notify_all()
+        self._persist_state()
+
+    def _submit_one_locked(self, spec: TaskSpec):
+        """Enqueue one validated spec (call under ``self.lock``). The caller
+        owns validation/lineage (outside the lock), the scheduler wake, and
+        the persist — so a coalesced batch pays ONE lock hold and ONE wake
+        for N specs instead of N of each (see ``submit_batch``)."""
+        deps = {a[1] for a in spec.args if a[0] == "ref"}
+        pt = PendingTask(spec, deps)
+        self.pending_by_id[spec.task_id] = pt
+        # Pin deps for the task's lifetime.
+        for d in pt.all_deps:
+            self.ref_counts[d] += 1
+        if spec.task_type == TaskType.ACTOR_TASK:
+            self._submit_actor_task(pt)
+            return
+        unresolved = {d for d in pt.unresolved if not self.memory_store.contains(d)}
+        pt.unresolved = unresolved
+        if unresolved:
+            for d in unresolved:
+                self.waiting_on_deps[d].append(pt)
+            # a dep may be LOST (not merely pending) — kick recovery
+            self._maybe_recover(unresolved)
+        else:
+            self._enqueue_ready(pt)
+
+    def submit_batch(self, items: list, caller=None):
+        """Apply one client-coalesced control batch in FIFO order. Items:
+        ``("submit", spec, actor_name)`` | ``("add_ref", [oid, ...])`` |
+        ``("free", [oid, ...])``.
+
+        This is the head's half of the client-side submit coalescer: one
+        ``Request`` carries N submissions plus the ref traffic that used to
+        cost a fire-and-forget request per submit, and the whole batch is
+        applied under ONE lock hold with ONE scheduler wake (the batched
+        drain replacing one wake per spec).
+
+        Replay-safe: chaos injection (``testing_rpc_failure`` /
+        ``RAY_TPU_WORKER_RPC_FAILURE``) fails the request BEFORE any item
+        applies, so a client retries the identical batch; specs already
+        pending or completed are skipped (no double-dispatch, no lost
+        spec). Per-item submission errors seal error results onto the
+        spec's return ids — an async submission's failure surfaces at
+        ``get()`` without poisoning the rest of the batch."""
+        prepared: list = []
+        failed: list = []  # (PendingTask, exception) — sealed after apply
+
+        def _fail_item(spec, exc):
+            # empty dep set: these specs never pinned args, so _fail_task
+            # must not unpin anything
+            failed.append((PendingTask(spec, set()), exc))
+
+        for item in items:
+            if item[0] != "submit":
+                prepared.append(item)
+                continue
+            spec = item[1]
+            try:
+                self._validate_runtime_env(spec)
+            except Exception as e:  # noqa: BLE001 — sealed onto the returns
+                _fail_item(spec, e)
+                continue
+            self._record_lineage(spec)
+            prepared.append(item)
+        frees: list = []
+        with self.lock:
+            for item in prepared:
+                kind = item[0]
+                if kind == "add_ref":
+                    for oid in item[1]:
+                        self.ref_counts[oid] += 1
+                elif kind == "free":
+                    # applied after the lock drops: a free can cascade into
+                    # store/agent I/O that must not ride the batch hold
+                    frees.extend(item[1])
+                elif kind == "submit":
+                    spec, name = item[1], item[2]
+                    rets = spec.return_ids()
+                    if spec.task_id in self.pending_by_id or (
+                        rets and self.memory_store.contains(rets[0])
+                    ):
+                        continue  # idempotent replay of an applied batch
+                    if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                        if spec.actor_id in self.actors:
+                            continue  # replayed creation
+                        if name and name in self.named_actors:
+                            _fail_item(
+                                spec,
+                                ValueError(f"actor name {name!r} already taken"),
+                            )
+                            continue
+                        actor = ActorState(spec.actor_id, spec)
+                        actor.name = name
+                        self.actors[spec.actor_id] = actor
+                        if name:
+                            self.named_actors[name] = spec.actor_id
+                    # return-id refs fold into the batch apply: the client
+                    # no longer pays a separate add_ref request per submit
+                    for oid in rets:
+                        self.ref_counts[oid] += 1
+                    self._submit_one_locked(spec)
+                else:
+                    logger.error("submit_batch: unknown item kind %r", kind)
+            self.sched_cv.notify_all()
+        for oid in frees:
+            self.remove_ref(oid)
+        for pt, exc in failed:
+            with self.lock:
+                for oid in pt.spec.return_ids():
+                    self.ref_counts[oid] += 1
+            self._fail_task(pt, exc)
         self._persist_state()
 
     # -------------------------------------------------- lineage reconstruction
@@ -2409,24 +2595,9 @@ class Controller:
     def _tenant_contending(
         ts: "tenants_mod.TenantState", against: dict
     ) -> bool:
-        """Does this tenant have queued work that could take the capacity
-        an ``against``-shaped lease holds RIGHT NOW? A shape contends only
-        when (a) its demand overlaps the lease's resource keys (yielding
-        CPU slots frees nothing for a TPU-only backlog), (b) it demands
-        anything at all (zero-resource work always places), and (c) that
-        demand clears the tenant's own quota. (Call under lock. Each shape
-        key carries its resource tuple at index 1, and every task in a
-        shape queue shares it, so no task access is needed.)"""
-        for shape in ts.queues:
-            demand = dict(shape[1])
-            if not demand:
-                continue
-            if against and not (demand.keys() & against.keys()):
-                continue
-            if ts.quota and ts.over_quota(demand):
-                continue
-            return True
-        return False
+        """Delegates to ``TenantState.contending_for`` — the shared
+        fairness gate of pipelining and the lease-cache re-arm."""
+        return ts.contending_for(against)
 
     def set_tenant_quota(
         self,
@@ -2544,6 +2715,9 @@ class Controller:
                     # restartable actors (checked every round — other
                     # tenants progressing must not mask the starvation).
                     self._maybe_preempt_locked()
+                    # one LeaseBatch push per agent carrying every grant
+                    # this round made (batched wire ops, PR 12)
+                    self._flush_lease_outbox_locked()
                 except Exception:
                     # The scheduler thread must never die; a scheduling bug on
                     # one task must not freeze the cluster.
@@ -2829,17 +3003,18 @@ class Controller:
             return True  # consumed (failed), not requeued
         demand = spec.resources
         pg_bundle = getattr(pt, "_pg_bundle", None)
-        try:
-            node.agent.send(
-                P.LeaseTask(
-                    spec,
-                    resolved_args,
-                    bool(spec.resources.get("TPU")),
-                    dict((spec.runtime_env or {}).get("env_vars") or {}),
-                )
-            )
-        except (OSError, EOFError):
-            return False  # agent gone; heartbeat monitor will remove the node
+        # queued, not sent: the scheduling round's grants for this agent
+        # coalesce into one LeaseBatch push at round end (flush failure
+        # requeues the lease — see _flush_lease_outbox_locked)
+        self._queue_lease_locked(
+            node,
+            P.LeaseTask(
+                spec,
+                resolved_args,
+                bool(spec.resources.get("TPU")),
+                dict((spec.runtime_env or {}).get("env_vars") or {}),
+            ),
+        )
         if pg_bundle is not None:
             pg, i = pg_bundle
             for k, v in demand.items():
@@ -2890,19 +3065,20 @@ class Controller:
         # miss the pool and silently defeat the warm pop path
         env_vars = dict(rt.get("env_vars") or {})
         env_vars.update(extra_env)
-        try:
-            node.agent.send(
-                P.LeaseActor(
-                    spec,
-                    resolved_args,
-                    bool(spec.resources.get("TPU")),
-                    env_vars,
-                    self._env_fingerprint(spec),
-                    packages,
-                )
-            )
-        except (OSError, EOFError):
-            return False  # agent gone; heartbeat monitor will remove the node
+        # queued, not sent: coalesced into the round's LeaseBatch for this
+        # agent (flush failure requeues — the creation lease protocol is
+        # already idempotent end-to-end)
+        self._queue_lease_locked(
+            node,
+            P.LeaseActor(
+                spec,
+                resolved_args,
+                bool(spec.resources.get("TPU")),
+                env_vars,
+                self._env_fingerprint(spec),
+                packages,
+            ),
+        )
         demand = spec.resources
         pg_bundle = getattr(pt, "_pg_bundle", None)
         if pg_bundle is not None:
@@ -2926,6 +3102,120 @@ class Controller:
              "t": pt.dispatch_t}
         )
         return True
+
+    def _queue_lease_locked(self, node: NodeState, msg) -> None:
+        """Buffer one lease grant for the node's agent (call under
+        self.lock); the scheduling round flushes one LeaseBatch per agent."""
+        entry = self._lease_outbox.get(node.node_id)
+        if entry is None:
+            entry = self._lease_outbox[node.node_id] = (node.agent, [])
+        entry[1].append(msg)
+
+    def _flush_lease_outbox_locked(self) -> None:
+        """Push every buffered grant, ONE frame per agent (call under
+        self.lock). A failed push — dead connection, or injected
+        "lease_batch" chaos dropping the whole batch before the wire —
+        requeues every lease it carried: the grants are idempotent leases,
+        so a later round re-grants with no double-spawn (the agent never
+        saw the lost batch)."""
+        if not self._lease_outbox:
+            return
+        outbox, self._lease_outbox = self._lease_outbox, {}
+        for nid, (agent, msgs) in outbox.items():
+            try:
+                if len(msgs) == 1:
+                    agent.send(msgs[0])
+                else:
+                    self._maybe_inject_rpc_failure("lease_batch")
+                    agent.send(P.LeaseBatch(msgs))
+                    self.lease_stats["lease_batches"] += 1
+                    self.lease_stats["leases_batched"] += len(msgs)
+            except (OSError, EOFError, WorkerCrashedError) as e:
+                if isinstance(e, WorkerCrashedError):
+                    self.lease_stats["lease_batch_injected_failures"] += 1
+                self._requeue_unsent_leases_locked(nid, msgs)
+
+    def _requeue_unsent_leases_locked(self, nid: NodeID, msgs: list) -> None:
+        """A lease batch never reached its agent: uncharge and requeue every
+        lease still tracked against the node (node removal may already have
+        re-placed them — only requeue what is still ours)."""
+        node = self.nodes.get(nid)
+        if node is None:
+            return  # remove_node already re-placed this node's leases
+        for msg in msgs:
+            tid_b = msg.spec.task_id.binary()
+            table = (
+                node.actor_leases
+                if isinstance(msg, P.LeaseActor)
+                else node.leased
+            )
+            pt = table.pop(tid_b, None)
+            if pt is None:
+                continue  # killed/reclaimed meanwhile
+            self._release_task_resources(pt)
+            self._enqueue_ready(pt)
+        self.sched_cv.notify_all()
+
+    def _maybe_rearm_locked(self, node: Optional[NodeState], agent, spec) -> None:
+        """Agent lease caching: a node that just completed a lease for
+        shape S may immediately re-arm on the next queued spec of the same
+        (tenant, shape), cutting the scheduler-wake grant round trip off
+        the steady-state hot path. The head still arbitrates: a re-arm is
+        REFUSED like an over-quota grant when the tenant is over its cap,
+        and yielded entirely when any OTHER tenant has queued work (the DRR
+        pop must arbitrate — the same fairness yield _try_pipeline makes),
+        so quotas and weighted shares hold exactly as without the cache."""
+        if not self.config.agent_lease_cache:
+            return
+        if node is None or not node.schedulable or node.agent is not agent:
+            return
+        shape = self._shape_key(spec)
+        ts = self.tenants.get(shape[0])
+        if ts is None:
+            return
+        q = ts.queues.get(shape)
+        if q:
+            # reap cancelled heads exactly like the DRR pop — the fast
+            # path must never dispatch (and execute) a cancelled task
+            while q and q[0].cancelled:
+                q.popleft()
+            ts.reap_queue(shape)
+            q = ts.queues.get(shape)
+        if not q:
+            return  # no same-shape follower queued: nothing to cache
+        held = dict(shape[1])
+        for other_name, other_ts in self.tenants.items():
+            if other_name != ts.name and other_ts.contending_for(held):
+                # same fairness yield the pipelining fast path makes: a
+                # re-arm bypasses the DRR pop, so a contending tenant's
+                # claim wins and this grant goes back through the scheduler
+                self.lease_stats["rearm_refused_fairness"] += 1
+                return
+        pt = q[0]
+        if (
+            pt.spec.task_type != TaskType.NORMAL_TASK
+            or not self._leasable(pt.spec)
+        ):
+            return  # only plain task leases ride the cache
+        if ts.over_quota(pt.spec.resources):
+            self.lease_stats["rearm_refused_quota"] += 1
+            return
+        if len(node.leased) >= self._lease_backlog_cap(node):
+            return
+        if self._lease_to_agent(node, pt):
+            q.popleft()
+            ts.reap_queue(shape)
+            ts.deficit -= tenants_mod.TASK_COST
+            if not getattr(pt, "_drr_counted", False):
+                pt._drr_counted = True  # type: ignore[attr-defined]
+                ts.stats["dispatched"] += 1
+            if ts.starved_head is pt:
+                # dispatched: the preemption claim this head started must
+                # die with it (mirrors the DRR dispatch path) — else the
+                # stale clock drain-preempts victims for satisfied demand
+                ts.starved_since = None
+                ts.starved_head = None
+            self.lease_stats["rearm_grants"] += 1
 
     def _try_place(self, pt: PendingTask) -> bool:
         spec = pt.spec
@@ -3358,6 +3648,15 @@ class Controller:
             json.dumps(pip_spec, sort_keys=True) if pip_spec else None,
         )
 
+    def _startup_concurrency(self) -> int:
+        """Effective per-node worker-startup throttle. Thread-mode "spawn"
+        is a pair of in-process threads (no fork/exec, no venv): the
+        reference's conservative process throttle would serialize the
+        1000-actor envelope behind 2-at-a-time thread creation."""
+        if self.mode == "thread":
+            return max(self.config.maximum_startup_concurrency, 32)
+        return self.config.maximum_startup_concurrency
+
     def _worker_pool_cap(self, node: NodeState) -> int:
         if self.config.worker_pool_soft_limit > 0:
             return self.config.worker_pool_soft_limit
@@ -3378,7 +3677,7 @@ class Controller:
         # worker/actor creation cluster-wide — with N agents, spawns must
         # pipeline N× in parallel (each agent owns its own spawn +
         # registration handshake; the head only picks the node)
-        if node.starting_workers >= self.config.maximum_startup_concurrency:
+        if node.starting_workers >= self._startup_concurrency():
             return None
         # Soft pool cap: past it, grow only while the pool is *blocked*
         # (nothing completed recently). Short-task churn keeps completing, so
@@ -3895,6 +4194,12 @@ class Controller:
                     self._route_worker_msg(handle, msg.msg)
             elif isinstance(msg, P.AgentTaskDone):
                 self._on_agent_task_done(agent, msg)
+            elif isinstance(msg, P.AgentReportBatch):
+                # one frame, N completion reports (agent flush tick); FIFO
+                # order preserved — and each completion may re-arm the node
+                # through the lease cache exactly as a lone report would
+                for item in msg.items:
+                    self._on_agent_task_done(agent, item)
             elif isinstance(msg, P.TaskSpilled):
                 self._on_task_spilled(agent, msg)
             elif isinstance(msg, P.Heartbeat):
@@ -4107,18 +4412,107 @@ class Controller:
             )
 
     def _dispatch_request(self, op: str, payload, caller: "WorkerHandle" = None):
+        """Route one string-keyed request to its subsystem's dispatch
+        shard. The old single if-ladder serialized every op behind one
+        string-compare walk; the table routes in O(1) and each shard
+        documents which subsystem lock its handlers take (reference:
+        the per-manager gRPC services of ``src/ray/gcs/`` vs one
+        monolithic handler). Chaos injection stays here so every op —
+        batched or not — remains injectable by name."""
         self._maybe_inject_rpc_failure(op)
+        shard = self._dispatch_table.get(op)
+        if shard is None:
+            raise ValueError(f"unknown controller op: {op}")
+        return shard(op, payload, caller)
+
+    def _dispatch_task_ops(self, op: str, payload, caller: "WorkerHandle" = None):
+        """Dispatch shard: task submission / cancellation / task-state queries."""
         if op == "submit_task":
             spec, name = payload
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                # register_actor submits under its ONE lock hold (no second
+                # lock take through submit_task) and raises synchronously —
+                # named creations stay a sync op so duplicate names surface
+                # at the call site, not at get()
                 self.register_actor(spec, name=name)
             else:
                 self.submit_task(spec)
             return None
-        if op == "add_ref":
-            for oid in payload:
-                self.add_ref(oid)
+        if op == "submit_batch":
+            # client-coalesced submits + ref traffic, one lock hold, one
+            # scheduler wake (see Controller.submit_batch for replay rules)
+            if caller is not None and getattr(caller, "is_driver", False):
+                # crash-reap bookkeeping parity with the unbatched add_ref/
+                # FreeObjects paths: a detached client's refs must release
+                for item in payload:
+                    if item[0] == "add_ref":
+                        caller.held_refs.update(item[1])
+                    elif item[0] == "submit":
+                        caller.held_refs.update(item[1].return_ids())
+                    elif item[0] == "free":
+                        caller.held_refs.difference_update(item[1])
+            self.submit_batch(payload, caller=caller)
             return None
+        if op == "cancel":
+            self.cancel_task(payload)
+            return None
+        if op == "tasks_pending":
+            # liveness of specific task ids (direct transport's head-queue
+            # drain check — cross-path per-caller ordering)
+            with self.lock:
+                return [tid in self.pending_by_id for tid in payload]
+        if op == "task_events":
+            return list(self.task_events)
+        if op == "list_tasks":
+            limit = payload or 1000
+            with self.lock:
+                running = [
+                    {
+                        "task_id": pt.spec.task_id.hex(),
+                        "name": pt.spec.name,
+                        "state": "RUNNING",
+                        "worker_id": w.worker_id.hex(),
+                    }
+                    for w in self.workers.values()
+                    for pt in w.running.values()
+                ]
+                queued = [
+                    {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
+                     "state": "PENDING_SCHEDULING", "worker_id": None}
+                    for pt in self._iter_ready()
+                ]
+                ready_ids = {pt.spec.task_id for pt in self._iter_ready()}
+                running_ids = {
+                    pt.spec.task_id
+                    for w in self.workers.values()
+                    for pt in w.running.values()
+                }
+                actor_queued_ids = {
+                    pt.spec.task_id
+                    for a in self.actors.values()
+                    for pt in a.queue
+                }
+                blocked = [
+                    {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
+                     "state": "PENDING_ARGS_AVAIL", "worker_id": None}
+                    for pt in self.pending_by_id.values()
+                    if pt.spec.task_id not in ready_ids
+                    and pt.spec.task_id not in running_ids
+                    and pt.spec.task_id not in actor_queued_ids
+                ]
+                actor_queued = [
+                    {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
+                     "state": "PENDING_ACTOR", "worker_id": None}
+                    for a in self.actors.values()
+                    for pt in a.queue
+                ]
+            return (running + queued + blocked + actor_queued)[:limit]
+        if op == "debug_worker_msg_count":
+            return self.worker_msg_count
+        raise ValueError(f"unknown controller op: {op}")
+
+    def _dispatch_actor_ops(self, op: str, payload, caller: "WorkerHandle" = None):
+        """Dispatch shard: actor lifecycle, placement reports, actor-state queries."""
         if op == "actor_direct_endpoint":
             # direct actor-call transport: resolve the actor's worker
             # endpoint ONCE per caller (cached caller-side; invalidated when
@@ -4136,124 +4530,91 @@ class Controller:
                 ):
                     return ("ALIVE", actor.worker.direct_address)
                 return (actor.state if actor is not None else "UNKNOWN", None)
-        if op == "debug_worker_msg_count":
-            return self.worker_msg_count
-        if op == "tasks_pending":
-            # liveness of specific task ids (direct transport's head-queue
-            # drain check — cross-path per-caller ordering)
-            with self.lock:
-                return [tid in self.pending_by_id for tid in payload]
-        if op == "log_get":
-            prefix, source, tail_bytes = payload
-            return self._log_fetch(prefix, source, tail_bytes)
-        if op == "log_list":
-            return self._log_list()
-        if op == "log_tail_buffer":
-            # most recent captured lines across all workers (state API /
-            # dashboard "logs" source)
-            n = int(payload or 1000)
-            return list(self._log_buffer)[-n:]
-        if op == "wait":
-            object_ids, num_returns, timeout = payload
-            return self.memory_store.wait(object_ids, num_returns, timeout)
-        if op == "stream_consumed_report":
-            # consumer progress: feeds backpressure and transfers the
-            # producer's pin of the taken item to the consumer (who has
-            # already add_ref'd it — FIFO on the channel guarantees order)
-            task_id, count = payload
-            with self.lock:
-                # -1 (consumer abandoned the stream) is STICKY: a progress
-                # report processed after the abandon marker must not revive
-                # a dead-stream producer's poll loop
-                current = self._stream_consumed.get(task_id, 0)
-                if current >= 0 and count > current:
-                    self._stream_consumed[task_id] = count
-                if len(self._stream_consumed) > 4096:
-                    # evict only finished streams: dropping a live counter
-                    # would deadlock its backpressured producer against its
-                    # consumer
-                    for tid in list(self._stream_consumed):
-                        if tid not in self.pending_by_id:
-                            del self._stream_consumed[tid]
-                            if len(self._stream_consumed) <= 4096:
-                                break
-                pins = self._stream_pins.get(task_id)
-                if pins is not None:
-                    for idx in [i for i in pins if i <= count]:
-                        pins.discard(idx)
-                        self.remove_ref(ObjectID.for_return(task_id, idx))
-                    if not pins:
-                        self._stream_pins.pop(task_id, None)
-            return None
-        if op == "stream_abandoned":
-            # Explicit consumer-gone: the serve handle's finalize watcher
-            # reports an abandoned stream directly instead of relying on the
-            # completion refcount reaching zero (a stray interpreter-held
-            # ObjectRef instance must not keep a dead stream's producer
-            # polling). Force-drops the completion record; _free_object's
-            # stream branch releases producer pins and sets the sticky -1.
-            with self.lock:
-                self.ref_counts.pop(payload, None)
-                self._free_object(payload)
-            return None
-        if op == "stream_consumed_get":
-            with self.lock:
-                return self._stream_consumed.get(payload, 0)
-        if op == "pubsub_poll":
-            channel, after_seq, timeout = payload
-            return self.pubsub_poll(channel, after_seq, min(timeout, 30.0))
-        if op == "pubsub_publish":
-            channel, event = payload
-            self.publish(channel, event)
-            return None
-        if op == "worker_stacks":
-            # on-demand profiling (reference: dashboard reporter py-spy
-            # stack dumps): ask worker(s) to dump all thread stacks
-            target = payload  # worker id hex prefix, or None = all
-            with self.lock:
-                handles = [
-                    h
-                    for h in self.workers.values()
-                    if not h.dead
-                    and h.conn is not None  # still handshaking: no channel yet
-                    and (target is None or h.worker_id.hex().startswith(target))
-                ]
-            # fan out ALL requests first, then collect with one shared
-            # deadline: serial 5s waits would stall this (threaded) handler
-            # for 5s x N dead workers. Note the caller itself replies only
-            # because this op runs OFF its reader thread.
-            pending = []
-            out = {}
-            for h in handles:
-                req_id = next(self._stack_req_counter)
-                ev: threading.Event = threading.Event()
-                box: list = []
-                self._stack_waiters[req_id] = (ev, box)
-                try:
-                    h.send(P.DumpStacks(req_id))
-                    pending.append((h, req_id, ev, box))
-                except (OSError, EOFError):
-                    self._stack_waiters.pop(req_id, None)
-                    out[h.worker_id.hex()] = "<unreachable>"
-            deadline = time.monotonic() + 5.0
-            for h, req_id, ev, box in pending:
-                ev.wait(timeout=max(0.0, deadline - time.monotonic()))
-                out[h.worker_id.hex()] = (
-                    box[0] if box else "<no response within 5s>"
-                )
-                self._stack_waiters.pop(req_id, None)
-            return out
-        if op == "head_arena":
-            # client drivers probe-attach this arena: same-host clients get
-            # the shared-memory data plane, cross-host ones fall back to
-            # chunked push/pull
-            return getattr(self.plasma, "arena_name", None)
         if op == "get_named_actor":
             actor_id = self.get_named_actor(payload)
             if actor_id is None:
                 return None
             actor = self.actors[actor_id]
             return (actor_id, actor.creation_spec.max_concurrency)
+        if op == "actor_state":
+            actor = self.actors.get(payload)
+            return actor.state if actor else None
+        if op == "kill_actor":
+            actor_id, no_restart = payload
+            self.kill_actor(actor_id, no_restart)
+            return None
+        # ---- state API (reference: util/state/api.py over GcsTaskManager
+        #      and per-entity GCS tables) ----
+        if op == "list_actors":
+            with self.lock:
+                return [
+                    {
+                        "actor_id": a.actor_id.hex(),
+                        "class_name": a.creation_spec.name.split(".")[0],
+                        "state": a.state,
+                        "name": a.name or "",
+                        "pending_tasks": len(a.queue),
+                        "restarts_left": a.restarts_left,
+                        "death_cause": a.death_cause,
+                    }
+                    for a in self.actors.values()
+                ]
+        if op == "actor_placed":
+            # The agent completed a creation lease end-to-end (spawn,
+            # registration handshake, creation task): bind the actor to its
+            # worker and go ALIVE. Verdicts: "ok" (bound; idempotent on a
+            # duplicate report) or "dead" (the actor was killed/superseded
+            # meanwhile, or the worker already died — the agent must reap
+            # the worker / the lease was re-placed).
+            actor_id, worker_id, direct_address, results, exec_ms = payload
+            if not isinstance(caller, AgentHandle):
+                raise ValueError("actor_placed requires an agent caller")
+            return self._on_actor_placed(
+                caller, actor_id, worker_id, direct_address, results, exec_ms
+            )
+        if op == "actor_placed_batch":
+            # N coalesced placement reports (one agent flush tick): one
+            # round trip carrying a verdict per item, order-preserving.
+            # Each item is idempotent exactly like a lone actor_placed, so
+            # a replayed batch draws the same verdicts.
+            if not isinstance(caller, AgentHandle):
+                raise ValueError("actor_placed_batch requires an agent caller")
+            verdicts = []
+            for item in payload:
+                actor_id, worker_id, direct_address, results, exec_ms = item
+                verdicts.append(
+                    self._on_actor_placed(
+                        caller, actor_id, worker_id, direct_address,
+                        results, exec_ms,
+                    )
+                )
+            return verdicts
+        if op == "actor_creation_failed":
+            # The agent could not place the leased actor. retryable=True →
+            # infra failure (worker/spawn/handshake death, drain race):
+            # re-place per the budget policy; retryable=False → the
+            # creation task itself failed (raising __init__): terminal.
+            actor_id, reason, retryable, results, exec_ms = payload
+            if not isinstance(caller, AgentHandle):
+                raise ValueError("actor_creation_failed requires an agent caller")
+            self._on_actor_creation_failed(
+                caller, actor_id, reason, retryable, results, exec_ms
+            )
+            return None
+        if op == "actor_creation_stats":
+            with self.lock:
+                return dict(self.actor_creation_stats)
+        raise ValueError(f"unknown controller op: {op}")
+
+    def _dispatch_object_ops(self, op: str, payload, caller: "WorkerHandle" = None):
+        """Dispatch shard: object plane: refs, waits, chunk transfer, streams, replicas."""
+        if op == "add_ref":
+            for oid in payload:
+                self.add_ref(oid)
+            return None
+        if op == "wait":
+            object_ids, num_returns, timeout = payload
+            return self.memory_store.wait(object_ids, num_returns, timeout)
         if op == "shm_create":
             # native-arena allocation for a worker (the plasma-create RPC;
             # reference: plasma client protocol CreateRequest), spilling
@@ -4305,26 +4666,6 @@ class Controller:
                     object_id, SerializedObject.from_buffer(bytes(buf))
                 )
             return None
-        if op == "testing_lose_object":
-            # Test hook: destroy an object's sole copy WITHOUT touching ref
-            # counts or lineage — simulates a crashed store/node (reference:
-            # the killer-actor + free() loss pattern in recovery tests).
-            object_id = payload
-            entry = self.memory_store.get([object_id], timeout=0)[0]
-            with self.lock:
-                self.memory_store.delete([object_id])
-                self.plasma_resident.pop(object_id, None)
-            if entry is not None and entry[0] == "plasma":
-                self._store_for_location(entry[1][0]).delete(object_id)
-            elif entry is not None and entry[0] == "spilled":
-                try:
-                    os.unlink(entry[1][0])
-                except OSError:
-                    pass
-            # the hook simulates losing EVERY copy: replicas go too, or the
-            # "lost" object would keep serving from the directory
-            self._drop_replicas(object_id)
-            return entry is not None
         if op == "pull_object_chunk":
             # chunked node-to-node transfer (reference: ObjectManager::Push
             # streaming chunks, object_buffer_pool.h): serve [offset,
@@ -4389,6 +4730,14 @@ class Controller:
             # inline/error entries are small: serve from their bytes
             data = p.to_bytes()
             return (len(data), data[offset : offset + length])
+        if op == "pull_into_arena":
+            # A head-side worker asks for a remote object to be
+            # materialized into ITS node's arena (agent-host workers never
+            # reach here — their agent intercepts the op locally).
+            object_id, size_hint = payload
+            return self.pull_into_arena(
+                getattr(caller, "node_id", None), object_id, size_hint
+            )
         if op == "object_locations":
             # Full replica set: every data address that can serve this
             # object's chunks — the owner plus registered replicas
@@ -4426,14 +4775,6 @@ class Controller:
                 if loc is not None and loc[0] == arena:
                     return "primary"
             return None
-        if op == "pull_into_arena":
-            # A head-side worker asks for a remote object to be
-            # materialized into ITS node's arena (agent-host workers never
-            # reach here — their agent intercepts the op locally).
-            object_id, size_hint = payload
-            return self.pull_into_arena(
-                getattr(caller, "node_id", None), object_id, size_hint
-            )
         if op == "transfer_stats":
             with self.lock:
                 return dict(self.transfer_stats)
@@ -4453,152 +4794,69 @@ class Controller:
                 self._agent_spills[object_id] = caller
                 self.memory_store.put(object_id, ("spilled", (path, size)))
             return None
-        if op == "actor_placed":
-            # The agent completed a creation lease end-to-end (spawn,
-            # registration handshake, creation task): bind the actor to its
-            # worker and go ALIVE. Verdicts: "ok" (bound; idempotent on a
-            # duplicate report) or "dead" (the actor was killed/superseded
-            # meanwhile, or the worker already died — the agent must reap
-            # the worker / the lease was re-placed).
-            actor_id, worker_id, direct_address, results, exec_ms = payload
-            if not isinstance(caller, AgentHandle):
-                raise ValueError("actor_placed requires an agent caller")
-            return self._on_actor_placed(
-                caller, actor_id, worker_id, direct_address, results, exec_ms
-            )
-        if op == "actor_creation_failed":
-            # The agent could not place the leased actor. retryable=True →
-            # infra failure (worker/spawn/handshake death, drain race):
-            # re-place per the budget policy; retryable=False → the
-            # creation task itself failed (raising __init__): terminal.
-            actor_id, reason, retryable, results, exec_ms = payload
-            if not isinstance(caller, AgentHandle):
-                raise ValueError("actor_creation_failed requires an agent caller")
-            self._on_actor_creation_failed(
-                caller, actor_id, reason, retryable, results, exec_ms
-            )
+        if op == "testing_lose_object":
+            # Test hook: destroy an object's sole copy WITHOUT touching ref
+            # counts or lineage — simulates a crashed store/node (reference:
+            # the killer-actor + free() loss pattern in recovery tests).
+            object_id = payload
+            entry = self.memory_store.get([object_id], timeout=0)[0]
+            with self.lock:
+                self.memory_store.delete([object_id])
+                self.plasma_resident.pop(object_id, None)
+            if entry is not None and entry[0] == "plasma":
+                self._store_for_location(entry[1][0]).delete(object_id)
+            elif entry is not None and entry[0] == "spilled":
+                try:
+                    os.unlink(entry[1][0])
+                except OSError:
+                    pass
+            # the hook simulates losing EVERY copy: replicas go too, or the
+            # "lost" object would keep serving from the directory
+            self._drop_replicas(object_id)
+            return entry is not None
+        if op == "stream_consumed_report":
+            # consumer progress: feeds backpressure and transfers the
+            # producer's pin of the taken item to the consumer (who has
+            # already add_ref'd it — FIFO on the channel guarantees order)
+            task_id, count = payload
+            with self.lock:
+                # -1 (consumer abandoned the stream) is STICKY: a progress
+                # report processed after the abandon marker must not revive
+                # a dead-stream producer's poll loop
+                current = self._stream_consumed.get(task_id, 0)
+                if current >= 0 and count > current:
+                    self._stream_consumed[task_id] = count
+                if len(self._stream_consumed) > 4096:
+                    # evict only finished streams: dropping a live counter
+                    # would deadlock its backpressured producer against its
+                    # consumer
+                    for tid in list(self._stream_consumed):
+                        if tid not in self.pending_by_id:
+                            del self._stream_consumed[tid]
+                            if len(self._stream_consumed) <= 4096:
+                                break
+                pins = self._stream_pins.get(task_id)
+                if pins is not None:
+                    for idx in [i for i in pins if i <= count]:
+                        pins.discard(idx)
+                        self.remove_ref(ObjectID.for_return(task_id, idx))
+                    if not pins:
+                        self._stream_pins.pop(task_id, None)
             return None
-        if op == "actor_creation_stats":
+        if op == "stream_abandoned":
+            # Explicit consumer-gone: the serve handle's finalize watcher
+            # reports an abandoned stream directly instead of relying on the
+            # completion refcount reaching zero (a stray interpreter-held
+            # ObjectRef instance must not keep a dead stream's producer
+            # polling). Force-drops the completion record; _free_object's
+            # stream branch releases producer pins and sets the sticky -1.
             with self.lock:
-                return dict(self.actor_creation_stats)
-        if op == "kill_actor":
-            actor_id, no_restart = payload
-            self.kill_actor(actor_id, no_restart)
+                self.ref_counts.pop(payload, None)
+                self._free_object(payload)
             return None
-        if op == "cancel":
-            self.cancel_task(payload)
-            return None
-        if op == "pg_create":
-            bundles, strategy, name = payload
-            return self.create_placement_group(bundles, strategy, name)
-        if op == "pg_ready":
-            pg_id, timeout = payload
-            return self.pg_ready(pg_id, timeout)
-        if op == "pg_remove":
-            self.remove_placement_group(payload)
-            return None
-        if op == "pg_table":
-            pg = self.placement_groups.get(payload)
-            if pg is None:
-                return None
-            return {
-                "bundles": pg.bundles,
-                "strategy": pg.strategy,
-                "nodes": [n.hex() if n else None for n in pg.bundle_nodes],
-                "ready": pg.ready.is_set(),
-            }
-        if op == "cluster_resources":
-            return self.cluster_resources()
-        if op == "available_resources":
-            return self.available_resources()
-        if op == "nodes":
-            return self.node_infos()
-        if op == "kv_put":
-            ns, key, value = payload
+        if op == "stream_consumed_get":
             with self.lock:
-                self.kv[(ns, key)] = value
-            self._persist_kv()
-            return None
-        if op == "kv_get":
-            ns, key = payload
-            with self.lock:
-                return self.kv.get((ns, key))
-        if op == "kv_del":
-            ns, key = payload
-            with self.lock:
-                existed = self.kv.pop((ns, key), None) is not None
-            if existed:
-                self._persist_kv()
-            return existed
-        if op == "kv_keys":
-            ns, prefix = payload
-            with self.lock:
-                return [
-                    k for (n, k) in self.kv if n == ns and k.startswith(prefix)
-                ]
-        if op == "actor_state":
-            actor = self.actors.get(payload)
-            return actor.state if actor else None
-        # ---- state API (reference: util/state/api.py over GcsTaskManager
-        #      and per-entity GCS tables) ----
-        if op == "list_actors":
-            with self.lock:
-                return [
-                    {
-                        "actor_id": a.actor_id.hex(),
-                        "class_name": a.creation_spec.name.split(".")[0],
-                        "state": a.state,
-                        "name": a.name or "",
-                        "pending_tasks": len(a.queue),
-                        "restarts_left": a.restarts_left,
-                        "death_cause": a.death_cause,
-                    }
-                    for a in self.actors.values()
-                ]
-        if op == "list_tasks":
-            limit = payload or 1000
-            with self.lock:
-                running = [
-                    {
-                        "task_id": pt.spec.task_id.hex(),
-                        "name": pt.spec.name,
-                        "state": "RUNNING",
-                        "worker_id": w.worker_id.hex(),
-                    }
-                    for w in self.workers.values()
-                    for pt in w.running.values()
-                ]
-                queued = [
-                    {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
-                     "state": "PENDING_SCHEDULING", "worker_id": None}
-                    for pt in self._iter_ready()
-                ]
-                ready_ids = {pt.spec.task_id for pt in self._iter_ready()}
-                running_ids = {
-                    pt.spec.task_id
-                    for w in self.workers.values()
-                    for pt in w.running.values()
-                }
-                actor_queued_ids = {
-                    pt.spec.task_id
-                    for a in self.actors.values()
-                    for pt in a.queue
-                }
-                blocked = [
-                    {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
-                     "state": "PENDING_ARGS_AVAIL", "worker_id": None}
-                    for pt in self.pending_by_id.values()
-                    if pt.spec.task_id not in ready_ids
-                    and pt.spec.task_id not in running_ids
-                    and pt.spec.task_id not in actor_queued_ids
-                ]
-                actor_queued = [
-                    {"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
-                     "state": "PENDING_ACTOR", "worker_id": None}
-                    for a in self.actors.values()
-                    for pt in a.queue
-                ]
-            return (running + queued + blocked + actor_queued)[:limit]
+                return self._stream_consumed.get(payload, 0)
         if op == "list_objects":
             with self.lock:
                 return {
@@ -4611,34 +4869,40 @@ class Controller:
                     "plasma_used_bytes": self.plasma.used_bytes(),
                     "ref_counted": len(self.ref_counts),
                 }
-        if op == "list_placement_groups":
-            with self.lock:
-                return [
-                    {
-                        "placement_group_id": pg_id.hex(),
-                        "strategy": pg.strategy,
-                        "bundles": pg.bundles,
-                        "state": (
-                            "REMOVED" if pg.removed
-                            else "CREATED" if pg.ready.is_set() else "PENDING"
-                        ),
-                    }
-                    for pg_id, pg in self.placement_groups.items()
-                ]
-        if op == "list_workers":
-            with self.lock:
-                return [
-                    {
-                        "worker_id": w.worker_id.hex(),
-                        "node_id": w.node_id.hex(),
-                        "pid": getattr(getattr(w, "proc", None), "pid", None),
-                        "running_tasks": len(w.running),
-                        "idle": not w.running,
-                    }
-                    for w in self.workers.values()
-                ]
-        if op == "task_events":
-            return list(self.task_events)
+        if op == "head_arena":
+            # client drivers probe-attach this arena: same-host clients get
+            # the shared-memory data plane, cross-host ones fall back to
+            # chunked push/pull
+            return getattr(self.plasma, "arena_name", None)
+        raise ValueError(f"unknown controller op: {op}")
+
+    def _dispatch_node_ops(self, op: str, payload, caller: "WorkerHandle" = None):
+        """Dispatch shard: cluster membership, placement groups, tenants, autoscaling."""
+        if op == "add_node":
+            resources, labels = payload
+            return self.add_node(resources, labels).hex()
+        if op == "remove_node":
+            from ray_tpu._private.ids import NodeID as _NodeID
+
+            self.remove_node(_NodeID(bytes.fromhex(payload)))
+            return True
+        if op == "drain_node":
+            from ray_tpu._private.ids import NodeID as _NodeID
+
+            node_hex, deadline_s, reason = payload
+            return self.drain_node(
+                _NodeID(bytes.fromhex(node_hex)),
+                deadline_s=float(deadline_s),
+                reason=reason or "",
+            )
+        if op == "drain_status":
+            return self.drain_status(payload)
+        if op == "nodes":
+            return self.node_infos()
+        if op == "cluster_resources":
+            return self.cluster_resources()
+        if op == "available_resources":
+            return self.available_resources()
         if op == "autoscaler_state":
             # demand younger than 60s + per-node utilization snapshot; each
             # demand entry names the tenant driving it (per-tenant scale-up
@@ -4668,25 +4932,51 @@ class Controller:
                     for n in self.nodes.values()
                 ]
             return {"pending_demand": demand, "nodes": nodes}
-        if op == "add_node":
-            resources, labels = payload
-            return self.add_node(resources, labels).hex()
-        if op == "remove_node":
-            from ray_tpu._private.ids import NodeID as _NodeID
-
-            self.remove_node(_NodeID(bytes.fromhex(payload)))
-            return True
-        if op == "drain_node":
-            from ray_tpu._private.ids import NodeID as _NodeID
-
-            node_hex, deadline_s, reason = payload
-            return self.drain_node(
-                _NodeID(bytes.fromhex(node_hex)),
-                deadline_s=float(deadline_s),
-                reason=reason or "",
-            )
-        if op == "drain_status":
-            return self.drain_status(payload)
+        if op == "list_workers":
+            with self.lock:
+                return [
+                    {
+                        "worker_id": w.worker_id.hex(),
+                        "node_id": w.node_id.hex(),
+                        "pid": getattr(getattr(w, "proc", None), "pid", None),
+                        "running_tasks": len(w.running),
+                        "idle": not w.running,
+                    }
+                    for w in self.workers.values()
+                ]
+        if op == "pg_create":
+            bundles, strategy, name = payload
+            return self.create_placement_group(bundles, strategy, name)
+        if op == "pg_ready":
+            pg_id, timeout = payload
+            return self.pg_ready(pg_id, timeout)
+        if op == "pg_remove":
+            self.remove_placement_group(payload)
+            return None
+        if op == "pg_table":
+            pg = self.placement_groups.get(payload)
+            if pg is None:
+                return None
+            return {
+                "bundles": pg.bundles,
+                "strategy": pg.strategy,
+                "nodes": [n.hex() if n else None for n in pg.bundle_nodes],
+                "ready": pg.ready.is_set(),
+            }
+        if op == "list_placement_groups":
+            with self.lock:
+                return [
+                    {
+                        "placement_group_id": pg_id.hex(),
+                        "strategy": pg.strategy,
+                        "bundles": pg.bundles,
+                        "state": (
+                            "REMOVED" if pg.removed
+                            else "CREATED" if pg.ready.is_set() else "PENDING"
+                        ),
+                    }
+                    for pg_id, pg in self.placement_groups.items()
+                ]
         if op == "set_tenant_quota":
             tenant, quota, weight, priority = payload
             return self.set_tenant_quota(
@@ -4694,6 +4984,91 @@ class Controller:
             )
         if op == "tenant_stats":
             return self.tenant_stats()
+        raise ValueError(f"unknown controller op: {op}")
+
+    def _dispatch_kv_ops(self, op: str, payload, caller: "WorkerHandle" = None):
+        """Dispatch shard: the internal KV table (own subsystem lock: controller.kv)."""
+        if op == "kv_put":
+            ns, key, value = payload
+            with self._kv_lock:
+                self.kv[(ns, key)] = value
+            self._persist_kv()
+            return None
+        if op == "kv_get":
+            ns, key = payload
+            with self._kv_lock:
+                return self.kv.get((ns, key))
+        if op == "kv_del":
+            ns, key = payload
+            with self._kv_lock:
+                existed = self.kv.pop((ns, key), None) is not None
+            if existed:
+                self._persist_kv()
+            return existed
+        if op == "kv_keys":
+            ns, prefix = payload
+            with self._kv_lock:
+                return [
+                    k for (n, k) in self.kv if n == ns and k.startswith(prefix)
+                ]
+        raise ValueError(f"unknown controller op: {op}")
+
+    def _dispatch_observe_ops(self, op: str, payload, caller: "WorkerHandle" = None):
+        """Dispatch shard: logs, pubsub, on-demand profiling."""
+        if op == "log_get":
+            prefix, source, tail_bytes = payload
+            return self._log_fetch(prefix, source, tail_bytes)
+        if op == "log_list":
+            return self._log_list()
+        if op == "log_tail_buffer":
+            # most recent captured lines across all workers (state API /
+            # dashboard "logs" source)
+            n = int(payload or 1000)
+            return list(self._log_buffer)[-n:]
+        if op == "pubsub_poll":
+            channel, after_seq, timeout = payload
+            return self.pubsub_poll(channel, after_seq, min(timeout, 30.0))
+        if op == "pubsub_publish":
+            channel, event = payload
+            self.publish(channel, event)
+            return None
+        if op == "worker_stacks":
+            # on-demand profiling (reference: dashboard reporter py-spy
+            # stack dumps): ask worker(s) to dump all thread stacks
+            target = payload  # worker id hex prefix, or None = all
+            with self.lock:
+                handles = [
+                    h
+                    for h in self.workers.values()
+                    if not h.dead
+                    and h.conn is not None  # still handshaking: no channel yet
+                    and (target is None or h.worker_id.hex().startswith(target))
+                ]
+            # fan out ALL requests first, then collect with one shared
+            # deadline: serial 5s waits would stall this (threaded) handler
+            # for 5s x N dead workers. Note the caller itself replies only
+            # because this op runs OFF its reader thread.
+            pending = []
+            out = {}
+            for h in handles:
+                req_id = next(self._stack_req_counter)
+                ev: threading.Event = threading.Event()
+                box: list = []
+                self._stack_waiters[req_id] = (ev, box)
+                try:
+                    h.send(P.DumpStacks(req_id))
+                    pending.append((h, req_id, ev, box))
+                except (OSError, EOFError):
+                    self._stack_waiters.pop(req_id, None)
+                    out[h.worker_id.hex()] = "<unreachable>"
+            deadline = time.monotonic() + 5.0
+            for h, req_id, ev, box in pending:
+                ev.wait(timeout=max(0.0, deadline - time.monotonic()))
+                out[h.worker_id.hex()] = (
+                    box[0] if box else "<no response within 5s>"
+                )
+                self._stack_waiters.pop(req_id, None)
+            return out
         raise ValueError(f"unknown controller op: {op}")
 
     # ------------------------------------------------------------ dispatching
@@ -4788,6 +5163,12 @@ class Controller:
             self._release_task_resources(pt)
             self.pending_by_id.pop(spec.task_id, None)
             self._unpin_task_deps(pt)
+            # agent lease cache: hand the freed capacity the next queued
+            # same-(tenant, shape) spec right here — no scheduler wake, no
+            # grant round trip (refused like an over-quota grant when the
+            # tenant is capped or another tenant is waiting)
+            self._maybe_rearm_locked(node, agent, spec)
+            self._flush_lease_outbox_locked()
             self.sched_cv.notify_all()
         self._persist_state()
 
@@ -5307,16 +5688,26 @@ class Controller:
         self._persist_state()
 
     def register_actor(self, spec: TaskSpec, name: Optional[str] = None) -> ActorState:
+        """Register + submit an actor creation under ONE lock hold (the old
+        register-then-submit_task path took the controller lock twice per
+        creation — measurable at the 1000-actor envelope). Idempotent on a
+        replayed creation (coalesced-batch retry): returns the existing
+        state. Validation runs BEFORE registration so a rejected runtime
+        env doesn't leave a phantom DEAD-less actor behind."""
         self._validate_runtime_env(spec)
         with self.lock:
+            existing = self.actors.get(spec.actor_id)
+            if existing is not None:
+                return existing
+            if name and name in self.named_actors:
+                raise ValueError(f"actor name {name!r} already taken")
             actor = ActorState(spec.actor_id, spec)
             actor.name = name
             self.actors[spec.actor_id] = actor
             if name:
-                if name in self.named_actors:
-                    raise ValueError(f"actor name {name!r} already taken")
                 self.named_actors[name] = spec.actor_id
-        self.submit_task(spec)
+            self._submit_one_locked(spec)
+            self.sched_cv.notify_all()
         self._persist_state()
         return actor
 

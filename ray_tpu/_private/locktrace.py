@@ -26,6 +26,110 @@ _COUNTER: dict[str, int] = {}
 
 _RLOCK_RE = re.compile(r"<(locked|unlocked) _thread\.RLock object owner=(\d+) count=(\d+)")
 
+# --- subsystem locks -------------------------------------------------------
+#
+# The controller's sharded dispatch tables (PR 12) give each subsystem its
+# own lock. The invariant that keeps the split deadlock-free is simple: NO
+# thread ever holds two subsystem locks at once (cross-subsystem work must
+# sequence, never nest). `subsystem_lock` wraps a lock so every acquire
+# checks the invariant at runtime — a violation raises immediately at the
+# nested acquire site instead of surfacing rounds later as an
+# order-dependent deadlock.
+
+_held_subsystems = threading.local()
+
+
+class SubsystemNestingError(RuntimeError):
+    """A thread tried to acquire a second subsystem lock while holding one."""
+
+
+class _SubsystemLock:
+    """Context-manager wrapper enforcing the one-subsystem-lock-per-thread
+    invariant. Re-entrant acquires of the SAME subsystem are allowed (the
+    wrapped lock decides whether that blocks — pair with an RLock when the
+    subsystem's code re-enters)."""
+
+    __slots__ = ("name", "_lock", "__weakref__")
+
+    def __init__(self, name: str, lock):
+        self.name = name
+        self._lock = lock
+
+    def held_here(self) -> bool:
+        """Is THIS thread inside this subsystem lock?"""
+        return self.name in getattr(_held_subsystems, "names", ())
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        names = getattr(_held_subsystems, "names", None)
+        if names is None:
+            names = _held_subsystems.names = []
+        if names and self.name not in names:
+            raise SubsystemNestingError(
+                f"thread {threading.current_thread().name!r} acquiring "
+                f"subsystem lock {self.name!r} while already holding "
+                f"{names!r} — subsystem handlers must never hold two "
+                f"subsystem locks (sequence the work instead)"
+            )
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            names.append(self.name)
+        return ok
+
+    def release(self):
+        names = getattr(_held_subsystems, "names", None)
+        if names and names[-1] == self.name:
+            names.pop()
+        elif names and self.name in names:
+            names.remove(self.name)
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    # Condition protocol: threading.Condition(wrapped_rlock) must keep the
+    # RLock's save/restore semantics (a plain acquire/release fallback would
+    # under-release a recursively held RLock inside cv.wait() and deadlock).
+    def _acquire_restore(self, state):
+        self._lock._acquire_restore(state)
+        names = getattr(_held_subsystems, "names", None)
+        if names is None:
+            names = _held_subsystems.names = []
+        names.append(self.name)
+
+    def _release_save(self):
+        names = getattr(_held_subsystems, "names", None)
+        if names and self.name in names:
+            # cv.wait releases EVERY recursion level of this thread's hold
+            _held_subsystems.names = [n for n in names if n != self.name]
+        return self._lock._release_save()
+
+    def _is_owned(self):
+        return self._lock._is_owned()
+
+    def locked(self):
+        locked = getattr(self._lock, "locked", None)
+        return locked() if callable(locked) else False
+
+    def __repr__(self):  # locktrace dumps describe the wrapped lock
+        return repr(self._lock)
+
+
+def subsystem_lock(name: str, lock) -> _SubsystemLock:
+    """Register ``lock`` under ``name`` AND wrap it with the no-two-
+    subsystem-locks nesting assertion (see _SubsystemLock)."""
+    wrapped = _SubsystemLock(name, lock)
+    register_lock(name, wrapped)
+    return wrapped
+
+
+def held_subsystem_locks() -> tuple:
+    """Subsystem locks the CURRENT thread holds (test/debug introspection)."""
+    return tuple(getattr(_held_subsystems, "names", ()))
+
 
 def join_if_alive(thread, timeout: float) -> bool:
     """Bounded best-effort join for shutdown paths: no-op for a missing,

@@ -364,6 +364,7 @@ CONTROLLER_OPS = frozenset(
         "actor_creation_stats",
         "actor_direct_endpoint",
         "actor_placed",
+        "actor_placed_batch",
         "actor_state",
         "add_node",
         "add_ref",
@@ -408,6 +409,7 @@ CONTROLLER_OPS = frozenset(
         "stream_abandoned",
         "stream_consumed_get",
         "stream_consumed_report",
+        "submit_batch",
         "submit_task",
         "task_events",
         "tasks_pending",
@@ -457,7 +459,11 @@ def parse_worker_chaos_table(spec: str) -> dict:
 # retry/re-place path without a receiver-side hook. Kept separate from
 # CONTROLLER_OPS so the wire-conformance declared-set check (which mirrors
 # the `_dispatch_request` branch ladder) stays exact.
-AGENT_PUSH_OPS = frozenset({"lease_actor"})
+#
+# "lease_batch" covers the batched grant push (``LeaseBatch``): an injected
+# failure drops the WHOLE batch before the wire, and the scheduler requeues
+# every lease it carried — exercising idempotent re-grant of a lost batch.
+AGENT_PUSH_OPS = frozenset({"lease_actor", "lease_batch"})
 
 
 # ---- worker -> controller ----
@@ -717,6 +723,19 @@ class LeaseActor:
 
 
 @dataclasses.dataclass
+class LeaseBatch:
+    """Controller → agent: N lease grants (``LeaseTask``/``LeaseActor``) in
+    ONE push — the scheduler's per-round outbox coalesces every grant bound
+    for the same agent instead of paying one wire frame per lease
+    (reference: the raylet pipelines lease traffic while the GCS owns
+    durable state, PAPER.md L4/L5). Order within the batch is the
+    scheduler's dispatch order; the agent unpacks FIFO, so per-agent grant
+    ordering is exactly what N single pushes gave."""
+
+    leases: list  # of LeaseTask | LeaseActor
+
+
+@dataclasses.dataclass
 class AgentTaskDone:
     """Agent → controller: a leased task finished (results already sealed
     into the agent's arena where plasma-sized)."""
@@ -724,6 +743,19 @@ class AgentTaskDone:
     task_id: Any  # TaskID
     results: list  # [(object_id, kind, payload)]
     exec_ms: float = 0.0
+
+
+@dataclasses.dataclass
+class AgentReportBatch:
+    """Agent → controller: N per-task completion reports coalesced per
+    flush tick (``AgentTaskDone`` entries, FIFO). A steady-state agent
+    completing hundreds of short leases per second pays one wire frame per
+    tick instead of one per task; the head processes entries in order, and
+    each completion may immediately re-arm the finishing node with the next
+    queued same-(tenant, shape) spec (agent lease caching — see
+    ``Controller._maybe_rearm_locked``)."""
+
+    items: list  # of AgentTaskDone
 
 
 @dataclasses.dataclass

@@ -124,6 +124,29 @@ class TenantState:
             else:
                 self.usage.pop(k, None)
 
+    def contending_for(self, against: dict) -> bool:
+        """Does this tenant have queued work that could take the capacity
+        an ``against``-shaped lease holds RIGHT NOW? A shape contends only
+        when (a) its demand overlaps the lease's resource keys (yielding
+        CPU slots frees nothing for a TPU-only backlog), (b) it demands
+        anything at all (zero-resource work always places), and (c) that
+        demand clears the tenant's own quota. Shared fairness gate of the
+        lease-pipelining fast path AND the agent lease-cache re-arm — both
+        bypass the DRR pop, so both must yield to a contending tenant.
+        (Call under the controller lock. Each shape key carries its
+        resource tuple at index 1, and every task in a shape queue shares
+        it, so no task access is needed.)"""
+        for shape in self.queues:
+            demand = dict(shape[1])
+            if not demand:
+                continue
+            if against and not (demand.keys() & against.keys()):
+                continue
+            if self.quota and self.over_quota(demand):
+                continue
+            return True
+        return False
+
     # -- introspection ------------------------------------------------------
 
     def snapshot(self) -> dict:

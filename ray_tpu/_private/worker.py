@@ -22,7 +22,12 @@ from ray_tpu._private.config import Config, get_config, set_config
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.serialization import SerializationContext, SerializedObject
 from ray_tpu._private.task_spec import SchedulingStrategy, TaskSpec, TaskType
-from ray_tpu.exceptions import GetTimeoutError, RayTpuError, TaskError
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
 from ray_tpu.object_ref import ObjectRef
 
 _global_api = None
@@ -123,6 +128,19 @@ class WorkerAPI:
     # transport hooks -------------------------------------------------------
     def _submit(self, spec: TaskSpec, actor_name: Optional[str] = None):
         raise NotImplementedError
+
+    def _submit_coalesced(self, spec: TaskSpec, actor_name: Optional[str] = None) -> bool:
+        """Queue a submission into the client-side submit coalescer (the
+        batched wire path: N specs + their return-id refs ride one
+        ``submit_batch`` request). Returns False when this transport has no
+        coalescer or batching is disabled — the caller then takes the
+        synchronous ``add_refs`` + ``_submit`` path."""
+        return False
+
+    def flush_submits(self) -> None:
+        """Deliver any coalesced submissions now (no-op without a
+        coalescer). Called before every synchronous controller interaction
+        so batching never reorders program-visible effects."""
 
     def _get_serialized(self, object_ids, timeout):
         raise NotImplementedError
@@ -231,10 +249,15 @@ class WorkerAPI:
             priority=self._current_priority(priority),
         )
         return_ids = spec.return_ids()
-        self.add_refs(return_ids)
         refs = [ObjectRef(oid) for oid in return_ids]
         self._promote_ref_args(spec)
-        self._submit(spec)
+        # runtime_env specs stay synchronous: their validation errors (bad
+        # py_modules path, container refusal, pip/uv conflicts) must raise
+        # at the call site, not be sealed onto the returns — and they're
+        # heavyweight enough that batching buys nothing
+        if runtime_env is not None or not self._submit_coalesced(spec):
+            self.add_refs(return_ids)
+            self._submit(spec)
         return refs
 
     def _promote_ref_args(self, spec: TaskSpec):
@@ -286,9 +309,17 @@ class WorkerAPI:
             tenant=self._current_tenant(tenant),
             priority=self._current_priority(priority),
         )
-        self.add_refs(spec.return_ids())
         self._promote_ref_args(spec)
-        self._submit(spec, actor_name=name)
+        # NAMED creations and runtime_env creations stay synchronous:
+        # duplicate-name / env-validation errors must surface at the call
+        # site, not be sealed onto the creation ref
+        if (
+            name is not None
+            or runtime_env is not None
+            or not self._submit_coalesced(spec)
+        ):
+            self.add_refs(spec.return_ids())
+            self._submit(spec, actor_name=name)
         return actor_id
 
     def submit_actor_task(
@@ -341,7 +372,6 @@ class WorkerAPI:
         # unknown endpoints, and restart windows.
         if direct.try_submit(spec):
             return refs
-        self.add_refs(return_ids)
         self._promote_ref_args(spec)
         # cross-path per-caller ordering, both directions: this head
         # submission must not overtake direct/inline calls already in
@@ -354,7 +384,9 @@ class WorkerAPI:
             # must not overtake this head-queued one. note_head_submit
             # self-compacts, so never-fast actors don't grow it unboundedly.
             direct.note_head_submit(spec)
-        self._submit(spec)
+        if not self._submit_coalesced(spec):
+            self.add_refs(return_ids)
+            self._submit(spec)
         return refs
 
     @staticmethod
@@ -591,19 +623,74 @@ class WorkerAPI:
 
 
 class DriverAPI(WorkerAPI):
-    """Driver-side: direct in-process calls into the controller."""
+    """Driver-side: direct in-process calls into the controller.
+
+    Thread mode batches too: the submit coalescer applies N queued
+    submissions (plus ref traffic) under ONE controller lock hold with one
+    scheduler wake — in-process the win is lock/wake amortization rather
+    than wire frames. Delivery goes through ``_dispatch_request`` so the
+    ``submit_batch`` chaos channel covers this path as well."""
 
     def __init__(self, controller):
         super().__init__()
         self.controller = controller
+        from ray_tpu._private.worker_runtime import (
+            SubmitCoalescer,
+            batch_knobs,
+        )
+
+        window_s, max_items = batch_knobs()
+        # GC-queued frees (ObjectRef.__del__ may fire inside ANY locked
+        # region — append-only list, drained by the coalescer flush)
+        self._free_queue: list = []
+        self._coalescer = SubmitCoalescer(
+            self._deliver_batch, window_s, max_items,
+            name="driver-submit-coalescer",
+        )
+        if self._coalescer.enabled:
+            # started eagerly: GC frees queue from __del__ paths that must
+            # never start threads (or take locks) themselves
+            self._coalescer._ensure_thread()
+
+    def _submit_coalesced(self, spec: TaskSpec, actor_name: Optional[str] = None) -> bool:
+        if not self._coalescer.enabled:
+            return False
+        self._coalescer.queue(("submit", spec, actor_name))
+        return True
+
+    def flush_submits(self) -> None:
+        self._coalescer.flush()
+
+    def _deliver_batch(self, items: list) -> None:
+        frees, self._free_queue = self._free_queue, []
+        if frees:
+            items = items + [("free", frees)]
+        if not items:
+            return
+        last_err = None
+        for _attempt in range(20):
+            try:
+                # through _dispatch_request (not submit_batch directly) so
+                # testing_rpc_failure chaos injects here exactly like on
+                # the wire path; an injected failure applies NOTHING, so
+                # replaying the identical batch is safe
+                self.controller._dispatch_request("submit_batch", items)
+                return
+            except WorkerCrashedError as e:
+                last_err = e
+        raise last_err
 
     def _submit(self, spec: TaskSpec, actor_name: Optional[str] = None):
+        # synchronous path (named actors / batching off): earlier coalesced
+        # submissions must land first to keep program-order FIFO
+        self.flush_submits()
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             self.controller.register_actor(spec, name=actor_name)
         else:
             self.controller.submit_task(spec)
 
     def _get_serialized(self, object_ids, timeout):
+        self.flush_submits()
         entries = self.controller.get_entries(object_ids, timeout=timeout)
         out = []
         for oid, e in zip(object_ids, entries):
@@ -647,6 +734,7 @@ class DriverAPI(WorkerAPI):
         return self.controller._authkey
 
     def controller_call(self, op, payload=None):
+        self.flush_submits()
         return self.controller._dispatch_request(op, payload)
 
     def add_refs(self, object_ids):
@@ -658,6 +746,13 @@ class DriverAPI(WorkerAPI):
             st = self._direct.release_local(object_id.binary())
             if st == "local":
                 return  # caller-owned, never head-registered
+        if self._coalescer.enabled:
+            # FIFO through the batcher: a ref dropped right after .remote()
+            # must release AFTER the (possibly still-coalesced) submit adds
+            # it — a direct remove here would transiently free-then-
+            # resurrect the return object. Append-only (GC-safe).
+            self._free_queue.append(object_id)
+            return
         self.controller.remove_ref(object_id)
 
 
@@ -673,7 +768,15 @@ class WorkerProcAPI(WorkerAPI):
         runtime.serialization = self.serialization
 
     def _submit(self, spec, actor_name: Optional[str] = None):
+        # call_controller flushes the coalescer first, so a synchronous
+        # submit (named actor / batching off) keeps program-order FIFO
         self.runtime.call_controller("submit_task", (spec, actor_name))
+
+    def _submit_coalesced(self, spec, actor_name: Optional[str] = None) -> bool:
+        return self.runtime.queue_submit(spec, actor_name)
+
+    def flush_submits(self) -> None:
+        self.runtime.flush_submits()
 
     def _get_serialized(self, object_ids, timeout):
         try:
@@ -698,6 +801,10 @@ class WorkerProcAPI(WorkerAPI):
         return self.runtime.call_controller(op, payload)
 
     def add_refs(self, object_ids):
+        # coalesced with submits when batching is on (one Request per flush
+        # window instead of a fire-and-forget Request + drain thread each)
+        if self.runtime.queue_add_refs(object_ids):
+            return
         self.runtime.call_controller("add_ref", list(object_ids), fire_and_forget=True)
 
     def remove_ref(self, object_id):
@@ -972,12 +1079,19 @@ def shutdown():
             return
         _global_api = None
         ObjectRef._on_delete = None
+        coalescer = getattr(api, "_coalescer", None)
+        if coalescer is not None:
+            # stop the window thread WITHOUT a final flush: at shutdown the
+            # cluster is going away — a last-breath batch would race the
+            # controller teardown (pending refs die with the head anyway)
+            coalescer._shutdown = True
         if api._direct is not None:
             api._direct.shutdown()
         if getattr(api, "is_client", False):
             runtime = getattr(api, "runtime", None)
             if runtime is not None:
                 runtime._shutdown = True
+                runtime._coalescer._shutdown = True
                 try:
                     runtime.conn.close()
                 except OSError:

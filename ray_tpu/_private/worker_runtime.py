@@ -170,6 +170,149 @@ class _DirectReplyConn:
             self.conn.send(msg)
 
 
+def batch_knobs() -> tuple[float, int]:
+    """(window_seconds, max_items) for the client-side submit coalescer.
+    Config-backed with env overrides (worker processes inherit only the
+    environment). window <= 0 disables coalescing."""
+    window_ms: Optional[float] = None
+    max_items: Optional[int] = None
+    env_w = os.environ.get("RAY_TPU_SUBMIT_BATCH_WINDOW_MS")
+    env_m = os.environ.get("RAY_TPU_SUBMIT_BATCH_MAX")
+    try:
+        if env_w is not None:
+            window_ms = float(env_w)
+        if env_m is not None:
+            max_items = int(env_m)
+    except (TypeError, ValueError):
+        # a typo'd deployment env must degrade to the defaults, not crash
+        # every worker/driver at startup
+        window_ms, max_items = None, None
+    if window_ms is None or max_items is None:
+        try:
+            from ray_tpu._private.config import get_config
+
+            cfg = get_config()
+            if window_ms is None:
+                window_ms = cfg.submit_batch_window_ms
+            if max_items is None:
+                max_items = cfg.submit_batch_max
+        except Exception:  # noqa: BLE001 — env-only processes
+            window_ms = 2.0 if window_ms is None else window_ms
+            max_items = 256 if max_items is None else max_items
+    return max(0.0, window_ms) / 1000.0, max(1, max_items)
+
+
+class SubmitCoalescer:
+    """Client-side control-plane batcher (the tentpole of the batched-wire-
+    ops PR): task submissions and fire-and-forget ref traffic queue here and
+    ride ONE ``submit_batch`` request per flush instead of one request each.
+
+    Ordering contract: items flush in FIFO order, and every SYNCHRONOUS
+    controller interaction (get/wait/any request op) flushes the buffer
+    first — so program-order visibility is preserved and ``get()`` never
+    waits out the window. Flushes are serialized (``_flush_lock``), so
+    batches hit the wire in swap order even when the window thread and a
+    sync caller race.
+
+    Reliability: ``flush_fn(items)`` owns delivery + retry. The controller
+    applies a batch atomically w.r.t. chaos injection and skips
+    already-applied specs, so retrying the identical batch is safe
+    (idempotent replay — no lost spec, no double dispatch)."""
+
+    def __init__(self, flush_fn, window_s: float, max_items: int, name: str = "submit-coalescer"):
+        self._flush_fn = flush_fn
+        self.window_s = window_s
+        self.max_items = max_items
+        self._name = name
+        self._items: list = []
+        self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
+        self._shutdown = False
+        # optional owner-supplied thread starter (() -> started Thread): the
+        # owner keeps the flusher thread's target among its OWN methods, so
+        # thread-root analyses (locktrace dumps, tpulint shared-state) see it
+        self.thread_starter = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.window_s > 0 and not self._shutdown
+
+    def queue(self, item) -> None:
+        """Append one batch item; flushes inline past the size cap
+        (submitter backpressure bounds buffer memory)."""
+        with self._lock:
+            self._items.append(item)
+            n = len(self._items)
+        self._ensure_thread()
+        if n >= self.max_items:
+            self.flush()
+        else:
+            self._wake.set()
+
+    def pending(self) -> int:
+        return len(self._items)
+
+    def flush(self) -> None:
+        """Drain and deliver everything queued (called from sync paths and
+        the window thread; FIFO across concurrent flushers). Always invokes
+        ``flush_fn`` — even with zero queued items — because the flush
+        function may own side queues of its own (the worker runtime drains
+        its GC free queue into the same batch)."""
+        with self._flush_lock:
+            with self._lock:
+                items, self._items = self._items, []
+            self._flush_fn(items)
+
+    def _ensure_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._thread_lock:
+            if self._thread is None or not self._thread.is_alive():
+                if self.thread_starter is not None:
+                    self._thread = self.thread_starter()
+                    return
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name=self._name
+                )
+                self._thread.start()
+
+    def _loop(self):
+        while not self._shutdown:
+            # short poll (matching the old free flusher's cadence): GC frees
+            # are queued from __del__ paths that can never set the wake
+            # event, so the loop must look for them on its own beat
+            self._wake.wait(timeout=0.05)
+            self._wake.clear()
+            if self._shutdown:
+                break
+            if self.window_s:
+                # coalescing beat: submissions arrive in bursts; one extra
+                # breath batches the whole burst into a single request
+                time.sleep(self.window_s)
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 — sync paths re-raise their own
+                if not self._shutdown:
+                    traceback.print_exc()
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def shutdown(self):
+        """Final flush, then stop the window thread."""
+        self._shutdown = True
+        self._wake.set()
+        locktrace.join_if_alive(self._thread, timeout=1.0)
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+
+
 class WorkerRuntime:
     def __init__(
         self,
@@ -263,8 +406,20 @@ class WorkerRuntime:
         self._conn_epoch = 0
         # async ref-release queue (see queue_free)
         self._free_queue: list = []
-        self._free_flusher: Optional[threading.Thread] = None
-        self._free_flusher_lock = threading.Lock()
+        # Client-side submit coalescer (batched wire ops): submissions and
+        # add_ref bursts buffer here and ride one submit_batch Request per
+        # flush; the flusher also drains _free_queue into the same batch, so
+        # a GC burst costs one Request instead of one FreeObjects frame per
+        # flush window. Disabled for in-process (thread-mode) runtimes — the
+        # driver API owns batching there.
+        window_s, max_items = batch_knobs()
+        self._coalescer = SubmitCoalescer(
+            self._deliver_batch,
+            window_s if not in_process else 0.0,
+            max_items,
+            name=f"submit-coalescer-{worker_id.hex()[:8]}",
+        )
+        self._coalescer.thread_starter = self._start_coalescer_thread
 
     # ------------------------------------------------------------- transport
 
@@ -296,56 +451,106 @@ class WorkerRuntime:
         """Asynchronous ref release (called from ObjectRef.__del__ — must
         never touch the connection OR any non-reentrant lock: GC can
         interrupt a thread that is already inside a locked region, and a
-        nested acquire would self-deadlock). Append only; the flusher
-        thread (started eagerly, see _ensure_free_flusher) batches sends."""
+        nested acquire would self-deadlock). Append only; the coalescer
+        flush drains this queue into its control batch."""
         self._free_queue.append(object_id)
 
-    def _ensure_free_flusher(self):
-        """Start the free flusher OUTSIDE any __del__ path (plain call
-        sites only, so the lock here can never be re-entered by GC)."""
-        with self._free_flusher_lock:
-            if self._free_flusher is None or not self._free_flusher.is_alive():
-                self._free_flusher = threading.Thread(
-                    target=self._free_flush_loop, daemon=True,
-                    name="free-flusher",
-                )
-                self._free_flusher.start()
+    # ---------------------------------------------- submit coalescer plumbing
 
-    def _free_flush_loop(self):
-        while not self._shutdown:
-            time.sleep(0.05)
-            if not self._free_queue:
-                continue
-            # coalescing window: GC frees arrive in bursts (a dropped list of
-            # refs fires N __del__s back to back); a short extra beat batches
-            # the whole burst into one FreeObjects message
-            time.sleep(0.02)
-            if not self._flush_frees():
-                return
-        # shutdown: flush the final batch instead of dropping it (a flush
-        # racing teardown used to leak whatever queued after the last tick)
-        self._flush_frees()
+    def queue_submit(self, spec, actor_name=None) -> bool:
+        """Coalesce a task/actor submission into the control batch (the
+        head folds the return-id add_refs into the batch apply). Returns
+        False when batching is disabled — the caller takes the synchronous
+        submit_task path instead."""
+        if not self._coalescer.enabled:
+            return False
+        self._coalescer.queue(("submit", spec, actor_name))
+        return True
 
-    def _flush_frees(self) -> bool:
-        """Send every queued free in one batch; False when the connection is
-        gone (the head will reap this worker's refs on death instead)."""
+    def queue_add_refs(self, object_ids) -> bool:
+        """Coalesce an add_ref burst (serialization hooks); replaces the
+        old fire-and-forget Request that spawned a drain thread per call."""
+        if not self._coalescer.enabled:
+            return False
+        self._coalescer.queue(("add_ref", list(object_ids)))
+        return True
+
+    def flush_submits(self) -> None:
+        """Deliver everything coalesced (queued submits, add_refs, frees).
+        Every synchronous controller interaction calls this first, so
+        program-order visibility survives batching."""
+        self._coalescer.flush()
+
+    def _start_coalescer_thread(self):
+        """Flusher-thread factory handed to the coalescer: keeping the
+        target among THIS class's methods keeps thread-root analyses
+        (watchdog dumps, tpulint's shared-state check) aware that the
+        runtime runs its own flusher."""
+        t = threading.Thread(
+            target=self._coalescer_flush_loop, daemon=True,
+            name=f"submit-coalescer-{self.worker_id.hex()[:8]}",
+        )
+        t.start()
+        return t
+
+    def _coalescer_flush_loop(self):
+        self._coalescer._loop()
+
+    def _drain_free_item(self):
         batch, self._free_queue = self._free_queue, []
-        if not batch:
-            return True
+        return ("free", batch) if batch else None
+
+    def _deliver_batch(self, items: list) -> None:
+        """Ship one coalesced control batch (runs under the coalescer's
+        flush lock, so batches hit the wire in FIFO order). Pure-free
+        batches ride the classic fire-and-forget FreeObjects frame; any
+        batch carrying submits/add_refs goes as ONE submit_batch Request,
+        retried on failure — the head's apply is replay-idempotent, so a
+        lost batch is re-sent verbatim with no double-dispatch."""
+        free_item = self._drain_free_item()
+        if free_item is not None:
+            items = items + [free_item]
+        if not items:
+            return
+        if all(it[0] == "free" for it in items):
+            oids = [oid for it in items for oid in it[1]]
+            try:
+                self._send(P.FreeObjects(oids))
+            except (OSError, EOFError):
+                pass  # conn gone: the head reaps this worker's refs on death
+            return
+        last_err: Optional[BaseException] = None
+        for attempt in range(20):
+            if self._shutdown and attempt > 0:
+                return
+            try:
+                self.call_controller("submit_batch", items, _skip_flush=True)
+                return
+            except (OSError, EOFError, TimeoutError, RuntimeError) as e:
+                # client-side injected chaos (OSError pre-send), an injected
+                # controller failure (error reply -> RuntimeError), or a
+                # transport hiccup: replay the identical batch
+                last_err = e
+                time.sleep(min(0.02 * (attempt + 1), 0.2))
+        raise OSError(f"submit_batch delivery failed after retries: {last_err}")
+
+    def shutdown(self):
+        """Deterministic teardown: stop the coalescer (its shutdown flushes
+        the final batch) — the final free batch must hit the wire before
+        the process exits."""
+        self._shutdown = True
+        if not self.in_process:
+            self._coalescer.shutdown()
+        else:
+            self._coalescer._shutdown = True
+
+    # compat shim for older call sites/tests: flush everything queued
+    def _flush_frees(self) -> bool:
         try:
-            self._send(P.FreeObjects(batch))
+            self._coalescer.flush()
             return True
         except (OSError, EOFError):
             return False
-
-    def shutdown(self):
-        """Deterministic teardown: park the free flusher (its loop flushes
-        the final batch on exit), then push any remainder synchronously —
-        the final free batch must hit the wire before the process exits."""
-        self._shutdown = True
-        locktrace.join_if_alive(self._free_flusher, timeout=1.0)
-        if not self.in_process:
-            self._flush_frees()
 
     def register_driver(self):
         """Synchronous client-driver registration: MUST be on the wire before
@@ -359,7 +564,7 @@ class WorkerRuntime:
             # the global one and frees flow through it) — a flusher thread
             # per in-process worker is pure thread-count overhead at the
             # 1000-actor envelope scale
-            self._ensure_free_flusher()
+            self._coalescer._ensure_thread()
         if self.client_mode:
             # client driver: this loop only pumps replies; no tasks arrive
             # (registration already sent synchronously by _connect_client)
@@ -652,7 +857,11 @@ class WorkerRuntime:
 
     def get_objects(self, object_ids: list[ObjectID], timeout=None) -> list:
         """Returns [(SerializedObject, kind)] parallel to object_ids."""
+        # injection FIRST (a failed request leaves the coalescer untouched),
+        # then flush: pending coalesced submits must be on the wire before a
+        # synchronous read (program-order visibility across the window)
         self._maybe_inject_failure("get_objects")
+        self._coalescer.flush()
         req_id = next(self._req_counter)
         epoch = self._conn_epoch
         self._send(P.GetObjects(req_id, object_ids))
@@ -683,8 +892,15 @@ class WorkerRuntime:
                 self._get_cv.wait(timeout=remaining if remaining is not None else 1.0)
             return self._get_replies.pop(req_id)
 
-    def call_controller(self, op: str, payload=None, fire_and_forget: bool = False):
+    def call_controller(self, op: str, payload=None, fire_and_forget: bool = False, _skip_flush: bool = False):
         self._maybe_inject_failure(op)
+        if not _skip_flush:
+            # any synchronous controller interaction flushes the submit
+            # coalescer first — ordering and get()/cancel/kill visibility
+            # are preserved across the batching window (_skip_flush marks
+            # the coalescer's own delivery call; flushing there would
+            # re-enter the flush lock)
+            self._coalescer.flush()
         req_id = next(self._req_counter)
         epoch = self._conn_epoch
         self._send(P.Request(req_id, op, payload))

@@ -158,15 +158,16 @@ def _collect_imports(tree: ast.Module) -> dict:
 
 
 def _unwrap_register(call: ast.expr) -> ast.expr:
-    """`locktrace.register_lock("name", Lock())` -> the inner ctor call, so
-    watchdog registration doesn't blind the analyzer to a lock."""
+    """`locktrace.register_lock("name", Lock())` (and the subsystem-lock
+    wrapper `locktrace.subsystem_lock("name", Lock())`) -> the inner ctor
+    call, so watchdog registration doesn't blind the analyzer to a lock."""
     if (
         isinstance(call, ast.Call)
         and isinstance(call.func, (ast.Attribute, ast.Name))
         and (
             call.func.attr if isinstance(call.func, ast.Attribute) else call.func.id
         )
-        == "register_lock"
+        in ("register_lock", "subsystem_lock")
         and len(call.args) >= 2
     ):
         return call.args[1]

@@ -257,43 +257,114 @@ def envelope(num_cpus: int = 8) -> list[dict]:
     no-cliff check: per-task drain cost must stay roughly flat as the queue
     deepens (the shape-indexed scheduler keeps rounds O(shapes), not
     O(queued))."""
+    import gc
     import os
+    import threading
 
     import ray_tpu
 
     results = []
+
+    def _quiesce_between_rows():
+        """A fresh init() is not enough isolation: thread-mode workers of
+        the PREVIOUS row exit asynchronously (hundreds of threads linger
+        seconds after shutdown) and a 100k-task sweep leaves the GC heap
+        churning — both tax the next row by 2x+. Wait the stragglers out
+        and compact before measuring again."""
+        deadline = time.time() + 15
+        while threading.active_count() > 8 and time.time() < deadline:
+            time.sleep(0.2)
+        gc.collect()
 
     # --- queued-task depth sweep: submit into a deep queue, then drain.
     # Each depth runs in a FRESH cluster so rows are comparable and free of
     # cross-row interpreter-heap effects (the reference's release
     # benchmarks likewise isolate workloads).
     for depth in (5_000, 50_000, 100_000):
+        _quiesce_between_rows()
         ray_tpu.init(num_cpus=num_cpus, mode="thread")
 
         @ray_tpu.remote(num_cpus=0)
         def tick(i):
             return i
 
+        # per-.remote() latency distribution alongside throughput: the
+        # submit coalescer must not trade call latency for batch throughput
+        # (acceptance: batched p50 within 2x of the unbatched path)
+        lat_us = []
         t0 = time.perf_counter()
-        refs = [tick.remote(i) for i in range(depth)]
+        refs = []
+        for i in range(depth):
+            c0 = time.perf_counter_ns()
+            refs.append(tick.remote(i))
+            lat_us.append((time.perf_counter_ns() - c0) / 1e3)
         submit_dur = time.perf_counter() - t0
         t1 = time.perf_counter()
         out = ray_tpu.get(refs, timeout=1800)
         drain_dur = time.perf_counter() - t1
         assert out[-1] == depth - 1
+        lat_us.sort()
         row = {
             "name": f"queued tasks depth {depth}",
             "submit_per_s": depth / submit_dur,
             "drain_per_s": depth / drain_dur,
+            "submit_p50_us": lat_us[len(lat_us) // 2],
+            "submit_p99_us": lat_us[int(len(lat_us) * 0.99)],
         }
         print(
             f"{row['name']:<42s} submit {row['submit_per_s']:>10.1f}/s "
-            f"drain {row['drain_per_s']:>10.1f}/s"
+            f"drain {row['drain_per_s']:>10.1f}/s "
+            f"p50 {row['submit_p50_us']:>6.1f}us p99 {row['submit_p99_us']:>7.1f}us"
         )
         results.append(row)
         del refs, out
         ray_tpu.shutdown()
 
+    # --- single-task submit→result round trip, batched vs unbatched: the
+    # batching window must not show up in a lone task's latency (every sync
+    # get() flushes the coalescer inline)
+    import os as _os
+
+    rtt_row = {"name": "single-task rtt p50/p99 ms"}
+    for label, window in (("batched", None), ("unbatched", "0")):
+        old = _os.environ.get("RAY_TPU_SUBMIT_BATCH_WINDOW_MS")
+        if window is not None:
+            _os.environ["RAY_TPU_SUBMIT_BATCH_WINDOW_MS"] = window
+        try:
+            _quiesce_between_rows()
+            ray_tpu.init(num_cpus=num_cpus, mode="thread")
+
+            @ray_tpu.remote(num_cpus=0)
+            def one():
+                return 1
+
+            ray_tpu.get(one.remote(), timeout=60)  # warm
+            samples = []
+            for _ in range(300):
+                c0 = time.perf_counter_ns()
+                ray_tpu.get(one.remote(), timeout=60)
+                samples.append((time.perf_counter_ns() - c0) / 1e6)
+            samples.sort()
+            rtt_row[f"{label}_p50_ms"] = samples[len(samples) // 2]
+            rtt_row[f"{label}_p99_ms"] = samples[int(len(samples) * 0.99)]
+            ray_tpu.shutdown()
+        finally:
+            if window is not None:
+                if old is None:
+                    _os.environ.pop("RAY_TPU_SUBMIT_BATCH_WINDOW_MS", None)
+                else:
+                    _os.environ["RAY_TPU_SUBMIT_BATCH_WINDOW_MS"] = old
+            from ray_tpu._private import config as _config_mod
+
+            _config_mod._global_config = None  # re-read env next init
+    print(
+        f"{rtt_row['name']:<42s} batched {rtt_row['batched_p50_ms']:.2f}/"
+        f"{rtt_row['batched_p99_ms']:.2f}  unbatched "
+        f"{rtt_row['unbatched_p50_ms']:.2f}/{rtt_row['unbatched_p99_ms']:.2f}"
+    )
+    results.append(rtt_row)
+
+    _quiesce_between_rows()
     ray_tpu.init(num_cpus=num_cpus, mode="thread")
 
     # --- many actors: create 1000, call each once ---
@@ -509,10 +580,24 @@ def record(path: str = "MICROBENCH.json") -> None:
     print(f"wrote {path}")
 
 
+def update_envelope(path: str = "MICROBENCH.json") -> None:
+    """Re-record ONLY the scalability-envelope section (the control-plane
+    perf artifact this file's other sections don't depend on) — the full
+    --record run re-measures every subsystem and takes far longer."""
+    with open(path) as f:
+        out = json.load(f)
+    out["envelope"] = envelope()
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"updated envelope in {path}")
+
+
 if __name__ == "__main__":
     import sys
 
     if "--record" in sys.argv:
         record()
+    elif "--update-envelope" in sys.argv:
+        update_envelope()
     else:
         main()

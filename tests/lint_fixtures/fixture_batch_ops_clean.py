@@ -1,0 +1,89 @@
+"""Companion fixture: the batched control-plane ops done RIGHT.
+
+Same protocol shapes as ``fixture_batch_ops_leak.py`` with the bugs fixed
+— correct op literal, reply consumed without unpacking a None path, the
+batch trace log credited in a ``finally``, and the declared op set in
+sync with the ladder. Zero findings across every family.
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import threading
+
+CONTROLLER_OPS = frozenset({"submit_batch", "tasks_pending"})
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    """Dispatch surface for the batched submission ops."""
+
+    def __init__(self):
+        self._pending = {}
+        self._refs = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "submit_batch":
+            for item in payload:
+                if item[0] == "submit":
+                    self._pending[item[1]] = item[2]
+                elif item[0] == "add_ref":
+                    for oid in item[1]:
+                        self._refs[oid] = self._refs.get(oid, 0) + 1
+            return None
+        if op == "tasks_pending":
+            return [tid in self._pending for tid in payload]
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class Coalescer:
+    """Client-side submit batcher speaking the batched ops correctly."""
+
+    def __init__(self, conn):
+        self._conn = conn
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+        self._items = []
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def flush(self):
+        items, self._items = self._items, []
+        self.call_controller("submit_batch", items)
+
+    def drained(self, task_ids):
+        pending = self.call_controller("tasks_pending", list(task_ids))
+        if pending is None:
+            return False
+        return not any(pending)
+
+    def flush_traced(self, batch):
+        log = open(batch.trace_path, "ab")  # noqa: SIM115 — fixture shape
+        try:
+            log.write(b"batch flush\n")
+            deliver(batch)
+        finally:
+            log.close()
+
+
+def deliver(batch) -> None:
+    if not batch.items:
+        raise ValueError("empty batch delivery")

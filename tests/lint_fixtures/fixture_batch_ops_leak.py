@@ -1,0 +1,99 @@
+"""Self-detection fixture: the batched control-plane ops done WRONG.
+
+The PR 12 growth shape — the client-side submit coalescer ships its
+batches from a flusher module far from the controller's dispatch ladder,
+so a typo'd batch op or a misread reply shape ships clean and only
+surfaces at runtime (every coalesced submission dying as an unknown-op
+error reply, or a TypeError in the flusher's retry loop); and the flush
+path stages a per-batch trace log that a delivery raise strands. tpulint
+must flag:
+
+- wire-conformance: the misspelled ``submit_batc`` send (did-you-mean)
+  and the flusher unpacking ``submit_batch``'s reply into two names when
+  the handler's only return path is ``None``;
+- ref-lifecycle: the batch trace log leaked when delivery raises
+  (leak-on-raise in the flush path).
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import threading
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    """Dispatch surface for the batched submission ops."""
+
+    def __init__(self):
+        self._pending = {}
+        self._refs = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "submit_batch":
+            for item in payload:
+                if item[0] == "submit":
+                    self._pending[item[1]] = item[2]
+                elif item[0] == "add_ref":
+                    for oid in item[1]:
+                        self._refs[oid] = self._refs.get(oid, 0) + 1
+            return None
+        if op == "tasks_pending":
+            return [tid in self._pending for tid in payload]
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class Coalescer:
+    """Client-side submit batcher with the protocol bugs under test."""
+
+    def __init__(self, conn):
+        self._conn = conn
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+        self._items = []
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def flush(self):
+        # BUG: "submit_batc" — no handler branch matches; every coalesced
+        # submission in the batch dies as one unknown-op error reply
+        items, self._items = self._items, []
+        return self.call_controller("submit_batc", items)
+
+    def flush_and_count(self, items):
+        # BUG: the submit_batch handler's only return path is None — this
+        # two-name unpack is a TypeError in the flusher's retry loop
+        applied, skipped = self.call_controller("submit_batch", items)
+        return applied
+
+    def flush_traced(self, batch):
+        """Leak-on-raise in the flush path: the per-batch trace log is
+        open while deliver() can raise — no handler, no finally, the
+        handle (and its fd) strands with the failed batch."""
+        log = open(batch.trace_path, "ab")  # noqa: SIM115 — fixture shape
+        log.write(b"batch flush\n")
+        deliver(batch)
+        log.close()
+
+
+def deliver(batch) -> None:
+    if not batch.items:
+        raise ValueError("empty batch delivery")

@@ -88,20 +88,12 @@ class FakeAgent:
                 with self._reply_cv:
                     self._replies[msg.req_id] = msg
                     self._reply_cv.notify_all()
-            elif isinstance(msg, P.LeaseActor):
-                self.leases.append(msg)
-            elif isinstance(msg, P.LeaseTask):
-                # a real agent runs the leased task and reports done; the
-                # scripted agent completes it instantly with None results
-                self.task_leases.append(msg)
-                if self.echo_tasks:
-                    self._send(
-                        P.AgentTaskDone(
-                            msg.spec.task_id,
-                            self._none_results(msg.spec),
-                            exec_ms=0.1,
-                        )
-                    )
+            elif isinstance(msg, P.LeaseBatch):
+                # batched grant push (PR 12): unpack FIFO like a real agent
+                for lease in msg.leases:
+                    self._on_lease(lease)
+            elif isinstance(msg, (P.LeaseActor, P.LeaseTask)):
+                self._on_lease(msg)
             elif isinstance(msg, P.KillWorker):
                 # a real agent kills the process and reports the death —
                 # the scripted worker "dies" instantly (drain migration and
@@ -129,6 +121,22 @@ class FakeAgent:
                             ),
                         )
                     )
+
+    def _on_lease(self, msg):
+        if isinstance(msg, P.LeaseActor):
+            self.leases.append(msg)
+            return
+        # a real agent runs the leased task and reports done; the
+        # scripted agent completes it instantly with None results
+        self.task_leases.append(msg)
+        if self.echo_tasks:
+            self._send(
+                P.AgentTaskDone(
+                    msg.spec.task_id,
+                    self._none_results(msg.spec),
+                    exec_ms=0.1,
+                )
+            )
 
     def _hb_loop(self):
         while not self.closed:

@@ -333,11 +333,10 @@ def test_direct_inline_max_bytes_spills_to_shm(ray_start_process):
 
 
 def test_queue_free_flusher_flushes_on_shutdown():
-    """Satellite: the free-flusher must deliver the FINAL batch when the
+    """Satellite: the coalescer must deliver the FINAL free batch when the
     runtime shuts down — a flush racing teardown used to drop it (head-side
-    ref leak)."""
-    import threading as _threading
-
+    ref leak). Pure-free batches still ride the fire-and-forget
+    FreeObjects frame (no reply needed at teardown)."""
     from ray_tpu._private import protocol as P
     from ray_tpu._private.ids import ObjectID, WorkerID
     from ray_tpu._private.worker_runtime import WorkerRuntime
@@ -352,24 +351,22 @@ def test_queue_free_flusher_flushes_on_shutdown():
             pass
 
     rt = WorkerRuntime(WorkerID.from_random(), StubConn(), in_process=False)
-    flusher = _threading.Thread(target=rt._free_flush_loop, daemon=True)
-    flusher.start()
+    rt._coalescer._ensure_thread()
     time.sleep(0.02)
-    # frees queued right at teardown: the loop must flush them on exit
-    rt._shutdown = True
+    # frees queued right at teardown: shutdown must flush them on exit
     rt.queue_free(ObjectID.from_put(1, rt.worker_id))
     rt.queue_free(ObjectID.from_put(2, rt.worker_id))
-    flusher.join(timeout=5)
-    assert not flusher.is_alive()
+    rt.shutdown()
     frees = [m for m in sent if isinstance(m, P.FreeObjects)]
-    assert frees and len(frees[-1].object_ids) == 2, f"final batch dropped: {sent}"
+    assert frees, f"final batch dropped: {sent}"
+    assert sum(len(m.object_ids) for m in frees) == 2
     assert rt._free_queue == []
 
 
 def test_queue_free_flusher_coalesces_bursts():
-    """A GC burst of frees lands as one batched FreeObjects message, not N."""
-    import threading as _threading
-
+    """A GC burst of frees lands as one batched FreeObjects message, not N
+    — the coalescer drains the whole free queue into a single frame per
+    flush tick."""
     from ray_tpu._private import protocol as P
     from ray_tpu._private.ids import ObjectID, WorkerID
     from ray_tpu._private.worker_runtime import WorkerRuntime
@@ -384,8 +381,7 @@ def test_queue_free_flusher_coalesces_bursts():
             pass
 
     rt = WorkerRuntime(WorkerID.from_random(), StubConn(), in_process=False)
-    flusher = _threading.Thread(target=rt._free_flush_loop, daemon=True)
-    flusher.start()
+    rt._coalescer._ensure_thread()
     for i in range(50):
         rt.queue_free(ObjectID.from_put(i + 1, rt.worker_id))
     deadline = time.monotonic() + 5
@@ -396,5 +392,4 @@ def test_queue_free_flusher_coalesces_bursts():
     frees = [m for m in sent if isinstance(m, P.FreeObjects)]
     assert sum(len(m.object_ids) for m in frees) == 50
     assert len(frees) <= 3, f"burst fragmented into {len(frees)} messages"
-    rt._shutdown = True
-    flusher.join(timeout=5)
+    rt.shutdown()

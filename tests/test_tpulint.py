@@ -1184,6 +1184,37 @@ def test_fixture_tenant_ops_clean_has_zero_findings():
     assert findings == [], [f.render() for f in findings]
 
 
+def test_fixture_batch_ops_leak_flagged():
+    """The PR 12 batched-ops shape done wrong: a typo'd submit_batc flush
+    (did-you-mean), the flusher unpacking submit_batch's None reply, and
+    the flush path stranding the per-batch trace log when delivery
+    raises."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_batch_ops_leak.py")]
+    )
+    wire = _by_check(findings).get("wire-conformance", [])
+    assert len(wire) == 2, [f.render() for f in findings]
+    typo = next(h for h in wire if "submit_batc" in h.message)
+    assert 'did you mean "submit_batch"' in typo.message
+    misuse = next(h for h in wire if "unpacked into 2" in h.message)
+    assert "submit_batch" in misuse.message and "None" in misuse.message
+    assert misuse.qualname.endswith("Coalescer.flush_and_count")
+    life = _by_check(findings).get("ref-lifecycle", [])
+    assert len(life) == 1, [f.render() for f in findings]
+    assert life[0].qualname.endswith("Coalescer.flush_traced")
+    assert "leaks when" in life[0].message
+
+
+def test_fixture_batch_ops_clean_has_zero_findings():
+    """Same batched-ops shapes done right (correct op literal, reply
+    guarded/ignored, finally-credited trace log, declared op set in sync):
+    zero findings across every family."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_batch_ops_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_protocol_doc_is_current_and_covers_controller_ops():
     """docs/PROTOCOL.md matches a fresh render of the extracted catalog and
     names every controller op + the agent data-plane surface."""
