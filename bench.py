@@ -816,6 +816,44 @@ def check_floor(max_regress: float = 0.25) -> int:
             }
             if rate < floor:
                 failures.append(name)
+
+    # --- serve-ingress ladder floor (ISSUE 13 satellite): a regression in
+    # the proxy data plane (admission, routing, zero-copy writes) fails
+    # HERE against the recorded saturation point, load-calibrated like the
+    # envelope floors with the same 2x probe-vs-full-run allowance.
+    rec_ladder = recorded.get("serve_ladder", {}).get("saturation_rps")
+    if rec_ladder:
+        from ray_tpu.scripts.serve_ladder_bench import (
+            _deploy_echo,
+            _run_clients,
+            _wait_route,
+        )
+
+        load_scale = load_scales.get("thread", 1.0)
+        ray_tpu.init(
+            num_cpus=8, mode="thread",
+            config={"serve_max_inflight_per_proxy": 4096},
+        )
+        from ray_tpu import serve as _serve
+
+        _deploy_echo()
+        _, sport = _serve.start_proxy(port=0)
+        _wait_route(sport, "/echo")
+        _run_clients([sport], 2, 0.5)  # warm
+        probe = _run_clients([sport], 8, 2.0)
+        _serve.shutdown()
+        ray_tpu.shutdown()
+        floor = rec_ladder * (1.0 - max_regress) * load_scale / 2.0
+        out["serve_ladder"] = {
+            "rate_per_s": probe["rps"],
+            "recorded_per_s": round(rec_ladder, 1),
+            "load_scale": round(load_scale, 3),
+            "floor_per_s": round(floor, 1),
+            "stalls": probe["stalls"],
+            "ok": probe["rps"] >= floor and probe["stalls"] == 0,
+        }
+        if not out["serve_ladder"]["ok"]:
+            failures.append("serve_ladder")
     print(json.dumps({"check_floor": out, "failed": failures}))
     return 1 if failures else 0
 
@@ -846,6 +884,22 @@ if __name__ == "__main__":
         from ray_tpu.scripts.fairshare_bench import record as fairshare_record
 
         fairshare_record(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "MICROBENCH.json"
+            )
+        )
+        sys.exit(0)
+    if "--serve-ladder" in sys.argv:
+        # serve ingress: RPS x latency ladder + saturation point, 2x
+        # overload shed behavior, and multi-proxy scaling rows, recorded
+        # into MICROBENCH.json["serve_ladder"]
+        import os
+
+        from ray_tpu.scripts.serve_ladder_bench import (
+            record as serve_ladder_record,
+        )
+
+        serve_ladder_record(
             os.path.join(
                 os.path.dirname(os.path.abspath(__file__)), "MICROBENCH.json"
             )

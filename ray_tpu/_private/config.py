@@ -110,6 +110,33 @@ class Config:
     # into one AgentReportBatch frame (0 = report per task, pre-batching
     # behavior).
     agent_report_flush_ms: float = 2.0
+    # --- serve ingress (see ray_tpu/serve/proxy.py AdmissionController) ---
+    # Global in-flight request budget per proxy actor: admitted-but-not-
+    # finished requests across every deployment and tenant. Past the budget
+    # the proxy SHEDS (429 + Retry-After) instead of queueing — an overload
+    # must degrade by rejecting cheaply, never by stalling every open
+    # connection behind an unbounded backlog.
+    serve_max_inflight_per_proxy: int = 256
+    # Per-deployment bounded queue at the proxy: in-flight requests for one
+    # deployment past this cap shed even while the global budget has room,
+    # so a single hot route cannot consume the whole ingress.
+    serve_queue_depth_per_deployment: int = 128
+    # Retry-After hint (seconds) attached to shed (429) responses.
+    serve_shed_retry_after_s: float = 1.0
+    # Bounded drain window for proxy shutdown: in-flight requests get this
+    # long to finish after listeners stop accepting; streams still open at
+    # the deadline are cut and counted in proxy stats (dropped_streams).
+    serve_drain_window_s: float = 10.0
+    # Streamed response chunks that are raw bytes of at least this size ride
+    # the zero-copy path: the replica wraps them as out-of-band buffers
+    # (RawBody), and the proxy writes the arena-backed memoryview straight
+    # to the socket — no pickle copy, no proxy-side staging buffer.
+    # 0 disables (every body is pickled + copied, the pre-ingress behavior).
+    serve_zero_copy_min_bytes: int = 256 * 1024
+    # Per-tenant admission at the proxy (weight-proportional caps derived
+    # from TenantState policy; see tenants.admission_caps). Disable to admit
+    # purely on the global/per-deployment budgets.
+    serve_tenant_admission: bool = True
     # --- object store ---
     # Objects <= this many bytes are returned inline through the control plane
     # (reference: max_direct_call_object_size, ray_config_def.h).

@@ -91,8 +91,8 @@ NODE_SHARD_OPS = frozenset({
 })
 KV_SHARD_OPS = frozenset({"kv_put", "kv_get", "kv_del", "kv_keys"})
 OBSERVE_SHARD_OPS = frozenset({
-    "log_get", "log_list", "log_tail_buffer", "pubsub_poll",
-    "pubsub_publish", "worker_stacks",
+    "log_get", "log_list", "log_tail_buffer", "proxy_stats",
+    "pubsub_poll", "pubsub_publish", "report_proxy_stats", "worker_stacks",
 })
 
 
@@ -497,6 +497,11 @@ class Controller:
         # transfer observability: tests assert the zero-re-transfer property
         # through these counters instead of timing
         self.transfer_stats: dict[str, int] = defaultdict(int)
+        # serve-ingress observability: proxy_id -> the admission/shed/byte
+        # counter snapshot each proxy pushes (report_proxy_stats) — the
+        # ``proxy_stats`` op / state API reads the aggregate. Guarded by
+        # self.lock; low-rate (one small dict per proxy every ~2 s).
+        self._proxy_stats: dict[str, dict] = {}
         # actor-creation observability (the agent-owned lease protocol):
         # tests pin "the head never runs a spawn thread for an agent-node
         # actor" through these counters instead of timing/threads
@@ -3801,6 +3806,29 @@ class Controller:
         env = dict(os.environ)
         env["RAY_TPU_WORKER"] = "1"
         env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
+        # Propagate the driver's resolved config table (reference:
+        # ray_config_def.h — RAY_CONFIG values propagate to child
+        # processes): a fresh worker rebuilds Config.from_env(), so every
+        # field overridden away from its default rides its RAY_TPU_<NAME>
+        # env var — otherwise `init(config={...})` knobs (serve admission
+        # budgets, transfer windows, batching) silently reset to defaults
+        # inside process-mode workers. Ambient env pins win untouched.
+        import dataclasses as _dc
+
+        _defaults = Config()
+        for _f in _dc.fields(Config):
+            _cur = getattr(self.config, _f.name)
+            if _cur == getattr(_defaults, _f.name):
+                continue
+            _key = "RAY_TPU_" + _f.name.upper()
+            if _key in env:
+                continue
+            if isinstance(_cur, bool):
+                env[_key] = "1" if _cur else "0"
+            elif isinstance(_cur, (int, float, str)):
+                env[_key] = str(_cur)
+            else:
+                env[_key] = json.dumps(_cur)
         # Make the ray_tpu package + the driver's modules importable in the
         # fresh interpreter (reference: services.py propagates sys.path).
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -5025,6 +5053,25 @@ class Controller:
             # dashboard "logs" source)
             n = int(payload or 1000)
             return list(self._log_buffer)[-n:]
+        if op == "report_proxy_stats":
+            # serve proxies push their admission/shed/byte counters here
+            # (one small dict per proxy every ~2 s); ``proxy_stats`` reads
+            proxy_id, stats = payload
+            with self.lock:
+                self._proxy_stats[proxy_id] = {
+                    **(stats or {}),
+                    "reported_t": time.time(),
+                }
+            return None
+        if op == "proxy_stats":
+            # per-proxy ingress counters (accepted/shed/queued/inflight +
+            # per-tenant shed); payload optionally filters by proxy-id prefix
+            with self.lock:
+                return {
+                    pid: dict(rec)
+                    for pid, rec in self._proxy_stats.items()
+                    if payload is None or pid.startswith(payload)
+                }
         if op == "pubsub_poll":
             channel, after_seq, timeout = payload
             return self.pubsub_poll(channel, after_seq, min(timeout, 30.0))

@@ -396,6 +396,7 @@ CONTROLLER_OPS = frozenset(
         "pg_ready",
         "pg_remove",
         "pg_table",
+        "proxy_stats",
         "pubsub_poll",
         "pubsub_publish",
         "pull_into_arena",
@@ -404,6 +405,7 @@ CONTROLLER_OPS = frozenset(
         "register_replica",
         "remove_node",
         "report_agent_spill",
+        "report_proxy_stats",
         "set_tenant_quota",
         "shm_create",
         "stream_abandoned",

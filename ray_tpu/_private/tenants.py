@@ -51,6 +51,35 @@ MIN_WEIGHT = 0.01
 TASK_COST = 1.0
 
 
+def admission_caps(policies: list[dict], budget: int) -> dict[str, int]:
+    """Weight-proportional per-tenant shares of an ingress in-flight budget.
+
+    The serve proxy's admission controller reuses the SAME fair-share policy
+    the scheduler arbitrates with (``TenantState.snapshot()`` records): each
+    tenant's cap is its weight fraction of the proxy's budget, floored at 1
+    so a configured tenant can always make progress. Caps are ceilings, not
+    reservations — the global budget still applies, so an idle tenant's
+    share is usable by others; the cap only stops one tenant's burst from
+    occupying the entire ingress (the PR 11 tail: the scheduler arbitrates,
+    the proxy now does too).
+
+    ``policies``: tenant stats records (need ``tenant`` + ``weight``).
+    Returns {} when fewer than two tenants are known — with a single tenant
+    the global budget alone is the policy.
+    """
+    known = {p["tenant"]: max(float(p.get("weight", 1.0)), MIN_WEIGHT)
+             for p in policies}
+    if len(known) < 2 or budget <= 0:
+        return {}
+    total = sum(known.values())
+    import math
+
+    return {
+        name: max(1, math.ceil(budget * w / total))
+        for name, w in known.items()
+    }
+
+
 class TenantState:
     """Per-tenant scheduling state (guarded by the controller lock)."""
 

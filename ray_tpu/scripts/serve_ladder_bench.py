@@ -1,0 +1,339 @@
+"""Serve-ingress RPS x latency ladder (``python bench.py --serve-ladder``).
+
+Records ``MICROBENCH.json["serve_ladder"]`` — the ROADMAP item 2 done-bar
+artifact:
+
+1. **Ladder** (thread mode, shed disabled): closed-loop concurrency rungs
+   against ONE proxy → achieved RPS + p50/p99 per rung, and the stated
+   **saturation point** (the best rung).
+2. **Calibrated admission budget**: the largest rung whose p99 stays
+   within 3x the unloaded (C=1) p99 — the budget at which admission
+   control keeps every ADMITTED request's time-in-system bounded. This is
+   the point of shedding: capacity beyond it only buys queueing delay.
+3. **2x overload** (budget applied): offered concurrency = 2x the budget;
+   clients honor a short backoff on 429. Graceful degradation =
+   shed rate > 0, admitted p99 <= 3x unloaded p99, ZERO stalls (no client
+   errors/timeouts, every shed returns immediately).
+4. **Multi-proxy scaling** (process mode, one proxy per node): handlers
+   model an accelerator step (sleep — a TPU matmul burns no host CPU), so
+   each proxy's admission budget is the capacity unit and horizontal
+   proxies scale admitted concurrency. Recorded per 1/2/3 proxies with the
+   2-proxy scaling factor.
+
+Honesty caveats ride in the artifact: the CI host is 1 vCPU, so the
+CPU-bound rungs measure the shared-core ingress stack (client + proxy +
+replica), and the multi-proxy row uses the modeled-accelerator workload
+(the same convention as the transfer bench's modeled-RTT rows and the
+actor-creation bench's delay-0 row).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(len(sorted_vals) * q))
+    return sorted_vals[idx]
+
+
+def _wait_route(port: int, prefix: str, timeout_s: float = 30.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            conn.request("GET", "/-/routes")
+            routes = json.loads(conn.getresponse().read())
+            conn.close()
+            if prefix in routes:
+                return
+        except Exception:  # noqa: BLE001 — proxy still starting
+            pass
+        time.sleep(0.3)
+    raise TimeoutError(f"route {prefix} never appeared on :{port}")
+
+
+def _run_clients(
+    ports: list,
+    conc_per_port: int,
+    secs: float,
+    path: str = "/echo/",
+    backoff_429_s: float = 0.025,
+) -> dict:
+    """Closed-loop keep-alive clients; returns achieved RPS + latency
+    percentiles of ADMITTED (200) requests, shed counts, and stalls
+    (client-side errors/timeouts — the "don't stall" criterion)."""
+    lock = threading.Lock()
+    lat: list = []
+    counts = {"ok": 0, "shed": 0, "stalls": 0}
+    stop = time.monotonic() + secs
+
+    def worker(port: int):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        my_lat = []
+        ok = shed = stalls = 0
+        while time.monotonic() < stop:
+            t0 = time.monotonic()
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            except Exception:  # noqa: BLE001 — conn died: a stall
+                stalls += 1
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=30
+                )
+                continue
+            if status == 200:
+                my_lat.append(time.monotonic() - t0)
+                ok += 1
+            elif status == 429:
+                shed += 1
+                time.sleep(backoff_429_s)
+            else:
+                stalls += 1
+        conn.close()
+        with lock:
+            lat.extend(my_lat)
+            counts["ok"] += ok
+            counts["shed"] += shed
+            counts["stalls"] += stalls
+
+    threads = [
+        threading.Thread(target=worker, args=(p,))
+        for p in ports
+        for _ in range(conc_per_port)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dur = time.monotonic() - t0
+    lat.sort()
+    return {
+        "rps": round(counts["ok"] / dur, 1),
+        "p50_ms": round(_percentile(lat, 0.50) * 1e3, 2),
+        "p99_ms": round(_percentile(lat, 0.99) * 1e3, 2),
+        "admitted": counts["ok"],
+        "shed": counts["shed"],
+        "stalls": counts["stalls"],
+        "duration_s": round(dur, 2),
+    }
+
+
+def _deploy_echo(replicas: int = 2):
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=replicas, max_ongoing_requests=64)
+    class Echo:
+        def __call__(self, request):
+            return {"ok": 1}
+
+    serve.run(Echo.bind(), name="echo", route_prefix="/echo")
+
+
+def _ladder_phase(rung_secs: float) -> dict:
+    """Thread-mode single-proxy ladder with shedding disabled (the raw
+    capacity curve the admission budget is calibrated from)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(
+        num_cpus=8, mode="thread",
+        config={"serve_max_inflight_per_proxy": 4096},
+    )
+    try:
+        _deploy_echo()
+        _, port = serve.start_proxy(port=0)
+        _wait_route(port, "/echo")
+        _run_clients([port], 2, 0.5)  # warm connections + replica path
+        rungs = []
+        for conc in (1, 2, 4, 8, 16, 32, 64):
+            row = _run_clients([port], conc, rung_secs)
+            row["concurrency"] = conc
+            rungs.append(row)
+        return {"rungs": rungs}
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def _overload_phase(budget: int, rung_secs: float) -> dict:
+    """Re-init with the calibrated budget; drive 2x overload."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(
+        num_cpus=8, mode="thread",
+        config={"serve_max_inflight_per_proxy": budget},
+    )
+    try:
+        _deploy_echo()
+        proxy, port = serve.start_proxy(port=0)
+        _wait_route(port, "/echo")
+        unloaded = _run_clients([port], 1, max(rung_secs / 2, 1.0))
+        over = _run_clients([port], 2 * budget, rung_secs)
+        stats = ray_tpu.get(proxy.get_stats.remote(), timeout=30)
+        return {
+            "budget": budget,
+            "offered_concurrency": 2 * budget,
+            "unloaded_p99_ms": unloaded["p99_ms"],
+            "admitted_rps": over["rps"],
+            "admitted_p50_ms": over["p50_ms"],
+            "admitted_p99_ms": over["p99_ms"],
+            "shed": over["shed"],
+            "shed_rate": round(
+                over["shed"] / max(over["shed"] + over["admitted"], 1), 3
+            ),
+            "stalls": over["stalls"],
+            "p99_vs_unloaded": round(
+                over["p99_ms"] / max(unloaded["p99_ms"], 1e-6), 2
+            ),
+            "proxy_counters": {
+                k: stats[k]
+                for k in ("accepted", "shed", "shed_global", "dropped_streams")
+            },
+            # the ROADMAP done-bar: shed > 0, bounded admitted p99, no stalls
+            "graceful": bool(
+                over["shed"] > 0
+                and over["stalls"] == 0
+                and over["p99_ms"] <= 3.0 * max(unloaded["p99_ms"], 1e-6)
+            ),
+        }
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def _multi_proxy_phase(
+    budget: int, step_s: float, rung_secs: float
+) -> dict:
+    """Process mode, one proxy per node (head + 2 added nodes), handlers
+    modeling an accelerator step: per-proxy admission budget is the
+    capacity unit, so rows show admitted-concurrency scaling."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.init(
+        num_cpus=8, mode="process",
+        config={"serve_max_inflight_per_proxy": budget},
+    )
+    try:
+        controller = global_worker().controller
+        controller.add_node({"CPU": 4.0}, None)
+        controller.add_node({"CPU": 4.0}, None)
+
+        @serve.deployment(num_replicas=4, max_ongoing_requests=64)
+        class Sleeper:
+            def __init__(self, step_s):
+                self._step_s = step_s
+
+            def __call__(self, request):
+                time.sleep(self._step_s)  # modeled accelerator step
+                return {"ok": 1}
+
+        serve.run(Sleeper.bind(step_s), name="echo", route_prefix="/echo")
+        proxies = serve.start_proxies(port=0)
+        ports = [p for _, p in proxies.values()]
+        for p in ports:
+            _wait_route(p, "/echo")
+        _run_clients(ports, 2, step_s * 3)  # warm every proxy + replica
+        rows = []
+        for n in (1, 2, 3):
+            if n > len(ports):
+                break
+            row = _run_clients(ports[:n], budget, rung_secs)
+            row["proxies"] = n
+            row["clients"] = n * budget
+            rows.append(row)
+        one = rows[0]["rps"]
+        return {
+            "workload": (
+                f"{step_s * 1e3:.0f} ms modeled accelerator step, "
+                f"budget {budget}/proxy, 4 replicas"
+            ),
+            "rows": rows,
+            "scaling_2p": round(rows[1]["rps"] / max(one, 1e-6), 2)
+            if len(rows) > 1
+            else None,
+            "scaling_3p": round(rows[2]["rps"] / max(one, 1e-6), 2)
+            if len(rows) > 2
+            else None,
+        }
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def run(rung_secs: float = 2.5) -> dict:
+    ladder = _ladder_phase(rung_secs)
+    rungs = ladder["rungs"]
+    saturation = max(rungs, key=lambda r: r["rps"])
+    unloaded_p99 = rungs[0]["p99_ms"]
+    # calibrated budget: deepest rung whose p99 holds the 3x bound (>=2)
+    budget = 2
+    for r in rungs:
+        if r["p99_ms"] <= 3.0 * max(unloaded_p99, 1e-6):
+            budget = max(budget, r["concurrency"])
+    overload = _overload_phase(budget, rung_secs)
+    multi = _multi_proxy_phase(budget=12, step_s=0.2, rung_secs=3.0)
+    return {
+        "host_vcpus": os.cpu_count(),
+        "ladder": rungs,
+        "saturation_rps": saturation["rps"],
+        "saturation_concurrency": saturation["concurrency"],
+        "unloaded_p99_ms": unloaded_p99,
+        "calibrated_budget": budget,
+        "overload_2x": overload,
+        "multi_proxy": multi,
+        "caveats": [
+            "ladder/overload rungs are thread-mode (in-proc store fast "
+            "path) on a shared host: client threads, proxy, and replicas "
+            "contend for the same core(s); absolute RPS is an "
+            "ambient-load snapshot, the shed/p99/stall semantics are the "
+            "gated artifact",
+            "multi-proxy rows run process mode with a sleep-modeled "
+            "accelerator step: on this 1-vCPU host, CPU-bound handlers "
+            "cannot scale with proxy count, so the row measures what "
+            "horizontal ingress actually adds — admitted-concurrency "
+            "capacity (one admission budget per proxy)",
+        ],
+    }
+
+
+def record(path: str) -> dict:
+    result = run()
+    data = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    data["serve_ladder"] = result
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"serve_ladder": result}, indent=1))
+    return result
+
+
+if __name__ == "__main__":
+    record(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+            "MICROBENCH.json",
+        )
+    )

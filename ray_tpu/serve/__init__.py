@@ -10,6 +10,7 @@ from ray_tpu.serve.api import (
     delete,
     get_app_handle,
     get_deployment_handle,
+    list_proxies,
     run,
     shutdown,
     status,
@@ -21,7 +22,8 @@ from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.grpc_proxy import GrpcProxyActor, start_grpc_proxy
-from ray_tpu.serve.proxy import ProxyActor, Request, start_proxy
+from ray_tpu.serve.proxy import ProxyActor, Request, start_proxies, start_proxy
+from ray_tpu.serve.streaming import RawBody
 
 __all__ = [
     "Application",
@@ -39,9 +41,12 @@ __all__ = [
     "get_deployment_handle",
     "get_multiplexed_model_id",
     "ingress",
+    "list_proxies",
     "multiplexed",
     "run",
+    "RawBody",
     "shutdown",
+    "start_proxies",
     "start_proxy",
     "GrpcProxyActor",
     "start_grpc_proxy",

@@ -82,6 +82,7 @@ def run(
                 "init_args_payload": cloudpickle.dumps((args, kwargs)),
                 "initial_replicas": cfg.initial_replicas(),
                 "max_ongoing_requests": cfg.max_ongoing_requests,
+                "max_queued_requests": cfg.max_queued_requests,
                 "autoscaling_config": (
                     cfg.autoscaling_config.__dict__ if cfg.autoscaling_config else None
                 ),
@@ -145,6 +146,14 @@ def get_app_handle(app_name: str = "default") -> DeploymentHandle:
     if app is None:
         raise RuntimeError(f"no application named {app_name!r}")
     return DeploymentHandle(app["ingress"])
+
+
+def list_proxies() -> dict:
+    """The ingress endpoint table: proxy_id -> {node_id, host, port}
+    (published by the serve controller; one proxy per node after
+    ``serve.start_proxies()``)."""
+    controller = _get_controller_handle()
+    return ray_tpu.get(controller.list_proxies.remote(), timeout=30)
 
 
 def shutdown():

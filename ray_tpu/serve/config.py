@@ -43,6 +43,13 @@ class AutoscalingConfig:
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 8
+    # Per-deployment bound on admitted-but-unfinished requests AT EACH
+    # PROXY (the ingress admission queue): past it the proxy sheds with
+    # 429 + Retry-After instead of queueing. None = the global
+    # ``Config.serve_queue_depth_per_deployment`` knob. Distinct from
+    # ``max_ongoing_requests``, which bounds concurrency INSIDE one
+    # replica (reference: serve's max_queued_requests handle option).
+    max_queued_requests: Optional[int] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     ray_actor_options: Optional[dict] = None
     health_check_period_s: float = 2.0

@@ -63,6 +63,12 @@ class ServeControllerActor:
     def __init__(self):
         self._deployments: dict[str, _DeploymentState] = {}
         self._apps: dict[str, dict] = {}  # app name -> {ingress, route_prefix}
+        # proxy endpoint table (reference: the proxy state the controller
+        # tracks in _private/proxy_state.py): proxy_id -> endpoint record.
+        # Proxies re-register periodically; the timestamp doubles as a
+        # liveness heartbeat and stale entries are reaped by the reconciler.
+        self._proxies: dict[str, dict] = {}
+        self._proxy_tombstones: dict[str, float] = {}  # incarnation -> t
         self._lock = locktrace.register_lock(
             "serve.controller_lock", threading.RLock()
         )
@@ -195,9 +201,74 @@ class ServeControllerActor:
     def list_routes(self) -> dict:
         with self._lock:
             return {
-                a["route_prefix"]: {"app": name, "ingress": a["ingress"]}
+                a["route_prefix"]: {
+                    "app": name,
+                    "ingress": a["ingress"],
+                    # per-deployment admission-queue override for the proxy
+                    # (None = the global serve_queue_depth_per_deployment)
+                    "max_queued": (
+                        self._deployments[a["ingress"]].spec.get(
+                            "max_queued_requests"
+                        )
+                        if a["ingress"] in self._deployments
+                        else None
+                    ),
+                }
                 for name, a in self._apps.items()
             }
+
+    # -- proxy endpoint table -----------------------------------------------
+
+    def register_proxy(
+        self, proxy_id: str, node_id: str, host: str, port: int,
+        incarnation: str = "",
+    ) -> bool:
+        """Publish/refresh one proxy's ingress endpoint (re-registration is
+        the liveness heartbeat; see ``list_proxies``). A registration from a
+        deregistered incarnation is refused: the proxy's stats tick can race
+        its own shutdown's deregister (proxy-side fire-and-forget sends give
+        no ordering), and a dead endpoint must not re-enter the table."""
+        with self._lock:
+            if incarnation and incarnation in self._proxy_tombstones:
+                return False
+            self._proxies[proxy_id] = {
+                "proxy_id": proxy_id,
+                "node_id": node_id,
+                "host": host,
+                "port": port,
+                "incarnation": incarnation,
+                "registered_t": time.time(),
+            }
+        return True
+
+    def deregister_proxy(self, proxy_id: str, incarnation: str = "") -> bool:
+        with self._lock:
+            if incarnation:
+                now = time.time()
+                self._proxy_tombstones[incarnation] = now
+                # bounded: prune tombstones past the table's 30 s staleness
+                # window (a zombie heartbeat older than that ages out anyway)
+                for key in [
+                    k for k, t in self._proxy_tombstones.items()
+                    if now - t > 60.0
+                ]:
+                    del self._proxy_tombstones[key]
+            return self._proxies.pop(proxy_id, None) is not None
+
+    def list_proxies(self) -> dict:
+        """The ingress endpoint table: proxy_id -> {node_id, host, port}.
+        Entries silent for >30 s are dropped (a killed proxy actor must not
+        stay routable)."""
+        now = time.time()
+        with self._lock:
+            stale = [
+                pid
+                for pid, rec in self._proxies.items()
+                if now - rec["registered_t"] > 30.0
+            ]
+            for pid in stale:
+                del self._proxies[pid]
+            return {pid: dict(rec) for pid, rec in self._proxies.items()}
 
     def status(self) -> dict:
         with self._lock:

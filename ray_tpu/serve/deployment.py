@@ -101,6 +101,7 @@ def deployment(
     name: Optional[str] = None,
     num_replicas: Optional[Union[int, str]] = None,
     max_ongoing_requests: int = 8,
+    max_queued_requests: Optional[int] = None,
     autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
     ray_actor_options: Optional[dict] = None,
     health_check_period_s: float = 2.0,
@@ -123,6 +124,7 @@ def deployment(
         cfg = DeploymentConfig(
             num_replicas=num_replicas or 1,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
             autoscaling_config=ac,
             ray_actor_options=ray_actor_options,
             health_check_period_s=health_check_period_s,

@@ -27,7 +27,12 @@ import threading
 from typing import Optional
 
 import ray_tpu
-from ray_tpu.serve.proxy import Request, RouteTable
+from ray_tpu.serve.proxy import (
+    TENANT_HEADER,
+    AdmissionController,
+    Request,
+    RouteTable,
+)
 
 SERVICE = "ray_tpu.serve.ServeAPI"
 
@@ -36,10 +41,14 @@ def _encode_message(item) -> Optional[bytes]:
     """One deployment chunk -> one gRPC message (None = skip framing-only
     chunks). SSE ``data:`` framing from HTTP-oriented generators is
     stripped — gRPC messages are already delimited."""
-    from ray_tpu.serve.streaming import StreamStart
+    from ray_tpu.serve.streaming import RawBody, StreamStart
 
     if isinstance(item, StreamStart):
         return None
+    if isinstance(item, RawBody):
+        # gRPC's generic serializer needs bytes; the store read was still
+        # zero-copy, this is the single wire-staging copy
+        return item.tobytes()
     if isinstance(item, bytes):
         return item
     if isinstance(item, str):
@@ -63,6 +72,9 @@ class GrpcProxyActor:
         import grpc
 
         self._rt = RouteTable()
+        # the gRPC front end admits against the SAME policy shape as the
+        # HTTP proxy: global budget, per-deployment queues, tenant caps
+        self._admission = AdmissionController()
         actor = self
 
         def _resolve(request: bytes, context):
@@ -75,31 +87,59 @@ class GrpcProxyActor:
                 )
             return handle, Request("POST", rest, {}, md, request)
 
+        def _admit(handle, req, context):
+            from ray_tpu._private.tenants import DEFAULT_TENANT
+
+            actor._maybe_refresh_tenant_caps()
+            tenant = req.headers.get(TENANT_HEADER, "") or DEFAULT_TENANT
+            ticket = actor._admission.try_admit(
+                handle.deployment_name, tenant,
+                dep_cap=actor._rt.dep_cap(handle.deployment_name),
+            )
+            if ticket is None:
+                context.set_trailing_metadata(
+                    (("retry-after", f"{actor._admission.retry_after_s:g}"),)
+                )
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    "ingress overloaded; retry later",
+                )
+            return ticket
+
         def predict(request: bytes, context) -> bytes:
             handle, req = _resolve(request, context)
+            ticket = _admit(handle, req, context)
             try:
-                result = handle.remote(req).result(timeout_s=120)
-            except Exception as e:  # noqa: BLE001 — surface as gRPC status
-                context.abort(grpc.StatusCode.INTERNAL, repr(e))
-                return b""
-            if isinstance(result, bytes):
-                return result
-            return json.dumps(result).encode()
+                try:
+                    result = handle.remote(req).result(timeout_s=120)
+                except Exception as e:  # noqa: BLE001 — surface as gRPC status
+                    context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                    return b""
+                if isinstance(result, bytes):
+                    return result
+                return json.dumps(result).encode()
+            finally:
+                actor._admission.release(ticket)
 
         def predict_streamed(request: bytes, context):
             handle, req = _resolve(request, context)
-            chunks = handle.options(stream=True).remote(req)
-            while True:
-                try:
-                    item = chunks.next(timeout_s=120)
-                except StopIteration:
-                    return
-                except Exception as e:  # noqa: BLE001
-                    context.abort(grpc.StatusCode.INTERNAL, repr(e))
-                    return
-                msg = _encode_message(item)
-                if msg is not None:
-                    yield msg
+            ticket = _admit(handle, req, context)
+            try:
+                chunks = handle.options(stream=True).remote(req)
+                chunks.unwrap_raw = False  # _encode_message handles RawBody
+                while True:
+                    try:
+                        item = chunks.next(timeout_s=120)
+                    except StopIteration:
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                        return
+                    msg = _encode_message(item)
+                    if msg is not None:
+                        yield msg
+            finally:
+                actor._admission.release(ticket)
 
         ident = lambda b: b  # raw-bytes (de)serializers
         handlers = grpc.method_handlers_generic_handler(
@@ -131,6 +171,22 @@ class GrpcProxyActor:
 
     def ready(self) -> bool:
         return True
+
+    def get_stats(self) -> dict:
+        return self._admission.snapshot()
+
+    def _maybe_refresh_tenant_caps(self):
+        """Amortized tenant-policy refresh (no background thread here: one
+        ``tenant_stats`` op at most every 5 s, piggybacked on admission).
+        Delegates to the shared fetch-and-apply, which is a no-op — no
+        controller RPC — when tenant admission is disabled."""
+        import time
+
+        now = time.monotonic()
+        if now - getattr(self, "_caps_refreshed_t", 0.0) < 5.0:
+            return
+        self._caps_refreshed_t = now
+        self._admission.refresh_policies()
 
     def shutdown(self):
         self._server.stop(grace=1.0)

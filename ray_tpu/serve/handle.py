@@ -22,6 +22,38 @@ import ray_tpu
 
 _REFRESH_PERIOD_S = 1.0
 
+# Bound on waiting out an empty replica list in ``_pick_replica`` (replica
+# restart storm / deployment still rolling out). Module-level so tests can
+# shrink it.
+_EMPTY_WAIT_DEADLINE_S = 30.0
+
+# Latency-feedback EWMA (see ``_note_latency``): asymmetric smoothing biases
+# the estimate toward the TAIL — one slow reply (a compiling or overloaded
+# replica) moves the estimate up fast, while recovery credits back slowly,
+# the p99-biased behavior the router wants (reference: the latency-aware
+# replica schedulers of serve's request_router/).
+_LATENCY_ALPHA_UP = 0.5
+_LATENCY_ALPHA_DOWN = 0.05
+# Routing floor: below this the latency term is noise vs the in-flight term.
+_LATENCY_FLOOR_S = 1e-4
+# Per-sample cap: streaming calls sample stream DURATION (the completion
+# record), and one long-lived SSE stream must not mark its replica slow for
+# the next ~1/alpha_down fast replies.
+_LATENCY_SAMPLE_CAP_S = 5.0
+# Tie handling: latency only decides the pick when the scores differ both
+# by this ratio AND by this absolute band (the drainer's wait slice folds
+# up to ~0.2 s of dwell noise into samples). Near-ties fall back to
+# in-flight P2C with a random tie-break — without this, two equally fast
+# replicas PIN to whichever measured lower first (the loser never gets
+# sampled, so its estimate never refreshes).
+_LATENCY_TIE_RATIO = 2.0
+_LATENCY_TIE_BAND_S = 0.25
+# Exploration: occasionally route on in-flight alone so a replica whose
+# EWMA went bad (then recovered) still gets re-sampled — a drained replica
+# produces no new samples, so without probes a stale-slow estimate would
+# exile it forever.
+_LATENCY_EXPLORE_P = 0.05
+
 
 class WouldBlock(Exception):
     """Raised by nowait submission paths instead of anything that could
@@ -169,6 +201,17 @@ class DeploymentHandle:
         self._done_queue: "queue.Queue" = queue.Queue()
         self._drainer: Optional[threading.Thread] = None
         self._applied_version = -(1 << 62)  # any real version exceeds this
+        # replica name -> EWMA of client-observed reply latency (seconds),
+        # piggybacked on the completion seals the drainer already watches;
+        # shared with the stream/unary variant (options()) like _inflight
+        self._latency: dict[str, float] = {}
+        # empty-replica wait plumbing (see _wait_for_replicas): waiters park
+        # HERE; _apply_names wakes them the moment a replica set lands (a
+        # long-poll push wakes instantly — no per-thread poll loop), and the
+        # gate single-flights the forced controller refresh across threads
+        self._replicas_event = threading.Event()
+        self._refresh_gate = threading.Lock()
+        self._refresh_stats = {"calls": 0}  # dict: shared across variants
         # completion-record ids of streams whose consumer generator was GC'd
         # mid-stream (abandoned HTTP client): id -> mark time. The drainer
         # drops its pin on these so the producer's consumer-gone signal fires.
@@ -183,6 +226,7 @@ class DeploymentHandle:
         from ray_tpu.serve.api import _get_controller_handle
 
         controller = _get_controller_handle()
+        self._refresh_stats["calls"] += 1
         version, names = ray_tpu.get(
             controller.get_replicas_versioned.remote(self.deployment_name),
             timeout=30,
@@ -213,8 +257,14 @@ class DeploymentHandle:
             for n in list(self._inflight):
                 if n not in keep:
                     del self._inflight[n]
+            for n in list(self._latency):
+                if n not in keep:
+                    del self._latency[n]
             for n in keep:
                 self._inflight.setdefault(n, 0)
+        if replicas:
+            # wake every thread parked on the empty-replica wait
+            self._replicas_event.set()
 
     # -- routing ------------------------------------------------------------
 
@@ -233,23 +283,103 @@ class DeploymentHandle:
                 raise WouldBlock(self.deployment_name)
         else:
             self._refresh()
-            deadline = time.monotonic() + 30.0
-            while True:
-                with self._lock:
-                    replicas = list(self._replicas)
-                if replicas:
-                    break
-                if time.monotonic() > deadline:
-                    raise RuntimeError(
-                        f"no replicas for deployment {self.deployment_name!r}"
-                    )
-                time.sleep(0.1)
-                self._refresh(force=True)
+            with self._lock:
+                replicas = list(self._replicas)
+            if not replicas:
+                replicas = self._wait_for_replicas()
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
         with self._lock:
-            return a if self._inflight.get(a[0], 0) <= self._inflight.get(b[0], 0) else b
+            ia = self._inflight.get(a[0], 0)
+            ib = self._inflight.get(b[0], 0)
+            la = self._latency.get(a[0])
+            lb = self._latency.get(b[0])
+        if (
+            la is None
+            or lb is None
+            or random.random() < _LATENCY_EXPLORE_P
+        ):
+            # no latency signal for one of the pair yet (fresh replica) or
+            # an exploration probe: classic P2C on in-flight counts with a
+            # random tie-break — the probed replica earns a fresh estimate
+            if ia != ib:
+                return a if ia < ib else b
+            return a if random.random() < 0.5 else b
+        # latency-feedback P2C: expected-wait score = (queue + 1) x the
+        # p99-biased latency estimate, so a slow/compiling replica sheds
+        # load automatically even when both replicas look idle. Only a
+        # DECISIVE gap routes on latency (see _LATENCY_TIE_RATIO).
+        sa = (ia + 1) * max(la, _LATENCY_FLOOR_S)
+        sb = (ib + 1) * max(lb, _LATENCY_FLOOR_S)
+        lo, hi = (sa, sb) if sa <= sb else (sb, sa)
+        if hi - lo >= _LATENCY_TIE_BAND_S and hi >= lo * _LATENCY_TIE_RATIO:
+            return a if sa <= sb else b
+        if ia != ib:
+            return a if ia < ib else b
+        return a if random.random() < 0.5 else b
+
+    def _wait_for_replicas(self) -> list:
+        """Wait out an empty replica list (rollout, restart storm).
+
+        All waiting threads share ONE forced controller refresh at a time
+        (the gate) with jittered exponential backoff between attempts;
+        everyone else parks on ``_replicas_event``, which ``_apply_names``
+        sets the instant a replica set lands from either the refresh or a
+        long-poll push. The old shape — every caller thread looping
+        ``_refresh(force=True)`` + ``sleep(0.1)`` — hammered the controller
+        with O(threads x 10/s) RPCs for up to 30 s under a replica-restart
+        storm."""
+        deadline = time.monotonic() + _EMPTY_WAIT_DEADLINE_S
+        backoff = 0.05
+        while True:
+            with self._lock:
+                if self._replicas:
+                    return list(self._replicas)
+            now = time.monotonic()
+            if now > deadline:
+                raise RuntimeError(
+                    f"no replicas for deployment {self.deployment_name!r}"
+                )
+            # clear-then-recheck-then-wait: an _apply_names landing after
+            # the clear re-sets the event, so no wakeup is lost
+            self._replicas_event.clear()
+            with self._lock:
+                if self._replicas:
+                    return list(self._replicas)
+            wait_s = min(backoff * (1.0 + random.random()),
+                         max(0.05, deadline - now))
+            if self._refresh_gate.acquire(blocking=False):
+                try:
+                    try:
+                        self._refresh(force=True)
+                    except Exception:  # noqa: BLE001 — controller flapping
+                        pass
+                    with self._lock:
+                        if self._replicas:
+                            continue
+                    # pace the NEXT forced refresh while parked on the
+                    # event (a push still wakes us instantly)
+                    self._replicas_event.wait(timeout=wait_s)
+                finally:
+                    self._refresh_gate.release()
+            else:
+                self._replicas_event.wait(timeout=wait_s)
+            backoff = min(backoff * 2.0, 1.0)
+
+    def _note_latency(self, name: str, sample_s: float):
+        """Fold one client-observed reply latency into the replica's EWMA
+        (callers hold self._lock). Asymmetric: jumps up fast, recovers
+        slowly — a tail-biased estimate (see _LATENCY_ALPHA_UP)."""
+        sample_s = min(sample_s, _LATENCY_SAMPLE_CAP_S)
+        prev = self._latency.get(name)
+        if prev is None:
+            self._latency[name] = sample_s
+        else:
+            alpha = (
+                _LATENCY_ALPHA_UP if sample_s > prev else _LATENCY_ALPHA_DOWN
+            )
+            self._latency[name] = prev + alpha * (sample_s - prev)
 
     def _call(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
         name, actor = self._pick_replica()
@@ -271,8 +401,9 @@ class DeploymentHandle:
                 self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
             raise
         resp = DeploymentResponse(ref)
-        # decrement in-flight when the result lands (single drainer thread)
-        self._done_queue.put((name, ref))
+        # decrement in-flight when the result lands (single drainer thread);
+        # the submit timestamp feeds the per-replica latency EWMA
+        self._done_queue.put((name, ref, time.monotonic()))
         with self._lock:
             if self._drainer is None or not self._drainer.is_alive():
                 self._drainer = threading.Thread(
@@ -286,16 +417,16 @@ class DeploymentHandle:
         """Decrement in-flight counts as requests finish. All pending refs
         are waited on together — a slow request must not head-of-line-block
         the accounting for fast ones (P2C routes on these counts)."""
-        pending: dict = {}  # ref -> replica name
+        pending: dict = {}  # ref -> (replica name, submit time)
         while True:
             block = not pending
             try:
-                name, ref = self._done_queue.get(block=block, timeout=None)
-                pending[ref] = name
+                name, ref, t0 = self._done_queue.get(block=block, timeout=None)
+                pending[ref] = (name, t0)
                 # opportunistically drain whatever else is queued
                 while True:
-                    name, ref = self._done_queue.get_nowait()
-                    pending[ref] = name
+                    name, ref, t0 = self._done_queue.get_nowait()
+                    pending[ref] = (name, t0)
             except queue.Empty:
                 pass
             if not pending:
@@ -309,7 +440,7 @@ class DeploymentHandle:
 
                     for ref in list(pending):
                         if ref.id() in self._abandoned:
-                            name = pending.pop(ref)
+                            name, _t0 = pending.pop(ref)
                             self._abandoned.pop(ref.id(), None)
                             self._inflight[name] = max(
                                 0, self._inflight.get(name, 1) - 1
@@ -328,15 +459,19 @@ class DeploymentHandle:
             if not pending:
                 continue
             try:
+                # short wait slices: the slice bounds the dwell error folded
+                # into the latency samples the router scores on
                 ready, _ = ray_tpu.wait(
-                    list(pending), num_returns=1, timeout=0.5
+                    list(pending), num_returns=1, timeout=0.2
                 )
             except Exception:
                 ready = []
+            done_t = time.monotonic()
             for ref in ready:
-                name = pending.pop(ref)
+                name, t0 = pending.pop(ref)
                 with self._lock:
                     self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
+                    self._note_latency(name, max(done_t - t0, 0.0))
             # this frame is long-lived: loop variables would otherwise keep
             # the LAST popped completion ObjectRef alive indefinitely,
             # pinning a freed/abandoned stream's refcount above zero
@@ -378,7 +513,7 @@ class DeploymentHandle:
             raise
         # in-flight accounting keys off the completion record: it seals when
         # the replica's generator exits (same drainer as unary calls)
-        self._done_queue.put((name, ref_gen.completed()))
+        self._done_queue.put((name, ref_gen.completed(), time.monotonic()))
         with self._lock:
             if self._drainer is None or not self._drainer.is_alive():
                 self._drainer = threading.Thread(
@@ -471,8 +606,12 @@ class DeploymentHandle:
                 h._stream = stream
                 h._lock = self._lock
                 h._inflight = self._inflight
+                h._latency = self._latency
                 h._done_queue = self._done_queue
                 h._abandoned = self._abandoned
+                h._replicas_event = self._replicas_event
+                h._refresh_gate = self._refresh_gate
+                h._refresh_stats = self._refresh_stats
                 h._variant = self
                 self._variant = h
                 cached = h

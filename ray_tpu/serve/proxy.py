@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import threading
 import traceback
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
@@ -80,6 +81,199 @@ def _hget(headers: dict, name: str, default: str = "") -> str:
         if k.lower() == lname:
             return v
     return default
+
+
+# Request header naming the submitting tenant (admission accounting); absent
+# = the scheduler's default tenant.
+TENANT_HEADER = "x-ray-tpu-tenant"
+# Shared cap bucket for tenant names outside the scheduler's policy records,
+# and the overflow key + size bound for the per-tenant shed table: both keep
+# untrusted free-form header values from bypassing isolation or growing
+# proxy state without bound.
+_UNREGISTERED_TENANT = "(unregistered)"
+_OVERFLOW_TENANT = "(other)"
+_SHED_TENANT_TABLE_MAX = 64
+
+
+class AdmissionController:
+    """Token-budget admission with load shedding (shed, don't stall).
+
+    Reference shape: the proxy-level backpressure of Ray Serve's
+    ``_private/proxy.py`` (``max_ongoing_requests`` rejections) extended
+    with the multi-tenant policy the PR 11 scheduler already arbitrates:
+
+    - a **global in-flight budget** per proxy (``serve_max_inflight_per_
+      proxy``): past it, new requests get 429 + ``Retry-After`` instead of
+      joining an unbounded backlog — under overload every admitted request
+      keeps a bounded queue ahead of it, so admitted-request latency stays
+      flat while excess load is rejected cheaply;
+    - a **per-deployment bounded queue** (``serve_queue_depth_per_
+      deployment``, overridable per deployment via ``max_queued_requests``)
+      so one hot route cannot occupy the whole ingress;
+    - **per-tenant caps** derived from the SAME ``TenantState`` fair-share
+      weights the scheduler uses (``tenants.admission_caps``): one tenant's
+      burst sheds at its weight share of the budget, leaving headroom for
+      every other tenant (the PR 11 tail — the scheduler arbitrated, the
+      proxy now does too).
+
+    Thread-safe: handler threads, the asyncio loop, and the stats pusher
+    all touch the counters.
+    """
+
+    def __init__(self):
+        from ray_tpu._private.config import get_config
+
+        cfg = get_config()
+        self.budget = cfg.serve_max_inflight_per_proxy
+        self.dep_default_cap = cfg.serve_queue_depth_per_deployment
+        self.retry_after_s = cfg.serve_shed_retry_after_s
+        self.tenant_enabled = cfg.serve_tenant_admission
+        self._lock = locktrace.register_lock(
+            "serve.admission", threading.Lock()
+        )
+        self._inflight_total = 0
+        self._inflight_dep: dict[str, int] = {}
+        self._inflight_tenant: dict[str, int] = {}
+        self._tenant_caps: dict[str, int] = {}
+        self._draining = False
+        self._stats = {
+            "accepted": 0,
+            "shed": 0,  # total sheds (all causes below + drain rejects)
+            "shed_global": 0,
+            "shed_deployment": 0,
+            "shed_tenant": 0,
+            "shed_draining": 0,
+            "dropped_streams": 0,
+            "body_bytes_zero_copy": 0,
+            "body_bytes_copied": 0,
+        }
+        self._shed_by_tenant: dict[str, int] = {}
+
+    def set_tenant_policies(self, policies: list) -> None:
+        """Refresh per-tenant caps from the scheduler's tenant policy
+        records (the ``tenant_stats`` op reply)."""
+        from ray_tpu._private.tenants import admission_caps
+
+        caps = admission_caps(policies or [], self.budget)
+        with self._lock:
+            self._tenant_caps = caps
+
+    def refresh_policies(self) -> None:
+        """Fetch tenant policy from the head and refresh caps — the one
+        shared fetch-and-apply for every ingress front end. No-op (and no
+        controller RPC) when tenant admission is disabled."""
+        if not self.tenant_enabled:
+            return
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            policies = global_worker().controller_call("tenant_stats")
+        except Exception:  # noqa: BLE001 — head unreachable / shutting down
+            return
+        if policies:
+            self.set_tenant_policies(policies)
+
+    def try_admit(self, deployment: str, tenant: str,
+                  dep_cap: Optional[int] = None):
+        """Admit (returns a release ticket) or shed (returns None)."""
+        with self._lock:
+            if self._draining:
+                self._stats["shed"] += 1
+                self._stats["shed_draining"] += 1
+                return None
+            if self._inflight_total >= self.budget:
+                self._shed_locked(tenant, "shed_global")
+                return None
+            cap = dep_cap if dep_cap is not None else self.dep_default_cap
+            if self._inflight_dep.get(deployment, 0) >= cap:
+                self._shed_locked(tenant, "shed_deployment")
+                return None
+            if self.tenant_enabled and self._tenant_caps:
+                tcap = self._tenant_caps.get(tenant)
+                if tcap is None:
+                    # the tenant header is free-form client input: every
+                    # name outside the scheduler's policy records shares
+                    # ONE bucket at the smallest configured share, so
+                    # rotating the header cannot bypass per-tenant
+                    # isolation and occupy the whole budget
+                    tenant = _UNREGISTERED_TENANT
+                    tcap = min(self._tenant_caps.values())
+                if self._inflight_tenant.get(tenant, 0) >= tcap:
+                    self._shed_locked(tenant, "shed_tenant")
+                    return None
+            self._inflight_total += 1
+            self._inflight_dep[deployment] = (
+                self._inflight_dep.get(deployment, 0) + 1
+            )
+            self._inflight_tenant[tenant] = (
+                self._inflight_tenant.get(tenant, 0) + 1
+            )
+            self._stats["accepted"] += 1
+            return (deployment, tenant)
+
+    def _shed_locked(self, tenant: str, reason: str) -> None:
+        self._stats["shed"] += 1
+        self._stats[reason] += 1
+        # bounded: the tenant name is untrusted header input and this map
+        # is copied into every stats snapshot + 2 s head push — a client
+        # rotating names while being shed must not grow it forever
+        if (
+            tenant not in self._shed_by_tenant
+            and len(self._shed_by_tenant) >= _SHED_TENANT_TABLE_MAX
+        ):
+            tenant = _OVERFLOW_TENANT
+        self._shed_by_tenant[tenant] = self._shed_by_tenant.get(tenant, 0) + 1
+
+    def release(self, ticket) -> None:
+        if ticket is None:
+            return
+        deployment, tenant = ticket
+        with self._lock:
+            self._inflight_total = max(0, self._inflight_total - 1)
+            for table, key in (
+                (self._inflight_dep, deployment),
+                (self._inflight_tenant, tenant),
+            ):
+                left = table.get(key, 1) - 1
+                if left > 0:
+                    table[key] = left
+                else:
+                    table.pop(key, None)
+
+    def count_body(self, nbytes: int, zero_copy: bool) -> None:
+        key = "body_bytes_zero_copy" if zero_copy else "body_bytes_copied"
+        with self._lock:
+            self._stats[key] += nbytes
+
+    def begin_drain(self) -> None:
+        with self._lock:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight_total
+
+    def note_dropped(self, n: int) -> None:
+        with self._lock:
+            self._stats["dropped_streams"] += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                **self._stats,
+                "inflight": self._inflight_total,
+                "inflight_by_deployment": dict(self._inflight_dep),
+                "inflight_by_tenant": dict(self._inflight_tenant),
+                "shed_by_tenant": dict(self._shed_by_tenant),
+                "tenant_caps": dict(self._tenant_caps),
+                "budget": self.budget,
+                "draining": self._draining,
+            }
 
 
 class AsyncHTTPServer:
@@ -166,15 +360,27 @@ class AsyncHTTPServer:
         proxy = self._proxy
         parsed = urlparse(raw_path)
         if parsed.path == "/-/healthz":
+            if proxy._admission.draining:
+                # draining proxies fail health checks so load balancers
+                # stop routing here before the listener closes
+                return await self._respond(writer, 503, b"draining", "text/plain")
             return await self._respond(writer, 200, b"ok", "text/plain")
         if parsed.path == "/-/routes":
             return await self._respond(
                 writer, 200,
                 json.dumps(proxy._route_table()).encode(), "application/json",
             )
+        if parsed.path == "/-/stats":
+            return await self._respond(
+                writer, 200,
+                json.dumps(proxy.get_stats()).encode(), "application/json",
+            )
         handle, rest = proxy._match(parsed.path)
         if handle is None:
             return await self._respond(writer, 404, b"no route", "text/plain")
+        ticket = proxy._admit(handle.deployment_name, headers)
+        if ticket is None:
+            return await self._shed_respond(writer, proxy)
         req = Request(
             method,
             rest,
@@ -186,6 +392,7 @@ class AsyncHTTPServer:
         loop = asyncio.get_running_loop()
         try:
             from ray_tpu.serve.handle import WouldBlock
+            from ray_tpu.serve.streaming import RawBody
 
             streamh = handle.options(stream=True)
             chunks = None
@@ -199,6 +406,10 @@ class AsyncHTTPServer:
                     chunks = streamh._call_streaming(
                         "__call__", (req,), {}, nowait=True
                     )
+                    # this front end writes RawBody views straight to the
+                    # socket; keep the wrapper instead of the handle-level
+                    # bytes unwrap
+                    chunks.unwrap_raw = False
                 except WouldBlock:
                     chunks = None
             if chunks is not None:
@@ -210,6 +421,7 @@ class AsyncHTTPServer:
                 # every open connection
                 def call_backend():
                     chunks = streamh.remote(req)
+                    chunks.unwrap_raw = False  # proxy writes the raw view
                     try:
                         return chunks, chunks.next(timeout_s=120), False
                     except StopIteration:
@@ -223,17 +435,37 @@ class AsyncHTTPServer:
                     writer, chunks.stream_start, first, done,
                     chunks, loop,
                 )
+            if isinstance(first, RawBody):
+                # zero-copy: the view is arena/store-backed; write it
+                # straight to the socket, no staging copy
+                self._proxy._admission.count_body(len(first), True)
+                return await self._respond(
+                    writer, 200, first.view(), "application/octet-stream"
+                )
             if isinstance(first, bytes):
+                self._proxy._admission.count_body(len(first), False)
                 return await self._respond(
                     writer, 200, first, "application/octet-stream"
                 )
+            body = json.dumps(first).encode()
+            self._proxy._admission.count_body(len(body), False)
             return await self._respond(
-                writer, 200, json.dumps(first).encode(), "application/json"
+                writer, 200, body, "application/json"
             )
         except Exception:
             return await self._respond(
                 writer, 500, traceback.format_exc().encode(), "text/plain"
             )
+        finally:
+            proxy._admission.release(ticket)
+
+    async def _shed_respond(self, writer, proxy):
+        """429 + Retry-After: the load-shed reply (cheap, no backend hop)."""
+        retry = proxy._admission.retry_after_s
+        return await self._respond(
+            writer, 429, b"ingress overloaded; retry later", "text/plain",
+            extra_headers=[("Retry-After", f"{retry:g}")],
+        )
 
     def _inproc_store(self):
         """The controller's memory store when it lives in THIS process
@@ -309,19 +541,23 @@ class AsyncHTTPServer:
                 for i in watch:
                     store.remove_seal_callback(i, _wake)
 
-    async def _respond(self, writer, code, body, ctype):
+    async def _respond(self, writer, code, body, ctype, extra_headers=None):
         import http.client as _hc
 
         reason = _hc.responses.get(code, "")
-        writer.write(
-            (
-                f"HTTP/1.1 {code} {reason}\r\n"
-                f"Content-Type: {ctype}\r\n"
-                f"Content-Length: {len(body)}\r\n"
-                f"\r\n"
-            ).encode()
-            + body
-        )
+        head = [
+            f"HTTP/1.1 {code} {reason}",
+            f"Content-Type: {ctype}",
+            f"Content-Length: {len(body)}",
+        ]
+        for name, value in extra_headers or []:
+            n, v = _clean_header(name, value)
+            head.append(f"{n}: {v}")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
+        if body:
+            # separate write: a memoryview body (zero-copy path) must not
+            # be concatenated into a fresh bytes object
+            writer.write(body)
         await writer.drain()
 
     async def _stream_body(self, writer, start, first, done, chunks, loop):
@@ -365,16 +601,24 @@ class AsyncHTTPServer:
                     pass
             return
 
+        from ray_tpu.serve.streaming import RawBody
+
         try:
             item = first
             while not done:
                 if item is not None:
-                    data = _encode_chunk(item)
+                    if isinstance(item, RawBody):
+                        data, zero_copy = item.view(), True
+                    else:
+                        data, zero_copy = _encode_chunk(item), False
                     if data:
-                        writer.write(
-                            f"{len(data):x}\r\n".encode() + data + b"\r\n"
-                        )
+                        # framing writes split around the payload so a
+                        # zero-copy view reaches the socket un-concatenated
+                        writer.write(f"{len(data):x}\r\n".encode())
+                        writer.write(data)
+                        writer.write(b"\r\n")
                         await writer.drain()
+                        self._proxy._admission.count_body(len(data), zero_copy)
                 item, done = await self._next_chunk_async(chunks)
             writer.write(b"0\r\n\r\n")
             await writer.drain()
@@ -398,6 +642,7 @@ class RouteTable:
 
     def __init__(self):
         self._routes: dict = {}
+        self._dep_caps: dict = {}  # ingress deployment -> max_queued override
         self._routes_lock = threading.Lock()
         self._refresher = threading.Thread(
             target=self._refresh_loop, daemon=True, name="serve-routes"
@@ -428,9 +673,18 @@ class RouteTable:
                         )
                         for prefix, info in routes.items()
                     }
+                    self._dep_caps = {
+                        info["ingress"]: info.get("max_queued")
+                        for info in routes.values()
+                    }
             except Exception:
                 pass
             time.sleep(1.0)
+
+    def dep_cap(self, deployment_name: str):
+        """Per-deployment admission-queue override (None = global knob)."""
+        with self._routes_lock:
+            return self._dep_caps.get(deployment_name)
 
     def table(self) -> dict:
         with self._routes_lock:
@@ -452,15 +706,52 @@ class RouteTable:
 
 
 class ProxyActor:
-    """Runs the HTTP server; one per node in a real cluster (here: one)."""
+    """Runs the HTTP server; one per node (``start_proxies``) behind the
+    controller-published endpoint table, each with its own admission
+    controller (reference: one ``ProxyActor`` per node in
+    ``serve/_private/proxy.py``, fronted by an external load balancer)."""
 
     def __init__(
         self, host: str = "127.0.0.1", port: int = 8000,
         server: Optional[str] = None,
+        node_id: Optional[str] = None, proxy_name: Optional[str] = None,
     ):
         import os
 
         self._rt = RouteTable()
+        self._admission = AdmissionController()
+        self._node_id = node_id or ""
+        self._proxy_id = proxy_name or (
+            f"serve-proxy-{node_id[:8]}" if node_id else "serve-proxy"
+        )
+        self._host = host
+        # unique per proxy INSTANCE (proxy ids are deterministic per node):
+        # deregistration tombstones this incarnation at the controller, so a
+        # stats tick stuck past shutdown's bounded join cannot re-publish
+        # the dead endpoint, while a fresh proxy on the same node (new
+        # incarnation) registers immediately
+        self._incarnation = uuid.uuid4().hex
+        self._stop = threading.Event()
+        # the runtime session this proxy belongs to: the stats thread exits
+        # when a DIFFERENT session owns the process (init/shutdown cycles in
+        # one interpreter — a zombie proxy thread must not re-register
+        # itself into a later session's serve controller)
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            self._owner_api = global_worker()
+        except Exception:  # noqa: BLE001 — constructed outside a runtime
+            self._owner_api = None
+        # stats pusher: periodically reports admission counters to the head
+        # (the ``report_proxy_stats`` op behind ``util.state.api.
+        # proxy_stats()``), refreshes per-tenant caps from scheduler policy,
+        # and re-registers this proxy's endpoint with the serve controller
+        # (registration doubles as a liveness heartbeat for the table)
+        self._stats_thread = threading.Thread(
+            target=self._stats_loop, daemon=True,
+            name=f"serve-proxy-stats-{self._proxy_id}",
+        )
+        self._stats_thread.start()
         proxy = self
         # data plane: 'async' (default — persistent-connection asyncio
         # server) or 'threading' (stdlib thread-per-request, kept for
@@ -481,8 +772,12 @@ class ProxyActor:
 
             def _handle(self):
                 try:
+                    from ray_tpu.serve.streaming import RawBody
+
                     parsed = urlparse(self.path)
                     if parsed.path == "/-/healthz":
+                        if proxy._admission.draining:
+                            return self._respond(503, b"draining", "text/plain")
                         return self._respond(200, b"ok", "text/plain")
                     if parsed.path == "/-/routes":
                         return self._respond(
@@ -490,47 +785,83 @@ class ProxyActor:
                             json.dumps(proxy._route_table()).encode(),
                             "application/json",
                         )
+                    if parsed.path == "/-/stats":
+                        return self._respond(
+                            200,
+                            json.dumps(proxy.get_stats()).encode(),
+                            "application/json",
+                        )
                     handle, rest = proxy._match(parsed.path)
                     if handle is None:
                         return self._respond(404, b"no route", "text/plain")
+                    # read the body BEFORE any admission decision: a shed
+                    # reply with the request body still unread would desync
+                    # this keep-alive connection (the next request would be
+                    # parsed starting at the leftover body bytes)
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
-                    req = Request(
-                        self.command,
-                        rest,
-                        {k: v[-1] for k, v in parse_qs(parsed.query).items()},
-                        dict(self.headers.items()),
-                        body,
-                        raw_query=parsed.query,
+                    ticket = proxy._admit(
+                        handle.deployment_name, dict(self.headers.items())
                     )
-                    # All proxy requests ride the streaming path; unary
-                    # handlers arrive as a single non-StreamStart chunk and
-                    # fall back to a buffered JSON response (reference:
-                    # proxy.py streaming responses — ASGI there, chunked
-                    # transfer-encoding here).
-                    chunks = handle.options(stream=True).remote(req)
-                    try:
-                        first = chunks.next(timeout_s=120)
-                    except StopIteration:
-                        first = None
-                    if chunks.stream_start is not None:
-                        return self._stream_body(
-                            chunks.stream_start, first, chunks
+                    if ticket is None:
+                        retry = proxy._admission.retry_after_s
+                        return self._respond(
+                            429, b"ingress overloaded; retry later",
+                            "text/plain",
+                            extra_headers=[("Retry-After", f"{retry:g}")],
                         )
-                    if isinstance(first, bytes):
-                        return self._respond(200, first, "application/octet-stream")
-                    return self._respond(
-                        200, json.dumps(first).encode(), "application/json"
-                    )
+                    try:
+                        req = Request(
+                            self.command,
+                            rest,
+                            {k: v[-1] for k, v in parse_qs(parsed.query).items()},
+                            dict(self.headers.items()),
+                            body,
+                            raw_query=parsed.query,
+                        )
+                        # All proxy requests ride the streaming path; unary
+                        # handlers arrive as a single non-StreamStart chunk and
+                        # fall back to a buffered JSON response (reference:
+                        # proxy.py streaming responses — ASGI there, chunked
+                        # transfer-encoding here).
+                        chunks = handle.options(stream=True).remote(req)
+                        chunks.unwrap_raw = False  # proxy writes the raw view
+                        try:
+                            first = chunks.next(timeout_s=120)
+                        except StopIteration:
+                            first = None
+                        if chunks.stream_start is not None:
+                            return self._stream_body(
+                                chunks.stream_start, first, chunks
+                            )
+                        if isinstance(first, RawBody):
+                            proxy._admission.count_body(len(first), True)
+                            return self._respond(
+                                200, first.view(), "application/octet-stream"
+                            )
+                        if isinstance(first, bytes):
+                            proxy._admission.count_body(len(first), False)
+                            return self._respond(
+                                200, first, "application/octet-stream"
+                            )
+                        out = json.dumps(first).encode()
+                        proxy._admission.count_body(len(out), False)
+                        return self._respond(200, out, "application/json")
+                    finally:
+                        proxy._admission.release(ticket)
                 except Exception:
                     return self._respond(
                         500, traceback.format_exc().encode(), "text/plain"
                     )
 
-            def _respond(self, code: int, body: bytes, ctype: str):
+            def _respond(self, code: int, body, ctype: str,
+                         extra_headers=None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in extra_headers or []:
+                    n, v = _clean_header(name, value)
+                    self.send_header(n, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -564,15 +895,24 @@ class ProxyActor:
                     except Exception:  # noqa: BLE001
                         self.close_connection = True
                     return
+                from ray_tpu.serve.streaming import RawBody
+
                 try:
                     item = first
                     while True:
                         if item is not None:
-                            data = _encode_chunk(item)
+                            if isinstance(item, RawBody):
+                                data, zero_copy = item.view(), True
+                            else:
+                                data, zero_copy = _encode_chunk(item), False
                             if data:
                                 self.wfile.write(f"{len(data):x}\r\n".encode())
-                                self.wfile.write(data + b"\r\n")
+                                self.wfile.write(data)
+                                self.wfile.write(b"\r\n")
                                 self.wfile.flush()
+                                proxy._admission.count_body(
+                                    len(data), zero_copy
+                                )
                         try:
                             # per-chunk deadline: a stalled replica must not
                             # pin this handler thread forever
@@ -602,6 +942,77 @@ class ProxyActor:
     def _match(self, path: str):
         return self._rt.match(path)
 
+    # -- admission ----------------------------------------------------------
+
+    def _admit(self, deployment_name: str, headers: dict):
+        """Admission decision for one request (ticket or None = shed)."""
+        from ray_tpu._private.tenants import DEFAULT_TENANT
+
+        tenant = _hget(headers, TENANT_HEADER, "") or DEFAULT_TENANT
+        return self._admission.try_admit(
+            deployment_name, tenant, dep_cap=self._rt.dep_cap(deployment_name)
+        )
+
+    # -- stats / registration -----------------------------------------------
+
+    def get_stats(self) -> dict:
+        """Admission + data-plane counters (also pushed to the head as
+        ``report_proxy_stats`` and served at ``/-/stats``)."""
+        snap = self._admission.snapshot()
+        snap["proxy_id"] = self._proxy_id
+        snap["node_id"] = self._node_id
+        # the stats thread starts before the listener binds; None until then
+        snap["port"] = getattr(self, "_port", None)
+        return snap
+
+    def _stats_loop(self):
+        first = True
+        while not self._stop.wait(0.2 if first else 2.0):
+            first = False
+            if not self._session_alive():
+                return
+            self._push_stats()
+            self._admission.refresh_policies()
+            # re-check after the controller RPCs above: a tick blocked in
+            # them past shutdown's bounded join must not re-register the
+            # endpoint shutdown is about to (or already did) deregister
+            if self._stop.is_set():
+                return
+            self._register()
+
+    def _session_alive(self) -> bool:
+        """Does THIS proxy's runtime session still own the process?"""
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            return self._owner_api is None or global_worker() is self._owner_api
+        except Exception:  # noqa: BLE001 — runtime shut down
+            return False
+
+    def _push_stats(self):
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            global_worker().controller_call(
+                "report_proxy_stats", (self._proxy_id, self.get_stats())
+            )
+        except Exception:  # noqa: BLE001 — head unreachable / shutting down
+            pass
+
+    def _register(self):
+        """(Re-)publish this proxy's endpoint in the serve controller's
+        table; re-registration refreshes the liveness timestamp."""
+        try:
+            from ray_tpu.serve.api import _get_controller_handle
+
+            controller = _get_controller_handle()
+            controller.register_proxy.remote(
+                self._proxy_id, self._node_id, self._host, self._port,
+                incarnation=self._incarnation,
+            )
+        except Exception:  # noqa: BLE001 — serve not running yet
+            pass
+
     # -- control ------------------------------------------------------------
 
     def get_port(self) -> int:
@@ -610,7 +1021,48 @@ class ProxyActor:
     def ready(self) -> bool:
         return True
 
-    def shutdown(self):
+    def drain_stats(self) -> dict:
+        """Drain-facing view: in-flight now + dropped so far."""
+        return {
+            "inflight": self._admission.inflight(),
+            "dropped_streams": self._admission.snapshot()["dropped_streams"],
+        }
+
+    def shutdown(self, drain_s: Optional[float] = None):
+        """Drain, then stop. New requests shed immediately (and /-/healthz
+        flips 503 so balancers stop routing here); in-flight requests get a
+        bounded ``serve_drain_window_s`` to finish before the listeners
+        close — streams still open at the deadline are cut and counted
+        (``dropped_streams``), never silently."""
+        import time as _time
+
+        from ray_tpu._private.config import get_config
+
+        window = (
+            get_config().serve_drain_window_s if drain_s is None else drain_s
+        )
+        self._admission.begin_drain()
+        deadline = _time.monotonic() + max(0.0, window)
+        while _time.monotonic() < deadline and self._admission.inflight() > 0:
+            _time.sleep(0.05)
+        dropped = self._admission.inflight()
+        if dropped:
+            self._admission.note_dropped(dropped)
+        self._stop.set()
+        # join BEFORE deregistering: a stats-loop tick already past its
+        # wait could otherwise re-register this endpoint after the
+        # deregister lands, leaving a dead proxy routable for the table's
+        # whole staleness window
+        locktrace.join_if_alive(self._stats_thread, timeout=2.0)
+        self._push_stats()  # final counter flush (best-effort)
+        try:
+            from ray_tpu.serve.api import _get_controller_handle
+
+            _get_controller_handle().deregister_proxy.remote(
+                self._proxy_id, incarnation=self._incarnation
+            )
+        except Exception:  # noqa: BLE001
+            pass
         if self._async is not None:
             self._async.shutdown()
         else:
@@ -624,7 +1076,8 @@ _proxy_handle = None
 
 
 def start_proxy(port: int = 8000):
-    """Ensure the proxy actor is running; returns (handle, port)."""
+    """Ensure the (head-node) proxy actor is running; returns
+    (handle, port). For one proxy per node, see :func:`start_proxies`."""
     global _proxy_handle
     if _proxy_handle is not None:
         try:
@@ -639,6 +1092,41 @@ def start_proxy(port: int = 8000):
             # zero-CPU (reference: proxy actors reserve no CPU) — a saturated
             # node must still be able to host the ingress
             name="serve-proxy", num_cpus=0, max_concurrency=32
-        ).remote(port=port)
+        ).remote(port=port, proxy_name="serve-proxy")
     real_port = ray_tpu.get(_proxy_handle.get_port.remote(), timeout=60)
     return _proxy_handle, real_port
+
+
+def start_proxies(port: int = 0):
+    """Horizontal ingress: ensure ONE proxy actor per alive, non-draining
+    node (reference: Ray Serve runs an HTTP proxy on every node; an
+    external balancer spreads clients across them). Each proxy is pinned to
+    its node with node-affinity, reserves zero CPU (the PR 2 control-plane
+    pattern — a saturated node must still host its ingress), registers its
+    endpoint with the serve controller (``serve.list_proxies()`` publishes
+    the table), and runs its own admission controller.
+
+    ``port=0`` (default) gives every proxy an ephemeral port — required
+    when several "nodes" share one test host. Returns
+    ``{node_id_hex: (handle, port)}``.
+    """
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+    from ray_tpu.util.state.api import list_nodes
+
+    out = {}
+    for node in list_nodes():
+        if not node.get("Alive", True) or node.get("Draining"):
+            continue
+        nid = node["NodeID"]
+        name = f"serve-proxy-{nid[:8]}"
+        try:
+            h = ray_tpu.get_actor(name)
+        except Exception:  # noqa: BLE001 — not started yet
+            cls = ray_tpu.remote(ProxyActor)
+            h = cls.options(
+                name=name, num_cpus=0, max_concurrency=32,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(nid),
+            ).remote(port=port, node_id=nid, proxy_name=name)
+        real_port = ray_tpu.get(h.get_port.remote(), timeout=60)
+        out[nid] = (h, real_port)
+    return out

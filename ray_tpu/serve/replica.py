@@ -111,20 +111,50 @@ class ReplicaActor:
                     if first and not isinstance(item, StreamStart):
                         if isinstance(item, str):
                             ct = "text/event-stream"
-                        elif isinstance(item, bytes):
+                        elif isinstance(item, (bytes, bytearray, memoryview)):
                             ct = "application/octet-stream"
                         else:
                             ct = "application/x-ndjson"
                         yield StreamStart(ct)
                     first = False
-                    yield item
+                    yield self._maybe_raw(item)
                 if first:
                     yield StreamStart()
             else:
-                yield result
+                yield self._maybe_raw(result)
         finally:
             with self._lock:
                 self._ongoing -= 1
+
+    @staticmethod
+    def _maybe_raw(item):
+        """Route large raw bodies onto the zero-copy path: bytes-like chunks
+        at or above ``serve_zero_copy_min_bytes`` seal as out-of-band
+        buffers (``streaming.RawBody``) so the proxy forwards an
+        arena-backed view instead of re-pickling the payload."""
+        if not isinstance(item, (bytes, bytearray, memoryview)):
+            return item
+        from ray_tpu._private.config import get_config
+
+        threshold = get_config().serve_zero_copy_min_bytes
+        if isinstance(item, memoryview):
+            # len() counts ELEMENTS for typed views — measure bytes. A
+            # non-contiguous view can't ride PickleBuffer: flatten it.
+            if not item.contiguous:
+                item = item.tobytes()
+            elif threshold:
+                # a bare memoryview can't pickle at all: whenever the
+                # zero-copy path is on it rides RawBody regardless of size
+                from ray_tpu.serve.streaming import RawBody
+
+                return RawBody(item)
+            else:
+                return item.tobytes()  # zero-copy off: picklable bytes
+        if threshold and len(item) >= threshold:
+            from ray_tpu.serve.streaming import RawBody
+
+            return RawBody(item)
+        return item
 
     # -- control plane ------------------------------------------------------
 

@@ -30,6 +30,46 @@ class StreamStart:
     headers: Optional[list] = None  # [(name, value)] strings
 
 
+class RawBody:
+    """A large raw response body on the zero-copy path.
+
+    Replicas wrap ``bytes`` chunks at or above
+    ``Config.serve_zero_copy_min_bytes`` in a RawBody so the payload rides
+    pickle-5 **out-of-band buffers** through the object plane: sealing
+    writes the bytes once into the store, and the proxy's read comes back
+    as a memoryview over the arena mapping (the PR 8 pull-into-arena /
+    windowed-transfer machinery moves it node-to-node) — the proxy then
+    writes that view straight to the socket. No pickle copy, no proxy-side
+    staging buffer, and cross-node bodies never relay through the head.
+    """
+
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = data  # bytes / memoryview / any buffer
+
+    def __len__(self) -> int:
+        # BYTES, not elements: the admission byte counters and chunk
+        # framing size a typed view by nbytes
+        return memoryview(self.data).nbytes
+
+    def view(self) -> memoryview:
+        return memoryview(self.data).cast("B")
+
+    def tobytes(self) -> bytes:
+        return self.data if isinstance(self.data, bytes) else bytes(self.data)
+
+    def __reduce_ex__(self, protocol):
+        import pickle
+
+        if protocol >= 5:
+            # out-of-band: with a buffer_callback (the serialization
+            # context sets one) the payload never enters the pickle stream,
+            # and loads() hands back a zero-copy view of the store buffer
+            return (RawBody, (pickle.PickleBuffer(self.data),))
+        return (RawBody, (self.tobytes(),))
+
+
 class DeploymentResponseGenerator:
     """Iterator over a streaming deployment call's chunk VALUES
     (reference: ``DeploymentResponseGenerator``, ``python/ray/serve/handle.py``
@@ -42,6 +82,12 @@ class DeploymentResponseGenerator:
         # than yielded: handle-level consumers see only user chunks; the
         # proxy reads .stream_start to pick content type
         self.stream_start: Optional[StreamStart] = None
+        # RawBody is likewise proxy protocol, not a user chunk: the replica
+        # wraps large bytes for the zero-copy socket path, so by default it
+        # unwraps back to the bytes the handler yielded (deployment
+        # composition / driver streaming handles must never see it). The
+        # proxies flip this off to write the store-backed view directly.
+        self.unwrap_raw = True
         # Shared with the handle's abandon watcher (weakref.finalize): when
         # this generator is GC'd with done=False, the consumer walked away
         # mid-stream and the drainer must drop its completion pin so the
@@ -75,6 +121,8 @@ class DeploymentResponseGenerator:
             if isinstance(value, StreamStart):
                 self.stream_start = value
                 continue
+            if self.unwrap_raw and isinstance(value, RawBody):
+                return value.tobytes()
             return value
 
     def completed(self):

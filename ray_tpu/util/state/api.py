@@ -91,6 +91,16 @@ def transfer_stats() -> dict:
     return _call("transfer_stats")
 
 
+def proxy_stats(proxy_id_prefix: Optional[str] = None) -> dict:
+    """Per-proxy serve-ingress counters pushed by each proxy actor
+    (reference: the proxy metrics serve's controller aggregates):
+    accepted/shed (global, per-deployment, per-tenant causes), current
+    in-flight by deployment and tenant, dropped streams at shutdown drain,
+    and zero-copy vs copied response-body bytes. Keyed by proxy id; pass a
+    prefix to filter."""
+    return _call("proxy_stats", proxy_id_prefix) or {}
+
+
 def actor_creation_stats() -> dict:
     """Counters for the agent-owned actor-creation lease protocol
     (reference: GcsActorScheduler leasing creation to the raylet): leases

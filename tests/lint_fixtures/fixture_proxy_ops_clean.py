@@ -1,0 +1,93 @@
+"""Clean fixture: the serve-ingress proxy ops done right.
+
+Correct op names, a ``report_proxy_stats`` payload matching the handler's
+2-field unpack (the port rides inside the stats dict), a guarded use of
+the maybe-empty ``proxy_stats`` reply (never an unguarded subscript), a
+bounded reply wait, raise→error-reply conversion at the dispatch site, a
+declared op catalog matching the ladder, and the shed-audit spool
+credited through try/finally — zero findings across every family.
+"""
+
+import threading
+
+# mirrors the dispatch ladder below; wire-conformance cross-checks it
+CONTROLLER_OPS = frozenset({"proxy_stats", "report_proxy_stats"})
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    def __init__(self):
+        self._proxy_stats = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "report_proxy_stats":
+            proxy_id, stats = payload
+            self._proxy_stats[proxy_id] = dict(stats or {})
+            return None
+        if op == "proxy_stats":
+            return {
+                pid: dict(rec)
+                for pid, rec in self._proxy_stats.items()
+                if payload is None or pid.startswith(payload)
+            }
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class ProxyStatsPusher:
+    def __init__(self, conn, proxy_id, port):
+        self._conn = conn
+        self._proxy_id = proxy_id
+        self._port = port
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def push(self, stats):
+        return self.call_controller(
+            "report_proxy_stats",
+            (self._proxy_id, {**stats, "port": self._port}),
+        )
+
+    def shed_rates(self):
+        table = self.call_controller("proxy_stats")
+        # guarded consumption: the reply may be an empty dict
+        if not table:
+            return {}
+        return {
+            pid: rec.get("shed", 0) / max(rec.get("accepted", 0), 1)
+            for pid, rec in table.items()
+        }
+
+    def flush_window(self, window):
+        """The per-window shed-audit spool is released on EVERY path — a
+        raising delivery unwinds through the finally."""
+        spool = open(window.audit_path, "ab")  # noqa: SIM115 — fixture shape
+        try:
+            spool.write(b"shed window\n")
+            deliver_window(window)
+        finally:
+            spool.close()
+
+
+def deliver_window(window) -> None:
+    if not window.counters:
+        raise ValueError("empty stats window")

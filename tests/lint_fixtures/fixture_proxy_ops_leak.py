@@ -1,0 +1,103 @@
+"""Self-detection fixture: the serve-ingress proxy ops done WRONG.
+
+The PR 13 growth shape — per-node proxy actors push their admission
+counters (``report_proxy_stats``) and pull policy from modules far from
+the controller's dispatch ladder, so a typo'd stats push or a
+payload-arity drift ships clean and every proxy's counters silently
+never land (the overload dashboard reads zeros while the ingress sheds);
+and the shed-audit path stages a per-window spool that a push failure
+strands. tpulint must flag:
+
+- wire-conformance: the misspelled ``report_proxy_statz`` push
+  (did-you-mean) and the 3-tuple ``report_proxy_stats`` payload against
+  the handler's 2-field unpack (port does not belong in the payload);
+- ref-lifecycle: the shed-audit spool leaked when the push raises
+  (leak-on-raise in the stats-flush path).
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import threading
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    """Dispatch surface for the serve-ingress proxy ops."""
+
+    def __init__(self):
+        self._proxy_stats = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "report_proxy_stats":
+            proxy_id, stats = payload
+            self._proxy_stats[proxy_id] = dict(stats or {})
+            return None
+        if op == "proxy_stats":
+            return {
+                pid: dict(rec)
+                for pid, rec in self._proxy_stats.items()
+                if payload is None or pid.startswith(payload)
+            }
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class ProxyStatsPusher:
+    """Proxy-side stats client with the protocol bugs under test."""
+
+    def __init__(self, conn, proxy_id, port):
+        self._conn = conn
+        self._proxy_id = proxy_id
+        self._port = port
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def push(self, stats):
+        # BUG: "report_proxy_statz" — no handler branch matches; every
+        # stats window dies as an unknown-op error reply and the overload
+        # dashboard reads zeros while the ingress sheds
+        return self.call_controller(
+            "report_proxy_statz", (self._proxy_id, stats)
+        )
+
+    def push_with_port(self, stats):
+        # BUG: 3-tuple payload vs the handler's 2-field unpack (the port
+        # rides inside the stats dict, not the payload) — ValueError at
+        # dispatch, the counters silently never land
+        return self.call_controller(
+            "report_proxy_stats", (self._proxy_id, stats, self._port)
+        )
+
+    def flush_window(self, window):
+        """Leak-on-raise in the stats-flush path: the per-window shed-audit
+        spool is open while deliver_window() can raise — no handler, no
+        finally, the handle (and its fd) strands with the failed window."""
+        spool = open(window.audit_path, "ab")  # noqa: SIM115 — fixture shape
+        spool.write(b"shed window\n")
+        deliver_window(window)
+        spool.close()
+
+
+def deliver_window(window) -> None:
+    if not window.counters:
+        raise ValueError("empty stats window")

@@ -1215,6 +1215,41 @@ def test_fixture_batch_ops_clean_has_zero_findings():
     assert findings == [], [f.render() for f in findings]
 
 
+def test_fixture_proxy_ops_leak_flagged():
+    """The PR 13 serve-ingress shape done wrong: a typo'd
+    report_proxy_statz push (did-you-mean), a 3-tuple report payload
+    against the handler's 2-field unpack, and the stats-flush path
+    stranding the shed-audit spool when delivery raises."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_proxy_ops_leak.py")]
+    )
+    wire = _by_check(findings).get("wire-conformance", [])
+    assert len(wire) == 2, [f.render() for f in findings]
+    typo = next(h for h in wire if "report_proxy_statz" in h.message)
+    assert 'did you mean "report_proxy_stats"' in typo.message
+    arity = next(
+        h for h in wire
+        if "report_proxy_stats" in h.message and "statz" not in h.message
+    )
+    assert "3-tuple" in arity.message and "2 fields" in arity.message
+    assert arity.qualname.endswith("ProxyStatsPusher.push_with_port")
+    life = _by_check(findings).get("ref-lifecycle", [])
+    assert len(life) == 1, [f.render() for f in findings]
+    assert life[0].qualname.endswith("ProxyStatsPusher.flush_window")
+    assert "leaks when" in life[0].message
+
+
+def test_fixture_proxy_ops_clean_has_zero_findings():
+    """Same serve-ingress proxy-op shapes done right (matching ops and
+    arities, guarded maybe-empty proxy_stats reply, finally-credited
+    shed-audit spool, declared op set in sync): zero findings across every
+    family."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_proxy_ops_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_protocol_doc_is_current_and_covers_controller_ops():
     """docs/PROTOCOL.md matches a fresh render of the extracted catalog and
     names every controller op + the agent data-plane surface."""
@@ -1380,6 +1415,7 @@ def test_cli_exits_nonzero_on_fixtures():
         "fixture_wire_none_reply.py",
         "fixture_actor_lease_leak.py",
         "fixture_tenant_ops_leak.py",
+        "fixture_proxy_ops_leak.py",
     ):
         proc = subprocess.run(
             [
