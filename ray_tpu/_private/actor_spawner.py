@@ -67,6 +67,10 @@ class _Lease:
         self.reported = False
         # set on reset/shutdown: aborts report backoff waits immediately
         self.abort = threading.Event()
+        # agent-plane tracing (creation lease recv → placement report)
+        self.recv_t = time.time()
+        self.trace_span: Optional[str] = None
+        self.trace_parent: Optional[str] = None
 
 
 class ActorSpawner:
@@ -153,6 +157,21 @@ class ActorSpawner:
             if verdict == "dead":
                 # killed/superseded while we were creating: reap the orphan
                 self._kill_worker(st.worker_id)
+        if st.trace_span is not None:
+            from ray_tpu.util import tracing
+
+            tid_hex = st.lease.spec.task_id.hex()
+            tracing.record_span(
+                "agent.actor_create",
+                st.recv_t,
+                time.time(),
+                trace_id=st.lease.spec.trace_id,
+                span_id=st.trace_span,
+                parent_id=st.trace_parent,
+                plane="agent",
+                task_id=tid_hex,
+                pooled=st.pooled,
+            )
         self._forget(st)
         return True
 
@@ -267,6 +286,12 @@ class ActorSpawner:
         # dispatch the creation task; completion (or the worker's death)
         # continues on the worker's reader thread
         st.dispatched = True
+        if agent._trace_gate(lease.spec):
+            # re-point the spec's dispatch parent at the agent span (the
+            # head's sched span becomes OUR parent) before the wire
+            st.trace_parent = getattr(lease.spec, "sched_span_id", None)
+            st.trace_span = f"{lease.spec.task_id.hex()}:agent"
+            lease.spec.sched_span_id = st.trace_span
         if not agent._send_to_worker(
             wid, P.ExecuteTask(lease.spec, lease.resolved_args)
         ):

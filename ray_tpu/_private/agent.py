@@ -139,6 +139,30 @@ class NodeAgent:
         self._report_lock = threading.Lock()
         self._report_wake = threading.Event()
 
+        # Observability plane (PR 14): worker processes on this node push
+        # their span-ring drains + metrics snapshots to US (the agent
+        # intercepts report_observability on the worker socket); the node's
+        # merged payload — workers' entries plus this agent's own spans and
+        # registry snapshot — piggybacks on the report-batch flush tick, so
+        # shipping costs ZERO extra head round trips. Cadence: config
+        # metrics_report_interval_ms / RAY_TPU_METRICS_REPORT_INTERVAL_MS.
+        try:
+            _obs_ms = float(
+                os.environ.get(
+                    "RAY_TPU_METRICS_REPORT_INTERVAL_MS",
+                    _get_config().metrics_report_interval_ms,
+                )
+            )
+        except (TypeError, ValueError):
+            _obs_ms = 2000.0
+        self._obs_interval_s = max(0.05, _obs_ms / 1000.0)
+        self._obs_pending: list = []  # worker reporter entries, bounded
+        self._obs_pending_cap = 256
+        self._obs_lock = threading.Lock()
+        self._obs_last_ship = 0.0
+        self._obs_metric = None  # lazy transfer_stats → Counter mirror
+        self._obs_metric_last: dict[str, float] = {}
+
         # Actor creation leases (reference: the raylet side of
         # GcsActorScheduler's lease protocol): the spawner owns worker
         # acquisition, the registration handshake, creation dispatch, and
@@ -626,10 +650,31 @@ class NodeAgent:
     def _lease_fp(lease: P.LeaseTask) -> tuple:
         return (lease.needs_tpu, tuple(sorted(lease.env_vars.items())))
 
+    def _trace_gate(self, spec) -> bool:
+        """Record agent-plane spans for this lease? Same deterministic
+        per-task sampling verdict every plane computes."""
+        if getattr(spec, "trace_id", None) is None:
+            return False
+        from ray_tpu.util import tracing
+
+        return tracing.sampled(spec.task_id.binary())
+
+    def _stamp_lease_trace(self, lease) -> None:
+        """First dispatch of a traced lease: remember the head's sched span
+        as OUR parent and re-point ``spec.sched_span_id`` at the agent span
+        (``<task_id>:agent``), so the worker's exec span parents under the
+        plane that actually handed it the task."""
+        spec = lease.spec
+        if getattr(lease, "_obs_span", None) is None and self._trace_gate(spec):
+            lease._obs_parent = getattr(spec, "sched_span_id", None)
+            lease._obs_span = f"{spec.task_id.hex()}:agent"
+            spec.sched_span_id = lease._obs_span
+
     def _on_lease_task(self, lease: P.LeaseTask):
         """Second-level dispatch: the head picked this node; the agent picks
         (or spawns) the worker (reference: LocalTaskManager dispatch,
         local_task_manager.h:60)."""
+        lease._obs_recv = time.time()  # agent-plane span start
         if self.draining:
             # quiesce: reject new leases outright — the head re-places them
             # elsewhere (the drain window race: the head marked us DRAINING
@@ -668,6 +713,7 @@ class NodeAgent:
         """Pop an idle compatible worker or start one (call under
         _lease_lock). Returns True when the task went to a worker."""
         fp = self._lease_fp(lease)
+        self._stamp_lease_trace(lease)  # before the spec crosses the wire
         idle = self._fp_idle.get(fp)
         while idle:
             wid = idle.pop()
@@ -675,6 +721,20 @@ class NodeAgent:
                 continue  # retired
             if self._send_to_worker(wid, P.ExecuteTask(lease.spec, lease.resolved_args)):
                 self._busy.setdefault(wid, set()).add(lease.spec.task_id.binary())
+                if getattr(lease, "_obs_span", None) is not None:
+                    tid_hex = lease.spec.task_id.hex()
+                    from ray_tpu.util import tracing
+
+                    tracing.record_span(
+                        "agent.dispatch",
+                        getattr(lease, "_obs_recv", time.time()),
+                        time.time(),
+                        trace_id=lease.spec.trace_id,
+                        span_id=f"{tid_hex}:agent:dispatch",
+                        parent_id=lease._obs_span,
+                        plane="agent",
+                        task_id=tid_hex,
+                    )
                 return True
             self._retire_local_worker(wid)
         n = len(self._wid_fp) + self._spawning
@@ -793,6 +853,21 @@ class NodeAgent:
             if fp is not None:
                 self._fp_idle.setdefault(fp, []).append(wid)
                 self._pump_local_locked()
+        if getattr(lease, "_obs_span", None) is not None:
+            # agent-plane umbrella span: lease recv → done-report queued
+            tid_hex = lease.spec.task_id.hex()
+            from ray_tpu.util import tracing
+
+            tracing.record_span(
+                "agent.lease",
+                getattr(lease, "_obs_recv", time.time()),
+                time.time(),
+                trace_id=lease.spec.trace_id,
+                span_id=lease._obs_span,
+                parent_id=getattr(lease, "_obs_parent", None),
+                plane="agent",
+                task_id=tid_hex,
+            )
         self._queue_report(P.AgentTaskDone(msg.task_id, msg.results, msg.exec_ms))
         return True
 
@@ -812,18 +887,107 @@ class NodeAgent:
     def _flush_reports(self) -> None:
         with self._report_lock:
             batch, self._report_queue = self._report_queue, []
-        if not batch:
+        # the node's observability payload rides THIS tick (zero extra
+        # round trips). Chaos (RAY_TPU_WORKER_RPC_FAILURE
+        # "report_observability=p") drops ONLY the observability payload —
+        # it refolds for the next tick; task-done reports are unaffected.
+        obs = self._collect_observability()
+        if obs is not None:
+            try:
+                self._maybe_inject_failure("report_observability")
+            except OSError:
+                self._requeue_observability(obs)
+                obs = None
+        if not batch and obs is None:
             return
         try:
-            if len(batch) == 1:
+            if len(batch) == 1 and obs is None:
                 self._send(batch[0])
             else:
-                self._send(P.AgentReportBatch(batch))
+                self._send(P.AgentReportBatch(batch, observability=obs))
         except (OSError, EOFError):
             # conn mid-reconnect: these reports reference the OLD head
             # incarnation's lease state — the reconnect reset re-places
-            # everything, so dropping them is the correct outcome
-            pass
+            # everything, so dropping them is the correct outcome. The
+            # observability payload is incarnation-free: refold it.
+            if obs is not None:
+                self._requeue_observability(obs)
+
+    # ------------------------------------------------- observability plane
+
+    def _queue_observability(self, payload) -> None:
+        """Worker-socket intercept of ``report_observability``: buffer the
+        worker's reporter entries for the node's next piggybacked ship
+        (bounded — a stalled head drops the oldest entries, whose metrics
+        snapshots are superseded by newer cumulative ones anyway)."""
+        _node_hint, entries = payload
+        with self._obs_lock:
+            self._obs_pending.extend(entries or [])
+            if len(self._obs_pending) > self._obs_pending_cap:
+                del self._obs_pending[: -self._obs_pending_cap]
+        self._report_wake.set()
+        return None
+
+    def _requeue_observability(self, entries: list) -> None:
+        # same drop-OLDEST policy as _queue_observability: under a long
+        # head outage the stale requeued entries go first, the freshest
+        # worker reports survive
+        with self._obs_lock:
+            self._obs_pending = (entries + self._obs_pending)[
+                -self._obs_pending_cap:
+            ]
+
+    def _mirror_stats_metrics(self) -> None:
+        """Register this node's transfer counters as real util.metrics
+        samples (delta mirror) so they reach the head's one-scrape
+        ``/metrics`` under this node's label."""
+        from ray_tpu.util import metrics as M
+
+        if self._obs_metric is None:
+            self._obs_metric = M.Counter(
+                "rtpu_transfer_events_total",
+                "object-transfer plane counters (transfer_stats)",
+                tag_keys=("event",),
+            )
+        with self._stats_lock:
+            snap = dict(self.transfer_stats)
+        for ev, v in snap.items():
+            M.fold_counter_delta(
+                self._obs_metric, self._obs_metric_last, ev, float(v),
+                tags={"event": ev},
+            )
+
+    def _collect_observability(self):
+        """Build the node's piggyback payload: buffered worker entries
+        plus — when the report interval has elapsed — this agent process's
+        own span drain and registry snapshot. None when nothing to ship."""
+        now = time.monotonic()
+        with self._obs_lock:
+            entries, self._obs_pending = self._obs_pending, []
+        if now - self._obs_last_ship >= self._obs_interval_s:
+            self._obs_last_ship = now
+            from ray_tpu.util import tracing as t
+            spans = t.drain_spans()
+            try:
+                self._mirror_stats_metrics()
+            except Exception:  # noqa: BLE001 — mirror must not block shipping
+                pass
+            from ray_tpu.util import metrics as M
+
+            snap = M.snapshot()
+            if spans or snap:
+                entries = entries + [
+                    {
+                        "reporter": (
+                            f"a-{self.node_id.hex()[:12]}-{os.getpid()}"
+                        ),
+                        "pid": os.getpid(),
+                        "spans": spans,
+                        "dropped_spans": t.dropped_spans(),
+                        "metrics": snap,
+                    }
+                ]
+        return entries or None
 
     def _report_flush_loop(self):
         while not self.shutting_down:
@@ -1123,6 +1287,15 @@ class NodeAgent:
             self._reply_worker(
                 conn, worker_id, msg.req_id,
                 lambda _p: self._snapshot_stats(), msg.payload,
+            )
+            return
+        if isinstance(msg, P.Request) and msg.op == "report_observability":
+            # buffer the worker's span/metric report; the node's merged
+            # payload piggybacks on the report-batch tick (the head also
+            # accepts this op directly — head-node workers have no agent)
+            self._reply_worker(
+                conn, worker_id, msg.req_id,
+                self._queue_observability, msg.payload,
             )
             return
         if isinstance(msg, P.PutObject) and msg.kind == "plasma":

@@ -218,7 +218,22 @@ class Config:
     testing_rpc_failure_prob: float = 0.0
     # --- logging/observability ---
     event_buffer_size: int = 10000
+    # Workers and agents snapshot their util.metrics registry and drain
+    # their span ring on this cadence (shipped to the head piggybacked on
+    # existing report traffic; see report_observability in docs/PROTOCOL.md).
     metrics_report_interval_ms: int = 2000
+    # Distributed-tracing sampling: 0 disables tracing entirely; 1 records
+    # every task's full span chain; N>1 records the head/agent/worker span
+    # chain for 1-in-N tasks (deterministic by task id, so a sampled task's
+    # head→agent→worker chain is complete) while every task's head events
+    # stay trace-joinable in task_events.
+    # The always-on default is overhead-gated by bench.py --observability
+    # (MICROBENCH.json["observability"], enforced by --check-floor).
+    trace_sample_n: int = 16
+    # Per-process span ring-buffer bound; overflow increments the
+    # dropped_spans counter instead of growing without bound in long-lived
+    # workers.
+    trace_buffer_size: int = 4096
     # --- TPU ---
     tpu_chips_per_host_default: int = 4
     tpu_slice_grace_period_s: float = 60.0

@@ -91,8 +91,9 @@ NODE_SHARD_OPS = frozenset({
 })
 KV_SHARD_OPS = frozenset({"kv_put", "kv_get", "kv_del", "kv_keys"})
 OBSERVE_SHARD_OPS = frozenset({
-    "log_get", "log_list", "log_tail_buffer", "proxy_stats",
-    "pubsub_poll", "pubsub_publish", "report_proxy_stats", "worker_stacks",
+    "cluster_metrics", "log_get", "log_list", "log_tail_buffer",
+    "proxy_stats", "pubsub_poll", "pubsub_publish", "report_observability",
+    "report_proxy_stats", "worker_stacks",
 })
 
 
@@ -279,6 +280,7 @@ class PendingTask:
         self.retries_left = spec.max_retries
         self.worker: Optional[WorkerHandle] = None
         self.cancelled = False
+        self.submit_t: float = time.time()  # head.sched span start
         self.dispatch_t: float = 0.0  # set when handed to a worker
         self.seq = 0  # global submission order (FIFO across shape queues)
 
@@ -502,6 +504,46 @@ class Controller:
         # ``proxy_stats`` op / state API reads the aggregate. Guarded by
         # self.lock; low-rate (one small dict per proxy every ~2 s).
         self._proxy_stats: dict[str, dict] = {}
+        # Cluster observability plane (one scrape, one timeline):
+        # - metrics_agg merges per-reporter util.metrics snapshots shipped
+        #   by workers/agents (report_observability pushes + the
+        #   AgentReportBatch piggyback) into a node-labeled cluster view;
+        # - _span_store holds shipped lifecycle/app spans (the head's own
+        #   spans live in this process's tracing ring) for the merged
+        #   timeline, bounded like task_events with a drop counter.
+        from ray_tpu.util.metrics import MetricsAggregator
+
+        self.metrics_agg = MetricsAggregator()
+        self._span_store: deque = deque(maxlen=config.event_buffer_size)
+        self._span_dropped = 0
+        # remote rings drop too: reporters ship their CUMULATIVE
+        # dropped_spans count with every entry — keep last-per-reporter
+        # (bounded LRU, dead reporters evict first and fold into a base
+        # so the total stays monotonic; like the MetricsAggregator
+        # baselines, the cap must exceed the live reporter count or an
+        # evicted live reporter re-adds on its next report) and sum into
+        # the cluster dropped_spans figure
+        self._span_reporter_dropped: "OrderedDict[str, float]" = (
+            OrderedDict()
+        )
+        self._span_dropped_evicted = 0.0
+        # replay guard: a reporter requeues its drained spans on ANY send
+        # failure, including a lost reply after we already applied them —
+        # dedup on (span_id, start) so the resend folds to zero like the
+        # metrics deltas do (a task RETRY reuses the deterministic span id
+        # but starts at a different time, so it still lands). Bounded LRU
+        # sized to the store.
+        self._span_seen: "OrderedDict[tuple, None]" = OrderedDict()
+        self._span_lock = threading.Lock()
+        # core-stats → util.metrics mirror baselines (the scattered
+        # lease/transfer/tenant/proxy counters become real metrics; see
+        # _sync_core_metrics)
+        self._core_metrics: Optional[dict] = None
+        self._core_metric_last: dict[tuple, float] = {}
+        # serializes the whole mirror pass: a dashboard /metrics scrape
+        # (HTTP thread) racing a cluster_metrics op (dispatch shard) on
+        # the read-diff-inc baselines would double-count deltas
+        self._core_metric_lock = threading.Lock()
         # actor-creation observability (the agent-owned lease protocol):
         # tests pin "the head never runs a spawn thread for an agent-node
         # actor" through these counters instead of timing/threads
@@ -3036,8 +3078,14 @@ class Controller:
         )
         self.task_events.append(
             {"task_id": spec.task_id.hex(), "name": spec.name,
-             "event": "LEASED", "node": node.node_id.hex(), "t": pt.dispatch_t}
+             "event": "LEASED", "node": node.node_id.hex(), "t": pt.dispatch_t,
+             "trace_id": getattr(spec, "trace_id", None),
+             "parent_span_id": getattr(spec, "parent_span_id", None),
+             "submit_t": pt.submit_t}
         )
+        # the lease message in the outbox carries this spec by reference:
+        # stamping here lands on the wire at the round's batch flush
+        self._record_sched_span(pt, "LEASED", node.node_id.hex()[:12])
         return True
 
     def _lease_actor_to_agent(self, node: NodeState, pt: PendingTask) -> bool:
@@ -3104,8 +3152,12 @@ class Controller:
         self.task_events.append(
             {"task_id": spec.task_id.hex(), "name": spec.name,
              "event": "ACTOR_LEASED", "node": node.node_id.hex(),
-             "t": pt.dispatch_t}
+             "t": pt.dispatch_t,
+             "trace_id": getattr(spec, "trace_id", None),
+             "parent_span_id": getattr(spec, "parent_span_id", None),
+             "submit_t": pt.submit_t}
         )
+        self._record_sched_span(pt, "ACTOR_LEASED", node.node_id.hex()[:12])
         return True
 
     def _queue_lease_locked(self, node: NodeState, msg) -> None:
@@ -4228,6 +4280,11 @@ class Controller:
                 # through the lease cache exactly as a lone report would
                 for item in msg.items:
                     self._on_agent_task_done(agent, item)
+                # the node's span/metric payload piggybacks on this tick
+                # (see protocol.AgentReportBatch.observability)
+                obs = getattr(msg, "observability", None)
+                if obs:
+                    self._apply_observability(agent.node_id.hex()[:12], obs)
             elif isinstance(msg, P.TaskSpilled):
                 self._on_task_spilled(agent, msg)
             elif isinstance(msg, P.Heartbeat):
@@ -5042,7 +5099,57 @@ class Controller:
         raise ValueError(f"unknown controller op: {op}")
 
     def _dispatch_observe_ops(self, op: str, payload, caller: "WorkerHandle" = None):
-        """Dispatch shard: logs, pubsub, on-demand profiling."""
+        """Dispatch shard: logs, pubsub, on-demand profiling, and the
+        cluster observability plane (span/metric report ingestion + the
+        one-scrape merged metrics / merged-timeline query)."""
+        if op == "report_observability":
+            # a worker/agent process ships its span ring + util.metrics
+            # snapshot; node attribution comes from the payload hint (the
+            # agent piggyback stamps its node) or the caller's node table
+            # entry (head-process workers land under "head")
+            node_hint, entries = payload
+            node_label = node_hint
+            if node_label is None:
+                nid = getattr(caller, "node_id", None)
+                node_label = (
+                    "head"
+                    if nid is None or nid == self.head_node_id
+                    else nid.hex()[:12]
+                )
+            self._apply_observability(node_label, entries)
+            return None
+        if op == "cluster_metrics":
+            # the merged cluster view: {"metrics": node-labeled model} and,
+            # when asked, {"spans": shipped + head-local span records} —
+            # the state API's timeline()/cluster_metrics() surface
+            include = {"metrics"}
+            if isinstance(payload, dict) and payload.get("include"):
+                include = set(payload["include"])
+            out: dict = {}
+            if "metrics" in include:
+                from ray_tpu.util import metrics as metrics_mod
+
+                self._sync_core_metrics()
+                out["metrics"] = metrics_mod.merged_model(
+                    self.metrics_agg, local_node="head"
+                )
+            if "spans" in include:
+                from ray_tpu.util import tracing as t
+                local = []
+                for s in t.get_spans():
+                    if s.get("node") is None:
+                        s = {**s, "node": "head"}
+                    local.append(s)
+                with self._span_lock:
+                    shipped = list(self._span_store)
+                    remote_dropped = self._span_dropped_evicted + sum(
+                        self._span_reporter_dropped.values()
+                    )
+                out["spans"] = shipped + local
+                out["dropped_spans"] = (
+                    self._span_dropped + t.dropped_spans() + remote_dropped
+                )
+            return out
         if op == "log_get":
             prefix, source, tail_bytes = payload
             return self._log_fetch(prefix, source, tail_bytes)
@@ -5118,6 +5225,195 @@ class Controller:
             return out
         raise ValueError(f"unknown controller op: {op}")
 
+    # ------------------------------------------------- observability plane
+
+    def _apply_observability(self, node_label: str, entries) -> None:
+        """Fold one node's shipped observability payload into the cluster
+        view: metrics snapshots through the aggregator (delta merge,
+        replay-idempotent), spans into the bounded store stamped with the
+        reporting node."""
+        if not entries:
+            return
+        for entry in entries:
+            try:
+                reporter = str(entry.get("reporter") or "unknown")
+                snap = entry.get("metrics") or []
+                if snap:
+                    self.metrics_agg.apply(node_label, reporter, snap)
+                dropped = entry.get("dropped_spans")
+                if isinstance(dropped, (int, float)) and dropped > 0:
+                    with self._span_lock:
+                        self._span_reporter_dropped.pop(reporter, None)
+                        self._span_reporter_dropped[reporter] = float(dropped)
+                        while len(self._span_reporter_dropped) > 4096:
+                            _, v = self._span_reporter_dropped.popitem(
+                                last=False
+                            )
+                            self._span_dropped_evicted += v
+                spans = entry.get("spans") or []
+                if spans:
+                    with self._span_lock:
+                        for s in spans:
+                            key = (s.get("span_id"), s.get("start"))
+                            if key[0] is not None:
+                                if key in self._span_seen:
+                                    continue  # replayed report
+                                self._span_seen[key] = None
+                                while (
+                                    self._span_store.maxlen is not None
+                                    and len(self._span_seen)
+                                    > self._span_store.maxlen
+                                ):
+                                    self._span_seen.popitem(last=False)
+                            if s.get("node") is None:
+                                s["node"] = node_label
+                            if (
+                                self._span_store.maxlen is not None
+                                and len(self._span_store)
+                                >= self._span_store.maxlen
+                            ):
+                                self._span_dropped += 1
+                            self._span_store.append(s)
+            except Exception:  # noqa: BLE001 — a bad entry must not poison the batch
+                logger.warning(
+                    "malformed observability entry from %s", node_label,
+                    exc_info=True,
+                )
+
+    def _core_metric_objs(self) -> dict:
+        """The util.metrics objects mirroring the controller's ad-hoc stats
+        dicts (built lazily so a test's registry clear just re-registers on
+        the next scrape)."""
+        from ray_tpu.util import metrics as M
+
+        if self._core_metrics is not None and (
+            M._registry.get("rtpu_lease_events_total")
+            is not self._core_metrics["lease"]
+        ):
+            # the registry was cleared (test reset) out from under us:
+            # rebuild fresh objects and drop the delta baselines so the
+            # stats dicts' full cumulative values re-mirror
+            self._core_metrics = None
+            self._core_metric_last.clear()
+        if self._core_metrics is None:
+            self._core_metrics = {
+                "lease": M.Counter(
+                    "rtpu_lease_events_total",
+                    "lease-cache / lease-batching counters (lease_stats)",
+                    tag_keys=("event",),
+                ),
+                "transfer": M.Counter(
+                    "rtpu_transfer_events_total",
+                    "object-transfer plane counters (transfer_stats)",
+                    tag_keys=("event",),
+                ),
+                "actor_creation": M.Counter(
+                    "rtpu_actor_creation_events_total",
+                    "agent-owned actor-creation lease counters",
+                    tag_keys=("event",),
+                ),
+                "tenant": M.Counter(
+                    "rtpu_tenant_events_total",
+                    "per-tenant scheduler counters (dispatched, quota_parked, "
+                    "preemptions, ...)",
+                    tag_keys=("tenant", "event"),
+                ),
+                "tenant_queued": M.Gauge(
+                    "rtpu_tenant_queued",
+                    "queued tasks per tenant",
+                    tag_keys=("tenant",),
+                ),
+                "proxy": M.Counter(
+                    "rtpu_proxy_events_total",
+                    "serve-ingress proxy counters (accepted, shed causes, "
+                    "body bytes)",
+                    tag_keys=("proxy", "event"),
+                ),
+                "proxy_gauge": M.Gauge(
+                    "rtpu_proxy_gauge",
+                    "serve proxy point-in-time values (inflight, queued)",
+                    tag_keys=("proxy", "field"),
+                ),
+            }
+        return self._core_metrics
+
+    def _mirror_counter(self, metric, key: tuple, tags: dict, value: float):
+        from ray_tpu.util.metrics import fold_counter_delta
+
+        fold_counter_delta(metric, self._core_metric_last, key, value, tags)
+
+    def _sync_core_metrics(self) -> None:
+        """Register the controller's scattered stats counters
+        (``lease_stats``, ``transfer_stats``, ``actor_creation_stats``,
+        tenant ``dispatched``/``quota_parked``/... + queue depth, serve
+        ``proxy_stats``) as REAL util.metrics samples so one ``/metrics``
+        scrape carries them. The existing state-API ops stay untouched —
+        this mirrors, it does not move."""
+        try:
+            with self._core_metric_lock:
+                self._sync_core_metrics_locked()
+        except Exception:  # noqa: BLE001 — a scrape must never take the head down
+            logger.warning("core-metrics mirror failed", exc_info=True)
+
+    def _sync_core_metrics_locked(self) -> None:
+        m = self._core_metric_objs()
+        with self.lock:
+            lease = dict(self.lease_stats)
+            transfer = dict(self.transfer_stats)
+            creation = dict(self.actor_creation_stats)
+            tenants = [
+                (
+                    name,
+                    dict(ts.stats),
+                    sum(len(q) for q in ts.queues.values()),
+                )
+                for name, ts in self.tenants.items()
+            ]
+            proxies = {
+                pid: dict(rec) for pid, rec in self._proxy_stats.items()
+            }
+        for table, mkey in (
+            (lease, "lease"),
+            (transfer, "transfer"),
+            (creation, "actor_creation"),
+        ):
+            for ev, v in table.items():
+                self._mirror_counter(
+                    m[mkey], (mkey, ev), {"event": ev}, float(v)
+                )
+        for name, stats, queued in tenants:
+            for ev, v in stats.items():
+                if isinstance(v, (int, float)):
+                    self._mirror_counter(
+                        m["tenant"], ("tenant", name, ev),
+                        {"tenant": name, "event": ev}, float(v),
+                    )
+            m["tenant_queued"].set(float(queued), tags={"tenant": name})
+        for pid, rec in proxies.items():
+            for k, v in rec.items():
+                if not isinstance(v, (int, float)) or k in ("reported_t", "port"):
+                    continue
+                if "inflight" in k or "queued" in k:
+                    m["proxy_gauge"].set(
+                        float(v), tags={"proxy": pid, "field": k}
+                    )
+                else:
+                    self._mirror_counter(
+                        m["proxy"], ("proxy", pid, k),
+                        {"proxy": pid, "event": k}, float(v),
+                    )
+
+    def metrics_text(self) -> str:
+        """The one-scrape Prometheus exposition: this process's registry
+        (node="head") merged with every shipped node's snapshot (the
+        dashboard's /metrics handler)."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        self._sync_core_metrics()
+        return metrics_mod.export_prometheus_merged(
+            self.metrics_agg, local_node="head"
+        )
+
     # ------------------------------------------------------------ dispatching
 
     def _resolve_args(self, pt: PendingTask):
@@ -5139,6 +5435,41 @@ class Controller:
                 resolved_args.append(a)
         return resolved_args, None
 
+    def _record_sched_span(self, pt: PendingTask, event: str,
+                           node_label: Optional[str] = None) -> None:
+        """Head-plane lifecycle span (submit → tenant queue → lease grant /
+        dispatch) for a traced spec, recorded into this process's tracing
+        ring for SAMPLED tasks (same deterministic verdict as the other
+        planes — a sampled task's whole chain exists, head included);
+        ``spec.sched_span_id`` is stamped so the downstream plane's span
+        parents under this one. Unsampled tasks still get every HEAD EVENT:
+        the task_events entries at the dispatch/lease sites carry the
+        spec's trace_id, so per-task head history stays trace-joinable at
+        zero span-record cost. Deterministic id: ``<task_id>:sched``."""
+        spec = pt.spec
+        trace_id = getattr(spec, "trace_id", None)
+        if trace_id is None:
+            return
+        from ray_tpu.util import tracing as t
+        if not t.sampled(spec.task_id.binary()):
+            return
+        tid_hex = spec.task_id.hex()
+        spec.sched_span_id = f"{tid_hex}:sched"
+        t.record_span(
+            "head.sched",
+            getattr(pt, "submit_t", pt.dispatch_t) or pt.dispatch_t,
+            pt.dispatch_t,
+            trace_id=trace_id,
+            span_id=spec.sched_span_id,
+            parent_id=getattr(spec, "parent_span_id", None),
+            plane="head",
+            task_id=tid_hex,
+            node="head",
+            task=spec.name,
+            event=event,
+            target_node=node_label,
+        )
+
     def _dispatch_to_worker(self, worker: WorkerHandle, pt: PendingTask):
         spec = pt.spec
         resolved_args, lost = self._resolve_args(pt)
@@ -5156,8 +5487,14 @@ class Controller:
         pt.dispatch_t = time.time()
         worker.running[spec.task_id] = pt
         self.task_events.append(
-            {"task_id": spec.task_id.hex(), "name": spec.name, "event": "DISPATCHED", "t": pt.dispatch_t}
+            {"task_id": spec.task_id.hex(), "name": spec.name,
+             "event": "DISPATCHED", "t": pt.dispatch_t,
+             "trace_id": getattr(spec, "trace_id", None),
+             "parent_span_id": getattr(spec, "parent_span_id", None),
+             "submit_t": pt.submit_t}
         )
+        # stamp sched_span_id BEFORE the spec crosses the wire
+        self._record_sched_span(pt, "DISPATCHED")
         try:
             worker.send(P.ExecuteTask(spec, resolved_args))
         except (OSError, EOFError):

@@ -371,6 +371,7 @@ CONTROLLER_OPS = frozenset(
         "autoscaler_state",
         "available_resources",
         "cancel",
+        "cluster_metrics",
         "cluster_resources",
         "debug_worker_msg_count",
         "drain_node",
@@ -405,6 +406,7 @@ CONTROLLER_OPS = frozenset(
         "register_replica",
         "remove_node",
         "report_agent_spill",
+        "report_observability",
         "report_proxy_stats",
         "set_tenant_quota",
         "shm_create",
@@ -424,11 +426,19 @@ CONTROLLER_OPS = frozenset(
     }
 )
 
-# Ops a node agent intercepts for its local workers (node-local data plane).
+# Ops a node agent intercepts for its local workers (node-local data plane,
+# plus the observability push — the agent buffers its workers' span/metric
+# reports and piggybacks the node's merged payload on its report tick).
 # Must stay a subset of CONTROLLER_OPS: head-side workers have no agent, so
 # an agent-only op would work on agent nodes and break on the head node.
 AGENT_LOCAL_OPS = frozenset(
-    {"pull_into_arena", "pull_object_chunk", "shm_create", "transfer_stats"}
+    {
+        "pull_into_arena",
+        "pull_object_chunk",
+        "report_observability",
+        "shm_create",
+        "transfer_stats",
+    }
 )
 
 # Worker-side chaos channel names that are not request ops (the plasma /
@@ -755,9 +765,15 @@ class AgentReportBatch:
     tick instead of one per task; the head processes entries in order, and
     each completion may immediately re-arm the finishing node with the next
     queued same-(tenant, shape) spec (agent lease caching — see
-    ``Controller._maybe_rearm_locked``)."""
+    ``Controller._maybe_rearm_locked``).
+
+    ``observability`` piggybacks the node's due span/metric report on the
+    same tick (a list of per-reporter entries, the exact shape the
+    ``report_observability`` request op carries) — the observability plane
+    adds ZERO wire frames on the hot path. None when nothing is due."""
 
     items: list  # of AgentTaskDone
+    observability: Any = None  # list of reporter entries, or None
 
 
 @dataclasses.dataclass

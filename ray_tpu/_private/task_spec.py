@@ -77,6 +77,17 @@ class TaskSpec:
     # producer blocks; 0 = unbounded (reference:
     # _generator_backpressure_num_objects, python/ray/remote_function.py).
     generator_backpressure: int = 0
+    # Distributed tracing (reference: the W3C trace context the OTel
+    # tracing_helper injects into TaskSpec so spans stitch across
+    # driver/GCS/raylet/worker). ``trace_id`` groups one causal chain;
+    # ``parent_span_id`` is the submitter's span (the executing task's exec
+    # span for nested submits — inherited through the same thread-local
+    # that carries tenant/priority). ``sched_span_id`` is maintained by the
+    # DISPATCHING plane (head scheduler or node agent) so the worker's exec
+    # span parents under whichever plane actually handed it the task.
+    trace_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
+    sched_span_id: Optional[str] = None
 
     def return_ids(self) -> list[ObjectID]:
         if self.num_returns == "streaming":

@@ -115,6 +115,23 @@ class WorkerAPI:
 
         return current_exec_priority()
 
+    def _trace_fields(self) -> dict:
+        """Trace context to stamp on a submission (reference: the OTel
+        tracing_helper injecting W3C context into the TaskSpec). The parent
+        is the innermost open app span or — riding the same ``_exec_ctx``
+        thread-local that carries tenant/priority — the executing task's
+        exec span, so nested submits and actor calls chain causally across
+        processes. A top-level driver submit roots a fresh trace. Empty
+        when tracing is disabled (``trace_sample_n=0``)."""
+        from ray_tpu.util import tracing
+
+        if not tracing.enabled():
+            return {}
+        ctx = tracing.current_context()
+        if ctx is not None:
+            return {"trace_id": ctx[0], "parent_span_id": ctx[1]}
+        return {"trace_id": tracing.new_trace_id()}
+
     def _next_submit_index(self) -> int:
         """Submission index salted with this worker's identity so concurrent
         submitters (driver + workers) can never derive colliding TaskIDs —
@@ -247,6 +264,7 @@ class WorkerAPI:
             generator_backpressure=generator_backpressure,
             tenant=self._current_tenant(tenant),
             priority=self._current_priority(priority),
+            **self._trace_fields(),
         )
         return_ids = spec.return_ids()
         refs = [ObjectRef(oid) for oid in return_ids]
@@ -308,6 +326,7 @@ class WorkerAPI:
             runtime_env=runtime_env,
             tenant=self._current_tenant(tenant),
             priority=self._current_priority(priority),
+            **self._trace_fields(),
         )
         self._promote_ref_args(spec)
         # NAMED creations and runtime_env creations stay synchronous:
@@ -355,6 +374,7 @@ class WorkerAPI:
             generator_backpressure=generator_backpressure,
             tenant=self._current_tenant(),
             priority=self._current_priority(),
+            **self._trace_fields(),
         )
         return_ids = spec.return_ids()
         refs = [ObjectRef(oid) for oid in return_ids]
@@ -911,6 +931,11 @@ def init(
         if object_store_memory is not None:
             cfg.object_store_memory = object_store_memory
         set_config(cfg)
+        # tracing caches its sampling/buffer knobs per process: a re-init
+        # with different config (bench on/off rows, tests) must re-resolve
+        from ray_tpu.util import tracing as _tracing
+
+        _tracing._reset_sampling()
 
         head_resources = dict(resources or {})
         if num_cpus is None:

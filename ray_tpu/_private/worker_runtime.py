@@ -69,6 +69,46 @@ def current_exec_priority() -> Optional[int]:
     return getattr(_exec_ctx, "priority", None)
 
 
+# Tracing rides the same execution context: nested submits inherit the
+# executing task's (trace_id, exec span id) exactly like tenant/priority,
+# so one driver call's whole task tree stitches into one trace.
+_tracing_mod = None
+
+
+def _trace_mod():
+    """Lazy tracing import (ray_tpu.util's package __init__ pulls API
+    modules — importing it at this module's import time would cycle), plus
+    one-time registration of the task-context provider so app spans opened
+    inside a task body parent under the task's exec span."""
+    global _tracing_mod
+    if _tracing_mod is None:
+        from ray_tpu.util import tracing
+
+        tracing.set_context_provider(_task_trace_context)
+        _tracing_mod = tracing
+    return _tracing_mod
+
+
+def _task_trace_context() -> Optional[tuple]:
+    t = getattr(_exec_ctx, "trace_id", None)
+    s = getattr(_exec_ctx, "span_id", None)
+    return (t, s) if t and s else None
+
+
+def current_exec_trace() -> Optional[tuple]:
+    """(trace_id, exec span id) of the task executing on THIS thread."""
+    return _task_trace_context()
+
+
+def _obs_flush_loop(runtime: "WorkerRuntime") -> None:
+    """Periodic observability flusher (module-level like the coalescer's
+    loop thread: its only runtime interaction is the flush call, which
+    ships through the ordinary controller-request path)."""
+    while not runtime._obs_stop.wait(timeout=runtime._obs_interval_s):
+        runtime._flush_observability()
+    runtime._flush_observability()  # final report before teardown
+
+
 # Actors hosted in THIS process that are eligible for same-process inline
 # execution (sync, max_concurrency=1): actor_id binary -> hosting runtime.
 # The inline fast path (WorkerAPI submit) executes eligible calls on the
@@ -397,6 +437,27 @@ class WorkerRuntime:
         self._chaos_rng = _random.Random(
             int.from_bytes(worker_id.binary()[:4], "little")
         )
+        # Observability report loop (process workers only; thread-mode
+        # runtimes share the driver process's span ring and metrics
+        # registry, which the head reads directly): every tick the worker
+        # drains its span ring and snapshots its util.metrics registry into
+        # ONE report_observability push. On agent nodes the agent
+        # intercepts the push locally and piggybacks the node's merged
+        # payload on its report-batch tick — zero extra head round trips.
+        try:
+            from ray_tpu._private.config import get_config as _gc
+
+            _obs_ms = float(
+                os.environ.get(
+                    "RAY_TPU_METRICS_REPORT_INTERVAL_MS",
+                    _gc().metrics_report_interval_ms,
+                )
+            )
+        except Exception:  # noqa: BLE001 — env-only processes
+            _obs_ms = 2000.0
+        self._obs_interval_s = max(0.05, _obs_ms / 1000.0)
+        self._obs_stop = threading.Event()
+        self._obs_thread: Optional[threading.Thread] = None
         # client drivers attach to a foreign cluster: reply pump only, no
         # task execution, and never os._exit on disconnect
         self.client_mode = False
@@ -537,12 +598,44 @@ class WorkerRuntime:
     def shutdown(self):
         """Deterministic teardown: stop the coalescer (its shutdown flushes
         the final batch) — the final free batch must hit the wire before
-        the process exits."""
+        the process exits — and join the observability flusher (its exit
+        path ships the final span/metric report while the conn is still
+        plausibly alive)."""
         self._shutdown = True
+        self._obs_stop.set()
+        locktrace.join_if_alive(self._obs_thread, timeout=1.0)
         if not self.in_process:
             self._coalescer.shutdown()
         else:
             self._coalescer._shutdown = True
+
+    # ------------------------------------------------ observability shipping
+
+    def _flush_observability(self):
+        """Ship this process's span ring + metrics snapshot to the head (or
+        to the node agent's intercept). Metrics are cumulative snapshots —
+        a lost report is covered by the next one and a replay diffs to zero
+        at the head — so only spans need requeueing on failure."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        t = _trace_mod()
+        spans = t.drain_spans()
+        snap = metrics_mod.snapshot()
+        if not spans and not snap:
+            return
+        entry = {
+            "reporter": f"w-{self.worker_id.hex()[:12]}-{os.getpid()}",
+            "pid": os.getpid(),
+            "spans": spans,
+            "dropped_spans": t.dropped_spans(),
+            "metrics": snap,
+        }
+        try:
+            self.call_controller(
+                "report_observability", (None, [entry]), _skip_flush=True
+            )
+        except Exception:  # noqa: BLE001 — retried on the next tick
+            t.requeue_spans(spans)
 
     # compat shim for older call sites/tests: flush everything queued
     def _flush_frees(self) -> bool:
@@ -580,6 +673,13 @@ class WorkerRuntime:
         else:
             self._install_worker_api()
             self._start_direct_server()
+            # per-process observability flusher (thread mode shares the
+            # driver's ring/registry — the head reads them in-process)
+            self._obs_thread = threading.Thread(
+                target=_obs_flush_loop, args=(self,), daemon=True,
+                name=f"obs-flush-{self.worker_id.hex()[:8]}",
+            )
+            self._obs_thread.start()
         self._send(
             P.RegisterWorker(
                 self.worker_id, os.getpid(), direct_address=self.direct_address
@@ -1368,8 +1468,14 @@ class WorkerRuntime:
         prev_mkey = getattr(_exec_ctx, "method_key", None)
         prev_tenant = getattr(_exec_ctx, "tenant", None)
         prev_prio = getattr(_exec_ctx, "priority", None)
+        prev_trace = getattr(_exec_ctx, "trace_id", None)
+        prev_span = getattr(_exec_ctx, "span_id", None)
+        traced = self._trace_gate(spec)
+        t_wall = time.time()
+        failed = False
         try:
             if abin not in self.actors:
+                traced = False
                 return None
             try:
                 args, kwargs = self._deserialize_args(spec, resolved_args)
@@ -1381,6 +1487,7 @@ class WorkerRuntime:
                 # Ctrl-C must terminate the driver, not become a result
                 raise
             except BaseException as e:  # noqa: BLE001 — becomes the call's error result
+                failed = True
                 return self._store_error(spec, e)
         finally:
             # restore the OUTER execution context: a nested inline call from
@@ -1390,7 +1497,56 @@ class WorkerRuntime:
             _exec_ctx.method_key = prev_mkey
             _exec_ctx.tenant = prev_tenant
             _exec_ctx.priority = prev_prio
+            _exec_ctx.trace_id = prev_trace
+            _exec_ctx.span_id = prev_span
             lock.release()
+            if traced:
+                self._record_exec_spans(
+                    spec, t_wall, None, None, time.time(), failed
+                )
+
+    def _trace_gate(self, spec: TaskSpec) -> bool:
+        """Record this task's worker-plane spans? Sampled deterministically
+        by task id, so every plane of a sampled task agrees."""
+        t = _trace_mod()
+        return (
+            getattr(spec, "trace_id", None) is not None
+            and t.sampled(spec.task_id.binary())
+        )
+
+    def _record_exec_spans(
+        self, spec: TaskSpec, t0: float, t_deser: Optional[float],
+        t_ret: Optional[float], t_end: float, failed: bool,
+    ):
+        """The worker plane's lifecycle spans: one ``task.exec`` umbrella
+        (the id nested submits parent under) with deserialize/store-returns
+        children. Parent = whichever plane dispatched us (the head's sched
+        span or the agent's lease span, via ``spec.sched_span_id``; direct
+        worker-to-worker calls chain straight to the caller's span)."""
+        t = _trace_mod()
+        tid_hex = spec.task_id.hex()
+        trace_id = getattr(spec, "trace_id", None)
+        parent = getattr(spec, "sched_span_id", None) or getattr(
+            spec, "parent_span_id", None
+        )
+        eid = f"{tid_hex}:exec"
+        t.record_span(
+            "task.exec", t0, t_end, trace_id=trace_id, span_id=eid,
+            parent_id=parent, plane="worker", task_id=tid_hex,
+            task=spec.name, failed=failed,
+        )
+        if t_deser is not None:
+            t.record_span(
+                "task.deserialize", t0, t_deser, trace_id=trace_id,
+                span_id=f"{tid_hex}:deser", parent_id=eid, plane="worker",
+                task_id=tid_hex,
+            )
+        if t_ret is not None and t_end >= t_ret:
+            t.record_span(
+                "task.store_returns", t_ret, t_end, trace_id=trace_id,
+                span_id=f"{tid_hex}:store", parent_id=eid, plane="worker",
+                task_id=tid_hex,
+            )
 
     def _execute_task(self, msg: P.ExecuteTask):
         spec = msg.spec
@@ -1399,6 +1555,9 @@ class WorkerRuntime:
         with self._pf_lock:
             self._pending_futures.pop(spec.task_id.binary(), None)
         start = time.monotonic()
+        traced = self._trace_gate(spec)
+        t_wall = time.time()
+        t_deser = t_ret = None
         # head-dispatched calls to a sync maxc=1 actor serialize against
         # inline direct calls (the inline path already holds the lock)
         lock = None
@@ -1411,15 +1570,23 @@ class WorkerRuntime:
         if lock is not None:
             lock.acquire()
         results = []
+        failed = False
         try:
             args, kwargs = self._deserialize_args(spec, msg.resolved_args)
+            t_deser = time.time()
             value = self._invoke(spec, args, kwargs)
+            t_ret = time.time()
             results = self._store_returns(spec, value, inline_only=direct is not None)
         except BaseException as e:  # noqa: BLE001 — task errors must not kill the worker
+            failed = True
             results = self._store_error(spec, e)
         finally:
             if lock is not None:
                 lock.release()
+        if traced:
+            self._record_exec_spans(
+                spec, t_wall, t_deser, t_ret, time.time(), failed
+            )
         exec_ms = (time.monotonic() - start) * 1e3
         if direct is not None:
             # result rides the caller's connection; the head sees nothing
@@ -1435,7 +1602,22 @@ class WorkerRuntime:
         spec = msg.spec
         direct = getattr(msg, "direct_reply", None)
         start = time.monotonic()
+        traced = self._trace_gate(spec)
+        t_wall = time.time()
+        t_deser = t_ret = None
+        failed = False
         loop = asyncio.get_running_loop()
+        # Trace context for the async plane: a ContextVar set inside THIS
+        # coroutine (each run_coroutine_threadsafe task copied its context
+        # at creation, so concurrent calls don't cross-wire parents). App
+        # spans opened in the method body — or inside the executor-run
+        # deserialize/store segments below, which run under a copy of this
+        # context — parent under the task's exec span.
+        t = _trace_mod()
+        trace_id = getattr(spec, "trace_id", None)
+        token = t.attach_context(
+            (trace_id, f"{spec.task_id.hex()}:exec") if trace_id else None
+        )
         try:
             key = spec.actor_id.binary()
             adm = self._async_admission.get(key)
@@ -1449,9 +1631,15 @@ class WorkerRuntime:
             # methods still run atomically in that order; only the await of
             # an async method body (below, outside the lock) overlaps.
             async with adm:
+                import contextvars as _cv
+
+                _ctx = _cv.copy_context()
                 args, kwargs = await loop.run_in_executor(
-                    None, self._deserialize_args, spec, msg.resolved_args
+                    None,
+                    _ctx.run,
+                    self._deserialize_args, spec, msg.resolved_args,
                 )
+                t_deser = time.time()
                 instance = self.actors[key]
                 if spec.method_name == "__rtpu_call__":
                     value = args[0](instance, *args[1:], **kwargs)
@@ -1460,19 +1648,31 @@ class WorkerRuntime:
                     value = method(*args, **kwargs)
             if asyncio.iscoroutine(value):
                 value = await value
+            t_ret = time.time()
             if spec.num_returns == "streaming" and hasattr(value, "__anext__"):
                 results = await self._stream_returns_async(spec, value)
             else:
                 # same store-contention retry shape as the args pull above
+                import contextvars as _cv
+
+                _ctx = _cv.copy_context()
                 results = await loop.run_in_executor(
                     None,
+                    _ctx.run,
                     functools.partial(
                         self._store_returns, spec, value,
                         inline_only=direct is not None,
                     ),
                 )
         except BaseException as e:  # noqa: BLE001
+            failed = True
             results = self._store_error(spec, e)
+        finally:
+            t.detach_context(token)
+        if traced:
+            self._record_exec_spans(
+                spec, t_wall, t_deser, t_ret, time.time(), failed
+            )
         exec_ms = (time.monotonic() - start) * 1e3
         if direct is not None:
             try:
@@ -1487,6 +1687,13 @@ class WorkerRuntime:
         # nested submits from this task inherit its tenant + priority
         _exec_ctx.tenant = getattr(spec, "tenant", None)
         _exec_ctx.priority = getattr(spec, "priority", None)
+        # ... and its trace context: children parent under THIS task's exec
+        # span (deterministic id — every plane derives the same one)
+        _trace_mod()  # registers the context provider on first execution
+        _exec_ctx.trace_id = getattr(spec, "trace_id", None)
+        _exec_ctx.span_id = (
+            f"{spec.task_id.hex()}:exec" if _exec_ctx.trace_id else None
+        )
         _exec_ctx.actor_id = (
             spec.actor_id.binary()
             if spec.task_type != TaskType.NORMAL_TASK and spec.actor_id

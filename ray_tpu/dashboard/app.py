@@ -18,9 +18,13 @@ Endpoints:
   /api/tasks            recent task events + state summary
   /api/objects          object-store stats
   /api/stacks[?worker=] on-demand worker stack dump (py-spy analog)
-  /api/timeline         chrome://tracing JSON of task events
+  /api/timeline         chrome://tracing JSON: task events + the merged
+                        distributed trace (head/agent/worker spans, stitched
+                        by trace_id)
   /api/logs[?worker=]   captured worker stdout/stderr (dead workers too)
-  /metrics              Prometheus exposition (same registry as util.metrics)
+  /metrics              Prometheus exposition: ONE cluster scrape — the head
+                        registry merged with every node's shipped
+                        util.metrics snapshots, node-labeled
 """
 
 from __future__ import annotations
@@ -183,9 +187,26 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._json(st.list_logs())
             elif path == "/metrics":
+                # ONE cluster scrape: the head's registry merged with every
+                # node's shipped snapshots, node-labeled (workers/agents
+                # report on the observability tick). Falls back to the
+                # process-local registry when no controller is reachable.
+                from ray_tpu._private.worker import global_worker
                 from ray_tpu.util.metrics import export_prometheus
 
-                body = export_prometheus().encode()
+                controller = getattr(global_worker(), "controller", None)
+                if controller is not None:
+                    body = controller.metrics_text().encode()
+                else:
+                    # attached (client) dashboard: pull the merged view
+                    # over the wire — the local registry is near-empty
+                    try:
+                        from ray_tpu.util.metrics import render_prometheus
+                        from ray_tpu.util.state.api import cluster_metrics
+
+                        body = render_prometheus(cluster_metrics()).encode()
+                    except Exception:  # noqa: BLE001 — no cluster reachable
+                        body = export_prometheus().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))

@@ -183,6 +183,8 @@ def cmd_microbenchmark(args):
 
 
 def cmd_timeline(args):
+    # merged cluster export: task events + every shipped lifecycle span
+    # (head.sched / agent.lease / task.exec ...), stitched by trace_id
     from ray_tpu.util.state.api import timeline
 
     _ensure_init(args)
@@ -455,8 +457,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--tail", type=int, default=65536, help="tail bytes")
     s.set_defaults(fn=cmd_logs)
 
-    s = sub.add_parser("timeline", help="export chrome trace of task events")
-    s.add_argument("--output", "-o", default="timeline.json")
+    s = sub.add_parser(
+        "timeline",
+        help="export the merged cluster chrome trace (task events + "
+        "head/agent/worker spans stitched by trace_id)",
+    )
+    s.add_argument("--output", "--out", "-o", default="timeline.json")
     s.set_defaults(fn=cmd_timeline)
 
     s = sub.add_parser("serve", help="declarative serve deploy/status")

@@ -1,0 +1,148 @@
+"""Observability overhead benchmark: the cost of always-on tracing.
+
+The cluster observability plane (PR 14) keeps tracing ON by default —
+every submission stamps trace context, every task's head events stay
+trace-joinable, and 1-in-``trace_sample_n`` tasks record their full
+head/agent/worker span chain. That only ships if its cost is measured, so
+this bench re-runs the envelope's queued-submit row three ways:
+
+- ``traced_off``   — ``trace_sample_n=0`` (tracing fully off);
+- ``traced_default`` — the shipping default (events always joinable,
+  1-in-N span chains);
+- ``traced_full``  — ``trace_sample_n=1`` (every span of every task).
+
+and records submit throughput + end-to-end drain throughput for each, the
+overhead fraction of the default and full settings vs off, and the span
+payload rate (pickled bytes of the spans produced per wall second — what
+the report tick would ship). ``bench.py --check-floor`` gates the default
+setting's overhead so a future PR can't silently make always-on tracing
+expensive.
+
+Run via ``python bench.py --observability`` — records
+``MICROBENCH.json["observability"]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+
+DEPTH = 5_000
+BEST_OF = 5
+
+
+def _one_run(sample_n: int) -> dict:
+    """One envelope queued-submit run at the given sampling setting:
+    submit DEPTH zero-cpu no-op tasks, measure raw submit rate, then drain
+    and measure end-to-end rate."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    ray_tpu.init(
+        num_cpus=8, mode="thread", config={"trace_sample_n": sample_n}
+    )
+    try:
+        tracing._reset_sampling()
+        tracing.clear()
+
+        @ray_tpu.remote(num_cpus=0)
+        def _tick(i):
+            return i
+
+        ray_tpu.get([_tick.remote(i) for i in range(200)], timeout=120)
+        t0 = time.perf_counter()
+        refs = [_tick.remote(i) for i in range(DEPTH)]
+        submit_dt = time.perf_counter() - t0
+        ray_tpu.get(refs, timeout=600)
+        total_dt = time.perf_counter() - t0
+        spans = tracing.drain_spans()
+        span_bytes = len(pickle.dumps(spans)) if spans else 0
+        dropped = tracing.dropped_spans()
+        return {
+            "submit_per_s": round(DEPTH / submit_dt, 1),
+            "end_to_end_per_s": round(DEPTH / total_dt, 1),
+            "spans_buffered": len(spans),
+            "spans_dropped": dropped,
+            # spans are ring-bounded: account the DROPPED ones at the
+            # mean recorded span size so the ship-rate is honest
+            "span_bytes_per_s": round(
+                span_bytes * (1 + dropped / max(len(spans), 1)) / total_dt
+            ),
+        }
+    finally:
+        ray_tpu.shutdown()
+        tracing._reset_sampling()
+
+
+def observability_bench() -> dict:
+    from ray_tpu._private.config import Config
+
+    default_n = Config().trace_sample_n
+    # INTERLEAVED rounds, best-of per setting: consecutive same-setting
+    # runs absorb the shared CI host's ambient-load swings unevenly and
+    # fabricate overhead (or hide it); round-robin spreads the noise
+    # across all three settings so the off/default delta is the feature's
+    # cost, not the host's mood
+    best: dict[int, dict] = {}
+    for _ in range(BEST_OF):
+        for n in (0, default_n, 1):
+            row = _one_run(n)
+            if (
+                n not in best
+                or row["submit_per_s"] > best[n]["submit_per_s"]
+            ):
+                best[n] = row
+    off, default, full = best[0], best[default_n], best[1]
+
+    def overhead(row: dict) -> float:
+        return round(
+            max(1.0 - row["submit_per_s"] / max(off["submit_per_s"], 1e-9), 0.0),
+            4,
+        )
+
+    return {
+        "note": (
+            f"envelope queued-submit row (depth {DEPTH}, thread mode, "
+            f"best-of-{BEST_OF}) with tracing off / default "
+            f"(trace_sample_n={default_n}: head events always joinable, "
+            "1-in-N span chains) / full (N=1). overhead_frac_* compare "
+            "submit rates vs off; span_bytes_per_s is the pickled span "
+            "payload produced per wall second (what the report tick "
+            "ships), with ring-dropped spans accounted at the mean size. "
+            "--check-floor gates overhead_frac_default <= 0.10 recorded "
+            "and re-probes live with a noise ceiling."
+        ),
+        "sample_n_default": default_n,
+        "traced_off": off,
+        "traced_default": default,
+        "traced_full": full,
+        "overhead_frac_default": overhead(default),
+        "overhead_frac_full": overhead(full),
+        "span_bytes_per_s_full": full["span_bytes_per_s"],
+    }
+
+
+def record(path: str) -> dict:
+    section = observability_bench()
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {}
+    data["observability"] = section
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"observability": section}, indent=1))
+    return section
+
+
+if __name__ == "__main__":
+    record(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            "MICROBENCH.json",
+        )
+    )

@@ -146,16 +146,42 @@ def get_worker_stacks(worker_id: Optional[str] = None) -> dict:
     return _call("worker_stacks", worker_id)
 
 
+def cluster_metrics() -> list[dict]:
+    """The head's merged cluster metrics model: every node's shipped
+    ``util.metrics`` snapshots (workers and agents report on their
+    observability tick) plus the head's live registry, with a ``node``
+    label on every sample — the structured form of the one-scrape
+    ``/metrics`` endpoint (reference: the dashboard agents exporting
+    per-node OpenCensus metrics that one Prometheus job scrapes)."""
+    data = _call("cluster_metrics", {"include": ["metrics"]}) or {}
+    return data.get("metrics") or []
+
+
+def cluster_spans() -> dict:
+    """Raw merged span records (shipped worker/agent rings + the head's
+    own ring): ``{"spans": [...], "dropped_spans": n}``."""
+    data = _call("cluster_metrics", {"include": ["spans"]}) or {}
+    return {
+        "spans": data.get("spans") or [],
+        "dropped_spans": data.get("dropped_spans", 0),
+    }
+
+
 def timeline(path: Optional[str] = None) -> list[dict]:
-    """Chrome-trace export of task events (``ray timeline`` analog;
-    reference: task events buffered per worker → GcsTaskManager)."""
+    """Chrome-trace export of the MERGED cluster timeline (``ray
+    timeline`` analog): the head's task events plus every shipped
+    lifecycle/app span — head ``head.sched``, agent ``agent.lease``/
+    ``agent.dispatch``/``agent.actor_create``, worker ``task.exec`` with
+    deserialize/store children — joined by ``trace_id`` with parent edges
+    in ``args`` and pid/tid mapped to node/process, so one chrome trace
+    shows a driver call crossing head → agent → worker and back."""
     events = _call("task_events")
     # pair DISPATCHED/FINISHED per task id into complete events
     starts: dict[str, dict] = {}
     trace: list[dict] = []
     for e in events:
-        if e["event"] == "DISPATCHED":
-            starts[e["task_id"]] = e
+        if e["event"] in ("DISPATCHED", "LEASED", "ACTOR_LEASED"):
+            starts.setdefault(e["task_id"], e)
         elif e["event"] in ("FINISHED", "FAILED"):
             s = starts.pop(e["task_id"], None)
             begin = s["t"] if s else e["t"] - e.get("exec_ms", 0) / 1e3
@@ -168,9 +194,33 @@ def timeline(path: Optional[str] = None) -> list[dict]:
                     "dur": max((e["t"] - begin) * 1e6, 1),
                     "pid": 1,
                     "tid": hash(e["task_id"]) % 64,
-                    "args": {"task_id": e["task_id"], "status": e["event"]},
+                    "args": {
+                        "task_id": e["task_id"],
+                        "status": e["event"],
+                        # head events carry the trace id even for tasks the
+                        # span sampler skipped — every task's head history
+                        # stays joinable to its trace
+                        "trace_id": (s or {}).get("trace_id"),
+                        "parent_span_id": (s or {}).get("parent_span_id"),
+                    },
                 }
             )
+    # merged distributed spans: chrome pid = node, tid = recording process
+    try:
+        shipped = cluster_spans()["spans"]
+    except Exception:  # noqa: BLE001 — pre-observability head
+        shipped = []
+    from ray_tpu.util.tracing import spans_to_chrome
+
+    node_pids: dict = {"head": 1}
+    trace.extend(
+        spans_to_chrome(
+            shipped,
+            pid_of=lambda s: node_pids.setdefault(
+                s.get("node") or "head", len(node_pids) + 1
+            ),
+        )
+    )
     if path:
         import json
 

@@ -1,0 +1,103 @@
+"""Clean fixture: the observability-plane ops done right.
+
+Correct op names, a ``report_observability`` payload matching the
+handler's 2-field unpack (the dropped-span count rides inside each
+reporter entry), a guarded use of the maybe-empty ``cluster_metrics``
+reply (never an unguarded subscript), a bounded reply wait,
+raise→error-reply conversion at the dispatch site, a declared op catalog
+matching the ladder, and the span spool credited through try/finally —
+zero findings across every family.
+"""
+
+import threading
+
+# mirrors the dispatch ladder below; wire-conformance cross-checks it
+CONTROLLER_OPS = frozenset({"cluster_metrics", "report_observability"})
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    def __init__(self):
+        self._snapshots = {}
+        self._spans = []
+
+    def _dispatch_request(self, op, payload):
+        if op == "report_observability":
+            node_hint, entries = payload
+            for entry in entries or []:
+                self._snapshots[entry["reporter"]] = entry.get("metrics")
+                self._spans.extend(entry.get("spans") or [])
+            return None
+        if op == "cluster_metrics":
+            return {
+                "metrics": list(self._snapshots.values()),
+                "spans": list(self._spans),
+            }
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class ObservabilityShipper:
+    def __init__(self, conn, reporter_id):
+        self._conn = conn
+        self._reporter_id = reporter_id
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+        self._dropped = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def ship(self, spans, metrics):
+        return self.call_controller(
+            "report_observability",
+            (
+                None,
+                [
+                    {
+                        "reporter": self._reporter_id,
+                        "spans": spans,
+                        "dropped_spans": self._dropped,
+                        "metrics": metrics,
+                    }
+                ],
+            ),
+        )
+
+    def cluster_view(self):
+        data = self.call_controller("cluster_metrics", {"include": ["metrics"]})
+        # guarded consumption: the reply may be empty (pre-report head)
+        if not data:
+            return []
+        return data.get("metrics") or []
+
+    def ship_spooled(self, drain):
+        """The per-drain span spool is released on EVERY path — a raising
+        delivery unwinds through the finally."""
+        spool = open(drain.spool_path, "ab")  # noqa: SIM115 — fixture shape
+        try:
+            spool.write(b"span drain\n")
+            deliver_drain(drain)
+        finally:
+            spool.close()
+
+
+def deliver_drain(drain) -> None:
+    if not drain.spans:
+        raise ValueError("empty span drain")

@@ -1,0 +1,105 @@
+"""Self-detection fixture: the observability-plane ops done WRONG.
+
+The PR 14 growth shape — worker/agent processes push their span-ring
+drains and metrics snapshots (``report_observability``) and the state
+API pulls the merged cluster view (``cluster_metrics``) from modules far
+from the controller's dispatch ladder, so a typo'd report push or a
+payload-arity drift ships clean and the cluster timeline silently goes
+dark (every scrape reads an empty aggregate while workers keep
+recording); and the ship path stages a per-drain span spool that a
+delivery raise strands. tpulint must flag:
+
+- wire-conformance: the misspelled ``report_observabilty`` push
+  (did-you-mean) and the 3-tuple ``report_observability`` payload
+  against the handler's 2-field unpack (the dropped-span count rides
+  inside each reporter entry, not the payload);
+- ref-lifecycle: the span spool leaked when shipping raises
+  (leak-on-raise in the drain-and-ship path).
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import threading
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    """Dispatch surface for the observability-plane ops."""
+
+    def __init__(self):
+        self._snapshots = {}
+        self._spans = []
+
+    def _dispatch_request(self, op, payload):
+        if op == "report_observability":
+            node_hint, entries = payload
+            for entry in entries or []:
+                self._snapshots[entry["reporter"]] = entry.get("metrics")
+                self._spans.extend(entry.get("spans") or [])
+            return None
+        if op == "cluster_metrics":
+            return {
+                "metrics": list(self._snapshots.values()),
+                "spans": list(self._spans),
+            }
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class ObservabilityShipper:
+    """Worker-side span/metric reporter with the protocol bugs under test."""
+
+    def __init__(self, conn, reporter_id):
+        self._conn = conn
+        self._reporter_id = reporter_id
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+        self._dropped = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def ship(self, entries):
+        # BUG: "report_observabilty" — no handler branch matches; every
+        # span drain and metrics snapshot dies as one unknown-op error
+        # reply and the cluster timeline silently goes dark
+        return self.call_controller("report_observabilty", (None, entries))
+
+    def ship_with_dropped(self, entries):
+        # BUG: 3-tuple payload vs the handler's 2-field unpack (the
+        # dropped-span count rides inside each reporter entry, not the
+        # payload) — ValueError at dispatch, the report never lands
+        return self.call_controller(
+            "report_observability", (None, entries, self._dropped)
+        )
+
+    def ship_spooled(self, drain):
+        """Leak-on-raise in the drain-and-ship path: the per-drain span
+        spool is open while deliver_drain() can raise — no handler, no
+        finally, the handle (and its fd) strands with the failed drain."""
+        spool = open(drain.spool_path, "ab")  # noqa: SIM115 — fixture shape
+        spool.write(b"span drain\n")
+        deliver_drain(drain)
+        spool.close()
+
+
+def deliver_drain(drain) -> None:
+    if not drain.spans:
+        raise ValueError("empty span drain")

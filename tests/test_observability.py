@@ -1,0 +1,666 @@
+"""Cluster-wide observability plane: distributed tracing + one-scrape metrics.
+
+PR 14 pins (reference: ``python/ray/util/tracing/tracing_helper.py`` — OTel
+spans with W3C context propagated through the TaskSpec — and the dashboard
+agent exporting per-node metrics into one Prometheus scrape):
+
+- trace context stamped at submit rides the TaskSpec across processes, so a
+  driver → nested-task → actor-call chain stitches into ONE trace with
+  lifecycle spans from the head, agent, and worker planes and correct
+  parent edges (fake agent speaking the real wire protocol for the agent
+  plane; real process workers for the worker plane);
+- worker/agent ``util.metrics`` snapshots merge into the head's one-scrape
+  ``/metrics`` with a ``node`` label, counters as replay-idempotent deltas
+  (chaos on ``report_observability`` must not lose or double-count);
+- histogram bucket merges, the bounded span ring + ``dropped_spans``, span-id
+  uniqueness across threads, deterministic ``trace_sample_n`` sampling, and
+  app-span parenting across the async-actor executor hand-off.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import MetricsAggregator, render_prometheus
+
+from tests.test_actor_lease import FakeAgent, _controller, _wait
+
+
+# ------------------------------------------------------------- tracing units
+
+
+def test_span_ids_unique_across_threads():
+    """``time_ns`` alone collides for spans started in the same ns across
+    threads; the per-process counter makes ids collision-free."""
+    ids: list = []
+    lock = threading.Lock()
+
+    def mint(n):
+        local = [tracing.new_span_id() for _ in range(n)]
+        with lock:
+            ids.extend(local)
+
+    threads = [threading.Thread(target=mint, args=(500,)) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(ids) == len(set(ids)) == 4000
+
+
+def test_ring_buffer_bound_and_dropped_counter(monkeypatch):
+    """The span ring is bounded (no leak in long-lived workers); overflow
+    increments ``dropped_spans`` instead of growing the buffer."""
+    monkeypatch.setenv("RAY_TPU_TRACE_BUFFER_SIZE", "32")
+    tracing._reset_sampling()
+    tracing.clear()
+    try:
+        for i in range(50):
+            tracing.record_span(f"s{i}", 0.0, 1.0, trace_id="t")
+        assert len(tracing.get_spans()) == 32
+        assert tracing.dropped_spans() == 18
+        # requeue after a failed ship is bounded by the same cap
+        drained = tracing.drain_spans()
+        assert drained and not tracing.get_spans()
+        tracing.requeue_spans(drained)
+        tracing.requeue_spans(drained)  # second restore overflows
+        assert len(tracing.get_spans()) == 32
+        assert tracing.dropped_spans() > 18
+    finally:
+        tracing.clear()
+        tracing._reset_sampling()
+
+
+def test_sampling_is_deterministic_by_task_id():
+    """Every plane computes the same verdict from the task id bytes, so a
+    sampled task's chain is complete instead of randomly holey."""
+    tid = b"\x08" + b"\x00" * 23
+    assert tracing.sampled(tid, 1)
+    assert tracing.sampled(tid, 4)
+    assert not tracing.sampled(tid, 16)
+    assert not tracing.sampled(tid, 0)  # 0 disables tracing
+    # stable across calls (no per-process hash salt)
+    assert [tracing.sampled(tid, 4) for _ in range(3)] == [True] * 3
+
+
+# -------------------------------------------------------- aggregator units
+
+
+def _counter_rec(name, values):
+    return {
+        "name": name,
+        "kind": "counter",
+        "description": "",
+        "tag_keys": ("k",),
+        "values": values,
+    }
+
+
+def test_counter_delta_merge_is_replay_idempotent():
+    """Reporters ship CUMULATIVE values; the head folds deltas — a replayed
+    snapshot (retry after a lost reply) adds zero, a dropped report's
+    counts ride the next snapshot, a fresh reporter id adds cleanly."""
+    agg = MetricsAggregator()
+    agg.apply("n1", "r1", [_counter_rec("c_total", {("a",): 5.0})])
+    agg.apply("n1", "r1", [_counter_rec("c_total", {("a",): 5.0})])  # replay
+    (rec,) = agg.model()
+    assert rec["tag_keys"] == ("k", "node")
+    assert rec["values"] == {("a", "n1"): 5.0}
+    # dropped intermediate report: 5 -> (lost 8) -> 12 still lands at 12
+    agg.apply("n1", "r1", [_counter_rec("c_total", {("a",): 12.0})])
+    assert agg.model()[0]["values"] == {("a", "n1"): 12.0}
+    # restarted reporter (new pid-salted id) adds its fresh counts
+    agg.apply("n1", "r1-new", [_counter_rec("c_total", {("a",): 3.0})])
+    assert agg.model()[0]["values"] == {("a", "n1"): 15.0}
+    # another node keeps its own labeled sample
+    agg.apply("n2", "r2", [_counter_rec("c_total", {("a",): 2.0})])
+    assert agg.model()[0]["values"][("a", "n2")] == 2.0
+
+
+def test_histogram_bucket_merge_correctness():
+    """Histograms delta-merge PER BUCKET against the reporter's previous
+    snapshot; replay adds zero; the rendered scrape has cumulative ``le``
+    buckets and a node label."""
+
+    def rec(counts, sums):
+        return {
+            "name": "h_ms",
+            "kind": "histogram",
+            "description": "",
+            "tag_keys": (),
+            "boundaries": [1.0, 10.0],
+            "counts": {(): counts},
+            "sums": {(): sums},
+        }
+
+    agg = MetricsAggregator()
+    agg.apply("n1", "r1", [rec([1, 0, 2], 30.0)])
+    agg.apply("n1", "r1", [rec([2, 1, 2], 37.5)])  # cumulative growth
+    agg.apply("n1", "r1", [rec([2, 1, 2], 37.5)])  # replay: no change
+    (m,) = agg.model()
+    assert m["counts"] == {("n1",): [2, 1, 2]}
+    assert m["sums"] == {("n1",): pytest.approx(37.5)}
+    # a second node's buckets merge under its own label
+    agg.apply("n2", "r2", [rec([0, 4, 0], 8.0)])
+    m = agg.model()[0]
+    assert m["counts"][("n2",)] == [0, 4, 0]
+    text = render_prometheus(agg.model())
+    assert 'h_ms_bucket{node="n1",le="1.0"} 2' in text
+    assert 'h_ms_bucket{node="n1",le="+Inf"} 5' in text
+    assert 'h_ms_count{node="n1"} 5' in text
+
+
+def test_gauge_merge_is_last_write():
+    agg = MetricsAggregator()
+    g = {
+        "name": "g",
+        "kind": "gauge",
+        "description": "",
+        "tag_keys": (),
+        "values": {(): 7.0},
+    }
+    agg.apply("n1", "r1", [g])
+    agg.apply("n1", "r1", [{**g, "values": {(): 3.0}}])
+    assert agg.model()[0]["values"] == {("n1",): 3.0}
+
+
+# --------------------------------------------------- thread-mode integration
+
+
+@pytest.fixture
+def thread_cluster():
+    def start(**config):
+        ray_tpu.init(num_cpus=2, mode="thread", config=config or None)
+
+    yield start
+    ray_tpu.shutdown()
+    tracing.clear()
+    tracing._reset_sampling()
+
+
+def test_sampling_honors_trace_sample_n(thread_cluster):
+    """``trace_sample_n=N`` records worker exec spans for exactly the
+    deterministically-sampled 1-in-N task ids, while EVERY task's head
+    events stay trace-joinable (trace_id on the dispatch event)."""
+    thread_cluster(trace_sample_n=4)
+
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    assert ray_tpu.get([f.remote(i) for i in range(40)], timeout=60) == list(
+        range(40)
+    )
+    exec_tids = {
+        s["task_id"]
+        for s in tracing.get_spans()
+        if s["name"] == "task.exec"
+    }
+    events = [
+        e
+        for e in _controller().task_events
+        if e["event"] == "DISPATCHED"
+    ]
+    all_tids = {e["task_id"] for e in events}
+    sampled = {
+        t for t in all_tids if tracing.sampled(bytes.fromhex(t), 4)
+    }
+    assert len(all_tids) == 40
+    assert exec_tids == sampled  # the sampler's exact subset, no more
+    assert 0 < len(sampled) < 40
+    # unsampled tasks still joinable: every head event carries the trace id
+    assert all(e.get("trace_id") for e in events)
+
+
+def test_trace_sample_n_zero_disables_tracing(thread_cluster):
+    thread_cluster(trace_sample_n=0)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get([f.remote() for _ in range(5)], timeout=60) == [1] * 5
+    assert not tracing.enabled()
+    assert [s for s in tracing.get_spans() if s.get("plane") == "worker"] == []
+    # the off switch is total: app spans and raw record_span are no-ops
+    # too — no buffering, no shipping cost left behind
+    with tracing.span("app-noop"):
+        pass
+    assert tracing.record_span("raw-noop", 0.0, 1.0) is None
+    assert tracing.get_spans() == []
+
+
+def test_app_span_parents_under_async_actor_exec(thread_cluster):
+    """Parent tracking survives the ``run_in_executor`` hand-off the async
+    actor path uses: an app span opened in an async method body parents
+    under THAT call's exec span, in the same trace."""
+    thread_cluster(trace_sample_n=1)
+
+    @ray_tpu.remote
+    class A:
+        async def go(self):
+            with tracing.span("inner"):
+                return "ok"
+
+    a = A.remote()
+    assert ray_tpu.get(a.go.remote(), timeout=30) == "ok"
+    spans = tracing.get_spans()
+    inner = next(s for s in spans if s["name"] == "inner")
+    execs = {
+        s["span_id"]: s for s in spans if s["name"] == "task.exec"
+    }
+    assert inner["parent_id"] in execs
+    assert inner["trace_id"] == execs[inner["parent_id"]]["trace_id"]
+
+
+# -------------------------------------------------- process-mode integration
+
+
+@pytest.fixture
+def process_cluster(monkeypatch):
+    # env (not just head config): spawned worker processes resolve their
+    # sampling/report knobs from the environment they inherit
+    monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE_N", "1")
+    monkeypatch.setenv("RAY_TPU_METRICS_REPORT_INTERVAL_MS", "100")
+    ray_tpu.init(num_cpus=2, mode="process", config={"tcp_port": 0})
+    yield
+    ray_tpu.shutdown()
+    tracing.clear()
+    tracing._reset_sampling()
+
+
+def _span_index():
+    from ray_tpu.util.state.api import cluster_spans
+
+    spans = cluster_spans()["spans"]
+    return {s["span_id"]: s for s in spans if s.get("span_id")}
+
+
+def test_nested_trace_stitches_head_and_worker_planes(process_cluster):
+    """A driver call crossing head → worker with a nested submit and an
+    actor call stitches into ONE trace: head ``head.sched`` spans and
+    worker ``task.exec`` (+ deserialize/store children) joined by trace_id
+    with correct parent edges across process boundaries."""
+
+    @ray_tpu.remote(num_cpus=0)
+    def child(i):
+        return i * 2
+
+    @ray_tpu.remote(num_cpus=0)
+    class Act:
+        def ping(self):
+            return "pong"
+
+    act = Act.remote()
+    assert ray_tpu.get(act.ping.remote(), timeout=60) == "pong"
+
+    @ray_tpu.remote
+    def parent(n, a):
+        import ray_tpu as rt
+
+        total = sum(rt.get([child.remote(i) for i in range(n)]))
+        return total, rt.get(a.ping.remote())
+
+    assert ray_tpu.get(parent.remote(3, act), timeout=120) == (6, "pong")
+
+    def chain():
+        by_id = _span_index()
+        execs = [
+            s
+            for s in by_id.values()
+            if s["name"] == "task.exec" and s.get("attributes", {}).get("task") == "parent"
+        ]
+        if not execs:
+            return None
+        p_exec = execs[0]
+        trace = [
+            s for s in by_id.values() if s.get("trace_id") == p_exec["trace_id"]
+        ]
+        # parent exec + 3 child execs + the actor call from inside parent
+        if sum(1 for s in trace if s["name"] == "task.exec") < 4:
+            return None
+        if not any(
+            s["name"] == "task.exec"
+            and s.get("attributes", {}).get("task", "").endswith("ping")
+            for s in trace
+        ):
+            return None
+        return by_id, p_exec, trace
+
+    _wait(lambda: chain() is not None, timeout=30, msg="shipped spans")
+    by_id, p_exec, trace = chain()
+
+    planes = {s.get("plane") for s in trace}
+    assert {"head", "worker"} <= planes
+    # correct parent edges: parent.exec -> parent:sched (root, from the
+    # driver); child.exec -> child:sched -> parent:exec
+    p_sched = by_id[p_exec["parent_id"]]
+    assert p_sched["name"] == "head.sched" and p_sched["plane"] == "head"
+    assert p_sched["parent_id"] is None  # driver-rooted
+    child_execs = [
+        s
+        for s in trace
+        if s["name"] == "task.exec"
+        and s.get("attributes", {}).get("task") == "child"
+    ]
+    assert len(child_execs) == 3
+    for ce in child_execs:
+        sched = by_id[ce["parent_id"]]
+        assert sched["name"] == "head.sched"
+        assert sched["parent_id"] == p_exec["span_id"]
+    # the actor call from inside `parent` rides the same trace, chained
+    # under the parent task (via its own sched span or a direct call edge)
+    ping_execs = [
+        s
+        for s in trace
+        if s["name"] == "task.exec"
+        and s.get("attributes", {}).get("task", "").endswith("ping")
+    ]
+    assert ping_execs, [s["name"] for s in trace]
+    anc = ping_execs[0]
+    seen = set()
+    while anc.get("parent_id") and anc["parent_id"] not in seen:
+        seen.add(anc["parent_id"])
+        nxt = by_id.get(anc["parent_id"])
+        if nxt is None:
+            break
+        anc = nxt
+    assert anc["span_id"] in (p_sched["span_id"], p_exec["span_id"])
+
+    # worker deserialize/store children parent under their exec span
+    deser = [s for s in trace if s["name"] == "task.deserialize"]
+    assert deser and all(by_id[d["parent_id"]]["name"] == "task.exec" for d in deser)
+
+    # the merged chrome export renders the same chain (timeline() /
+    # /api/timeline / `ray-tpu timeline`)
+    from ray_tpu.util.state.api import timeline
+
+    tl = timeline()
+    tl_traces = {
+        e["args"].get("trace_id")
+        for e in tl
+        if e.get("args", {}).get("trace_id")
+    }
+    assert p_exec["trace_id"] in tl_traces
+    names = {e["name"] for e in tl}
+    assert {"head.sched", "task.exec"} <= names
+
+
+def test_timeline_export_writes_chrome_trace(process_cluster, tmp_path):
+    """`ray-tpu timeline --out` / ``timeline(path=...)`` writes a chrome
+    trace file of the merged view."""
+    import json
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    from ray_tpu.util.state.api import timeline
+
+    out = tmp_path / "trace.json"
+    events = timeline(path=str(out))
+    assert out.exists()
+    loaded = json.loads(out.read_text())
+    assert loaded and len(loaded) == len(events)
+    assert all("ts" in e and "ph" in e for e in loaded)
+
+
+def test_worker_metrics_reach_head_scrape_under_report_chaos(process_cluster):
+    """A worker-side Counter lands in the head's one-scrape ``/metrics``
+    with a ``node`` label, and survives dropped ``report_observability``
+    pushes with NO double count: snapshots are cumulative, the head merges
+    deltas, so retries/replays converge on the exact value."""
+    n = 30
+
+    @ray_tpu.remote(
+        runtime_env={
+            "env_vars": {
+                "RAY_TPU_WORKER_RPC_FAILURE": "report_observability=0.5",
+                "RAY_TPU_METRICS_REPORT_INTERVAL_MS": "50",
+            }
+        }
+    )
+    def bump():
+        from ray_tpu.util import metrics as M
+
+        c = M._registry.get("obs_chaos_total")
+        if c is None:
+            c = M.Counter("obs_chaos_total", "chaos test", tag_keys=())
+        c.inc(1)
+        return os.getpid()
+
+    pids = ray_tpu.get([bump.remote() for _ in range(n)], timeout=120)
+    assert len(pids) == n
+
+    def total():
+        from ray_tpu.util.state.api import cluster_metrics
+
+        for rec in cluster_metrics():
+            if rec["name"] == "obs_chaos_total":
+                return sum(rec["values"].values())
+        return 0.0
+
+    _wait(lambda: total() == n, timeout=30, msg="chaos-shipped counter")
+    # replays keep arriving on the report tick: the count must NOT inflate
+    time.sleep(0.5)
+    assert total() == n
+    # the rendered scrape carries the node label on the sample line
+    text = _controller().metrics_text()
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("obs_chaos_total{")
+    )
+    assert 'node="' in line
+    # core controller counters mirrored into the same scrape (satellite:
+    # the scattered stats dicts become real metrics)
+    assert "rtpu_lease_events_total" in text
+
+
+# -------------------------------------------- agent plane via the real wire
+
+
+class ObsFakeAgent(FakeAgent):
+    """Scripted node agent that answers a task lease the way a REAL agent's
+    observability plane does: agent.lease + task.exec spans with the
+    deterministic ids, shipped via the AgentReportBatch piggyback (zero
+    extra round trips), plus a cumulative metrics snapshot."""
+
+    def _on_lease(self, msg):
+        from ray_tpu._private import protocol as P
+
+        if not hasattr(msg, "spec") or msg.spec.actor_id is not None:
+            return super()._on_lease(msg)
+        self.task_leases.append(msg)
+        spec = msg.spec
+        tid = spec.task_id.hex()
+        now = time.time()
+        spans = [
+            {
+                "name": "agent.lease",
+                "span_id": f"{tid}:agent",
+                "parent_id": getattr(spec, "sched_span_id", None),
+                "trace_id": spec.trace_id,
+                "plane": "agent",
+                "task_id": tid,
+                "node": None,
+                "pid": os.getpid(),
+                "start": now - 0.002,
+                "end": now,
+                "attributes": {},
+            },
+            {
+                "name": "task.exec",
+                "span_id": f"{tid}:exec",
+                "parent_id": f"{tid}:agent",
+                "trace_id": spec.trace_id,
+                "plane": "worker",
+                "task_id": tid,
+                "node": None,
+                "pid": os.getpid() + 1,
+                "start": now - 0.001,
+                "end": now,
+                "attributes": {"task": spec.name},
+            },
+        ]
+        self.last_entry = {
+            "reporter": f"a-{self.node_id.hex()[:12]}-fake",
+            "pid": os.getpid(),
+            "spans": spans,
+            # a CUMULATIVE per-reporter figure, like a real ring reports
+            "dropped_spans": 5,
+            "metrics": [
+                {
+                    "name": "fake_agent_counter",
+                    "kind": "counter",
+                    "description": "",
+                    "tag_keys": (),
+                    "values": {(): 7.0},
+                }
+            ],
+        }
+        self._send(
+            P.AgentReportBatch(
+                [
+                    P.AgentTaskDone(
+                        spec.task_id, self._none_results(spec), exec_ms=0.1
+                    )
+                ],
+                observability=[self.last_entry],
+            )
+        )
+
+    def replay_report(self):
+        """Re-ship the exact same observability payload (a retry after a
+        lost reply): deltas must fold to zero at the head."""
+        from ray_tpu._private import protocol as P
+
+        self._send(P.AgentReportBatch([], observability=[self.last_entry]))
+
+
+@pytest.fixture
+def agent_plane_cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE_N", "1")
+    ray_tpu.init(num_cpus=1, mode="process", config={"tcp_port": 0})
+    agents = [
+        ObsFakeAgent(_controller(), {"CPU": 1, f"obs_slot_{i}": 1})
+        for i in range(2)
+    ]
+    for agent in agents:
+        _wait(
+            lambda a=agent: a.node_id in _controller().agents,
+            msg="fake agent registration",
+        )
+    yield agents
+    for agent in agents:
+        agent.close()
+    ray_tpu.shutdown()
+    tracing.clear()
+    tracing._reset_sampling()
+
+
+def test_agent_plane_spans_and_metrics_stitch_into_cluster_view(
+    agent_plane_cluster,
+):
+    """The full three-plane contract over the real wire: the head stamps
+    ``sched_span_id`` on the spec it leases out; the (fake) agent's
+    piggybacked report lands its spans under the reporting node's label,
+    parented to the head's sched span; its counter appears in the merged
+    scrape under the agent's node label; a replayed report batch does not
+    double-count."""
+    agent, agent2 = agent_plane_cluster
+
+    @ray_tpu.remote(resources={"obs_slot_0": 1})
+    def on_agent():
+        return "never runs for real"  # the scripted agent echoes None
+
+    @ray_tpu.remote(resources={"obs_slot_1": 1})
+    def on_agent2():
+        return "never runs for real"
+
+    refs = [on_agent.remote(), on_agent2.remote()]
+    _wait(lambda: agent.task_leases, msg="task leased to fake agent 0")
+    _wait(lambda: agent2.task_leases, msg="task leased to fake agent 1")
+    assert ray_tpu.get(refs, timeout=60) == [None, None]
+    lease = agent.task_leases[0]
+    tid = lease.spec.task_id.hex()
+    # the spec crossed the wire with the head's trace stamps on it
+    assert lease.spec.trace_id
+    assert lease.spec.sched_span_id == f"{tid}:sched"
+
+    node_label = agent.node_id.hex()[:12]
+
+    def stitched():
+        by_id = _span_index()
+        a = by_id.get(f"{tid}:agent")
+        w = by_id.get(f"{tid}:exec")
+        h = by_id.get(f"{tid}:sched")
+        return a and w and h and (by_id, a, w, h)
+
+    _wait(lambda: bool(stitched()), timeout=30, msg="three-plane stitch")
+    by_id, a_span, w_span, h_span = stitched()
+    # one trace, three planes, correct parent edges, node attribution
+    assert a_span["trace_id"] == w_span["trace_id"] == h_span["trace_id"]
+    assert (h_span["plane"], a_span["plane"], w_span["plane"]) == (
+        "head", "agent", "worker",
+    )
+    assert a_span["parent_id"] == h_span["span_id"]
+    assert w_span["parent_id"] == a_span["span_id"]
+    assert a_span["node"] == w_span["node"] == node_label
+    assert h_span["node"] == "head"
+
+    # the SECOND node's chain lands under its own label in the same store
+    node2 = agent2.node_id.hex()[:12]
+    tid2 = agent2.task_leases[0].spec.task_id.hex()
+    _wait(
+        lambda: _span_index().get(f"{tid2}:agent") is not None,
+        timeout=30, msg="second agent's spans shipped",
+    )
+    assert _span_index()[f"{tid2}:agent"]["node"] == node2
+
+    # each agent's counter is in the merged model under ITS node label
+    from ray_tpu.util.state.api import cluster_metrics
+
+    def agent_counter():
+        for rec in cluster_metrics():
+            if rec["name"] == "fake_agent_counter":
+                return rec["values"]
+        return {}
+
+    expected = {(node_label,): 7.0, (node2,): 7.0}
+    _wait(lambda: agent_counter() == expected, msg="both node counters")
+    # remote rings' losses surface in the cluster figure: each agent
+    # reported a cumulative dropped_spans of 5
+    from ray_tpu.util.state.api import cluster_spans
+
+    assert cluster_spans()["dropped_spans"] == 10
+    # chaos/retry shape: the same cumulative snapshot replayed through the
+    # batch piggyback folds to a zero delta — no double count (counters
+    # AND the per-reporter dropped_spans figure)
+    agent.replay_report()
+    time.sleep(0.3)
+    assert agent_counter() == expected
+    assert cluster_spans()["dropped_spans"] == 10
+    # ... and the replayed SPANS dedup too (same span_id + start): the
+    # store holds one agent.lease record for the task, not two
+    assert (
+        sum(
+            1
+            for s in cluster_spans()["spans"]
+            if s.get("span_id") == f"{tid}:agent"
+        )
+        == 1
+    )
+    # and the scrape carries one node-labeled sample line per agent
+    lines = [
+        ln
+        for ln in _controller().metrics_text().splitlines()
+        if ln.startswith("fake_agent_counter{")
+    ]
+    assert sorted(lines) == sorted(
+        f'fake_agent_counter{{node="{n}"}} 7.0' for n in (node_label, node2)
+    )

@@ -1250,6 +1250,41 @@ def test_fixture_proxy_ops_clean_has_zero_findings():
     assert findings == [], [f.render() for f in findings]
 
 
+def test_fixture_observe_ops_leak_flagged():
+    """The PR 14 observability shape done wrong: a typo'd
+    report_observabilty push (did-you-mean), a 3-tuple report payload
+    against the handler's 2-field unpack, and the drain-and-ship path
+    stranding the span spool when delivery raises."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_observe_ops_leak.py")]
+    )
+    wire = _by_check(findings).get("wire-conformance", [])
+    assert len(wire) == 2, [f.render() for f in findings]
+    typo = next(h for h in wire if "report_observabilty" in h.message)
+    assert 'did you mean "report_observability"' in typo.message
+    arity = next(
+        h for h in wire
+        if "report_observability" in h.message and "observabilty" not in h.message
+    )
+    assert "3-tuple" in arity.message and "2 fields" in arity.message
+    assert arity.qualname.endswith("ObservabilityShipper.ship_with_dropped")
+    life = _by_check(findings).get("ref-lifecycle", [])
+    assert len(life) == 1, [f.render() for f in findings]
+    assert life[0].qualname.endswith("ObservabilityShipper.ship_spooled")
+    assert "leaks when" in life[0].message
+
+
+def test_fixture_observe_ops_clean_has_zero_findings():
+    """Same observability-plane shapes done right (matching ops and
+    arities, guarded maybe-empty cluster_metrics reply, finally-credited
+    span spool, declared op set in sync): zero findings across every
+    family."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_observe_ops_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_protocol_doc_is_current_and_covers_controller_ops():
     """docs/PROTOCOL.md matches a fresh render of the extracted catalog and
     names every controller op + the agent data-plane surface."""
@@ -1416,6 +1451,7 @@ def test_cli_exits_nonzero_on_fixtures():
         "fixture_actor_lease_leak.py",
         "fixture_tenant_ops_leak.py",
         "fixture_proxy_ops_leak.py",
+        "fixture_observe_ops_leak.py",
     ):
         proc = subprocess.run(
             [
