@@ -899,6 +899,37 @@ def check_floor(max_regress: float = 0.25) -> int:
         if not out["tracing_overhead"]["ok"]:
             failures.append("tracing_overhead")
 
+    # --- recovery ceiling (ISSUE 15 satellite): head fault tolerance
+    # ships with its cost measured. Gates on the RECORDED artifact
+    # (bench.py --recovery re-records it whenever the plane changes): the
+    # SIGKILL->first-dispatch p50 must stay under its ceiling, and the
+    # WAL's submit-path overhead must stay inside the same envelope the
+    # PR 12 floors protect (a journal that taxes submits >20% would show
+    # up in the envelope floor anyway — this fails with a sharper name).
+    rec_recovery = recorded.get("recovery", {})
+    if rec_recovery:
+        ceilings = rec_recovery.get("ceilings", {})
+        ttfd_ceiling = ceilings.get("ttfd_p50_s", 10.0)
+        wal_ceiling = ceilings.get("wal_overhead_pct", 20.0)
+        ttfd_p50 = rec_recovery.get("ttfd", {}).get("ttfd_p50_s")
+        wal_pct = rec_recovery.get("wal_submit_overhead", {}).get(
+            "overhead_pct"
+        )
+        out["recovery"] = {
+            "recorded_ttfd_p50_s": ttfd_p50,
+            "ttfd_ceiling_s": ttfd_ceiling,
+            "recorded_wal_overhead_pct": wal_pct,
+            "wal_overhead_ceiling_pct": wal_ceiling,
+            "ok": (
+                ttfd_p50 is not None
+                and ttfd_p50 <= ttfd_ceiling
+                and wal_pct is not None
+                and wal_pct <= wal_ceiling
+            ),
+        }
+        if not out["recovery"]["ok"]:
+            failures.append("recovery")
+
     print(json.dumps({"check_floor": out, "failed": failures}))
     return 1 if failures else 0
 
@@ -961,6 +992,21 @@ if __name__ == "__main__":
         )
 
         observability_record(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "MICROBENCH.json"
+            )
+        )
+        sys.exit(0)
+    if "--recovery" in sys.argv:
+        # head fault tolerance: time-to-first-dispatch after a SIGKILL'd
+        # head restarts, WAL submit-path overhead (interleaved on/off),
+        # and journal replay rate, recorded into
+        # MICROBENCH.json["recovery"] (gated by --check-floor)
+        import os
+
+        from ray_tpu.scripts.recovery_bench import record as recovery_record
+
+        recovery_record(
             os.path.join(
                 os.path.dirname(os.path.abspath(__file__)), "MICROBENCH.json"
             )

@@ -157,6 +157,14 @@ class ActorSpawner:
             if verdict == "dead":
                 # killed/superseded while we were creating: reap the orphan
                 self._kill_worker(st.worker_id)
+            else:
+                # recovery registry: a restarted head rebuilds this binding
+                # from the agent's reconcile report (a None verdict — head
+                # unreachable — still registers: the actor IS alive here,
+                # and reconcile is exactly how the new head learns it)
+                self._agent.note_actor_placed(
+                    st.key, st.worker_id, st.direct_address
+                )
         if st.trace_span is not None:
             from ray_tpu.util import tracing
 
@@ -197,6 +205,34 @@ class ActorSpawner:
         """Creation leases not yet reported (drain-quiesce accounting)."""
         with self._lock:
             return sum(1 for st in self._leases.values() if not st.reported)
+
+    def held_creation_task_ids(self) -> list:
+        """Creation task ids still owned by this spawner (head-recovery
+        reconcile: the restarted head re-parks them under this node and
+        our in-flight report binds/fails them through the normal
+        idempotent path)."""
+        with self._lock:
+            return [
+                st.lease.spec.task_id.binary()
+                for st in self._leases.values()
+            ]
+
+    def drop_creation_leases(self, task_id_bins) -> None:
+        """Reconcile verdict: these creation leases were never journaled by
+        the restarted head (orphans) — kill their workers, report nothing."""
+        victims = []
+        with self._lock:
+            for tid in task_id_bins:
+                key = self._by_task.get(tid)
+                st = self._leases.get(key) if key is not None else None
+                if st is not None:
+                    victims.append(st)
+        for st in victims:
+            if self._claim(st):
+                st.abort.set()
+                st.ready.set()
+                self._kill_worker(st.worker_id)
+                self._forget(st)
 
     def reset(self):
         """Head reconnect / agent shutdown: the head-side lease state died
@@ -373,6 +409,11 @@ class ActorSpawner:
         for attempt in range(attempts):
             if self._agent.shutting_down:
                 return None
+            # resumed re-registration awaiting its reconcile verdict: hold
+            # the report until the gate opens or its bounded deadline lapses
+            # (escaping early would hit a still-RECOVERING head and get a
+            # spurious "dead" verdict for a healthy worker)
+            self._agent.wait_reports_open()
             try:
                 return self._agent.call_controller(op, payload, timeout=30.0)
             except Exception as e:  # noqa: BLE001 — retried, then reconciled
@@ -433,6 +474,9 @@ class ActorSpawner:
         for attempt in range(attempts):
             if self._agent.shutting_down:
                 break
+            # hold placements while a resume awaits its reconcile verdict
+            # (bounded by the agent's hold deadline, like _flush_reports)
+            self._agent.wait_reports_open()
             try:
                 verdicts = self._agent.call_controller(
                     "actor_placed_batch", payloads, timeout=30.0

@@ -116,6 +116,37 @@ class NodeAgent:
             int.from_bytes(self.node_id.binary()[:4], "little")
         )
 
+        # ---- head fault tolerance (PR 15) ----
+        # Placed actors living on this node: actor_id binary -> {worker_id,
+        # direct_address, pid}. This is the node's half of the head's actor
+        # directory — a RESTARTED head rebuilds bindings from it via the
+        # reconcile_report op (reference: raylet resubscribe after
+        # NotifyGCSRestart). Guarded by workers_lock (same lifecycle).
+        self._placed_actors: dict[bytes, dict] = {}
+        # Recently queued completion reports (bounded ring): the crashed
+        # head may have processed a report without journaling it — the
+        # reconcile report re-offers these and the head applies the ones it
+        # lost, closing the fsync window without double execution.
+        from collections import OrderedDict as _OD
+
+        self._done_ring: "_OD[bytes, Any]" = _OD()
+        self._done_ring_cap = 256
+        # Gate on outbound lease/placement reports while a resumed
+        # re-registration awaits its reconcile verdict: a report racing
+        # ahead of the reconcile would hit a head that has not rebuilt this
+        # node's lease table yet. The hold is DEADLINE-bounded
+        # (_reports_hold_deadline, set at resume): if the head's reconcile
+        # ask never arrives (both ask pushes lost), the gate reopens on its
+        # own — a permanently closed gate would silently stop every
+        # completion report this node ever sends.
+        self._reports_open = threading.Event()
+        self._reports_open.set()
+        self._reports_hold_deadline = 0.0
+        # bumped on every successful RESUME (head restart survived); local
+        # workers learn via P.HeadRestarted so their in-flight controller
+        # calls unblock and retry per idempotency class
+        self.head_epoch = 0
+
         # Batched completion reports (PR 12): AgentTaskDone frames queue
         # here and coalesce per flush tick into ONE AgentReportBatch — a
         # steady-state node completing hundreds of short leases per second
@@ -396,10 +427,15 @@ class NodeAgent:
         """Main loop: dispatch controller → agent traffic until shutdown.
 
         On head-connection loss the agent RECONNECTS (reference: raylet
-        ``NotifyGCSRestart`` reconnect, ``node_manager.cc:947``): local
-        workers are torn down (their control-plane state died with the old
-        head), the arena is recycled, and the agent re-registers as a fresh
-        node so the restored controller can re-place restartable actors."""
+        ``NotifyGCSRestart`` reconnect + resubscribe, ``node_manager.cc:947``).
+        It first tries to RESUME: workers, arena, and held leases are
+        preserved and re-offered to the head (``RegisterAgent(resume=True)``
+        → ``AgentReconcile`` ask → ``reconcile_report``), so a restarted
+        head rebuilds this node's truth and pre-crash work completes
+        exactly once. Only if the head refuses (it never died — its reader
+        EOF already re-placed everything — or the recovery window closed)
+        does the agent fall back to the old reset: tear down workers,
+        recycle the arena, and re-register as a fresh node."""
         while not self.shutting_down:
             try:
                 msg = self.conn.recv()
@@ -417,38 +453,273 @@ class NodeAgent:
                 logger.error("agent dispatch failed:\n%s", traceback.format_exc())
         self.shutdown()
 
+    def _register_msg(self, resume: bool) -> "P.RegisterAgent":
+        return P.RegisterAgent(
+            self.node_id,
+            self.resources,
+            self.labels,
+            self.arena_name,
+            self.data_address,
+            pid=os.getpid(),
+            hostname=socket.gethostname(),
+            resume=resume,
+        )
+
     def _reconnect(self, window_s: float) -> bool:
-        self._reset_local_state()
-        host, _, port = self.head_address.rpartition(":")
         deadline = time.monotonic() + window_s
+        # Phase 1 — RESUME: keep local state and offer it for reconcile.
+        # Reports are gated until the reconcile verdict lands (a placement
+        # report racing ahead would hit a head that has not rebuilt this
+        # node's lease table yet).
+        host, _, port = self.head_address.rpartition(":")
+        self._reports_open.clear()
+        # bounded hold mirroring the head's recovery window (+ its single
+        # re-ask allowance): past this, reports reopen even if no
+        # AgentReconcile ever arrived
+        from ray_tpu._private.config import get_config as _gc
+
+        try:
+            _cfg = _gc()
+            hold_s = _cfg.recovery_grace_s + _cfg.recovery_reconcile_resend_s + 5.0
+        except Exception:  # noqa: BLE001 — env-only processes
+            hold_s = 20.0
+        self._reports_hold_deadline = time.monotonic() + hold_s
         while time.monotonic() < deadline and not self.shutting_down:
             try:
                 conn = Client((host, int(port)), authkey=self.authkey)
-                # swap + register atomically: the heartbeat thread must not
-                # slip a Heartbeat in as the new connection's first message
-                # (the head closes conns whose first message isn't Register*)
+                # swap + register atomically: the heartbeat thread must
+                # not slip a Heartbeat in as the new connection's first
+                # message (the head closes conns whose first message
+                # isn't a Register*)
                 with self._send_lock:
                     self.conn = conn
-                    conn.send(
-                        P.RegisterAgent(
-                            self.node_id,
-                            self.resources,
-                            self.labels,
-                            self.arena_name,
-                            self.data_address,
-                            pid=os.getpid(),
-                            hostname=socket.gethostname(),
-                        )
+                    conn.send(self._register_msg(resume=True))
+                ack = conn.recv()
+                if (
+                    isinstance(ack, P.AgentAck)
+                    and getattr(ack, "resume_verdict", "fresh")
+                    == "reconcile"
+                ):
+                    self.head_epoch += 1
+                    # re-arm the hold from the ACK, not from disconnect
+                    # detection: a long head outage inside the reconnect
+                    # window would otherwise burn the whole hold budget
+                    # dialing, and reports would escape before the
+                    # reconcile report is applied
+                    self._reports_hold_deadline = time.monotonic() + hold_s
+                    logger.info(
+                        "resumed with restarted head (epoch %d): "
+                        "awaiting reconcile ask", self.head_epoch,
                     )
+                    return True
+                # verdict "reset" (or a pre-resume head): preserved
+                # state refused — fall through to the fresh path
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                break
+            except (OSError, EOFError, ConnectionError):
+                time.sleep(1.0)
+        # Phase 2 — RESET: the old incarnation's work was (or will be)
+        # re-placed by the head; executing any of it here would double it.
+        self._reset_local_state()
+        self._reports_open.set()
+        while time.monotonic() < deadline and not self.shutting_down:
+            try:
+                conn = Client((host, int(port)), authkey=self.authkey)
+                with self._send_lock:
+                    self.conn = conn
+                    conn.send(self._register_msg(resume=False))
                 ack = conn.recv()
                 if isinstance(ack, P.AgentAck):
-                    logger.info("re-registered with restarted head")
+                    logger.info("re-registered with restarted head (fresh)")
                     return True
                 conn.close()
             except (OSError, EOFError, ConnectionError):
                 pass
             time.sleep(1.0)
         return False
+
+    # ------------------------------------------- head-recovery reconcile
+
+    def wait_reports_open(self) -> None:
+        """Block an outbound lease/placement report while a resumed
+        re-registration awaits its reconcile verdict — until the gate opens
+        or the bounded hold deadline lapses (mirrors _flush_reports; a
+        report escaping EARLY would hit a still-RECOVERING head whose lease
+        table is parked, be answered 'dead', and kill a healthy worker)."""
+        while (
+            not self._reports_open.is_set()
+            and not self.shutting_down
+            and time.monotonic() < self._reports_hold_deadline
+        ):
+            self._reports_open.wait(timeout=0.2)
+
+    def note_actor_placed(self, aid_bin: bytes, worker_id, direct_address):
+        """The spawner finished a creation: remember the binding so a
+        restarted head can rebuild it from our reconcile report."""
+        with self.workers_lock:
+            w = self.workers.get(worker_id)
+            pid = getattr(w.get("proc"), "pid", 0) if w else 0
+            self._placed_actors[aid_bin] = {
+                "worker_id": worker_id,
+                "direct_address": direct_address,
+                "pid": pid or 0,
+            }
+
+    def _note_actor_gone(self, worker_id) -> None:
+        with self.workers_lock:
+            for aid, rec in list(self._placed_actors.items()):
+                if rec["worker_id"] == worker_id:
+                    del self._placed_actors[aid]
+
+    def _build_reconcile_report(self) -> dict:
+        """This node's truth for a recovering head: held task leases,
+        creation leases still in the spawner, placed actors (with pids as
+        incarnations), recently-queued completion reports, and the arena's
+        object inventory."""
+        with self._lease_lock:
+            task_leases = list(self._leased.keys())
+        with self.workers_lock:
+            actors = [
+                (aid, rec["worker_id"].binary(), rec["direct_address"],
+                 rec["pid"])
+                for aid, rec in self._placed_actors.items()
+            ]
+            workers = [
+                (wid.binary(), getattr(w.get("proc"), "pid", 0) or 0)
+                for wid, w in self.workers.items()
+            ]
+        with self._report_lock:
+            completed = [
+                (r.task_id.binary(), r.results, r.exec_ms)
+                for r in self._done_ring.values()
+            ]
+        with self._resident_lock:
+            objects = [
+                (key, name, size, key in self._replica_resident)
+                for key, (name, size) in self._resident.items()
+            ]
+        return {
+            "task_leases": task_leases,
+            "actor_leases": self.actor_spawner.held_creation_task_ids(),
+            "actors": actors,
+            "workers": workers,
+            "completed": completed,
+            "objects": objects,
+        }
+
+    def _send_reconcile_report(self, msg: "P.AgentReconcile"):
+        """Answer one AgentReconcile ask: ship the report (bounded retries
+        — the head's apply is idempotent and it re-asks once on a dropped
+        report), apply the orphan verdicts, then reopen reports and tell
+        local workers the head restarted (their in-flight controller calls
+        lost their replies)."""
+        report = self._build_reconcile_report()
+        verdict = None
+        # the ask carries the head's remaining recovery window: retrying
+        # past it is pointless (a late report gets the 'closed' verdict)
+        deadline = time.monotonic() + max(1.0, float(msg.deadline_s))
+        try:
+            for attempt in range(5):
+                if self.shutting_down or time.monotonic() >= deadline:
+                    return
+                try:
+                    verdict = self.call_controller(
+                        "reconcile_report",
+                        (self.node_id.hex(), report),
+                        timeout=30.0,
+                    )
+                    break
+                except Exception as e:  # noqa: BLE001 — chaos/transport
+                    logger.warning(
+                        "reconcile_report failed (attempt %d/5): %s",
+                        attempt + 1, e,
+                    )
+                    time.sleep(min(0.2 * (attempt + 1), 1.0))
+            if isinstance(verdict, dict) and verdict.get("status") == "ok":
+                self._apply_reconcile_verdict(verdict)
+            elif isinstance(verdict, dict) and verdict.get("status") == "closed":
+                # the head's recovery window closed before our report
+                # landed: our held work was already re-placed/re-created —
+                # keeping it would execute everything twice. Tear down and
+                # re-register fresh (closing the conn routes serve_forever
+                # through the normal reconnect path, whose resume attempt
+                # the non-recovering head answers with 'reset').
+                logger.warning(
+                    "reconcile arrived after the head's recovery window "
+                    "closed: resetting local state (held work was re-placed)"
+                )
+                try:
+                    self.conn.close()
+                except OSError:
+                    pass
+        finally:
+            # bounded hold: even a lost reconcile must not gate reports
+            # forever (the head re-places at its grace deadline and the
+            # normal idempotent report paths take over)
+            self._reports_open.set()
+            self._report_wake.set()
+        self._notify_workers_head_restarted()
+
+    def _apply_reconcile_verdict(self, verdict: dict):
+        """Reap what the journal never granted: orphan leases pop from the
+        local queue maps, orphan actors' workers die, orphan objects free."""
+        drop_tasks = set(verdict.get("drop_tasks") or ())
+        if drop_tasks:
+            with self._lease_lock:
+                for tid in drop_tasks:
+                    self._leased.pop(tid, None)
+                self._local_queue = [
+                    lt for lt in self._local_queue
+                    if lt.spec.task_id.binary() not in drop_tasks
+                ]
+            self.actor_spawner.drop_creation_leases(drop_tasks)
+        for aid in verdict.get("drop_actors") or ():
+            with self.workers_lock:
+                rec = self._placed_actors.pop(aid, None)
+            if rec is None:
+                continue
+            with self.workers_lock:
+                w = self.workers.get(rec["worker_id"])
+            proc = w.get("proc") if w else None
+            if proc is not None:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+        for oid_bin in verdict.get("drop_objects") or ():
+            oid = ObjectID(oid_bin)
+            self._invalidate_location(oid)
+            self._replica_resident.discard(oid_bin)
+            with self._resident_lock:
+                if self._resident.pop(oid_bin, None) is not None:
+                    try:
+                        self._resident_order.remove(oid_bin)
+                    except ValueError:
+                        pass
+            try:
+                self.store.delete(oid)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _notify_workers_head_restarted(self):
+        """Local workers' in-flight controller calls (relayed through us)
+        lost their replies with the crashed head: bump their connection
+        epoch so blocked waiters retry per idempotency class."""
+        note = P.HeadRestarted(epoch=self.head_epoch)
+        with self.workers_lock:
+            targets = [
+                w for w in self.workers.values()
+                if w.get("conn") is not None
+            ]
+        for w in targets:
+            try:
+                with w["lock"]:
+                    w["conn"].send(note)
+            except (OSError, EOFError):
+                pass
 
     def _drop_queued_reports(self):
         """Reconnect reset: queued reports reference the old head's lease
@@ -467,9 +738,12 @@ class NodeAgent:
         # must reach the new incarnation (it re-places restorable actors)
         self.actor_spawner.reset()
         self._drop_queued_reports()
+        with self._report_lock:
+            self._done_ring.clear()
         with self.workers_lock:
             workers = list(self.workers.values())
             self.workers.clear()
+            self._placed_actors.clear()
             self._pending_kills.clear()
         with self._lease_lock:
             self._leased.clear()
@@ -583,6 +857,13 @@ class NodeAgent:
                     self.store.delete(oid)
                 except Exception:  # noqa: BLE001
                     pass
+        elif isinstance(msg, P.AgentReconcile):
+            # the restarted head asks for our truth; answer OFF this loop
+            # (call_controller blocks on a reply that arrives HERE)
+            threading.Thread(
+                target=self._send_reconcile_report, args=(msg,),
+                daemon=True, name="agent-reconcile",
+            ).start()
         elif isinstance(msg, P.DrainAgent):
             self._on_drain(msg)
         elif isinstance(msg, P.Shutdown):
@@ -874,6 +1155,14 @@ class NodeAgent:
     def _queue_report(self, report: "P.AgentTaskDone") -> None:
         """Coalesce a completion report into the per-tick batch (0-window
         config sends it immediately — the pre-batching behavior)."""
+        # recovery ring: re-offered in reconcile_report so a completion the
+        # crashed head processed-but-never-journaled is not re-executed
+        with self._report_lock:
+            key = report.task_id.binary()
+            self._done_ring[key] = report
+            self._done_ring.move_to_end(key)
+            while len(self._done_ring) > self._done_ring_cap:
+                self._done_ring.popitem(last=False)
         if self._report_window_s <= 0:
             try:
                 self._send(report)
@@ -885,6 +1174,18 @@ class NodeAgent:
         self._report_wake.set()
 
     def _flush_reports(self) -> None:
+        if not self._reports_open.is_set():
+            if time.monotonic() < self._reports_hold_deadline:
+                # resumed re-registration awaiting its reconcile verdict:
+                # hold (don't drop) — the head has not rebuilt our lease
+                # table yet
+                return
+            # the reconcile ask never arrived inside the head's recovery
+            # window (both pushes lost): reopen — the head re-placed at
+            # its deadline, stale reports land idempotently, and local
+            # workers must stop waiting on dead replies
+            self._reports_open.set()
+            self._notify_workers_head_restarted()
         with self._report_lock:
             batch, self._report_queue = self._report_queue, []
         # the node's observability payload rides THIS tick (zero extra
@@ -1002,6 +1303,7 @@ class NodeAgent:
 
     def _on_local_worker_death(self, wid: WorkerID):
         """Spill this worker's in-flight leased tasks back to the head."""
+        self._note_actor_gone(wid)
         with self._lease_lock:
             was_spawning = self._agent_owned.pop(wid, None) is not None and wid not in self._wid_fp
             if was_spawning:
@@ -1214,7 +1516,15 @@ class NodeAgent:
         # onto it (agent-owned pool workers). The relay MUST precede any
         # actor_placed report on this FIFO connection — the head learns the
         # worker's identity + direct-call address before binding an actor.
-        self._send(P.FromWorker(msg.worker_id, msg))
+        try:
+            self._send(P.FromWorker(msg.worker_id, msg))
+        except (OSError, EOFError):
+            # head outage mid-handshake (restart window): the worker still
+            # joins the LOCAL pool — a resumed head learns its identity
+            # from later relayed traffic / the reconcile report, and
+            # killing the handshake here would strand the worker's conn
+            # unread forever
+            pass
         fp = self._agent_owned.get(msg.worker_id)
         if fp is not None:
             self._on_local_worker_ready(msg.worker_id, fp)

@@ -168,6 +168,37 @@ class Config:
     # KV persistence across controller restarts (GCS Redis-FT analog,
     # redis_store_client.h:111); None disables
     gcs_snapshot_path: Optional[str] = None
+    # --- head fault tolerance (see ray_tpu/_private/wal.py + README
+    # "Head fault tolerance") ---
+    # Write-ahead journal under the snapshot machinery: durable-truth
+    # mutations (accepted submits, lease grants, seals, frees, actor
+    # placements, tenant policy, PGs) append O(1) records that a restarted
+    # head replays on top of the last compacted snapshot. Active only when
+    # gcs_snapshot_path is set (the WAL is the snapshot's tail).
+    wal_enabled: bool = True
+    # Journal file directory; None = alongside the snapshot
+    # (<gcs_snapshot_path>.wal).
+    wal_dir: Optional[str] = None
+    # fsync batching window: appended records are durable within this many
+    # milliseconds (one write + one fsync per interval, not per record).
+    wal_flush_interval_ms: float = 5.0
+    # Compaction bound: when the journal grows past this, a fresh full
+    # snapshot is written and the journal truncates (replay cost stays
+    # O(snapshot + tail), never O(history)).
+    wal_rotate_bytes: int = 16 * 1024**2
+    # Bounded RECOVERING phase after a restart that found journaled agent
+    # nodes: re-attaching agents get this long to reconcile (held leases,
+    # alive actors/workers, arena inventory) before the head re-places
+    # journaled-but-unconfirmed work and opens the dispatch loop.
+    recovery_grace_s: float = 10.0
+    # A reconciling agent that hasn't reported within this window is asked
+    # ONCE more (a dropped agent_reconcile push or reconcile_report reply
+    # must not strand recovery until the full grace deadline).
+    recovery_reconcile_resend_s: float = 2.0
+    # Client-transparent reconnect: how long worker_runtime retries
+    # retryable controller calls across a head restart (bounded
+    # exponential backoff + jitter) before surfacing the failure.
+    head_retry_timeout_s: float = 60.0
     # --- fault injection (reference: rpc_chaos.h:23, RAY_testing_rpc_failure)
     # format: "op1=prob1,op2=prob2" — controller ops fail with given
     # probability (tasks/retries exercise the recovery paths); empty = off
@@ -254,6 +285,27 @@ class Config:
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+    def override_env(self) -> dict:
+        """``RAY_TPU_<NAME>`` env assignments for every field overridden
+        away from its default — the child-propagation contract (reference:
+        ``ray_config_def.h`` RAY_CONFIG values reaching child processes).
+        Shared by head-local worker spawn AND the agent lease paths, so a
+        driver's ``init(config={...})`` knobs reach remote workers too."""
+        out: dict[str, str] = {}
+        defaults = type(self)()
+        for f in dataclasses.fields(self):
+            cur = getattr(self, f.name)
+            if cur == getattr(defaults, f.name):
+                continue
+            key = "RAY_TPU_" + f.name.upper()
+            if isinstance(cur, bool):
+                out[key] = "1" if cur else "0"
+            elif isinstance(cur, (int, float, str)):
+                out[key] = str(cur)
+            else:
+                out[key] = json.dumps(cur)
+        return out
 
 
 _global_config: Config | None = None

@@ -87,13 +87,14 @@ NODE_SHARD_OPS = frozenset({
     "add_node", "remove_node", "drain_node", "drain_status", "nodes",
     "cluster_resources", "available_resources", "autoscaler_state",
     "list_workers", "pg_create", "pg_ready", "pg_remove", "pg_table",
-    "list_placement_groups", "set_tenant_quota", "tenant_stats",
+    "list_placement_groups", "reconcile_report", "set_tenant_quota",
+    "tenant_stats",
 })
 KV_SHARD_OPS = frozenset({"kv_put", "kv_get", "kv_del", "kv_keys"})
 OBSERVE_SHARD_OPS = frozenset({
     "cluster_metrics", "log_get", "log_list", "log_tail_buffer",
-    "proxy_stats", "pubsub_poll", "pubsub_publish", "report_observability",
-    "report_proxy_stats", "worker_stacks",
+    "proxy_stats", "pubsub_poll", "pubsub_publish", "recovery_stats",
+    "report_observability", "report_proxy_stats", "worker_stacks",
 })
 
 
@@ -335,6 +336,13 @@ class Controller:
     def __init__(self, config: Config, head_resources: dict[str, float], mode: str = "process"):
         self.config = config
         self.mode = mode
+        # RAY_TPU_<NAME> exports for every config field overridden from its
+        # default — propagated to EVERY spawned worker: head-local spawns,
+        # head-managed remote spawns, and agent lease grants (whose agents
+        # spawn pool workers from the lease's env_vars). Without the lease
+        # half, a driver's init(config=...) knobs silently reset to
+        # defaults inside agent-spawned workers (the PR 13 noted tail).
+        self._child_env_overrides = config.override_env()
         # Core scheduler/cluster-state lock. Registered as a SUBSYSTEM lock:
         # the sharded dispatch tables give some subsystems (KV) their own
         # lock, and locktrace asserts at runtime that no thread ever holds
@@ -599,7 +607,10 @@ class Controller:
                 )
             self._rpc_chaos[op_name.strip()] = float(p)
         unknown_chaos = (
-            set(self._rpc_chaos) - P.CONTROLLER_OPS - P.AGENT_PUSH_OPS
+            set(self._rpc_chaos)
+            - P.CONTROLLER_OPS
+            - P.AGENT_PUSH_OPS
+            - P.INTERNAL_CHAOS_OPS
         )
         if unknown_chaos:
             raise ValueError(
@@ -625,6 +636,10 @@ class Controller:
         # subsystem lock: _persist_kv runs both under the core lock and
         # under the KV lock)
         self._kv_flusher_start_lock = threading.Lock()
+        # serializes WHOLE compactions (rotate + snapshot + unlink): the
+        # journal-tick trigger and _finish_recovery's compaction can race,
+        # and two concurrent rotates would clobber each other's segments
+        self._compact_lock = threading.Lock()
         self._boot_snapshot = None
         if self._kv_snapshot_path and os.path.exists(self._kv_snapshot_path):
             try:
@@ -645,6 +660,88 @@ class Controller:
                 )
             except Exception:
                 logger.warning("state snapshot restore failed", exc_info=True)
+
+        # ---- head fault tolerance: write-ahead journal + recovery plane
+        # (reference: the GCS's Redis-backed tables + gcs_init_data reload,
+        # and the raylet resubscribe after NotifyGCSRestart). The snapshot
+        # is the compacted base; the WAL is the tail of durable-truth
+        # mutations since — a SIGKILL'd head replays snapshot + tail and
+        # reconciles live state with its re-attaching agents instead of
+        # forgetting everything after the last full snapshot write.
+        self._wal = None
+        self._wal_suppress = False  # True while replaying (records exist)
+        self._wal_append_tick = 0
+        self._wal_compacting = False
+        self._boot_wal_records: list = []
+        # RECOVERING phase state: dispatch is gated until every journaled
+        # agent node reconciled (or the grace deadline lapsed)
+        self.recovering = False
+        self._recovery_deadline = 0.0
+        # node_hex -> {"status": waiting|asked|done, "asked_t", "asks"}
+        self._recovery_nodes: dict[str, dict] = {}
+        # journal-granted leases awaiting agent confirmation:
+        # task_id binary -> (PendingTask, node_hex, is_actor_lease)
+        self._recovery_parked: dict[bytes, tuple] = {}
+        # journal-known ALIVE placements awaiting rebind:
+        # actor_id binary -> (node_hex, worker_id binary, direct_address)
+        self._recovery_placements: dict[bytes, tuple] = {}
+        # journal-known sealed plasma locations awaiting inventory
+        # confirmation: oid binary -> (location_name, size)
+        self._recovery_objects: dict[bytes, tuple] = {}
+        # actor creations DEFERRED during recovery (the actor may be alive
+        # on a reconciling agent — resubmitting before its report lands
+        # would double-create): actor_id binary -> (spec, name)
+        self._recovery_unplaced_actors: dict[bytes, tuple] = {}
+        # journal-sealed head-arena locations whose payload died with the
+        # crash: surfaced as ObjectLostError at recovery close
+        self._recovery_dropped_plasma: list = []
+        # first post-restore dispatch stamps time_to_first_dispatch
+        self._ttfd_pending = False
+        # set once boot restore (snapshot + journal replay) has finished:
+        # a RESUMING agent can dial in while replay is still parking
+        # leases — its registration must wait, or its reconcile report
+        # races an empty table and every held lease reaps as an orphan
+        self._restore_done = threading.Event()
+        # counters surfaced by the recovery_stats op / rtpu_recovery_*
+        self.recovery_counters: dict[str, int] = defaultdict(int)
+        # last recovery's shape (durations, per-phase counts)
+        self.recovery_info: dict[str, Any] = {}
+        self._boot_t = time.monotonic()
+        if self._kv_snapshot_path and config.wal_enabled:
+            from ray_tpu._private.wal import WriteAheadLog
+
+            wal_path = (
+                os.path.join(
+                    config.wal_dir,
+                    os.path.basename(self._kv_snapshot_path) + ".wal",
+                )
+                if config.wal_dir
+                else self._kv_snapshot_path + ".wal"
+            )
+            try:
+                # replay order: the orphaned pre-compaction segment first (a
+                # crash between rotate and snapshot write leaves one), then
+                # the live tail — replay application is idempotent, so a
+                # record landing in both is harmless
+                for seg in (wal_path + ".1", wal_path):
+                    if os.path.exists(seg):
+                        self._boot_wal_records.extend(
+                            WriteAheadLog.replay(seg)
+                        )
+                self._wal = WriteAheadLog(
+                    wal_path,
+                    flush_interval_ms=config.wal_flush_interval_ms,
+                    on_error=self._on_wal_error,
+                    inject_failure=lambda: self._maybe_inject_rpc_failure(
+                        "wal_write"
+                    ),
+                )
+            except Exception:
+                logger.warning(
+                    "WAL unavailable; snapshot-only durability", exc_info=True
+                )
+                self._wal = None
+                self.recovery_counters["wal_errors"] += 1
 
         # Observability: task events ring buffer.
         self.task_events: deque[dict] = deque(maxlen=config.event_buffer_size)
@@ -786,12 +883,16 @@ class Controller:
         t.start()
         self._threads.append(t)
 
-        if self._boot_snapshot is not None:
+        if self._boot_snapshot is not None or self._boot_wal_records:
             try:
-                self._restore_snapshot(self._boot_snapshot)
+                self._restore_state(
+                    self._boot_snapshot or {}, self._boot_wal_records
+                )
             except Exception:
                 logger.warning("snapshot state restore failed", exc_info=True)
             self._boot_snapshot = None
+            self._boot_wal_records = []
+        self._restore_done.set()
 
     @staticmethod
     def _session_file_path() -> str:
@@ -984,8 +1085,16 @@ class Controller:
         connection thread and racy on the shared tmp path). The flusher
         start is guarded by its own tiny lock — callers arrive holding the
         core lock OR the KV subsystem lock, and this path must not nest a
-        second subsystem lock."""
+        second subsystem lock.
+
+        With a healthy WAL this is a no-op: every durable-truth mutation
+        journals an O(1) record at its own site (``_journal``) and the
+        snapshot is written only at compaction — the per-mutation full
+        snapshot would be pure write amplification on top of the journal.
+        A degraded WAL falls back here (coarser, but never silent)."""
         if not self._kv_snapshot_path:
+            return
+        if self._wal is not None and self._wal.healthy:
             return
         self._kv_dirty.set()
         with self._kv_flusher_start_lock:
@@ -1015,14 +1124,29 @@ class Controller:
         with self._kv_lock:
             kv_copy = dict(self.kv)
         with self.lock:
+            # the restorable actor population: named actors (the v2 rule)
+            # PLUS any actor living on an agent node — those survive a head
+            # crash physically and reconcile back by identity (v3)
+            def _on_agent(a: "ActorState") -> bool:
+                w = a.worker
+                if w is not None and w.agent is not None:
+                    return True
+                tidb = TaskID.for_actor_creation(a.actor_id).binary()
+                return any(
+                    tidb in n.actor_leases for n in self.nodes.values()
+                )
+
+            persisted_actors = [
+                a for a in self.actors.values()
+                if a.state != "DEAD" and (a.name or _on_agent(a))
+            ]
             actors = [
                 {
                     "spec": a.creation_spec,
                     "name": a.name,
                     "restarts_left": a.restarts_left,
                 }
-                for a in self.actors.values()
-                if a.name and a.state != "DEAD"
+                for a in persisted_actors
             ]
             cap = self.config.gcs_snapshot_max_pending
             pending = []
@@ -1038,10 +1162,9 @@ class Controller:
                             cap,
                         )
                         break
-            # actor tasks queued on restartable (named) actors
-            for a in self.actors.values():
-                if a.name and a.state != "DEAD":
-                    pending.extend(pt.spec for pt in a.queue)
+            # actor tasks queued on the restorable actors
+            for a in persisted_actors:
+                pending.extend(pt.spec for pt in a.queue)
             pgs = [
                 {
                     "pg_id": pg_id,
@@ -1064,13 +1187,58 @@ class Controller:
                 for ts in self.tenants.values()
                 if ts.configured
             ]
+            # ---- v3 recovery tables (the compacted form of the journal's
+            # lease / placement / membership / seal records) ----
+            nodes_alive = [
+                nid.hex()
+                for nid, n in self.nodes.items()
+                if n.alive and n.agent is not None
+            ]
+            task_leases = {}
+            actor_leases = {}
+            for nid, n in self.nodes.items():
+                if n.agent is None:
+                    continue
+                for tidb in n.leased:
+                    task_leases[tidb] = nid.hex()
+                for tidb in n.actor_leases:
+                    actor_leases[tidb] = nid.hex()
+            placements = {}
+            for a in persisted_actors:
+                w = a.worker
+                if a.state == "ALIVE" and w is not None and w.agent is not None:
+                    placements[a.actor_id.binary()] = (
+                        w.agent.node_id.hex(),
+                        w.worker_id.binary(),
+                        w.direct_address,
+                    )
+            seals = []
+            for oid in list(self.ref_counts):
+                entry = self.memory_store.peek(oid)
+                if entry is None:
+                    continue
+                kind, payload = entry
+                if kind in ("inline", "error"):
+                    seals.append((oid.binary(), kind, payload.to_bytes()))
+                elif kind == "plasma":
+                    seals.append((oid.binary(), "plasma", tuple(payload)))
+                if len(seals) >= cap:
+                    logger.warning(
+                        "state snapshot truncated at %d sealed objects", cap
+                    )
+                    break
             return {
-                "version": 2,
+                "version": 3,
                 "kv": kv_copy,
                 "actors": actors,
                 "placement_groups": pgs,
                 "pending_tasks": pending,
                 "tenants": tenant_rows,
+                "nodes": nodes_alive,
+                "task_leases": task_leases,
+                "actor_leases": actor_leases,
+                "actor_placements": placements,
+                "seals": seals,
             }
 
     def _write_snapshot(self, suffix: str):
@@ -1098,14 +1266,88 @@ class Controller:
             time.sleep(0.2)  # batch bursts of mutations
 
     def flush_kv_now(self):
-        """Synchronous flush (used at shutdown so the last writes persist)."""
+        """Synchronous flush (used at shutdown so the last writes persist).
+        With a WAL this is the final compaction: the snapshot subsumes the
+        journal, which closes truncated."""
         if not self._kv_snapshot_path:
             return
         try:
             self._write_snapshot(f".final{os.getpid()}")
             self._kv_dirty.clear()
+            if self._wal is not None:
+                self._wal.truncate()
+                self._wal.close(final_flush=False)
         except Exception:
             logger.warning("final state snapshot failed", exc_info=True)
+
+    # ------------------------------------------- write-ahead journal (WAL)
+
+    def _journal(self, kind: str, payload) -> None:
+        """Append one durable-truth mutation record (O(1): deque append —
+        the WAL flusher pickles/writes/fsyncs in batches). Suppressed while
+        replaying (the records being applied are already on disk); silent
+        no-op when the journal is off or degraded (the legacy dirty-flag
+        snapshot flusher owns durability then)."""
+        w = self._wal
+        if w is None or self._wal_suppress or not w.healthy:
+            return
+        if self.shutting_down:
+            # teardown mutations (remove_node on closed agent conns, final
+            # frees) are not membership/work truth — the final compaction
+            # snapshot in flush_kv_now records the clean-shutdown state
+            return
+        w.append(kind, payload)
+        self._wal_append_tick += 1
+        if self._wal_append_tick >= 512:
+            # amortized rotation check: replay must stay O(snapshot + tail)
+            self._wal_append_tick = 0
+            if (
+                not self._wal_compacting
+                and w.size_bytes() > self.config.wal_rotate_bytes
+            ):
+                self._wal_compacting = True
+                threading.Thread(
+                    target=self._compact_bg, daemon=True, name="wal-compact"
+                ).start()
+
+    def _compact_bg(self):
+        try:
+            self.compact_now()
+        finally:
+            self._wal_compacting = False
+
+    def compact_now(self):
+        """Journal compaction: rotate to a fresh segment, write the full
+        snapshot, drop the old segment (see ``WriteAheadLog.rotate`` for
+        why this ordering is crash-safe). Serialized: a concurrent pair of
+        compactions would clobber each other's rotated segments and race
+        on the snapshot temp file."""
+        if self._wal is None or not self._kv_snapshot_path:
+            return
+        with self._compact_lock:
+            try:
+                self._wal.flush()
+                old = self._wal.rotate()
+                self._write_snapshot(f".compact{os.getpid()}")
+                try:
+                    os.unlink(old)
+                except OSError:
+                    pass
+                self.recovery_counters["wal_compactions"] += 1
+            except Exception:  # noqa: BLE001 — degrade is handled by the WAL
+                logger.warning("WAL compaction failed", exc_info=True)
+
+    def _on_wal_error(self, exc: BaseException):
+        """The journal degraded (write/rotate failure): durability falls
+        back LOUDLY to the per-mutation snapshot flusher — coarser, but
+        never a silent hole in the log (``rtpu_wal_errors`` counts it)."""
+        self.recovery_counters["wal_errors"] += 1
+        logger.error(
+            "WAL degraded — falling back to snapshot-only durability: %s",
+            exc,
+        )
+        # reactivate the legacy dirty-flag path (wal.healthy is False now)
+        self._persist_kv()
 
     def _restore_snapshot(self, snap: dict):
         """Rebuild restorable state from a snapshot (run at the END of
@@ -1168,6 +1410,796 @@ class Controller:
                 len(snap.get("actors", ())), restored,
                 len(snap.get("placement_groups", ())),
             )
+
+    # -------------------------------------- crash recovery (snapshot + WAL)
+
+    def _restore_state(self, snap: dict, wal_records: list):
+        """Rebuild from the compacted snapshot plus the journal tail. With
+        no journal (WAL disabled, legacy v2 snapshot) this is the old
+        restore-and-resubmit path; otherwise the merged model drives a
+        reconciling recovery: journaled agent nodes get a bounded
+        RECOVERING window to confirm what they still hold before anything
+        is re-placed."""
+        if self._wal is None and not wal_records and snap.get("version", 0) < 3:
+            return self._restore_snapshot(snap)
+        model = self._build_recovery_model(snap, wal_records)
+        self._wal_suppress = True  # records being applied are already on disk
+        try:
+            self._restore_recovery(model)
+        finally:
+            self._wal_suppress = False
+
+    def _build_recovery_model(self, snap: dict, records: list) -> dict:
+        """Fold the journal tail onto the snapshot base. Application is
+        idempotent — a record that also made the snapshot (compaction race,
+        orphaned pre-compaction segment) folds to the same state."""
+        model: dict = {
+            "tenants": {t["name"]: t for t in snap.get("tenants", ())},
+            "pgs": {
+                e["pg_id"]: e for e in snap.get("placement_groups", ())
+            },
+            # aid binary -> {"spec","name","restarts_left","placed","dead"}
+            "actors": {},
+            # tid binary -> spec (submitted, not yet completed)
+            "pending": OrderedDict(),
+            "task_leases": dict(snap.get("task_leases", ())),
+            "actor_leases": dict(snap.get("actor_leases", ())),
+            # oid binary -> (kind, payload)
+            "seals": OrderedDict(
+                (oid, (kind, payload))
+                for oid, kind, payload in snap.get("seals", ())
+            ),
+            "nodes": set(snap.get("nodes", ())),
+        }
+        for entry in snap.get("actors", ()):
+            spec = entry["spec"]
+            model["actors"][spec.actor_id.binary()] = {
+                "spec": spec,
+                "name": entry.get("name"),
+                "restarts_left": entry.get("restarts_left", 0),
+                "placed": None,
+                "dead": False,
+            }
+        for aid, placed in (snap.get("actor_placements") or {}).items():
+            rec = model["actors"].get(aid)
+            if rec is not None:
+                rec["placed"] = tuple(placed)
+        for spec in snap.get("pending_tasks", ()):
+            model["pending"][spec.task_id.binary()] = spec
+        replayed = 0
+        for kind, payload in records:
+            replayed += 1
+            try:
+                self._apply_journal_record(model, kind, payload)
+            except Exception:  # noqa: BLE001 — one bad record, not the boot
+                logger.warning(
+                    "WAL record %r failed to apply", kind, exc_info=True
+                )
+        self.recovery_counters["wal_records_replayed"] += replayed
+        return model
+
+    def _apply_journal_record(self, model: dict, kind: str, payload):
+        actors = model["actors"]
+        if kind == "submit":
+            spec, name = payload
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                rec = actors.setdefault(
+                    spec.actor_id.binary(),
+                    {"spec": spec, "name": name,
+                     "restarts_left": spec.max_restarts,
+                     "placed": None, "dead": False},
+                )
+                rec["spec"], rec["name"] = spec, name
+            else:
+                model["pending"][spec.task_id.binary()] = spec
+        elif kind == "done":
+            model["pending"].pop(payload, None)
+            model["task_leases"].pop(payload, None)
+            model["actor_leases"].pop(payload, None)
+        elif kind == "lease":
+            tid, node_hex = payload
+            model["task_leases"][tid] = node_hex
+        elif kind == "alease":
+            tid, node_hex = payload
+            model["actor_leases"][tid] = node_hex
+        elif kind == "unlease":
+            model["task_leases"].pop(payload, None)
+            model["actor_leases"].pop(payload, None)
+        elif kind == "seal":
+            oid, k, p = payload
+            model["seals"][oid] = (k, p)
+        elif kind == "free":
+            model["seals"].pop(payload, None)
+        elif kind == "placed":
+            aid, node_hex, wid, addr = payload
+            rec = actors.get(aid)
+            if rec is not None:
+                rec["placed"] = (node_hex, wid, addr)
+        elif kind == "unplaced":
+            rec = actors.get(payload)
+            if rec is not None:
+                rec["placed"] = None
+        elif kind == "actor_dead":
+            rec = actors.get(payload)
+            if rec is not None:
+                rec["dead"] = True
+        elif kind == "restarts":
+            aid, n = payload
+            rec = actors.get(aid)
+            if rec is not None:
+                rec["restarts_left"] = n
+        elif kind == "node_up":
+            model["nodes"].add(payload)
+        elif kind == "node_down":
+            model["nodes"].discard(payload)
+        elif kind == "tenant":
+            model["tenants"][payload["name"]] = payload
+        elif kind == "pg":
+            pg_id, bundles, strategy = payload
+            model["pgs"][pg_id] = {
+                "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+            }
+        elif kind == "pg_remove":
+            model["pgs"].pop(payload, None)
+        elif kind == "kv_put":
+            ns, key, value = payload
+            with self._kv_lock:
+                self.kv[(ns, key)] = value
+        elif kind == "kv_del":
+            ns, key = payload
+            with self._kv_lock:
+                self.kv.pop((ns, key), None)
+        else:
+            logger.warning("unknown WAL record kind %r (skipped)", kind)
+
+    def _restore_recovery(self, model: dict):
+        """Apply the merged model. When journaled agent nodes exist, enter
+        the bounded RECOVERING phase: leases and placements park awaiting
+        each agent's reconcile report; the dispatch loop stays gated so
+        nothing re-places (and re-EXECUTES) work an agent still holds."""
+        expected = {
+            h for h in model["nodes"]
+            if h != self.head_node_id.hex()
+        }
+        recovering = bool(expected) and self.mode == "process"
+        if recovering:
+            with self.lock:
+                self.recovering = True
+                self._recovery_deadline = (
+                    time.monotonic() + self.config.recovery_grace_s
+                )
+                for h in expected:
+                    self._recovery_nodes[h] = {
+                        "status": "waiting", "asked_t": 0.0, "asks": 0,
+                    }
+            self.recovery_info["started_t"] = time.time()
+            self.recovery_info["expected_nodes"] = len(expected)
+        # tenant policy FIRST: restored work must route into queue groups
+        # with the configured weights/quotas/priorities already in force
+        for entry in model["tenants"].values():
+            try:
+                self.set_tenant_quota(
+                    entry["name"],
+                    quota=entry.get("quota") or {},
+                    weight=entry.get("weight"),
+                    priority=entry.get("priority"),
+                )
+            except Exception:
+                logger.warning(
+                    "could not restore tenant %s", entry.get("name"),
+                    exc_info=True,
+                )
+        for entry in model["pgs"].values():
+            pg = PlacementGroupState(
+                entry["pg_id"], entry["bundles"], entry["strategy"]
+            )
+            with self.lock:
+                self.placement_groups[entry["pg_id"]] = pg
+        # sealed objects: inline/error payloads re-seal from the journal;
+        # plasma locations lived in arenas — agent-arena copies park until
+        # the owning agent's inventory confirms them, head-arena copies
+        # died with the crashed process (lineage may rebuild on demand)
+        sealed = parked_obj = 0
+        dropped_plasma: list[bytes] = []
+        for oid_bin, (kind, payload) in model["seals"].items():
+            oid = ObjectID(oid_bin)
+            if kind in ("inline", "error"):
+                self.memory_store.put(
+                    oid, (kind, SerializedObject.from_buffer(payload))
+                )
+                with self.lock:
+                    self.ref_counts[oid] += 1  # recovery pin
+                sealed += 1
+            elif kind == "plasma" and recovering:
+                name, size = payload
+                self._recovery_objects[oid_bin] = (name, int(size))
+                parked_obj += 1
+            elif kind == "plasma":
+                # head-arena payload: its shared memory died with the
+                # crashed process — surfaced as lost after pending restore
+                # (a replayed producer may still re-run it)
+                dropped_plasma.append(oid_bin)
+        self.recovery_counters["seals_restored"] += sealed
+        # The submitting clients' return-id refs died with the crashed
+        # head (add_ref traffic is not journaled): pin every restored
+        # spec's returns with a recovery ref, or the eager refcount-0 free
+        # in _on_object_sealed reclaims results the reconnecting driver is
+        # blocked on. The driver's re-sent FreeObjects releases the pin.
+        def _pin_returns(spec):
+            with self.lock:
+                for oid in spec.return_ids():
+                    self.ref_counts[oid] += 1
+
+        # actors: rebuild identity; placements/creation-leases on expected
+        # nodes park for reconcile, everything else re-creates
+        resubmit = []
+        for aid_bin, rec in model["actors"].items():
+            if rec["dead"]:
+                continue
+            spec, name = rec["spec"], rec.get("name")
+            tid_bin = TaskID.for_actor_creation(ActorID(aid_bin)).binary()
+            try:
+                with self.lock:
+                    actor = ActorState(spec.actor_id, spec)
+                    actor.name = name
+                    actor.restarts_left = rec.get("restarts_left", 0)
+                    self.actors[spec.actor_id] = actor
+                    if name:
+                        self.named_actors[name] = spec.actor_id
+                placed = rec.get("placed")
+                lease_node = model["actor_leases"].get(tid_bin)
+                if recovering and placed and placed[0] in expected:
+                    with self.lock:
+                        actor.state = "RESTARTING"
+                        self._recovery_placements[aid_bin] = tuple(placed)
+                        self._recovery_unplaced_actors[aid_bin] = (spec, name)
+                elif recovering and lease_node in expected:
+                    # creation lease in flight at crash: the agent's spawner
+                    # still owns it and will (re)report actor_placed — park
+                    # the pending creation under its journaled node
+                    with self.lock:
+                        deps = {a[1] for a in spec.args if a[0] == "ref"}
+                        pt = PendingTask(spec, deps)
+                        for d in pt.all_deps:
+                            self.ref_counts[d] += 1
+                        for oid in spec.return_ids():
+                            self.ref_counts[oid] += 1  # recovery pin
+                        self.pending_by_id[spec.task_id] = pt
+                        self._recovery_parked[tid_bin] = (
+                            pt, lease_node, True,
+                        )
+                        self._recovery_unplaced_actors[aid_bin] = (spec, name)
+                elif recovering:
+                    # unknown placement: the actor MAY be alive on a
+                    # reconciling agent (a lost 'placed' record) — defer
+                    # the re-create decision to the end of recovery
+                    with self.lock:
+                        actor.state = "RESTARTING"
+                        self._recovery_unplaced_actors[aid_bin] = (spec, name)
+                else:
+                    resubmit.append(spec)
+            except Exception:
+                logger.warning(
+                    "could not restore actor %s", name or spec.actor_id.hex(),
+                    exc_info=True,
+                )
+        for spec in resubmit:
+            try:
+                _pin_returns(spec)
+                self._submit_replayed(spec)
+            except Exception:
+                logger.warning(
+                    "could not resubmit actor creation %s", spec.name,
+                    exc_info=True,
+                )
+        # pending tasks: journal-leased ones park under their node;
+        # completed-with-lost-'done' ones dedup against their sealed
+        # returns; the rest resubmit (dispatch is gated while recovering)
+        restored = parked = 0
+        for tid_bin, spec in model["pending"].items():
+            rets = spec.return_ids()
+            if rets and self.memory_store.contains(rets[0]):
+                continue  # completed pre-crash; 'done' record lost
+            lease_node = model["task_leases"].get(tid_bin)
+            try:
+                _pin_returns(spec)
+                if (
+                    recovering
+                    and spec.task_type == TaskType.NORMAL_TASK
+                    and lease_node in expected
+                ):
+                    with self.lock:
+                        deps = {a[1] for a in spec.args if a[0] == "ref"}
+                        pt = PendingTask(spec, deps)
+                        for d in pt.all_deps:
+                            self.ref_counts[d] += 1
+                        self.pending_by_id[spec.task_id] = pt
+                        self._recovery_parked[tid_bin] = (
+                            pt, lease_node, False,
+                        )
+                    parked += 1
+                else:
+                    self.submit_task(spec)
+                    restored += 1
+            except Exception:
+                logger.warning(
+                    "could not restore task %s", spec.name, exc_info=True
+                )
+        self.recovery_counters["tasks_restored"] += restored
+        self.recovery_counters["leases_parked"] += parked
+        self._ttfd_pending = bool(
+            restored or parked or model["actors"] or self._recovery_objects
+        )
+        self._recovery_dropped_plasma = dropped_plasma if recovering else []
+        if recovering:
+            logger.warning(
+                "head RECOVERING: %d journaled agent node(s), %d parked "
+                "lease(s), %d parked placement(s), %d parked object(s) — "
+                "dispatch gated for up to %.1fs while agents reconcile",
+                len(expected), len(self._recovery_parked),
+                len(self._recovery_placements), parked_obj,
+                self.config.recovery_grace_s,
+            )
+            t = threading.Thread(
+                target=self._recovery_monitor, daemon=True,
+                name="ctrl-recovery",
+            )
+            t.start()
+            self._threads.append(t)
+        else:
+            self._seal_lost_objects(dropped_plasma)
+            self._fail_unrecoverable_waiters()
+            if model["actors"] or restored:
+                logger.info(
+                    "restored %d actor(s), %d pending task(s), %d pg(s) "
+                    "from snapshot+journal",
+                    len(model["actors"]), restored, len(model["pgs"]),
+                )
+
+    def _seal_lost_objects(self, oid_bins) -> None:
+        """Journal-sealed plasma objects whose payload did not survive the
+        crash (head arena, or an agent that never reconciled) and whose
+        producer is not pending: seal ObjectLostError so a reconnecting
+        driver's get() FAILS instead of hanging forever on an entry that
+        can never re-seal."""
+        for oid_bin in oid_bins:
+            oid = ObjectID(oid_bin)
+            if self.memory_store.contains(oid):
+                continue
+            producer = TaskID(oid_bin[: TaskID.SIZE])
+            with self.lock:
+                if producer in self.pending_by_id or producer in self._recovering:
+                    continue  # a replayed producer will re-seal it
+            err = self.serialization.serialize(
+                ObjectLostError(
+                    f"object {oid.hex()} was sealed before the head crash "
+                    f"but its payload did not survive recovery"
+                )
+            )
+            self.memory_store.put(oid, ("error", err))
+            self._on_object_sealed(oid)
+            self.recovery_counters["objects_lost"] += 1
+
+    # ---------------------------------------- agent-driven reconciliation
+
+    def _ask_reconcile(self, agent: AgentHandle, seq: int = 1):
+        """Push the reconcile ask to a re-attached agent. An injected
+        'agent_reconcile' chaos failure drops the push before the wire —
+        the recovery monitor's single bounded re-ask covers it."""
+        h = agent.node_id.hex()
+        with self.lock:
+            rec = self._recovery_nodes.setdefault(
+                h, {"status": "waiting", "asked_t": 0.0, "asks": 0}
+            )
+            if rec["status"] == "done":
+                return
+            rec["status"] = "asked"
+            rec["asked_t"] = time.monotonic()
+            rec["asks"] += 1
+            deadline_s = max(0.5, self._recovery_deadline - time.monotonic())
+        try:
+            self._maybe_inject_rpc_failure("agent_reconcile")
+            agent.send(P.AgentReconcile(deadline_s, ask_seq=seq))
+            self.recovery_counters["reconcile_asks"] += 1
+        except (OSError, EOFError, WorkerCrashedError) as e:
+            # lost push: the monitor re-asks once after the resend window
+            if isinstance(e, WorkerCrashedError):
+                self.recovery_counters["reconcile_ask_injected_failures"] += 1
+            else:
+                self.recovery_counters["reconcile_ask_failures"] += 1
+
+    def _recovery_monitor(self):
+        """Bounded RECOVERING supervisor: re-asks silent agents ONCE after
+        the resend window, then closes recovery at the earlier of every
+        expected node reconciling or the grace deadline."""
+        resend_s = self.config.recovery_reconcile_resend_s
+        while not self.shutting_down:
+            with self.lock:
+                if not self.recovering:
+                    return
+                deadline = self._recovery_deadline
+                recs = {
+                    h: dict(r) for h, r in self._recovery_nodes.items()
+                }
+                agents = dict(self.agents)
+            now = time.monotonic()
+            if recs and all(r["status"] == "done" for r in recs.values()):
+                self._finish_recovery("all agents reconciled")
+                return
+            if now >= deadline:
+                self._finish_recovery("grace deadline lapsed")
+                return
+            for h, r in recs.items():
+                if (
+                    r["status"] == "asked"
+                    and r["asks"] < 2
+                    and now - r["asked_t"] > resend_s
+                ):
+                    agent = next(
+                        (a for nid, a in agents.items() if nid.hex() == h),
+                        None,
+                    )
+                    if agent is not None:
+                        self._ask_reconcile(agent, seq=2)
+            time.sleep(0.05)
+
+    def _unqueue_pending_locked(self, pt: PendingTask) -> bool:
+        """Remove a restored-but-queued task from its tenant ready queue
+        (call under self.lock). Covers the fsync window where a lease
+        record was lost: the agent's reconcile report proves it holds the
+        task, so the queued copy must not dispatch a second execution."""
+        shape = self._shape_key(pt.spec)
+        ts = self.tenants.get(shape[0])
+        if ts is None:
+            return False
+        q = ts.queues.get(shape)
+        if not q:
+            return False
+        try:
+            q.remove(pt)
+        except ValueError:
+            return False
+        ts.reap_queue(shape)
+        return True
+
+    def _apply_reconcile_report(self, node_hex: str, report: dict) -> dict:
+        """Fold one agent's truth into the recovering head: resume held
+        leases, apply completion reports the crashed head never journaled,
+        rebind alive actors by identity, confirm arena inventory. Returns
+        the orphan verdicts the agent must reap. Idempotent: the node's
+        'done' flag makes a duplicate report (head re-ask crossing the
+        original reply on the wire) a no-op — no double re-place."""
+        drop_tasks: list = []
+        drop_actors: list = []
+        drop_objects: list = []
+        completed_entries = list(report.get("completed") or ())
+        with self.lock:
+            if not self.recovering:
+                # the grace deadline already closed recovery: its journaled
+                # work was re-placed/re-created — applying this late report
+                # would bind a SECOND live copy of every lease and actor it
+                # names. The agent resets on this verdict (exactly-once
+                # depends on it).
+                self.recovery_counters["reconcile_late_rejected"] += 1
+                return {"status": "closed", "drop_tasks": [],
+                        "drop_actors": [], "drop_objects": []}
+            nid = next(
+                (n for n in self.agents if n.hex() == node_hex), None
+            )
+            node = self.nodes.get(nid) if nid is not None else None
+            agent = self.agents.get(nid) if nid is not None else None
+            if node is None or agent is None:
+                raise ValueError(
+                    f"reconcile_report from unregistered node {node_hex}"
+                )
+            rec = self._recovery_nodes.setdefault(
+                node_hex,
+                {"status": "waiting", "asked_t": 0.0, "asks": 0},
+            )
+            if rec["status"] == "done":
+                self.recovery_counters["reconcile_duplicates"] += 1
+                return {"status": "duplicate", "drop_tasks": [],
+                        "drop_actors": [], "drop_objects": []}
+            rec["status"] = "done"
+            # --- held normal-task leases: resume under this node ---
+            for tid_bin in report.get("task_leases") or ():
+                entry = self._recovery_parked.pop(tid_bin, None)
+                if entry is not None:
+                    pt = entry[0]
+                elif (pt_q := self.pending_by_id.get(
+                        TaskID(tid_bin))) is not None and \
+                        self._unqueue_pending_locked(pt_q):
+                    # lease record lost in the fsync window: the agent's
+                    # possession is the truth — adopt the queued copy
+                    pt = pt_q
+                else:
+                    drop_tasks.append(tid_bin)
+                    self.recovery_counters["orphan_tasks_reaped"] += 1
+                    continue
+                node.leased[tid_bin] = pt
+                node.allocate(pt.spec.resources)
+                pt._node = node  # type: ignore[attr-defined]
+                self._tenant_charge(
+                    self._tenant_for(pt.spec), pt.spec.resources
+                )
+                self.recovery_counters["leases_resumed"] += 1
+            # --- creation leases still owned by the agent's spawner ---
+            for tid_bin in report.get("actor_leases") or ():
+                entry = self._recovery_parked.pop(tid_bin, None)
+                if entry is None:
+                    drop_tasks.append(tid_bin)
+                    self.recovery_counters["orphan_tasks_reaped"] += 1
+                    continue
+                pt = entry[0]
+                node.actor_leases[tid_bin] = pt
+                node.allocate(pt.spec.resources)
+                pt._node = node  # type: ignore[attr-defined]
+                self._tenant_charge(
+                    self._tenant_for(pt.spec), pt.spec.resources
+                )
+                self.recovery_counters["creation_leases_resumed"] += 1
+            # --- alive actors: rebind by identity ---
+            for aid_bin, wid_bin, direct_address, pid in (
+                report.get("actors") or ()
+            ):
+                actor = self.actors.get(ActorID(aid_bin))
+                tid_bin = TaskID.for_actor_creation(ActorID(aid_bin)).binary()
+                if tid_bin in node.actor_leases:
+                    continue  # creation resumed above; actor_placed will bind
+                if actor is None or actor.state == "DEAD":
+                    drop_actors.append(aid_bin)
+                    self.recovery_counters["orphan_actors_reaped"] += 1
+                    continue
+                wid = WorkerID(wid_bin)
+                handle = self.workers.get(wid)
+                if handle is None:
+                    handle = WorkerHandle(
+                        wid, node.node_id, conn=_RelayConn(agent, wid),
+                    )
+                    handle.agent = agent
+                    handle.agent_owned = True
+                    handle.registered.set()
+                    self.workers[wid] = handle
+                handle.actor_id = actor.actor_id
+                if direct_address and not handle.direct_address:
+                    handle.direct_address = direct_address
+                self._recovery_placements.pop(aid_bin, None)
+                self._recovery_unplaced_actors.pop(aid_bin, None)
+                actor.state = "ALIVE"
+                actor.worker = handle
+                node.allocate(actor.creation_spec.resources)
+                actor.held = (
+                    node, None, dict(actor.creation_spec.resources)
+                )
+                self._tenant_charge(
+                    self._tenant_for(actor.creation_spec),
+                    actor.creation_spec.resources,
+                )
+                self.pending_by_id.pop(
+                    TaskID.for_actor_creation(actor.actor_id), None
+                )
+                self.recovery_counters["actors_rebound"] += 1
+                self._journal(
+                    "placed",
+                    (aid_bin, node_hex, wid_bin, direct_address),
+                )
+                self.publish(
+                    "actors",
+                    {"actor_id": actor.actor_id.hex(), "state": "ALIVE"},
+                )
+                self._pump_actor(actor)
+            # --- surviving pool workers: rebuild identity tracking (their
+            # own control-plane ops — stacks, log fetch — need handles;
+            # the lazy FromWorker path would rebuild them too, but only on
+            # the worker's NEXT message) ---
+            for wid_bin, _pid in report.get("workers") or ():
+                wid = WorkerID(wid_bin)
+                if wid not in self.workers:
+                    handle = WorkerHandle(
+                        wid, node.node_id, conn=_RelayConn(agent, wid),
+                    )
+                    handle.agent = agent
+                    handle.agent_owned = True
+                    handle.registered.set()
+                    self.workers[wid] = handle
+            # --- arena inventory: confirm journaled seal locations ---
+            for oid_bin, name, size, is_replica in (
+                report.get("objects") or ()
+            ):
+                oid = ObjectID(oid_bin)
+                if is_replica:
+                    # secondary copies re-enter the replica directory (the
+                    # location string carries the arena)
+                    self._register_replica_entry(oid, name, int(size))
+                    continue
+                if self._recovery_objects.pop(oid_bin, None) is None:
+                    if not self.memory_store.contains(oid):
+                        drop_objects.append(oid_bin)
+                        self.recovery_counters["orphan_objects_reaped"] += 1
+                    continue
+                self.ref_counts[oid] += 1  # recovery pin
+                self.recovery_counters["objects_restored"] += 1
+            self.sched_cv.notify_all()
+        # re-seal confirmed primaries OUTSIDE the lock (store ops lock
+        # themselves); membership tracking rides _seal_plasma
+        dropped = set(drop_objects)
+        for oid_bin, name, size, is_replica in report.get("objects") or ():
+            if is_replica or oid_bin in dropped:
+                continue
+            oid = ObjectID(oid_bin)
+            if not self.memory_store.contains(oid):
+                try:
+                    self._seal_plasma(oid, name, int(size))
+                    self._on_object_sealed(oid)
+                except Exception:  # noqa: BLE001 — one object, not the node
+                    logger.warning(
+                        "could not restore object %s", oid.hex(),
+                        exc_info=True,
+                    )
+        # completion reports the crashed head never journaled: resume the
+        # lease, then run the normal done path (seal + release + unpin)
+        for tid_bin, results, exec_ms in completed_entries:
+            with self.lock:
+                entry = self._recovery_parked.pop(tid_bin, None)
+                pt = entry[0] if entry else None
+                if pt is None:
+                    pt_q = self.pending_by_id.get(TaskID(tid_bin))
+                    if pt_q is not None and self._unqueue_pending_locked(pt_q):
+                        pt = pt_q
+                if pt is not None:
+                    node.leased[tid_bin] = pt
+            if pt is None:
+                continue  # already journaled done pre-crash
+            self._on_agent_task_done(
+                agent,
+                P.AgentTaskDone(TaskID(tid_bin), results, exec_ms=exec_ms),
+            )
+            self.recovery_counters["completions_recovered"] += 1
+        logger.info(
+            "node %s reconciled: +%d task lease(s), +%d creation lease(s), "
+            "%d actor(s) rebound, %d completion(s) recovered; reaping "
+            "%d/%d/%d orphan task/actor/object(s)",
+            node_hex[:8],
+            len(report.get("task_leases") or ()) - len(drop_tasks),
+            len(report.get("actor_leases") or ()),
+            self.recovery_counters.get("actors_rebound", 0),
+            len(completed_entries),
+            len(drop_tasks), len(drop_actors), len(drop_objects),
+        )
+        return {
+            "status": "ok",
+            "drop_tasks": drop_tasks,
+            "drop_actors": drop_actors,
+            "drop_objects": drop_objects,
+        }
+
+    def _finish_recovery(self, reason: str):
+        """Close the RECOVERING phase: re-place journal-granted work no
+        agent confirmed, re-create unconfirmed actors, drop unconfirmed
+        object locations, open the dispatch loop."""
+        with self.lock:
+            if not self.recovering:
+                return
+            self.recovering = False
+            parked, self._recovery_parked = self._recovery_parked, {}
+            # unconfirmed placements need no processing of their own: every
+            # parked placement also lives in _recovery_unplaced_actors,
+            # which the re-create loop below drains
+            self._recovery_placements.clear()
+            unplaced, self._recovery_unplaced_actors = (
+                self._recovery_unplaced_actors, {},
+            )
+            lost_objs, self._recovery_objects = self._recovery_objects, {}
+            for tid_bin, (pt, _node_hex, is_actor) in parked.items():
+                if is_actor:
+                    # the creation lease never re-confirmed: re-place via
+                    # the normal lease path (budget untouched — the node
+                    # vanished, not the actor)
+                    self._enqueue_ready(pt)
+                    self.recovery_counters["creation_leases_replaced"] += 1
+                else:
+                    self._enqueue_ready(pt)
+                    self.recovery_counters["leases_replaced"] += 1
+            self.sched_cv.notify_all()
+        # actors whose placement/creation never re-confirmed: re-create
+        # through the normal submit path (restart semantics)
+        recreated = 0
+        for aid_bin, (spec, name) in unplaced.items():
+            with self.lock:
+                actor = self.actors.get(ActorID(aid_bin))
+                if actor is None or actor.state in ("DEAD", "ALIVE"):
+                    continue  # reaped, or a late reconcile rebound it
+                if spec.task_id in self.pending_by_id:
+                    continue  # parked creation requeued above
+                actor.state = "PENDING"
+                for oid in spec.return_ids():
+                    self.ref_counts[oid] += 1  # recovery pin
+            try:
+                self._submit_replayed(spec)
+                recreated += 1
+            except Exception:
+                logger.warning(
+                    "could not re-create actor %s",
+                    name or spec.actor_id.hex(), exc_info=True,
+                )
+        self.recovery_counters["actors_recreated"] += recreated
+        dur = time.time() - self.recovery_info.get("started_t", time.time())
+        self.recovery_info.update(
+            finished_t=time.time(),
+            duration_s=dur,
+            reason=reason,
+            nodes_reconciled=sum(
+                1 for r in self._recovery_nodes.values()
+                if r["status"] == "done"
+            ),
+            lost_objects=len(lost_objs),
+        )
+        # recovery spans ride the PR 14 tracing plane (head-local ring →
+        # merged timeline)
+        try:
+            from ray_tpu.util import tracing
+
+            if tracing.enabled():
+                tracing.record_span(
+                    "head.recovery",
+                    self.recovery_info.get("started_t", time.time()),
+                    time.time(),
+                    plane="head",
+                    reason=reason,
+                    nodes=self.recovery_info.get("nodes_reconciled", 0),
+                )
+        except Exception:  # noqa: BLE001
+            pass
+        # getters blocked on objects that never re-confirmed must fail,
+        # not hang (lineage reconstruction still gets its chance)
+        if lost_objs:
+            self._maybe_recover([ObjectID(o) for o in lost_objs])
+        self._seal_lost_objects(
+            list(lost_objs) + self._recovery_dropped_plasma
+        )
+        self._recovery_dropped_plasma = []
+        self._fail_unrecoverable_waiters()
+        logger.warning(
+            "head recovery finished (%s) in %.2fs: %s", reason, dur,
+            {k: v for k, v in self.recovery_counters.items() if v},
+        )
+        # recovery settled: compact so the next restart replays this state
+        self.compact_now()
+
+    def recovery_report(self) -> dict:
+        """The ``recovery_stats`` op: WAL health + recovery phase/counters
+        (the ``ray-tpu recovery`` CLI and state API surface)."""
+        w = self._wal
+        with self.lock:
+            out = {
+                "recovering": self.recovering,
+                "phase": "recovering" if self.recovering else "normal",
+                "nodes": {
+                    h: r["status"] for h, r in self._recovery_nodes.items()
+                },
+                "parked_leases": len(self._recovery_parked),
+                "parked_placements": len(self._recovery_placements),
+                "parked_objects": len(self._recovery_objects),
+                "counters": {
+                    k: v for k, v in self.recovery_counters.items()
+                },
+                "last_recovery": dict(self.recovery_info),
+            }
+        out["wal"] = (
+            {
+                "enabled": True,
+                "path": w.path,
+                "healthy": w.healthy,
+                "appends": w.appends,
+                "flushes": w.flushes,
+                "errors": w.errors,
+                "bytes_written": w.bytes_written,
+                "size_bytes": w.size_bytes(),
+            }
+            if w is not None
+            else {"enabled": False}
+        )
+        return out
 
     def _fail_unrecoverable_waiters(self):
         with self.lock:
@@ -1275,6 +2307,12 @@ class Controller:
                 return  # unknown or already being removed
             node.alive = False
             agent = self.agents.pop(node_id, None)
+            rec = self._recovery_nodes.get(node_id.hex())
+            if rec is not None and rec["status"] != "done":
+                # a reconciling node died mid-recovery: stop waiting on it
+                # (its journaled leases re-place below / at the deadline)
+                rec["status"] = "done"
+        self._journal("node_down", node_id.hex())
         if agent is not None:
             try:
                 agent.send(P.Shutdown())
@@ -1323,6 +2361,10 @@ class Controller:
         # tasks leased to the dead node's agent: retry elsewhere or fail
         failed_leased: list = []
         with self.lock:
+            for tid_b in node.leased:
+                self._journal("unlease", tid_b)
+            for tid_b in node.actor_leases:
+                self._journal("unlease", tid_b)
             for pt in node.leased.values():
                 self._release_task_resources(pt)
                 if pt.retries_left > 0:
@@ -1651,7 +2693,17 @@ class Controller:
         from ray_tpu._private.object_store import ObjectExistsError
 
         if sobj.total_bytes() <= self.config.max_inline_object_size or is_error:
-            self.memory_store.put(object_id, ("error" if is_error else "inline", sobj))
+            kind = "error" if is_error else "inline"
+            self.memory_store.put(object_id, (kind, sobj))
+            if (
+                self._wal is not None
+                and not self._wal_suppress
+                and self._wal.healthy
+            ):
+                # flatten only when actually journaling: to_bytes() copies
+                self._journal(
+                    "seal", (object_id.binary(), kind, sobj.to_bytes())
+                )
         else:
             data = sobj.to_bytes()
             try:
@@ -1696,6 +2748,10 @@ class Controller:
         store = self._store_for_location(name)
         store.seal(object_id, name, size)  # idempotent
         self.memory_store.put(object_id, ("plasma", (name, size)))
+        # agent-arena locations replay as parked entries a reconciling
+        # agent confirms; head-arena payloads die with this process (the
+        # record still dedups a completed task against re-execution)
+        self._journal("seal", (object_id.binary(), "plasma", (name, size)))
         with self.lock:
             if getattr(store, "is_remote", False):
                 # resident on an agent's arena: the agent owns spilling;
@@ -2357,6 +3413,7 @@ class Controller:
         # secondary copies die with the primary: a freed-then-recreated id
         # must never be served from a stale replica
         self._drop_replicas(object_id)
+        self._journal("free", object_id.binary())
 
     # ------------------------------------------------------------- submission
 
@@ -2412,8 +3469,35 @@ class Controller:
         self._validate_runtime_env(spec)
         self._record_lineage(spec)
         with self.lock:
+            # idempotent replay (same dedup as submit_batch): a client's
+            # retry envelope re-sends this op across a head restart — the
+            # spec may already be pending (replayed from the journal, or
+            # resumed as a live lease on a reconciled agent) or already
+            # completed; re-enqueueing would execute it twice and orphan
+            # the overwritten PendingTask's bookkeeping
+            rets = spec.return_ids()
+            if spec.task_id in self.pending_by_id or (
+                rets and self.memory_store.contains(rets[0])
+            ):
+                return
             self._submit_one_locked(spec)
             self.sched_cv.notify_all()
+        self._journal("submit", (spec, None))
+        self._persist_state()
+
+    def _submit_replayed(self, spec: TaskSpec):
+        """Recovery-path submission: dedups on PENDING only. Actor
+        re-creation legitimately re-runs a creation task whose pre-crash
+        RESULT is journal-sealed — the full sealed-returns dedup of
+        submit_task would silently skip the respawn."""
+        self._validate_runtime_env(spec)
+        self._record_lineage(spec)
+        with self.lock:
+            if spec.task_id in self.pending_by_id:
+                return
+            self._submit_one_locked(spec)
+            self.sched_cv.notify_all()
+        self._journal("submit", (spec, None))
         self._persist_state()
 
     def _submit_one_locked(self, spec: TaskSpec):
@@ -2515,6 +3599,7 @@ class Controller:
                     for oid in rets:
                         self.ref_counts[oid] += 1
                     self._submit_one_locked(spec)
+                    self._journal("submit", (spec, name))
                 else:
                     logger.error("submit_batch: unknown item kind %r", kind)
             self.sched_cv.notify_all()
@@ -2670,6 +3755,15 @@ class Controller:
             ts.configured = True
             snap = ts.snapshot()
             self.sched_cv.notify_all()
+            self._journal(
+                "tenant",
+                {
+                    "name": ts.name,
+                    "weight": ts.weight,
+                    "priority": ts.priority,
+                    "quota": dict(ts.quota) if ts.quota else None,
+                },
+            )
         self._persist_state()
         return snap
 
@@ -2753,15 +3847,20 @@ class Controller:
                     # Retry placement of pending placement groups whenever
                     # the cluster state may have changed (resources freed,
                     # nodes joined) — reference: GcsPlacementGroupMgr retries.
-                    for pg in self.placement_groups.values():
-                        if not pg.removed and not pg.ready.is_set():
-                            if self._try_place_pg(pg):
-                                progressed = True
-                    # Priority preemption: a higher-priority tenant starved
-                    # past the bounded wait drains lower-priority
-                    # restartable actors (checked every round — other
-                    # tenants progressing must not mask the starvation).
-                    self._maybe_preempt_locked()
+                    # Gated while RECOVERING (like dispatch): bundles must
+                    # not reserve capacity that reconciling leases will
+                    # re-claim.
+                    if not self.recovering:
+                        for pg in self.placement_groups.values():
+                            if not pg.removed and not pg.ready.is_set():
+                                if self._try_place_pg(pg):
+                                    progressed = True
+                        # Priority preemption: a higher-priority tenant
+                        # starved past the bounded wait drains
+                        # lower-priority restartable actors (checked every
+                        # round — other tenants progressing must not mask
+                        # the starvation).
+                        self._maybe_preempt_locked()
                     # one LeaseBatch push per agent carrying every grant
                     # this round made (batched wire ops, PR 12)
                     self._flush_lease_outbox_locked()
@@ -2799,6 +3898,11 @@ class Controller:
         arbitration, PAPER.md L5). Over-QUOTA heads park (blocked without
         an autoscale hint or starvation clock); heads that fail placement
         start the starvation clock priority preemption reads."""
+        if self.recovering:
+            # RECOVERING gate: nothing dispatches until every journaled
+            # agent reconciled (or the grace deadline lapsed) — dispatching
+            # a parked-but-unconfirmed lease would execute it twice
+            return False
         progressed = False
         blocked: set = set()  # (tenant, shape) held out for this round
         while True:
@@ -2863,6 +3967,13 @@ class Controller:
                     # own (different) demand
                     ts.starved_since = time.monotonic()
                     ts.starved_head = pt
+        if progressed and self._ttfd_pending:
+            # first real dispatch after a restart's restore: the
+            # recovery bench / recovery_stats read this
+            self._ttfd_pending = False
+            self.recovery_info["time_to_first_dispatch_s"] = (
+                time.monotonic() - self._boot_t
+            )
         return progressed
 
     def _drr_next_locked(self, blocked: set):
@@ -3053,13 +4164,18 @@ class Controller:
         # queued, not sent: the scheduling round's grants for this agent
         # coalesce into one LeaseBatch push at round end (flush failure
         # requeues the lease — see _flush_lease_outbox_locked)
+        # driver config overrides ride the lease's env_vars (the agent's
+        # pool workers rebuild Config.from_env() from them, same as
+        # _spawn_worker_process's exports); explicit runtime_env vars win
+        lease_env = dict(self._child_env_overrides)
+        lease_env.update((spec.runtime_env or {}).get("env_vars") or {})
         self._queue_lease_locked(
             node,
             P.LeaseTask(
                 spec,
                 resolved_args,
                 bool(spec.resources.get("TPU")),
-                dict((spec.runtime_env or {}).get("env_vars") or {}),
+                lease_env,
             ),
         )
         if pg_bundle is not None:
@@ -3072,6 +4188,7 @@ class Controller:
         tenant = self._tenant_for(spec)
         self._tenant_charge(tenant, demand)
         node.leased[spec.task_id.binary()] = pt
+        self._journal("lease", (spec.task_id.binary(), node.node_id.hex()))
         pt.dispatch_t = time.time()
         self.pending_demand.pop(
             (tenant, tuple(sorted(demand.items()))), None
@@ -3115,8 +4232,11 @@ class Controller:
         # env_vars ship RAW (str-coerced only at spawn, like LeaseTask):
         # the agent's warm pool is keyed on (tpu, env_vars) and task leases
         # ship raw values — coercing here would make every non-str value
-        # miss the pool and silently defeat the warm pop path
-        env_vars = dict(rt.get("env_vars") or {})
+        # miss the pool and silently defeat the warm pop path. Driver
+        # config overrides ride underneath (explicit vars win), so the
+        # actor's worker sees the same resolved table as head-local spawns.
+        env_vars = dict(self._child_env_overrides)
+        env_vars.update(rt.get("env_vars") or {})
         env_vars.update(extra_env)
         # queued, not sent: coalesced into the round's LeaseBatch for this
         # agent (flush failure requeues — the creation lease protocol is
@@ -3144,6 +4264,7 @@ class Controller:
         tenant = self._tenant_for(spec)
         self._tenant_charge(tenant, demand)
         node.actor_leases[spec.task_id.binary()] = pt
+        self._journal("alease", (spec.task_id.binary(), node.node_id.hex()))
         pt.dispatch_t = time.time()
         self.pending_demand.pop(
             (tenant, tuple(sorted(demand.items()))), None
@@ -3209,6 +4330,7 @@ class Controller:
             pt = table.pop(tid_b, None)
             if pt is None:
                 continue  # killed/reclaimed meanwhile
+            self._journal("unlease", tid_b)
             self._release_task_resources(pt)
             self._enqueue_ready(pt)
         self.sched_cv.notify_all()
@@ -3222,7 +4344,7 @@ class Controller:
         and yielded entirely when any OTHER tenant has queued work (the DRR
         pop must arbitrate — the same fairness yield _try_pipeline makes),
         so quotas and weighted shares hold exactly as without the cache."""
-        if not self.config.agent_lease_cache:
+        if not self.config.agent_lease_cache or self.recovering:
             return
         if node is None or not node.schedulable or node.agent is not agent:
             return
@@ -3865,22 +4987,8 @@ class Controller:
         # env var — otherwise `init(config={...})` knobs (serve admission
         # budgets, transfer windows, batching) silently reset to defaults
         # inside process-mode workers. Ambient env pins win untouched.
-        import dataclasses as _dc
-
-        _defaults = Config()
-        for _f in _dc.fields(Config):
-            _cur = getattr(self.config, _f.name)
-            if _cur == getattr(_defaults, _f.name):
-                continue
-            _key = "RAY_TPU_" + _f.name.upper()
-            if _key in env:
-                continue
-            if isinstance(_cur, bool):
-                env[_key] = "1" if _cur else "0"
-            elif isinstance(_cur, (int, float, str)):
-                env[_key] = str(_cur)
-            else:
-                env[_key] = json.dumps(_cur)
+        for _key, _val in self._child_env_overrides.items():
+            env.setdefault(_key, _val)
         # Make the ray_tpu package + the driver's modules importable in the
         # fresh interpreter (reference: services.py propagates sys.path).
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -3985,7 +5093,10 @@ class Controller:
         worker_id = WorkerID.from_random()
         rt = spec_hint.runtime_env or {}
         packages, extra_env = self._runtime_packages(rt)
-        env_vars = {k: str(v) for k, v in (rt.get("env_vars") or {}).items()}
+        env_vars = dict(self._child_env_overrides)
+        env_vars.update(
+            {k: str(v) for k, v in (rt.get("env_vars") or {}).items()}
+        )
         env_vars.update(extra_env)
         handle = WorkerHandle(
             worker_id, node_id, proc=None, conn=_RelayConn(agent, worker_id)
@@ -4192,6 +5303,27 @@ class Controller:
         GCS, ``gcs_node_manager``). The agent owns its host's worker pool
         and arena; the controller records the node, routes spawns through
         the agent, and reads the node's objects over its data listener."""
+        resume = getattr(msg, "resume", False)
+        if resume:
+            # boot replay may still be parking this node's journaled leases
+            # — deciding the resume verdict (or applying a reconcile
+            # report) against a half-restored table would reap held work
+            # as orphans and double-execute it after re-place
+            self._restore_done.wait(timeout=60.0)
+        if resume and not self.recovering:
+            # preserved-state re-attach refused: either the head never died
+            # (its reader EOF already re-placed this node's leases) or the
+            # recovery window closed (journaled leases were re-placed at
+            # the deadline) — accepting held work now would execute it
+            # twice. The agent resets and re-registers fresh.
+            try:
+                conn.send(
+                    P.AgentAck(msg.node_id.hex(), resume_verdict="reset")
+                )
+            except (OSError, EOFError):
+                pass
+            conn.close()
+            return
         with self.lock:
             existing = self.nodes.get(msg.node_id)
         if existing is not None and existing.alive:
@@ -4206,7 +5338,12 @@ class Controller:
         # pick this node, a SpawnWorker may be serialized onto the conn, and
         # the joining agent's blocking recv expects the ack first.
         try:
-            agent.send(P.AgentAck(msg.node_id.hex()))
+            agent.send(
+                P.AgentAck(
+                    msg.node_id.hex(),
+                    resume_verdict="reconcile" if resume else "fresh",
+                )
+            )
         except (OSError, EOFError):
             conn.close()
             return
@@ -4228,9 +5365,11 @@ class Controller:
                 self._threads.append(t)
             self.sched_cv.notify_all()
         logger.info(
-            "node agent registered: %s host=%s resources=%s",
+            "node agent registered: %s host=%s resources=%s%s",
             msg.node_id.hex()[:8], msg.hostname, msg.resources,
+            " (resume: reconciling)" if resume else "",
         )
+        self._journal("node_up", msg.node_id.hex())
         self.publish(
             "nodes",
             {
@@ -4240,6 +5379,10 @@ class Controller:
                 "hostname": msg.hostname,
             },
         )
+        if resume:
+            # ask for the node's truth; the agent answers with the
+            # reconcile_report op on this connection
+            self._ask_reconcile(agent)
         self._agent_reader(agent)
 
     def _agent_reader(self, agent: AgentHandle):
@@ -4462,6 +5605,7 @@ class Controller:
             self.memory_store.put(
                 object_id, (kind, SerializedObject.from_buffer(payload))
             )
+            self._journal("seal", (object_id.binary(), kind, bytes(payload)))
         else:
             shm_name, size = payload
             self._seal_plasma(object_id, shm_name, size)
@@ -5062,6 +6206,13 @@ class Controller:
                     }
                     for pg_id, pg in self.placement_groups.items()
                 ]
+        if op == "reconcile_report":
+            # a re-attached agent's truth during head recovery: held
+            # task/creation leases, alive actors (with incarnations),
+            # recently-completed reports, arena inventory — the reply
+            # carries the orphan verdicts the agent must reap
+            node_hex, report = payload
+            return self._apply_reconcile_report(node_hex, report)
         if op == "set_tenant_quota":
             tenant, quota, weight, priority = payload
             return self.set_tenant_quota(
@@ -5077,6 +6228,7 @@ class Controller:
             ns, key, value = payload
             with self._kv_lock:
                 self.kv[(ns, key)] = value
+            self._journal("kv_put", (ns, key, value))
             self._persist_kv()
             return None
         if op == "kv_get":
@@ -5088,6 +6240,7 @@ class Controller:
             with self._kv_lock:
                 existed = self.kv.pop((ns, key), None) is not None
             if existed:
+                self._journal("kv_del", (ns, key))
                 self._persist_kv()
             return existed
         if op == "kv_keys":
@@ -5179,6 +6332,9 @@ class Controller:
                     for pid, rec in self._proxy_stats.items()
                     if payload is None or pid.startswith(payload)
                 }
+        if op == "recovery_stats":
+            # WAL health + recovery phase/counters (ray-tpu recovery CLI)
+            return self.recovery_report()
         if op == "pubsub_poll":
             channel, after_seq, timeout = payload
             return self.pubsub_poll(channel, after_seq, min(timeout, 30.0))
@@ -5334,6 +6490,22 @@ class Controller:
                     "serve proxy point-in-time values (inflight, queued)",
                     tag_keys=("proxy", "field"),
                 ),
+                "recovery": M.Counter(
+                    "rtpu_recovery_events_total",
+                    "head fault-tolerance counters (WAL appends/errors/"
+                    "compactions, reconcile asks, leases resumed/replaced, "
+                    "actors rebound, orphans reaped)",
+                    tag_keys=("event",),
+                ),
+                "wal_errors": M.Counter(
+                    "rtpu_wal_errors",
+                    "write-ahead-journal write failures (each one degrades "
+                    "durability to snapshot-only — never a silent hole)",
+                ),
+                "recovering": M.Gauge(
+                    "rtpu_recovering",
+                    "1 while the head is in its bounded RECOVERING phase",
+                ),
             }
         return self._core_metrics
 
@@ -5372,10 +6544,28 @@ class Controller:
             proxies = {
                 pid: dict(rec) for pid, rec in self._proxy_stats.items()
             }
+            recovery = dict(self.recovery_counters)
+            recovering = self.recovering
+        w = self._wal
+        if w is not None:
+            recovery["wal_appends"] = w.appends
+            recovery["wal_flushes"] = w.flushes
+            recovery["wal_bytes_written"] = w.bytes_written
+            self._mirror_counter(
+                m["wal_errors"], ("wal_errors",), {},
+                float(w.errors + recovery.get("wal_errors", 0)),
+            )
+        elif recovery.get("wal_errors"):
+            self._mirror_counter(
+                m["wal_errors"], ("wal_errors",), {},
+                float(recovery["wal_errors"]),
+            )
+        m["recovering"].set(1.0 if recovering else 0.0)
         for table, mkey in (
             (lease, "lease"),
             (transfer, "transfer"),
             (creation, "actor_creation"),
+            (recovery, "recovery"),
         ):
             for ev, v in table.items():
                 self._mirror_counter(
@@ -5512,6 +6702,7 @@ class Controller:
                 self.memory_store.put(
                     oid, (kind, SerializedObject.from_buffer(payload))
                 )
+                self._journal("seal", (oid.binary(), kind, bytes(payload)))
             self._on_object_sealed(oid)
 
     def _on_agent_task_done(self, agent: AgentHandle, msg: P.AgentTaskDone):
@@ -5547,6 +6738,7 @@ class Controller:
             self._release_task_resources(pt)
             self.pending_by_id.pop(spec.task_id, None)
             self._unpin_task_deps(pt)
+            self._journal("done", spec.task_id.binary())
             # agent lease cache: hand the freed capacity the next queued
             # same-(tenant, shape) spec right here — no scheduler wake, no
             # grant round trip (refused like an over-quota grant when the
@@ -5569,6 +6761,7 @@ class Controller:
                 pt = node.leased.pop(tid_b, None)
                 if pt is None:
                     continue
+                self._journal("unlease", tid_b)
                 self._release_task_resources(pt)
                 if msg.reason == "worker_died":
                     if pt.retries_left <= 0:
@@ -5619,12 +6812,14 @@ class Controller:
             self.pending_by_id.pop(spec.task_id, None)
             self._stream_consumed.pop(spec.task_id, None)
             self._unpin_task_deps(pt)
+            self._journal("done", spec.task_id.binary())
             if spec.is_actor_creation():
                 actor = self.actors.get(spec.actor_id)
                 if actor is not None:
                     if failed:
                         actor.state = "DEAD"
                         actor.death_cause = "creation task failed"
+                        self._journal("actor_dead", actor.actor_id.binary())
                         self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "DEAD", "reason": "creation task failed"})
                         self._drain_actor_queue(actor)
                         # the worker survives a raising __init__ — back to
@@ -5787,6 +6982,7 @@ class Controller:
             actor.worker = None
             actor.inflight = 0
             self._release_actor_resources(actor)
+            self._journal("unplaced", actor_id.binary())
             migrating = getattr(actor, "_drain_migrating", False)
             actor._drain_migrating = False
             actor._drain_hold = False
@@ -5796,6 +6992,13 @@ class Controller:
                     # a drain-driven migration is a controlled respawn, not a
                     # failure — it must not consume the restart budget
                     actor.restarts_left -= 1
+                    # journal the charge: with a healthy WAL the per-mutation
+                    # snapshot flusher is off, and a replayed "submit" record
+                    # would otherwise refill the budget after a head restart
+                    self._journal(
+                        "restarts",
+                        (actor_id.binary(), actor.restarts_left),
+                    )
                 actor.state = "RESTARTING"
                 self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "RESTARTING", "reason": reason})
                 # Re-pin creation args for the restart run (the original pins
@@ -5816,6 +7019,7 @@ class Controller:
             else:
                 actor.state = "DEAD"
                 actor.death_cause = reason
+                self._journal("actor_dead", actor_id.binary())
                 self.publish("actors", {"actor_id": actor.actor_id.hex(), "state": "DEAD", "reason": reason})
                 self._drain_actor_queue(actor)
                 self._persist_state()
@@ -5873,12 +7077,21 @@ class Controller:
         sobj = self.serialization.serialize(
             TaskError(pt.spec.name, error) if not isinstance(error, TaskError) else error
         )
+        if (
+            self._wal is not None
+            and not self._wal_suppress
+            and self._wal.healthy
+        ):
+            blob = sobj.to_bytes()
+            for oid in pt.spec.return_ids():
+                self._journal("seal", (oid.binary(), "error", blob))
         for oid in pt.spec.return_ids():
             self.memory_store.put(oid, ("error", sobj))
             self._on_object_sealed(oid)
         with self.lock:
             self.pending_by_id.pop(pt.spec.task_id, None)
             self._unpin_task_deps(pt)
+            self._journal("done", pt.spec.task_id.binary())
 
     def _unpin_task_deps(self, pt: PendingTask):
         """Release the submission-time pins on a task's args exactly once."""
@@ -5993,6 +7206,14 @@ class Controller:
             self._register_log_meta(
                 worker_id, label=(spec.name or "").rsplit(".", 1)[0] or None
             )
+            self._journal("done", spec.task_id.binary())
+            self._journal(
+                "placed",
+                (
+                    actor_id.binary(), agent.node_id.hex(),
+                    worker_id.binary(), handle.direct_address,
+                ),
+            )
             self._pump_actor(actor)
             self.sched_cv.notify_all()
         self._persist_state()
@@ -6027,9 +7248,14 @@ class Controller:
             requeue = retryable and (
                 reason == "draining" or actor.restarts_left != 0
             )
+            self._journal("unlease", tid.binary())
             if requeue:
                 if reason != "draining" and actor.restarts_left > 0:
                     actor.restarts_left -= 1
+                    self._journal(
+                        "restarts",
+                        (actor_id.binary(), actor.restarts_left),
+                    )
                 pt._avoid_node = agent.node_id  # type: ignore[attr-defined]
                 self._enqueue_ready(pt)
                 self.actor_creation_stats["lease_retries"] += 1
@@ -6061,6 +7287,8 @@ class Controller:
             self._unpin_task_deps(pt)
             actor.state = "DEAD"
             actor.death_cause = reason
+            self._journal("done", tid.binary())
+            self._journal("actor_dead", actor_id.binary())
             self.actor_creation_stats["failed"] += 1
             self.publish(
                 "actors",
@@ -6092,6 +7320,7 @@ class Controller:
                 self.named_actors[name] = spec.actor_id
             self._submit_one_locked(spec)
             self.sched_cv.notify_all()
+        self._journal("submit", (spec, name))
         self._persist_state()
         return actor
 
@@ -6126,6 +7355,7 @@ class Controller:
                 if actor is not None:
                     actor.state = "DEAD"
                     actor.death_cause = "killed via ray_tpu.kill"
+                    self._journal("actor_dead", actor_id.binary())
                     self.publish("actors", {"actor_id": actor_id.hex(), "state": "DEAD", "reason": "killed via ray_tpu.kill"})
                     self._release_actor_resources(actor)
                     self._drain_actor_queue(actor)
@@ -6165,6 +7395,7 @@ class Controller:
         with self.lock:
             self.placement_groups[pg_id] = pg
             self._try_place_pg(pg)
+        self._journal("pg", (pg_id, list(bundles), strategy))
         self._persist_state()
         return pg_id
 
@@ -6234,6 +7465,7 @@ class Controller:
                 node = self.nodes.get(nid)
                 if node is not None:
                     node.release(pg.bundles[i])
+        self._journal("pg_remove", pg_id)
         self._persist_state()
 
     def pg_ready(self, pg_id: PlacementGroupID, timeout=None) -> bool:

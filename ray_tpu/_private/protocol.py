@@ -403,6 +403,8 @@ CONTROLLER_OPS = frozenset(
         "pull_into_arena",
         "pull_object_chunk",
         "push_object_chunk",
+        "reconcile_report",
+        "recovery_stats",
         "register_replica",
         "remove_node",
         "report_agent_spill",
@@ -475,7 +477,107 @@ def parse_worker_chaos_table(spec: str) -> dict:
 # "lease_batch" covers the batched grant push (``LeaseBatch``): an injected
 # failure drops the WHOLE batch before the wire, and the scheduler requeues
 # every lease it carried — exercising idempotent re-grant of a lost batch.
-AGENT_PUSH_OPS = frozenset({"lease_actor", "lease_batch"})
+# "agent_reconcile" covers the recovery ask (``AgentReconcile``): an injected
+# failure drops the push before the wire, exercising the head's single
+# bounded re-ask (see Controller._recovery_monitor).
+AGENT_PUSH_OPS = frozenset({"agent_reconcile", "lease_actor", "lease_batch"})
+
+
+# Controller-internal chaos channels that are neither request ops nor agent
+# pushes: "wal_write" fails the next write-ahead-journal flush, exercising
+# the loud degrade to snapshot-only durability (rtpu_wal_errors counter,
+# never a silent hole in the log).
+INTERNAL_CHAOS_OPS = frozenset({"wal_write"})
+
+
+# ---- per-op idempotency classes (client-transparent head reconnect) -------
+#
+# The retry envelope around controller calls (worker_runtime.call_controller
+# / DriverAPI.controller_call) consults these when a call is interrupted by
+# a head restart: READ ops replay freely, IDEMPOTENT writes replay safely
+# (the head dedups — replayed submit_batch/submit_task skip specs already
+# pending or sealed; seals/frees/kv writes converge), and everything else
+# surfaces a typed ``HeadRestartedError`` instead of guessing.
+
+READ_ONLY_OPS = frozenset(
+    {
+        "actor_creation_stats",
+        "actor_direct_endpoint",
+        "actor_state",
+        "autoscaler_state",
+        "available_resources",
+        "cluster_metrics",
+        "cluster_resources",
+        "debug_worker_msg_count",
+        "drain_status",
+        "get_named_actor",
+        "head_arena",
+        "kv_get",
+        "kv_keys",
+        "list_actors",
+        "list_objects",
+        "list_placement_groups",
+        "list_tasks",
+        "list_workers",
+        "log_get",
+        "log_list",
+        "log_tail_buffer",
+        "nodes",
+        "object_locations",
+        "pg_ready",
+        "pg_table",
+        "proxy_stats",
+        "pubsub_poll",
+        "pull_object_chunk",
+        "recovery_stats",
+        "stream_consumed_get",
+        "task_events",
+        "tasks_pending",
+        "tenant_stats",
+        "transfer_stats",
+        "wait",
+        "worker_stacks",
+    }
+)
+
+IDEMPOTENT_OPS = frozenset(
+    {
+        "cancel",
+        "drain_node",
+        "kill_actor",
+        "kv_del",
+        "kv_put",
+        "pull_into_arena",
+        "push_object_chunk",
+        "reconcile_report",
+        "register_replica",
+        "remove_node",
+        "report_agent_spill",
+        "report_observability",
+        "report_proxy_stats",
+        "set_tenant_quota",
+        "stream_consumed_report",
+        "submit_batch",
+        "submit_task",
+        "unregister_replica",
+    }
+)
+
+# Everything else in CONTROLLER_OPS replays unsafely: add_ref (a replay
+# double-counts), pg_create (a replay reserves a second group), shm_create
+# (a replay allocates a second segment), pubsub_publish (duplicate events),
+# stream_abandoned (an at-most-once signal), testing hooks.
+
+
+def op_idempotency(op: str) -> str:
+    """'read' | 'idempotent' | 'once' for a controller request op (worker
+    channel names — get_objects/put_object — classify as reads/idempotent
+    at their call sites)."""
+    if op in READ_ONLY_OPS:
+        return "read"
+    if op in IDEMPOTENT_OPS:
+        return "idempotent"
+    return "once"
 
 
 # ---- worker -> controller ----
@@ -667,14 +769,53 @@ class RegisterAgent:
     data_address: Optional[str]  # "host:port" peers pull chunks from
     pid: int = 0
     hostname: str = ""
+    # True on a reconnect attempt that PRESERVED local state (workers,
+    # arena, held leases) hoping the head restarted and wants to reconcile
+    # (reference: raylet resubscribe after NotifyGCSRestart). The head
+    # answers with AgentAck.resume_verdict.
+    resume: bool = False
 
 
 @dataclasses.dataclass
 class AgentAck:
-    """Controller → agent: registration accepted."""
+    """Controller → agent: registration accepted (or, for a resume
+    attempt, refused — see ``resume_verdict``)."""
 
     node_id_hex: str
     head_data_address: Optional[str] = None
+    # Resume protocol: "fresh" (normal registration), "reconcile" (the head
+    # is RECOVERING and accepts the preserved state — an AgentReconcile ask
+    # follows on this connection), or "reset" (preserved state refused: the
+    # head never died, or the recovery window closed and journaled leases
+    # were already re-placed — the agent must tear down local state and
+    # re-register fresh, exactly-once execution depends on it).
+    resume_verdict: str = "fresh"
+
+
+@dataclasses.dataclass
+class AgentReconcile:
+    """Controller → agent: the restarted head asks for this node's truth
+    (reference: raylet resubscribe/reconciliation after a GCS restart).
+    The agent answers with the ``reconcile_report`` request op carrying its
+    held task/creation leases, alive workers and actors (with pids as
+    incarnations), recently-completed done reports the crashed head may
+    never have journaled, and its arena object inventory."""
+
+    deadline_s: float
+    # bumps on the head's bounded re-ask so a duplicate report is
+    # distinguishable in logs (application is idempotent either way)
+    ask_seq: int = 1
+
+
+@dataclasses.dataclass
+class HeadRestarted:
+    """Agent → local worker: the head connection was lost and re-established
+    against a restarted controller. In-flight controller calls relayed
+    through the agent lost their replies — the worker bumps its connection
+    epoch so blocked waiters unblock and the per-op retry envelope decides
+    (replay reads/idempotent writes, surface HeadRestartedError otherwise)."""
+
+    epoch: int = 0
 
 
 @dataclasses.dataclass

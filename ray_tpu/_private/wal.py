@@ -1,0 +1,276 @@
+"""Write-ahead journal for the controller's durable state.
+
+The reference's GCS leans on Redis for fault tolerance (``redis_store_client.h``
+— every table mutation lands in an external store the restarted GCS reloads
+via ``gcs_init_data``). Here the same role is played by a local append-only
+journal UNDER the existing snapshot machinery: the snapshot is the compacted
+base, the WAL is the tail of mutations since the last compaction, and a
+restarted controller replays snapshot + tail instead of losing everything
+after the last full snapshot write.
+
+Design constraints (the submit path journals every accepted spec):
+
+- ``append`` is O(1) and never touches the disk on the caller's thread:
+  records land in an in-memory deque; a flusher thread pickles, frames, and
+  writes them in batches with ONE fsync per flush interval (fsync batching —
+  the durability window is ``flush_interval_ms``).
+- Every record is framed ``[u32 length][u32 crc32][pickle bytes]`` so a crash
+  mid-write leaves a TORN TAIL, not a corrupt log: replay stops at the first
+  short/garbled frame and truncates the file back to the last good record.
+- Compaction: callers write a fresh full snapshot and then ``truncate()`` the
+  journal (the snapshot IS the compacted journal). ``size_bytes`` lets the
+  owner trigger compaction past a rotation bound.
+- A write error degrades LOUDLY to snapshot-only mode: the ``on_error``
+  callback fires once, ``healthy`` flips false, and every later append is
+  dropped with a counted error — a half-written journal must never be
+  mistaken for the whole truth (replay of a known-degraded log would
+  silently resurrect partial state).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import struct
+import threading
+import zlib
+from collections import deque
+from typing import Any, Callable, Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+_FRAME = struct.Struct("<II")  # (payload length, crc32 of payload)
+
+
+class WriteAheadLog:
+    """fsync-batched append-only journal of (kind, payload) records."""
+
+    def __init__(
+        self,
+        path: str,
+        flush_interval_ms: float = 5.0,
+        on_error: Optional[Callable[[BaseException], None]] = None,
+        inject_failure: Optional[Callable[[], None]] = None,
+    ):
+        self.path = path
+        self._flush_interval_s = max(0.0, flush_interval_ms) / 1000.0
+        self._on_error = on_error
+        # chaos hook (the controller wires testing_rpc_failure "wal_write"
+        # here): raising makes the NEXT flush fail like a real disk error
+        self._inject_failure = inject_failure
+        self._pending: deque = deque()
+        self._dirty = threading.Event()
+        # serializes WHOLE flushes (drain + frame + write): concurrent
+        # flush() calls (flusher thread vs the owner's compaction/shutdown
+        # flush) would otherwise interleave their deque drains and persist
+        # records out of append order — replay would then apply e.g.
+        # 'unlease' before its 'lease'
+        self._flush_lock = threading.Lock()
+        # serializes file writes/truncates against each other (the owner's
+        # snapshot+truncate compaction runs on a different thread than the
+        # flusher)
+        self._io_lock = threading.Lock()
+        self._stop = threading.Event()
+        self.healthy = True
+        self.appends = 0
+        self.flushes = 0
+        self.errors = 0
+        self.bytes_written = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # append mode: an existing tail (pre-restart records) is preserved
+        # until the owner compacts it away after replay
+        self._f = open(path, "ab")
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True, name="wal-flusher"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- hot path
+
+    def append(self, kind: str, payload: Any) -> None:
+        """Queue one record (sub-microsecond: deque append + event set).
+        Durable within one flush interval. Dropped (and counted) after the
+        journal degraded — the owner already switched to snapshot-only."""
+        if not self.healthy:
+            self.errors += 1
+            return
+        self._pending.append((kind, payload))
+        self.appends += 1
+        self._dirty.set()
+
+    # ------------------------------------------------------------- flushing
+
+    def _flush_loop(self):
+        while not self._stop.is_set():
+            self._dirty.wait(timeout=1.0)
+            if self._stop.is_set():
+                return
+            if not self._dirty.is_set():
+                continue
+            if self._flush_interval_s:
+                # batching beat: mutations arrive in bursts; one breath
+                # folds the burst into a single write + fsync
+                self._stop.wait(self._flush_interval_s)
+            self._dirty.clear()
+            self.flush()
+
+    def flush(self) -> None:
+        """Write + fsync everything queued (synchronous; also called by the
+        owner before compaction and at shutdown). One flush at a time: the
+        drain and its write commit as a unit, preserving append order."""
+        with self._flush_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._pending or not self.healthy:
+            return
+        batch: list = []
+        while self._pending:
+            try:
+                batch.append(self._pending.popleft())
+            except IndexError:  # pragma: no cover — single consumer
+                break
+        if not batch:
+            return
+        try:
+            if self._inject_failure is not None:
+                self._inject_failure()
+            frames = []
+            for rec in batch:
+                blob = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+                frames.append(_FRAME.pack(len(blob), zlib.crc32(blob)))
+                frames.append(blob)
+            data = b"".join(frames)
+            with self._io_lock:
+                self._f.write(data)
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            self.bytes_written += len(data)
+            self.flushes += 1
+        except BaseException as e:  # noqa: BLE001 — degrade, never raise
+            self.errors += 1
+            self._degrade(e)
+
+    def _degrade(self, exc: BaseException):
+        if not self.healthy:
+            return
+        self.healthy = False
+        logger.error(
+            "WAL write failed — degrading to snapshot-only durability "
+            "(mutations after the last snapshot are NOT journaled): %s", exc,
+        )
+        if self._on_error is not None:
+            try:
+                self._on_error(exc)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ---------------------------------------------------------- maintenance
+
+    def size_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def rotate(self) -> str:
+        """Compaction, step 1: swap appends onto a FRESH segment and return
+        the old segment's path. The owner writes its full snapshot next and
+        deletes the old segment last — a crash in between leaves the old
+        segment on disk, and boot replays ``<path>.1`` before ``<path>``
+        (replay application is idempotent, so records that land in both the
+        snapshot and the live tail are harmless). This ordering closes the
+        snapshot-vs-append race a plain truncate-after-snapshot would have:
+        no record can fall between the state capture and the truncate."""
+        import shutil
+
+        old = self.path + ".1"
+        with self._io_lock:
+            try:
+                self._f.close()
+                if os.path.exists(old):
+                    # a PRIOR compaction's snapshot never landed (write
+                    # failure after its rotate): that segment still holds
+                    # the only durable copy of its records — append the
+                    # live tail AFTER it instead of clobbering it (replay
+                    # order: old segment's records precede the live ones)
+                    with open(old, "ab") as dst, open(self.path, "rb") as src:
+                        shutil.copyfileobj(src, dst)
+                        dst.flush()
+                        os.fsync(dst.fileno())
+                    os.unlink(self.path)
+                else:
+                    os.replace(self.path, old)
+                self._f = open(self.path, "ab")
+            except OSError as e:
+                self._degrade(e)
+                raise
+        return old
+
+    def truncate(self) -> None:
+        """Compaction: the owner just wrote a full snapshot — drop every
+        journaled record it subsumes."""
+        with self._io_lock:
+            try:
+                self._f.truncate(0)
+                self._f.seek(0)
+                os.fsync(self._f.fileno())
+            except OSError as e:
+                self._degrade(e)
+
+    def close(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        self._dirty.set()
+        self._thread.join(timeout=2.0)
+        if final_flush:
+            self.flush()
+        with self._io_lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+    # --------------------------------------------------------------- replay
+
+    @staticmethod
+    def replay(path: str) -> Iterator[tuple]:
+        """Yield (kind, payload) records in append order. Tolerates a torn
+        tail: the first short or checksum-failed frame ends the replay and
+        the file is truncated back to the last good record (a crashed
+        writer's partial frame must not poison the next incarnation's
+        appends)."""
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return
+        good_end = 0
+        with f:
+            while True:
+                header = f.read(_FRAME.size)
+                if len(header) < _FRAME.size:
+                    break
+                length, crc = _FRAME.unpack(header)
+                blob = f.read(length)
+                if len(blob) < length or zlib.crc32(blob) != crc:
+                    logger.warning(
+                        "WAL torn tail at offset %d (%s): truncating",
+                        good_end, path,
+                    )
+                    break
+                try:
+                    rec = pickle.loads(blob)
+                except Exception:  # noqa: BLE001 — framed but unreadable
+                    logger.warning(
+                        "WAL undecodable record at offset %d (%s): "
+                        "truncating", good_end, path,
+                    )
+                    break
+                good_end = f.tell()
+                yield rec
+        try:
+            if os.path.getsize(path) > good_end:
+                with open(path, "r+b") as tf:
+                    tf.truncate(good_end)
+        except OSError:
+            pass

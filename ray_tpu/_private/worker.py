@@ -755,7 +755,29 @@ class DriverAPI(WorkerAPI):
 
     def controller_call(self, op, payload=None):
         self.flush_submits()
-        return self.controller._dispatch_request(op, payload)
+        # head-restart retry envelope, thread-mode flavor: the in-process
+        # controller can't crash separately, but the SAME per-op
+        # idempotency contract governs injected rpc chaos
+        # (testing_rpc_failure) — reads and idempotent writes replay with
+        # backoff, once-only ops surface the typed error instead of
+        # retrying blind (mirrors worker_runtime._head_retry)
+        from ray_tpu._private import protocol as P
+
+        cls = P.op_idempotency(op)
+        last: Optional[BaseException] = None
+        for _attempt in range(20):
+            try:
+                return self.controller._dispatch_request(op, payload)
+            except WorkerCrashedError as e:
+                last = e
+                if cls == "once":
+                    from ray_tpu.exceptions import HeadRestartedError
+
+                    raise HeadRestartedError(op, str(e)) from e
+                # immediate replay (no sleep: the controller is in-process,
+                # and chaos injection is probabilistic per attempt) — the
+                # same bounded-attempts shape as _deliver_batch
+        raise last
 
     def add_refs(self, object_ids):
         for oid in object_ids:
@@ -903,6 +925,45 @@ def init(
     with ``mode="thread"`` — the ``local_mode`` analog for fast tests).
     """
     global _global_api
+    if address is not None:
+        # connect OUTSIDE the api lock: the client attach probes the head
+        # over the wire (head_arena, retried across restart windows by the
+        # reconnect envelope) and must never block other threads' init
+        # checks on a slow/recovering head
+        with _api_lock:
+            if _global_api is not None:
+                if ignore_reinit_error:
+                    return _global_api
+                raise RayTpuError("ray_tpu.init() called twice")
+            if os.environ.get("RAY_TPU_WORKER") == "1":
+                raise RayTpuError("init() must not be called inside a worker")
+        if any(
+            v is not None
+            for v in (num_cpus, num_tpus, resources, object_store_memory, config)
+        ):
+            raise RayTpuError(
+                "resource/config arguments cannot be combined with "
+                "address=...: the attached cluster's configuration is "
+                "fixed by its head"
+            )
+        api = _connect_client(address)
+        with _api_lock:
+            if _global_api is not None:
+                # lost a concurrent-init race: retire the extra attachment
+                runtime = getattr(api, "runtime", None)
+                if runtime is not None:
+                    runtime._shutdown = True
+                    try:
+                        runtime.conn.close()
+                    except OSError:
+                        pass
+                if ignore_reinit_error:
+                    return _global_api
+                raise RayTpuError("ray_tpu.init() called twice")
+            _global_api = api
+            _install_ref_hooks(api)
+        atexit.register(shutdown)
+        return api
     with _api_lock:
         if _global_api is not None:
             if ignore_reinit_error:
@@ -910,22 +971,6 @@ def init(
             raise RayTpuError("ray_tpu.init() called twice")
         if os.environ.get("RAY_TPU_WORKER") == "1":
             raise RayTpuError("init() must not be called inside a worker")
-
-        if address is not None:
-            if any(
-                v is not None
-                for v in (num_cpus, num_tpus, resources, object_store_memory, config)
-            ):
-                raise RayTpuError(
-                    "resource/config arguments cannot be combined with "
-                    "address=...: the attached cluster's configuration is "
-                    "fixed by its head"
-                )
-            api = _connect_client(address)
-            _global_api = api
-            _install_ref_hooks(api)
-            atexit.register(shutdown)
-            return api
 
         cfg = Config.from_env(_system_config or config)
         if object_store_memory is not None:

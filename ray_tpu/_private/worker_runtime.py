@@ -38,6 +38,14 @@ from ray_tpu.exceptions import TaskError
 _INLINE_LIMIT_ENV = "RAY_TPU_MAX_INLINE_OBJECT_SIZE"
 
 
+class ConnEpochBumped(OSError):
+    """The controller connection was re-established (client pump re-dial
+    after a head restart, or the node agent's ``HeadRestarted`` notice for
+    relayed workers) while this request was in flight: its reply died with
+    the old head. The retry envelope replays reads and idempotent writes;
+    once-only ops surface ``HeadRestartedError``."""
+
+
 class StreamConsumerGone(Exception):
     """The consumer of a streaming generator freed its ObjectRefGenerator
     while the (backpressured) producer was still running."""
@@ -701,6 +709,14 @@ class WorkerRuntime:
                     self._send(P.StacksReply(msg.req_id, self._dump_stacks()))
                 except (OSError, EOFError):
                     pass
+            elif isinstance(msg, P.HeadRestarted):
+                # the agent re-registered with a RESTARTED head: every
+                # in-flight controller call relayed through it lost its
+                # reply — bump the epoch so blocked waiters unblock and
+                # the per-op retry envelope decides (replay vs surface)
+                with self._get_cv:
+                    self._conn_epoch += 1
+                    self._get_cv.notify_all()
             elif isinstance(msg, P.KillActor):
                 break
             elif isinstance(msg, P.Shutdown):
@@ -883,6 +899,15 @@ class WorkerRuntime:
                 with self._send_lock:
                     self.conn = conn
                     conn.send(P.RegisterDriver(self.worker_id, os.getpid()))
+                # bump AGAIN after the swap: a request sent DURING the dial
+                # window captured the entry bump's epoch but went into the
+                # dead socket — without this second bump its waiter would
+                # sit out its full timeout on a reply that can never come
+                # (the spuriously-kicked requests that raced the swap onto
+                # the live conn just replay through the retry envelope)
+                with self._get_cv:
+                    self._conn_epoch += 1
+                    self._get_cv.notify_all()
                 return True
             except (OSError, EOFError, ConnectionError):
                 time.sleep(1.0)
@@ -955,6 +980,65 @@ class WorkerRuntime:
 
     # -------------------------------------------------------- object plane
 
+    # ----------------------- client-transparent head-restart retry envelope
+
+    def _head_retry_window_s(self) -> float:
+        try:
+            from ray_tpu._private.config import get_config
+
+            return float(
+                os.environ.get(
+                    "RAY_TPU_HEAD_RETRY_TIMEOUT_S",
+                    get_config().head_retry_timeout_s,
+                )
+            )
+        except Exception:  # noqa: BLE001 — env-only processes
+            return 60.0
+
+    def _retry_recoverable(self, exc: BaseException) -> bool:
+        """Is this connection failure one a retry can outlive? An epoch
+        bump means a reconnect ALREADY happened (client pump re-dial, or
+        the agent's HeadRestarted notice for relayed workers). A raw send/
+        EOF failure is recoverable only in client mode, where the reply
+        pump keeps re-dialing — a head-local worker's dead socket never
+        comes back (the head respawns workers, not the reverse)."""
+        if isinstance(exc, ConnEpochBumped):
+            return True
+        return self.client_mode
+
+    def _head_retry(self, op: str, fn, *, idempotency: Optional[str] = None):
+        """Run one send+await closure, replaying it across head restarts
+        per its idempotency class (bounded exponential backoff + jitter
+        inside the configured window): reads replay freely, idempotent
+        writes replay under their original request ids' semantics (the
+        head dedups), and once-only ops surface a typed
+        ``HeadRestartedError`` instead of guessing."""
+        cls = idempotency or P.op_idempotency(op)
+        deadline = None
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except (ConnEpochBumped, OSError, EOFError) as e:
+                if isinstance(e, TimeoutError):
+                    raise  # a caller deadline, not a transport loss
+                if self._shutdown or not self._retry_recoverable(e):
+                    raise
+                if cls == "once":
+                    from ray_tpu.exceptions import HeadRestartedError
+
+                    raise HeadRestartedError(op, str(e)) from e
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + self._head_retry_window_s()
+                if now >= deadline:
+                    raise
+                import random as _random
+
+                delay = min(0.05 * (2 ** min(attempt, 6)), 2.0)
+                time.sleep(delay * (0.5 + _random.random()))
+                attempt += 1
+
     def get_objects(self, object_ids: list[ObjectID], timeout=None) -> list:
         """Returns [(SerializedObject, kind)] parallel to object_ids."""
         # injection FIRST (a failed request leaves the coalescer untouched),
@@ -962,10 +1046,16 @@ class WorkerRuntime:
         # synchronous read (program-order visibility across the window)
         self._maybe_inject_failure("get_objects")
         self._coalescer.flush()
-        req_id = next(self._req_counter)
-        epoch = self._conn_epoch
-        self._send(P.GetObjects(req_id, object_ids))
-        results = self._await_reply(req_id, timeout, epoch=epoch)
+
+        def attempt():
+            req_id = next(self._req_counter)
+            epoch = self._conn_epoch
+            self._send(P.GetObjects(req_id, object_ids))
+            return self._await_reply(req_id, timeout, epoch=epoch)
+
+        # pure read: a get() in flight across a head crash blocks through
+        # recovery and re-asks the restored head instead of erroring
+        results = self._head_retry("get_objects", attempt, idempotency="read")
         return [
             (self._materialize(kind, payload, object_id=oid), kind)
             for oid, kind, payload in results
@@ -985,7 +1075,9 @@ class WorkerRuntime:
                 if self._conn_epoch != epoch:
                     # head connection was lost and re-dialed: this request's
                     # reply died with the old connection
-                    raise OSError("connection to head lost (reconnected)")
+                    raise ConnEpochBumped(
+                        "connection to head lost (reconnected)"
+                    )
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     raise TimeoutError("controller reply timed out")
@@ -1001,10 +1093,11 @@ class WorkerRuntime:
             # the coalescer's own delivery call; flushing there would
             # re-enter the flush lock)
             self._coalescer.flush()
-        req_id = next(self._req_counter)
-        epoch = self._conn_epoch
-        self._send(P.Request(req_id, op, payload))
         if fire_and_forget:
+            req_id = next(self._req_counter)
+            epoch = self._conn_epoch
+            self._send(P.Request(req_id, op, payload))
+
             # Still consume the reply asynchronously to keep the table clean.
             def drain():
                 try:
@@ -1014,7 +1107,17 @@ class WorkerRuntime:
 
             threading.Thread(target=drain, daemon=True).start()
             return None
-        reply = self._await_reply(req_id, epoch=epoch)
+
+        def attempt():
+            req_id = next(self._req_counter)
+            epoch = self._conn_epoch
+            self._send(P.Request(req_id, op, payload))
+            return self._await_reply(req_id, epoch=epoch)
+
+        # head-restart envelope: reads and idempotent writes replay across
+        # the crash (the restored head dedups replayed submits by task id /
+        # sealed returns); once-only ops surface HeadRestartedError
+        reply = self._head_retry(op, attempt)
         if reply.error is not None:
             raise RuntimeError(f"controller call {op} failed: {reply.error}")
         return reply.payload
@@ -1299,24 +1402,33 @@ class WorkerRuntime:
             # push_manager.h:27). The controller seals into the head store.
             self._push_object(object_id, sobj.to_bytes())
             return
-        req_id = next(self._req_counter)
-        epoch = self._conn_epoch
         if sobj.total_bytes() <= self.max_inline:
-            self._send(P.PutObject(req_id, object_id, "inline", sobj.to_bytes()))
+            kind, put_payload = "inline", sobj.to_bytes()
         else:
-            name, size = self._write_shm(object_id, sobj)
-            self._send(P.PutObject(req_id, object_id, "plasma", (name, size)))
-        self._await_reply(req_id, epoch=epoch)
+            kind, put_payload = "plasma", self._write_shm(object_id, sobj)
+
+        def attempt():
+            req_id = next(self._req_counter)
+            epoch = self._conn_epoch
+            self._send(P.PutObject(req_id, object_id, kind, put_payload))
+            return self._await_reply(req_id, epoch=epoch)
+
+        # sealing the same (oid, payload) twice is idempotent head-side
+        self._head_retry("put_object", attempt, idempotency="idempotent")
 
     def put_entry(self, object_id: ObjectID, kind: str, payload: bytes):
         """Seal a pre-serialized entry with an explicit kind ("inline" or
         "error") into the head's store — used when promoting a direct-call
         result that escapes to another process (kind must survive: an
         "error" promoted as "inline" would stop propagating)."""
-        req_id = next(self._req_counter)
-        epoch = self._conn_epoch
-        self._send(P.PutObject(req_id, object_id, kind, payload))
-        self._await_reply(req_id, epoch=epoch)
+
+        def attempt():
+            req_id = next(self._req_counter)
+            epoch = self._conn_epoch
+            self._send(P.PutObject(req_id, object_id, kind, payload))
+            return self._await_reply(req_id, epoch=epoch)
+
+        self._head_retry("put_object", attempt, idempotency="idempotent")
 
     def _push_object(
         self,

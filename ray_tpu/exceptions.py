@@ -84,6 +84,22 @@ class WorkerCrashedError(RayTpuError):
     pass
 
 
+class HeadRestartedError(RayTpuError):
+    """The controller connection was lost mid-call (head crash/restart) on
+    an op that is NOT safe to replay (non-idempotent class — see
+    ``protocol.op_idempotency``). Reads and idempotent writes retry through
+    recovery transparently; callers of once-only ops must decide for
+    themselves whether to re-issue."""
+
+    def __init__(self, op: str, detail: str = ""):
+        self.op = op
+        super().__init__(
+            f"controller call {op!r} was interrupted by a head restart and "
+            f"is not safe to replay automatically"
+            + (f": {detail}" if detail else "")
+        )
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

@@ -134,6 +134,50 @@ def cmd_drain_node(args):
         sys.exit(1)
 
 
+def cmd_recovery(args):
+    """``ray-tpu recovery``: head fault-tolerance status — WAL health
+    (appends/errors/size; a degraded journal means snapshot-only
+    durability), the RECOVERING phase with per-node reconcile status, and
+    the last recovery's counters incl. time-to-first-dispatch."""
+    from ray_tpu.util.state.api import recovery_stats
+
+    _ensure_init(args)
+    rec = recovery_stats()
+    if args.json:
+        print(json.dumps(rec, indent=1, default=str))
+        return
+    wal = rec.get("wal") or {}
+    if not wal.get("enabled"):
+        print("WAL: disabled (set gcs_snapshot_path + wal_enabled)")
+    else:
+        state = "healthy" if wal.get("healthy") else "DEGRADED (snapshot-only)"
+        print(
+            f"WAL: {state}  appends={wal.get('appends', 0)} "
+            f"flushes={wal.get('flushes', 0)} errors={wal.get('errors', 0)} "
+            f"size={wal.get('size_bytes', 0)}B  {wal.get('path', '')}"
+        )
+    print(f"Phase: {rec.get('phase', 'normal')}")
+    nodes = rec.get("nodes") or {}
+    if nodes:
+        for h, status in sorted(nodes.items()):
+            print(f"  node {h[:12]}: {status}")
+    counters = {k: v for k, v in (rec.get("counters") or {}).items() if v}
+    if counters:
+        print("Counters:")
+        for k in sorted(counters):
+            print(f"  {k}: {counters[k]}")
+    last = rec.get("last_recovery") or {}
+    if last:
+        dur = last.get("duration_s")
+        ttfd = last.get("time_to_first_dispatch_s")
+        print(
+            "Last recovery: "
+            + (f"{dur:.2f}s " if dur is not None else "")
+            + (f"ttfd={ttfd:.2f}s " if ttfd is not None else "")
+            + (last.get("reason") or "")
+        )
+
+
 def cmd_tenants(args):
     """``ray-tpu tenants [set <name> ...]``: show (or configure) the
     multi-tenant scheduler — fair-share weights, quotas, usage, queue
@@ -440,6 +484,14 @@ def build_parser() -> argparse.ArgumentParser:
                            "('{}' clears)")
     s.add_argument("--num-cpus", type=int, default=4)
     s.set_defaults(fn=cmd_tenants)
+
+    s = sub.add_parser(
+        "recovery",
+        help="head fault-tolerance status (WAL health, RECOVERING phase, "
+        "reconcile counters)",
+    )
+    s.add_argument("--json", action="store_true", help="raw JSON record")
+    s.set_defaults(fn=cmd_recovery)
 
     s = sub.add_parser("microbenchmark", help="core throughput suite")
     s.add_argument("--mode", default="thread", choices=["thread", "process"])

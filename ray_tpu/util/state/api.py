@@ -101,6 +101,17 @@ def proxy_stats(proxy_id_prefix: Optional[str] = None) -> dict:
     return _call("proxy_stats", proxy_id_prefix) or {}
 
 
+def recovery_stats() -> dict:
+    """Head fault-tolerance state: WAL health (appends/flushes/errors/
+    size — a degraded journal means snapshot-only durability), the current
+    RECOVERING phase (per-node reconcile status, parked lease/placement/
+    object counts), cumulative recovery counters (leases resumed vs
+    re-placed, actors rebound vs re-created, orphans reaped), and the last
+    recovery's shape incl. time-to-first-dispatch (reference: GCS restart
+    + raylet resubscribe reconciliation)."""
+    return _call("recovery_stats") or {}
+
+
 def actor_creation_stats() -> dict:
     """Counters for the agent-owned actor-creation lease protocol
     (reference: GcsActorScheduler leasing creation to the raylet): leases

@@ -1,0 +1,104 @@
+"""Self-detection fixture: the head-recovery ops done WRONG.
+
+The PR 15 growth shape — a re-attaching agent answers the restarted head's
+reconcile ask (``reconcile_report``) and operators poll ``recovery_stats``
+from modules far from the controller's dispatch ladder, so a typo'd report
+op or a payload-arity drift ships clean and recovery silently degrades to
+re-place-everything (every reconcile dies as an unknown-op error while the
+grace clock runs out); and the journal-lifecycle paths stage a WAL segment
+handle that a raising compaction strands. tpulint must flag:
+
+- wire-conformance: the misspelled ``reconcile_repord`` send
+  (did-you-mean) and the 3-tuple ``reconcile_report`` payload against the
+  handler's 2-field unpack (the ask sequence rides inside the report, not
+  the payload);
+- ref-lifecycle: the rotated WAL segment handle leaked when the compaction
+  snapshot write raises (leak-on-raise in the rotate-and-compact path).
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import threading
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    """Dispatch surface for the recovery-plane ops."""
+
+    def __init__(self):
+        self._nodes = {}
+        self._counters = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "reconcile_report":
+            node_hex, report = payload
+            self._nodes[node_hex] = report
+            return {"status": "ok", "drop_tasks": []}
+        if op == "recovery_stats":
+            return {"nodes": dict(self._nodes), "counters": dict(self._counters)}
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class ReconcilingAgent:
+    """Agent-side reconcile sender with the protocol bugs under test."""
+
+    def __init__(self, conn, node_hex):
+        self._conn = conn
+        self._node_hex = node_hex
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+        self._ask_seq = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def reconcile(self, report):
+        # BUG: "reconcile_repord" — no handler branch matches; every
+        # reconcile dies as one unknown-op error reply and the recovering
+        # head re-places everything at the grace deadline
+        return self.call_controller(
+            "reconcile_repord", (self._node_hex, report)
+        )
+
+    def reconcile_with_seq(self, report):
+        # BUG: 3-tuple payload vs the handler's 2-field unpack (the ask
+        # sequence rides inside the report, not the payload) — ValueError
+        # at dispatch, the report never lands
+        return self.call_controller(
+            "reconcile_report", (self._node_hex, report, self._ask_seq)
+        )
+
+
+class Journal:
+    """WAL compaction with the lifecycle bug under test."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def compact(self, snapshot_fn):
+        """Leak-on-raise in the rotate-and-compact path: the rotated
+        segment handle is open while snapshot_fn() can raise — no handler,
+        no finally, the handle (and its fd) strands with the failed
+        compaction."""
+        segment = open(self.path + ".1", "ab")  # noqa: SIM115 — fixture shape
+        segment.write(b"rotate marker\n")
+        snapshot_fn()
+        segment.close()

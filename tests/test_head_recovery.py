@@ -1,0 +1,947 @@
+"""Head fault tolerance: WAL-backed controller recovery, agent-driven lease
+reconciliation, and client-transparent reconnect.
+
+Fast half (tier-1): the WAL unit contract (replay determinism, torn-tail
+truncation, compaction round-trip), the RECOVERING phase driven against
+scripted fake agents speaking the real wire protocol (resume registration,
+reconcile reports, orphan verdicts, chaos on both new ops, wal_write
+degrade), and the config-override-on-lease satellite. The slow half —
+SIGKILL a real head under load with real agents — lives at the bottom,
+modeled on test_head_restart.
+
+Reference: the GCS's Redis-backed restart + raylet resubscribe
+reconciliation (``redis_store_client.h:111``, ``gcs_init_data.h``,
+``NotifyGCSRestart`` / ``node_manager.cc:947``).
+"""
+
+import itertools
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol as P
+from ray_tpu._private.ids import JobID, NodeID, TaskID, WorkerID
+from ray_tpu._private.serialization import SerializationContext
+from ray_tpu._private.wal import WriteAheadLog
+
+
+def _controller():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# --------------------------------------------------------------- WAL units
+
+
+def test_wal_replay_determinism(tmp_path):
+    """Appended records replay in order, byte-identically, twice."""
+    path = str(tmp_path / "j.wal")
+    w = WriteAheadLog(path, flush_interval_ms=0.0)
+    records = [("submit", (b"tid%d" % i, "spec")) for i in range(50)]
+    records += [("free", b"oid"), ("tenant", {"name": "a", "weight": 2.0})]
+    for kind, payload in records:
+        w.append(kind, payload)
+    w.flush()
+    w.close()
+    got1 = list(WriteAheadLog.replay(path))
+    got2 = list(WriteAheadLog.replay(path))
+    assert got1 == records
+    assert got2 == records  # replay itself must not consume/corrupt
+
+
+def test_wal_torn_tail_truncates_to_last_good_record(tmp_path):
+    path = str(tmp_path / "j.wal")
+    w = WriteAheadLog(path, flush_interval_ms=0.0)
+    for i in range(10):
+        w.append("rec", i)
+    w.flush()
+    w.close()
+    good_size = os.path.getsize(path)
+    # a crash mid-write leaves a partial frame: header + truncated payload
+    with open(path, "ab") as f:
+        import struct
+
+        f.write(struct.pack("<II", 1000, 0xDEAD))
+        f.write(b"short")
+    assert list(WriteAheadLog.replay(path)) == [("rec", i) for i in range(10)]
+    # the torn tail was truncated away so future appends stay readable
+    assert os.path.getsize(path) == good_size
+    w2 = WriteAheadLog(path, flush_interval_ms=0.0)
+    w2.append("rec", 10)
+    w2.flush()
+    w2.close()
+    assert list(WriteAheadLog.replay(path)) == [
+        ("rec", i) for i in range(11)
+    ]
+
+
+def test_wal_corrupt_crc_stops_replay(tmp_path):
+    path = str(tmp_path / "j.wal")
+    w = WriteAheadLog(path, flush_interval_ms=0.0)
+    for i in range(5):
+        w.append("rec", i)
+    w.flush()
+    w.close()
+    # flip a byte in the middle of the file: replay stops at the bad frame
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    got = list(WriteAheadLog.replay(path))
+    assert len(got) < 5
+    assert got == [("rec", i) for i in range(len(got))]
+
+
+def test_wal_compaction_rotate_round_trip(tmp_path):
+    """rotate() swaps segments crash-safely: records before the rotate live
+    in the old segment, records after in the new; replaying .1 then the
+    live file reconstructs everything (the boot order)."""
+    path = str(tmp_path / "j.wal")
+    w = WriteAheadLog(path, flush_interval_ms=0.0)
+    for i in range(5):
+        w.append("pre", i)
+    w.flush()
+    old = w.rotate()
+    assert old == path + ".1" and os.path.exists(old)
+    for i in range(3):
+        w.append("post", i)
+    w.flush()
+    w.close()
+    merged = list(WriteAheadLog.replay(old)) + list(WriteAheadLog.replay(path))
+    assert merged == [("pre", i) for i in range(5)] + [
+        ("post", i) for i in range(3)
+    ]
+
+
+def test_wal_rotate_preserves_orphaned_segment(tmp_path):
+    """A prior compaction whose snapshot write failed leaves its rotated
+    segment on disk as the ONLY durable copy of its records: the next
+    rotate must append the live tail after it, never clobber it."""
+    path = str(tmp_path / "j.wal")
+    w = WriteAheadLog(path, flush_interval_ms=0.0)
+    for i in range(3):
+        w.append("first", i)
+    w.flush()
+    old = w.rotate()  # compaction #1 rotates...
+    # ...but its snapshot write "fails": the segment is never unlinked
+    for i in range(3):
+        w.append("second", i)
+    w.flush()
+    old2 = w.rotate()  # compaction #2 must MERGE, not clobber
+    assert old2 == old
+    for i in range(3):
+        w.append("third", i)
+    w.flush()
+    w.close()
+    merged = list(WriteAheadLog.replay(old)) + list(
+        WriteAheadLog.replay(path)
+    )
+    assert merged == (
+        [("first", i) for i in range(3)]
+        + [("second", i) for i in range(3)]
+        + [("third", i) for i in range(3)]
+    )
+
+
+def test_wal_write_failure_degrades_loudly(tmp_path):
+    path = str(tmp_path / "j.wal")
+    errors = []
+
+    def boom():
+        raise OSError("disk on fire")
+
+    w = WriteAheadLog(
+        path, flush_interval_ms=0.0, on_error=errors.append,
+        inject_failure=boom,
+    )
+    w.append("rec", 1)
+    w.flush()
+    assert not w.healthy
+    assert w.errors == 1
+    assert len(errors) == 1
+    # degraded: appends are counted as errors, never silently half-written
+    w.append("rec", 2)
+    assert w.errors == 2
+    w.close()
+    assert list(WriteAheadLog.replay(path)) == []
+
+
+# ----------------------------------------- scripted reconcile-capable agent
+
+
+class RecoveryAgent:
+    """Scripted node agent for the recovery plane: registers (optionally
+    resuming a prior incarnation's node id), records leases, and answers
+    the head's AgentReconcile ask with exactly the report the test
+    scripts."""
+
+    def __init__(self, controller, resources, node_id=None, resume=False,
+                 report=None, report_attempts=3):
+        from multiprocessing.connection import Client
+
+        host, _, port = controller.tcp_address.rpartition(":")
+        self.node_id = node_id or NodeID.from_random()
+        self.conn = Client((host, int(port)), authkey=controller._authkey)
+        self._send_lock = threading.Lock()
+        self.report = report or {}
+        self.report_attempts = report_attempts
+        self.verdicts: list = []  # reconcile_report replies
+        self.reconcile_asks: list = []  # AgentReconcile messages seen
+        self.leases: list = []  # LeaseActor messages
+        self.task_leases: list = []  # LeaseTask messages
+        self.worker_msgs: list = []
+        self.closed = False
+        self._ser = SerializationContext()
+        self._req = itertools.count(1)
+        self._replies: dict = {}
+        self._reply_cv = threading.Condition()
+        self._send(
+            P.RegisterAgent(
+                self.node_id, dict(resources), {}, None, None,
+                pid=os.getpid(), hostname="recovery-agent", resume=resume,
+            )
+        )
+        self.ack = self.conn.recv()
+        assert isinstance(self.ack, P.AgentAck)
+        if getattr(self.ack, "resume_verdict", "fresh") == "reset":
+            self.conn.close()
+            self.closed = True
+            return
+        threading.Thread(target=self._read_loop, daemon=True).start()
+        threading.Thread(target=self._hb_loop, daemon=True).start()
+
+    def _send(self, msg):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def _hb_loop(self):
+        while not self.closed:
+            try:
+                self._send(P.Heartbeat(self.node_id, {}))
+            except (OSError, EOFError):
+                return
+            time.sleep(1.0)
+
+    def _read_loop(self):
+        while not self.closed:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                return
+            except TypeError:
+                return
+            if isinstance(msg, P.Reply):
+                with self._reply_cv:
+                    self._replies[msg.req_id] = msg
+                    self._reply_cv.notify_all()
+            elif isinstance(msg, P.AgentReconcile):
+                self.reconcile_asks.append(msg)
+                threading.Thread(
+                    target=self._answer_reconcile, daemon=True
+                ).start()
+            elif isinstance(msg, P.LeaseBatch):
+                for lease in msg.leases:
+                    self._on_lease(lease)
+            elif isinstance(msg, (P.LeaseActor, P.LeaseTask)):
+                self._on_lease(msg)
+            elif isinstance(msg, P.ToWorker):
+                self.worker_msgs.append((msg.worker_id, msg.msg))
+
+    def _on_lease(self, msg):
+        if isinstance(msg, P.LeaseActor):
+            self.leases.append(msg)
+        else:
+            self.task_leases.append(msg)
+
+    def _answer_reconcile(self):
+        for attempt in range(self.report_attempts):
+            reply = self.call(
+                "reconcile_report", (self.node_id.hex(), self.report)
+            )
+            if reply.error is None:
+                self.verdicts.append(reply.payload)
+                return
+            time.sleep(0.1)
+
+    def call(self, op, payload, timeout=15.0):
+        req_id = next(self._req)
+        self._send(P.Request(req_id, op, payload))
+        deadline = time.monotonic() + timeout
+        with self._reply_cv:
+            while req_id not in self._replies:
+                remaining = deadline - time.monotonic()
+                assert remaining > 0, f"no reply to {op}"
+                self._reply_cv.wait(remaining)
+            return self._replies.pop(req_id)
+
+    def register_worker(self, worker_id, direct_address=None):
+        self._send(
+            P.FromWorker(
+                worker_id,
+                P.RegisterWorker(worker_id, pid=0,
+                                 direct_address=direct_address),
+            )
+        )
+
+    def inline_results(self, spec, value="pong"):
+        blob = self._ser.serialize(value).to_bytes()
+        return [(oid, "inline", blob) for oid in spec.return_ids()]
+
+    def close(self):
+        self.closed = True
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+@ray_tpu.remote(resources={"slot": 1})
+def _slot_task(x):
+    return x + 1
+
+
+@ray_tpu.remote(resources={"slot": 1}, max_restarts=1)
+class _Survivor:
+    def ping(self):
+        return "pong"
+
+
+def _crash_head():
+    """Simulate a SIGKILL of the in-process head: suppress the final
+    compaction snapshot so the journal is the only durable truth, then tear
+    the runtime down."""
+    ctrl = _controller()
+    time.sleep(0.25)  # > wal_flush_interval_ms: queued records hit disk
+    ctrl.flush_kv_now = lambda: None  # no final snapshot, no WAL truncate
+    ray_tpu.shutdown()
+
+
+def _recovery_config(snap, **extra):
+    cfg = {
+        "tcp_port": 0,
+        "gcs_snapshot_path": str(snap),
+        "recovery_grace_s": 6.0,
+        "recovery_reconcile_resend_s": 0.4,
+        "agent_heartbeat_timeout_s": 60.0,
+    }
+    cfg.update(extra)
+    return cfg
+
+
+def test_recovery_reconcile_end_to_end(tmp_path):
+    """Crash the head with journaled state on one agent node, restart, and
+    reconcile: the held lease resumes (never re-granted), a completed-but-
+    unjournaled task's report applies without re-execution, the sealed
+    inline result survives via the journal, the mid-creation actor binds
+    through the agent's (re)report, and an orphan lease reaps."""
+    snap = tmp_path / "gcs.snap"
+    ray_tpu.init(num_cpus=1, mode="process", config=_recovery_config(snap))
+    agent = None
+    held_spec = done_spec = None
+    try:
+        ctrl = _controller()
+        agent = RecoveryAgent(ctrl, {"CPU": 8, "slot": 8})
+        _wait(lambda: agent.node_id in ctrl.agents, msg="registration")
+        r_held = _slot_task.remote(1)
+        r_done = _slot_task.remote(2)
+        _wait(lambda: len(agent.task_leases) >= 2, msg="task leases")
+        held_spec = next(
+            lt.spec for lt in agent.task_leases
+            if lt.spec.task_id == r_held.id().task_id()
+        )
+        done_spec = next(
+            lt.spec for lt in agent.task_leases
+            if lt.spec.task_id == r_done.id().task_id()
+        )
+        # r_done completes pre-crash (sealed + journaled)
+        agent._send(
+            P.AgentTaskDone(
+                done_spec.task_id, agent.inline_results(done_spec, 3),
+                exec_ms=0.1,
+            )
+        )
+        _wait(
+            lambda: ctrl.memory_store.contains(r_done.id()),
+            msg="pre-crash completion sealed",
+        )
+        a = _Survivor.options(name="survivor").remote()
+        _wait(lambda: agent.leases, msg="creation lease")
+        creation_spec = agent.leases[0].spec
+        agent.close()  # the conn dies WITH the head; avoid EOF races
+        _crash_head()
+    except BaseException:
+        if agent is not None:
+            agent.close()
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        raise
+
+    # ---- restart: replay journal, reconcile with the resumed agent ----
+    ray_tpu.init(num_cpus=1, mode="process", config=_recovery_config(snap))
+    agent2 = None
+    try:
+        ctrl2 = _controller()
+        assert ctrl2.recovering, "journaled agent node must gate dispatch"
+        orphan_tid = TaskID.for_task(JobID.next(), None, 999).binary()
+        report = {
+            "task_leases": [held_spec.task_id.binary(), orphan_tid],
+            "actor_leases": [creation_spec.task_id.binary()],
+            "actors": [],
+            "workers": [],
+            # the done-report for r_done was processed pre-crash (journaled
+            # 'done'): re-offering it must be a no-op, not a re-execution
+            "completed": [
+                (done_spec.task_id.binary(),
+                 [], 0.1)
+            ],
+            "objects": [],
+        }
+        agent2 = RecoveryAgent(
+            ctrl2, {"CPU": 8, "slot": 8}, node_id=agent.node_id,
+            resume=True, report=report,
+        )
+        assert agent2.ack.resume_verdict == "reconcile"
+        _wait(lambda: not ctrl2.recovering, msg="recovery finishes")
+        assert ctrl2.recovery_info.get("reason") == "all agents reconciled"
+        # orphan verdict delivered; journaled lease resumed, not re-placed
+        _wait(lambda: agent2.verdicts, msg="reconcile verdict")
+        assert orphan_tid in agent2.verdicts[0]["drop_tasks"]
+        node2 = ctrl2.nodes[agent2.node_id]
+        assert held_spec.task_id.binary() in node2.leased
+        assert all(
+            lt.spec.task_id != held_spec.task_id
+            for lt in agent2.task_leases
+        ), "resumed lease must NOT be re-granted (double execution)"
+        # pre-crash sealed inline result survived via the journal
+        assert ctrl2.memory_store.contains(r_done.id())
+        # the held task now completes against the NEW head — exactly once
+        agent2._send(
+            P.AgentTaskDone(
+                held_spec.task_id, agent2.inline_results(held_spec, 2),
+                exec_ms=0.1,
+            )
+        )
+        _wait(
+            lambda: ctrl2.memory_store.contains(r_held.id()),
+            msg="resumed lease completes",
+        )
+        # the mid-creation actor binds through the agent's (re)report
+        aid = ctrl2.named_actors["survivor"]
+        assert creation_spec.task_id.binary() in node2.actor_leases
+        wid = WorkerID.from_random()
+        agent2.register_worker(wid)
+        reply = agent2.call(
+            "actor_placed",
+            (creation_spec.actor_id, wid, None,
+             agent2.inline_results(creation_spec, None), 1.0),
+        )
+        assert reply.error is None and reply.payload == "ok"
+        _wait(
+            lambda: ctrl2.actors[aid].state == "ALIVE",
+            msg="actor ALIVE with identity",
+        )
+        assert ctrl2.actors[aid].worker.worker_id == wid
+        assert ctrl2.recovery_counters["leases_resumed"] == 1
+        assert ctrl2.recovery_counters["creation_leases_resumed"] == 1
+        assert ctrl2.recovery_counters["orphan_tasks_reaped"] == 1
+        stats = ctrl2.recovery_report()
+        assert stats["wal"]["enabled"] and stats["wal"]["healthy"]
+        assert stats["last_recovery"]["duration_s"] >= 0.0
+    finally:
+        if agent2 is not None:
+            agent2.close()
+        ray_tpu.shutdown()
+
+
+def test_resume_refused_when_head_never_died(tmp_path):
+    """A preserved-state re-attach against a healthy head gets the 'reset'
+    verdict — its old incarnation's leases were already re-placed, so the
+    agent must tear down, not reconcile."""
+    snap = tmp_path / "gcs.snap"
+    ray_tpu.init(num_cpus=1, mode="process", config=_recovery_config(snap))
+    try:
+        ctrl = _controller()
+        agent = RecoveryAgent(
+            ctrl, {"CPU": 1}, resume=True,
+        )
+        assert agent.ack.resume_verdict == "reset"
+        assert agent.closed
+        assert agent.node_id not in ctrl.agents
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_dropped_reconcile_ask_single_bounded_reask(tmp_path):
+    """agent_reconcile chaos drops every ask push: the monitor re-asks
+    exactly ONCE, recovery closes at the grace deadline, and the parked
+    lease is re-placed exactly once (no double re-place)."""
+    snap = tmp_path / "gcs.snap"
+    ray_tpu.init(num_cpus=1, mode="process", config=_recovery_config(snap))
+    agent = None
+    try:
+        ctrl = _controller()
+        agent = RecoveryAgent(ctrl, {"CPU": 8, "slot": 8})
+        _wait(lambda: agent.node_id in ctrl.agents, msg="registration")
+        r = _slot_task.remote(1)
+        _wait(lambda: agent.task_leases, msg="lease")
+        agent.close()
+        _crash_head()
+    except BaseException:
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        raise
+    ray_tpu.init(
+        num_cpus=1, mode="process",
+        config=_recovery_config(
+            snap, recovery_grace_s=2.0,
+            testing_rpc_failure="agent_reconcile=1.0",
+        ),
+    )
+    agent2 = None
+    try:
+        ctrl2 = _controller()
+        assert ctrl2.recovering
+        agent2 = RecoveryAgent(
+            ctrl2, {"CPU": 8, "slot": 8}, node_id=agent.node_id, resume=True,
+        )
+        assert agent2.ack.resume_verdict == "reconcile"
+        _wait(
+            lambda: not ctrl2.recovering, timeout=15,
+            msg="recovery closes at deadline",
+        )
+        # both the ask and its single bounded re-ask were dropped
+        rec = ctrl2._recovery_nodes[agent2.node_id.hex()]
+        assert rec["asks"] == 2, "exactly one bounded re-ask"
+        assert agent2.reconcile_asks == []  # chaos dropped them pre-wire
+        assert (
+            ctrl2.recovery_counters["reconcile_ask_injected_failures"] == 2
+        )
+        # the journaled lease re-placed EXACTLY once, through the normal
+        # grant path, and completes exactly once
+        assert ctrl2.recovery_counters["leases_replaced"] == 1
+        _wait(lambda: agent2.task_leases, msg="re-placed lease granted")
+        time.sleep(0.5)
+        assert len(agent2.task_leases) == 1, "no double re-place"
+        lease = agent2.task_leases[0]
+        agent2._send(
+            P.AgentTaskDone(
+                lease.spec.task_id, agent2.inline_results(lease.spec, 2),
+                exec_ms=0.1,
+            )
+        )
+        _wait(
+            lambda: ctrl2.memory_store.contains(r.id()),
+            msg="re-placed lease completes",
+        )
+    finally:
+        if agent2 is not None:
+            agent2.close()
+        ray_tpu.shutdown()
+
+
+def test_dropped_reconcile_report_bounded_recovery(tmp_path):
+    """reconcile_report chaos (every report errors at dispatch): recovery
+    still closes at the grace deadline and re-places the journal's leases
+    exactly once — a lost report degrades to re-place, never to a hang or
+    a double grant."""
+    snap = tmp_path / "gcs.snap"
+    ray_tpu.init(num_cpus=1, mode="process", config=_recovery_config(snap))
+    agent = None
+    try:
+        ctrl = _controller()
+        agent = RecoveryAgent(ctrl, {"CPU": 8, "slot": 8})
+        _wait(lambda: agent.node_id in ctrl.agents, msg="registration")
+        _slot_task.remote(1)
+        _wait(lambda: agent.task_leases, msg="lease")
+        agent.close()
+        _crash_head()
+    except BaseException:
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        raise
+    ray_tpu.init(
+        num_cpus=1, mode="process",
+        config=_recovery_config(
+            snap, recovery_grace_s=2.0,
+            testing_rpc_failure="reconcile_report=1.0",
+        ),
+    )
+    agent2 = None
+    try:
+        ctrl2 = _controller()
+        agent2 = RecoveryAgent(
+            ctrl2, {"CPU": 8, "slot": 8}, node_id=agent.node_id, resume=True,
+            report={"task_leases": [], "actor_leases": [], "actors": [],
+                    "workers": [], "completed": [], "objects": []},
+        )
+        assert agent2.ack.resume_verdict == "reconcile"
+        _wait(
+            lambda: not ctrl2.recovering, timeout=15,
+            msg="recovery closes at deadline",
+        )
+        assert "deadline" in ctrl2.recovery_info.get("reason", "")
+        assert ctrl2.recovery_counters["leases_replaced"] == 1
+        _wait(lambda: agent2.task_leases, msg="re-placed lease granted")
+        time.sleep(0.5)
+        assert len(agent2.task_leases) == 1, "no double re-place"
+        assert agent2.verdicts == []  # every report errored at dispatch
+    finally:
+        if agent2 is not None:
+            agent2.close()
+        ray_tpu.shutdown()
+
+
+def test_wal_write_chaos_degrades_to_snapshot_only(tmp_path):
+    """wal_write chaos fails the journal flush: durability degrades LOUDLY
+    to the legacy snapshot flusher (rtpu_wal_errors counted, recovery_stats
+    reports unhealthy) — never a silent hole in the log."""
+    snap = tmp_path / "gcs.snap"
+    ray_tpu.init(
+        num_cpus=2, mode="thread",
+        config={
+            "gcs_snapshot_path": str(snap),
+            "testing_rpc_failure": "wal_write=1.0",
+        },
+    )
+    try:
+        ctrl = _controller()
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.remote(), timeout=30) == 1
+        _wait(
+            lambda: ctrl._wal is not None and not ctrl._wal.healthy,
+            msg="journal degrades",
+        )
+        from ray_tpu.util.state import api as state_api
+
+        stats = state_api.recovery_stats()
+        assert stats["wal"]["enabled"] and not stats["wal"]["healthy"]
+        assert stats["wal"]["errors"] >= 1
+        # the legacy dirty-flag snapshot flusher took over durability
+        _wait(lambda: snap.exists(), timeout=15, msg="fallback snapshot")
+        # the degrade reaches the one-scrape metrics plane
+        text = ctrl.metrics_text()
+        assert "rtpu_wal_errors" in text
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_config_overrides_ride_lease_env_vars(tmp_path):
+    """Satellite (PR 13 noted tail): a driver's init(config=...) override
+    ships on LeaseTask/LeaseActor env_vars, so agent-spawned workers
+    rebuild the SAME resolved config instead of silently resetting to
+    defaults."""
+    assert "RAY_TPU_OBJECT_TRANSFER_WINDOW" not in os.environ
+    ray_tpu.init(
+        num_cpus=1, mode="process",
+        config={"tcp_port": 0, "object_transfer_window": 3},
+    )
+    agent = None
+    try:
+        ctrl = _controller()
+        agent = RecoveryAgent(ctrl, {"CPU": 8, "slot": 8})
+        _wait(lambda: agent.node_id in ctrl.agents, msg="registration")
+        _slot_task.remote(5)
+        _Survivor.remote()
+        _wait(
+            lambda: agent.task_leases and agent.leases,
+            msg="task + creation leases",
+        )
+        assert (
+            agent.task_leases[0].env_vars["RAY_TPU_OBJECT_TRANSFER_WINDOW"]
+            == "3"
+        )
+        assert (
+            agent.leases[0].env_vars["RAY_TPU_OBJECT_TRANSFER_WINDOW"] == "3"
+        )
+        # explicit runtime_env vars still win over shipped overrides
+        _slot_task.options(
+            runtime_env={
+                "env_vars": {"RAY_TPU_OBJECT_TRANSFER_WINDOW": "7"}
+            }
+        ).remote(6)
+        _wait(lambda: len(agent.task_leases) >= 2, msg="override lease")
+        assert (
+            agent.task_leases[-1].env_vars["RAY_TPU_OBJECT_TRANSFER_WINDOW"]
+            == "7"
+        )
+    finally:
+        if agent is not None:
+            agent.close()
+        ray_tpu.shutdown()
+
+
+def test_once_only_ops_surface_head_restarted_error():
+    """The retry envelope's idempotency classes partition the full op
+    catalog, and a once-only op interrupted by a restart surfaces the
+    typed error instead of replaying blind."""
+    # every controller op is classified exactly once
+    assert P.READ_ONLY_OPS <= P.CONTROLLER_OPS
+    assert P.IDEMPOTENT_OPS <= P.CONTROLLER_OPS
+    assert not (P.READ_ONLY_OPS & P.IDEMPOTENT_OPS)
+    assert P.op_idempotency("wait") == "read"
+    assert P.op_idempotency("submit_batch") == "idempotent"
+    assert P.op_idempotency("pg_create") == "once"
+    assert P.op_idempotency("add_ref") == "once"
+
+    from ray_tpu._private.worker_runtime import (
+        ConnEpochBumped,
+        WorkerRuntime,
+    )
+    from ray_tpu.exceptions import HeadRestartedError
+
+    class _Conn:
+        def send(self, msg):
+            pass
+
+        def close(self):
+            pass
+
+    rt = WorkerRuntime(WorkerID.from_random(), _Conn(), in_process=True)
+    rt.client_mode = True  # a reconnectable transport
+
+    def always_bumped():
+        raise ConnEpochBumped("connection to head lost (reconnected)")
+
+    with pytest.raises(HeadRestartedError):
+        rt._head_retry("pg_create", always_bumped)
+
+    # reads replay through the bump and return the reconnected result
+    calls = {"n": 0}
+
+    def flaky_read():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnEpochBumped("connection to head lost (reconnected)")
+        return "value"
+
+    assert rt._head_retry("wait", flaky_read) == "value"
+    assert calls["n"] == 3
+    rt.shutdown()
+
+
+def test_thread_mode_driver_envelope_retries_by_class():
+    """DriverAPI.controller_call honors the same idempotency contract
+    against injected rpc chaos: reads/idempotent writes replay, once-only
+    ops surface HeadRestartedError."""
+    ray_tpu.init(
+        num_cpus=2, mode="thread",
+        config={"testing_rpc_failure": "nodes=0.6,pg_create=1.0"},
+    )
+    try:
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.exceptions import HeadRestartedError
+
+        api = global_worker()
+        # read: retried through the 60% injection until it lands
+        for _ in range(5):
+            assert api.controller_call("nodes") is not None
+        with pytest.raises(HeadRestartedError):
+            api.controller_call("pg_create", ([{"CPU": 1}], "PACK", ""))
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------- slow end-to-end (SIGKILL)
+
+
+def _native_available():
+    from ray_tpu._native import plasma
+
+    return plasma.available()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not _native_available(), reason="e2e recovery uses the native store"
+)
+def test_sigkill_head_under_load_exactly_once(tmp_path):
+    """The acceptance bar: SIGKILL the head mid-load (queued tasks + an
+    actor + sealed objects on 2 agent nodes), restart it, and every
+    pre-crash submission completes exactly once, the actor keeps its
+    identity (same pid), and a driver get() issued pre-crash returns
+    post-recovery."""
+    import json
+    import signal
+    import socket
+    import subprocess
+    import sys
+
+    TOKEN = "recovery-e2e-token"
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    port = free_port()
+    snap = tmp_path / "gcs.snap"
+
+    def start_head():
+        env = dict(os.environ)
+        env.pop("RAY_TPU_ARENA", None)
+        env.pop("RAY_TPU_WORKER", None)
+        env["RAY_TPU_RECOVERY_GRACE_S"] = "15"
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+                "--head", "--port", str(port), "--token", TOKEN,
+                "--num-cpus", "2", "--gcs-snapshot", str(snap),
+            ],
+            env=env,
+        )
+
+    def start_agent(name, resources):
+        env = dict(os.environ)
+        env["RAY_TPU_CLUSTER_TOKEN"] = TOKEN
+        env.pop("RAY_TPU_ARENA", None)
+        env.pop("RAY_TPU_WORKER", None)
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu._private.agent",
+                "--address", f"127.0.0.1:{port}",
+                "--resources", json.dumps(resources),
+                "--base-dir", str(tmp_path / name),
+            ],
+            env=env,
+        )
+
+    def attach(timeout=40):
+        from ray_tpu._private.protocol import token_to_authkey
+
+        authkey = token_to_authkey(TOKEN).hex()
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                return ray_tpu.init(
+                    address=f"tcp://127.0.0.1:{port}?authkey={authkey}"
+                )
+            except Exception as e:  # noqa: BLE001
+                last = e
+                time.sleep(0.5)
+        raise TimeoutError(f"could not attach: {last}")
+
+    head = start_head()
+    agents = []
+    try:
+        attach(timeout=60)
+        agents.append(start_agent("a1", {"CPU": 2, "slice": 1}))
+        agents.append(start_agent("a2", {"CPU": 2, "slice": 1}))
+        from ray_tpu.util.state.api import list_nodes
+
+        _wait(
+            lambda: sum(1 for n in list_nodes() if n["Alive"]) >= 2,
+            timeout=60, msg="agents join",
+        )
+
+        @ray_tpu.remote(resources={"slice": 1})
+        def marked(i):
+            time.sleep(1.5)  # in flight across the crash
+            return ("ran", i, os.getpid())
+
+        @ray_tpu.remote(resources={"slice": 1}, max_restarts=1)
+        class Keeper:
+            def __init__(self):
+                self.pid = os.getpid()
+                self.calls = 0
+
+            def bump(self):
+                self.calls += 1
+                return (self.pid, self.calls)
+
+        keeper = Keeper.options(name="keeper").remote()
+        pid0, _ = ray_tpu.get(keeper.bump.remote(), timeout=120)
+
+        # a sealed object resident on an AGENT arena, pre-crash: the
+        # reconcile inventory must restore its location directory entry
+        @ray_tpu.remote(resources={"slice": 1})
+        def make_big():
+            import numpy as np
+
+            return np.arange(200_000, dtype=np.int64)
+
+        big = make_big.remote()
+        ready, _ = ray_tpu.wait([big], timeout=120)
+        assert ready, "agent-resident object must seal pre-crash"
+        refs = [marked.remote(i) for i in range(4)]
+        time.sleep(0.8)  # leases journaled + in flight on the agents
+
+        # a pre-crash get() blocks across the crash on another thread and
+        # must return post-recovery (client-transparent reconnect)
+        got_box: list = []
+
+        def blocked_get():
+            got_box.append(ray_tpu.get(refs[0], timeout=180))
+
+        getter = threading.Thread(target=blocked_get, daemon=True)
+        getter.start()
+        time.sleep(0.2)
+
+        head.send_signal(signal.SIGKILL)
+        head.wait()
+        head = start_head()
+
+        # every pre-crash submission completes exactly once
+        results = ray_tpu.get(list(refs), timeout=180)
+        assert sorted(r[1] for r in results) == [0, 1, 2, 3]
+        assert all(r[0] == "ran" for r in results)
+        getter.join(timeout=180)
+        assert got_box and got_box[0][1] == 0
+
+        # actor survived WITH IDENTITY: same pid, state intact
+        h = ray_tpu.get_actor("keeper")
+        pid1, calls = ray_tpu.get(h.bump.remote(), timeout=120)
+        assert pid1 == pid0, "actor must keep its process across recovery"
+        assert calls == 2, "actor state (call count) must survive"
+
+        # pre-crash sealed object still readable (agent arena + reconcile
+        # rebuilt the location directory from the agent's inventory)
+        arr = ray_tpu.get(big, timeout=120)
+        assert int(arr[-1]) == 199_999
+
+        # the recovery plane is observable end-to-end: every node
+        # reconciled, the arena inventory restored the object directory
+        from ray_tpu.util.state.api import recovery_stats
+
+        stats = recovery_stats()
+        assert stats["phase"] == "normal"
+        assert set(stats["nodes"].values()) == {"done"}
+        counters = stats["counters"]
+        assert counters.get("objects_restored", 0) >= 1
+        assert counters.get("actors_rebound", 0) >= 1
+        assert stats["last_recovery"].get("time_to_first_dispatch_s", 0) > 0
+    finally:
+        for p in agents:
+            if p.poll() is None:
+                p.terminate()
+        for p in agents:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if head.poll() is None:
+            head.terminate()
+            try:
+                head.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                head.kill()
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()

@@ -1285,6 +1285,40 @@ def test_fixture_observe_ops_clean_has_zero_findings():
     assert findings == [], [f.render() for f in findings]
 
 
+def test_fixture_recovery_ops_leak_flagged():
+    """The PR 15 head-recovery shape done wrong: a typo'd reconcile_repord
+    send (did-you-mean), a 3-tuple reconcile_report payload against the
+    handler's 2-field unpack, and the rotate-and-compact path stranding
+    the WAL segment handle when the snapshot write raises."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_recovery_ops_leak.py")]
+    )
+    wire = _by_check(findings).get("wire-conformance", [])
+    assert len(wire) == 2, [f.render() for f in findings]
+    typo = next(h for h in wire if "reconcile_repord" in h.message)
+    assert 'did you mean "reconcile_report"' in typo.message
+    arity = next(
+        h for h in wire
+        if "reconcile_report" in h.message and "repord" not in h.message
+    )
+    assert "3-tuple" in arity.message and "2 fields" in arity.message
+    assert arity.qualname.endswith("ReconcilingAgent.reconcile_with_seq")
+    life = _by_check(findings).get("ref-lifecycle", [])
+    assert len(life) == 1, [f.render() for f in findings]
+    assert life[0].qualname.endswith("Journal.compact")
+    assert "leaks when" in life[0].message
+
+
+def test_fixture_recovery_ops_clean_has_zero_findings():
+    """Same recovery-plane shapes done right (matching ops and arities,
+    guarded maybe-empty recovery_stats reply, finally-credited WAL segment
+    handle, declared op set in sync): zero findings across every family."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_recovery_ops_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_protocol_doc_is_current_and_covers_controller_ops():
     """docs/PROTOCOL.md matches a fresh render of the extracted catalog and
     names every controller op + the agent data-plane surface."""
@@ -1452,6 +1486,7 @@ def test_cli_exits_nonzero_on_fixtures():
         "fixture_tenant_ops_leak.py",
         "fixture_proxy_ops_leak.py",
         "fixture_observe_ops_leak.py",
+        "fixture_recovery_ops_leak.py",
     ):
         proc = subprocess.run(
             [
