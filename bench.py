@@ -930,6 +930,39 @@ def check_floor(max_regress: float = 0.25) -> int:
         if not out["recovery"]["ok"]:
             failures.append("recovery")
 
+    # --- reconstruction ceiling (ISSUE 20): preemptible-fleet survival
+    # ships with its cost measured. Gates on the RECORDED artifact
+    # (bench.py --reconstruction re-records it whenever the lineage or
+    # drain plane changes): the 1 MiB lineage-reconstruction p50 must stay
+    # under its ceiling, and a preempt notice must fully drain the node
+    # inside the notice window — a drain that outlives its notice means
+    # the reclaim races the evacuation and sole copies die.
+    rec_recon = recorded.get("reconstruction", {})
+    if rec_recon:
+        ceilings = rec_recon.get("ceilings", {})
+        recon_ceiling = ceilings.get("reconstruct_1mib_p50_s", 10.0)
+        drain_ceiling = ceilings.get("notice_drained_p50_s", 20.0)
+        recon_p50 = (
+            rec_recon.get("reconstruct", {})
+            .get("1MiB", {})
+            .get("reconstruct_p50_s")
+        )
+        drain_p50 = rec_recon.get("notice_drain", {}).get("drained_p50_s")
+        out["reconstruction"] = {
+            "recorded_1mib_p50_s": recon_p50,
+            "reconstruct_ceiling_s": recon_ceiling,
+            "recorded_notice_drained_p50_s": drain_p50,
+            "notice_drained_ceiling_s": drain_ceiling,
+            "ok": (
+                recon_p50 is not None
+                and recon_p50 <= recon_ceiling
+                and drain_p50 is not None
+                and drain_p50 <= drain_ceiling
+            ),
+        }
+        if not out["reconstruction"]["ok"]:
+            failures.append("reconstruction")
+
     print(json.dumps({"check_floor": out, "failed": failures}))
     return 1 if failures else 0
 
@@ -1007,6 +1040,23 @@ if __name__ == "__main__":
         from ray_tpu.scripts.recovery_bench import record as recovery_record
 
         recovery_record(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "MICROBENCH.json"
+            )
+        )
+        sys.exit(0)
+    if "--reconstruction" in sys.argv:
+        # preemptible-fleet survival: lineage-reconstruction latency by
+        # object size (sole copy dropped, timed re-execute) and preempt
+        # notice -> fully-drained latency, recorded into
+        # MICROBENCH.json["reconstruction"] (gated by --check-floor)
+        import os
+
+        from ray_tpu.scripts.reconstruction_bench import (
+            record as reconstruction_record,
+        )
+
+        reconstruction_record(
             os.path.join(
                 os.path.dirname(os.path.abspath(__file__)), "MICROBENCH.json"
             )

@@ -864,10 +864,56 @@ class NodeAgent:
                 target=self._send_reconcile_report, args=(msg,),
                 daemon=True, name="agent-reconcile",
             ).start()
+        elif isinstance(msg, P.ReplicateObjects):
+            # preempt evacuation: pull each object into OUR arena off this
+            # loop (the pull's register_replica reply arrives HERE) — the
+            # single-flight pull machinery coalesces with any concurrent
+            # reader, and registration tells the head the copy survives
+            threading.Thread(
+                target=self._replicate_objects, args=(list(msg.objects),),
+                daemon=True, name="agent-replicate",
+            ).start()
         elif isinstance(msg, P.DrainAgent):
             self._on_drain(msg)
         elif isinstance(msg, P.Shutdown):
             self.shutting_down = True
+
+    def _replicate_objects(self, objects):
+        for oid, size in objects:
+            if self.shutting_down:
+                return
+            try:
+                self._pull_into_arena((oid, int(size)))
+            except Exception:  # noqa: BLE001 — per-object best effort: the
+                # head's drain loop falls back to a pull-to-head for
+                # anything that never registers
+                logger.warning(
+                    "replicate pull of %s failed", oid.hex(), exc_info=True
+                )
+
+    def announce_preemption(self, notice_s: float, reason: str = "SIGTERM"):
+        """The platform told THIS process it is being reclaimed (SIGTERM on
+        a spot/maintenance host): tell the head so it starts a preempt
+        drain with ``notice_s`` of runway, and begin quiescing locally
+        without waiting for the head's DrainAgent push (idempotent — the
+        push lands on an already-draining agent and early-returns). Never
+        raises: with the head unreachable the local quiesce still runs, and
+        heartbeat loss covers the rest."""
+        logger.warning(
+            "termination notice (%s): announcing %.0fs preempt drain",
+            reason, notice_s,
+        )
+        try:
+            self.call_controller(
+                "node_preempt_notice",
+                (self.node_id.hex(), float(notice_s), reason),
+                timeout=min(notice_s, 10.0) if notice_s > 0 else 10.0,
+            )
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "could not deliver preempt notice to head", exc_info=True
+            )
+        self._on_drain(P.DrainAgent(float(notice_s), f"preempt-notice:{reason}"))
 
     def _on_drain(self, msg: P.DrainAgent):
         """Quiesce for graceful release (the raylet half of the drain
@@ -2125,6 +2171,20 @@ def main(argv=None):
         data_port=args.data_port,
         node_ip=args.node_ip,
     )
+    # SIGTERM is the preemption channel (spot reclaim / maintenance event /
+    # operator kill): announce a termination notice to the head and drain
+    # within RAY_TPU_PREEMPT_NOTICE_S instead of dying with leased work and
+    # sole-copy objects. Handled off the signal frame — announce_preemption
+    # blocks on a controller round-trip, which a signal handler must not.
+    notice_s = float(os.environ.get("RAY_TPU_PREEMPT_NOTICE_S", "30.0"))
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001
+        threading.Thread(
+            target=agent.announce_preemption, args=(notice_s,),
+            daemon=True, name="agent-preempt",
+        ).start()
+
+    _signal.signal(_signal.SIGTERM, _on_sigterm)
     agent.serve_forever()
 
 

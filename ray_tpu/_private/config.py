@@ -241,6 +241,20 @@ class Config:
     # `max_lineage_bytes`): producer TaskSpecs of retriable tasks, evicted
     # FIFO past this budget. 0 disables reconstruction.
     max_lineage_bytes: int = 64 * 1024**2
+    # Lineage records are also journaled into the WAL (kind "lineage") so
+    # reconstruction survives head restarts; replay applies the same FIFO
+    # byte cap, so the restored table equals the pre-crash one.
+    # Transitive reconstruction cap: a lost object whose producer's own
+    # inputs were lost resubmits THEIR producers recursively; a chain
+    # deeper than this fails with ObjectLostError instead of recursing
+    # unboundedly (counted in rtpu_reconstruction_failures as
+    # reconstruction_depth_capped). 0 disables reconstruction entirely.
+    lineage_reconstruction_max_depth: int = 10
+    # Termination notices (preemptible/spot fleets): default drain window
+    # an agent announces when it receives SIGTERM before the platform
+    # reclaims its host (overridable per-notice via
+    # RAY_TPU_PREEMPT_NOTICE_S on the agent or `ray-tpu drain --notice-s`).
+    preempt_notice_s: float = 30.0
     actor_max_restarts: int = 0
     health_check_period_ms: int = 1000
     health_check_failure_threshold: int = 5

@@ -88,7 +88,7 @@ NODE_SHARD_OPS = frozenset({
     "cluster_resources", "available_resources", "autoscaler_state",
     "list_workers", "pg_create", "pg_ready", "pg_remove", "pg_table",
     "list_placement_groups", "reconcile_report", "set_tenant_quota",
-    "tenant_stats",
+    "tenant_stats", "node_preempt_notice",
 })
 KV_SHARD_OPS = frozenset({"kv_put", "kv_get", "kv_del", "kv_keys"})
 OBSERVE_SHARD_OPS = frozenset({
@@ -113,6 +113,12 @@ class NodeState:
         self.draining = False
         self.drain_reason: Optional[str] = None
         self.drain_deadline = 0.0
+        # Termination notice received (spot/maintenance reclaim announced):
+        # a preempt drain additionally re-replicates sole-copy arena
+        # objects to surviving nodes, and the autoscaler treats the node
+        # as already-dead for replacement purposes (launches a substitute
+        # immediately instead of waiting out heartbeat loss).
+        self.preempting = False
         # Set for REAL remote nodes (agent-backed); None for the head node
         # and fake test nodes (reference: raylet vs. cluster_utils nodes).
         self.agent: Optional["AgentHandle"] = None
@@ -448,6 +454,13 @@ class Controller:
         self.lineage: "OrderedDict[ObjectID, tuple[TaskSpec, int]]" = OrderedDict()
         self.lineage_bytes = 0
         self._recovering: set[TaskID] = set()
+        # Transitive-reconstruction depth per resubmitted producer: a
+        # resubmitted task whose OWN deps were lost kicks their producers
+        # at depth+1; chains past lineage_reconstruction_max_depth stop
+        # with ObjectLostError instead of recursing unboundedly. Entries
+        # clear with _recovering (seal / terminal failure / failed
+        # resubmit).
+        self._recon_depth: dict[TaskID, int] = {}
         # in-flight chunked pushes from arena-less client drivers:
         # object_id -> (buffer, {offset: length})
         self._pending_pushes: dict[ObjectID, tuple[bytearray, dict]] = {}
@@ -1227,6 +1240,19 @@ class Controller:
                         "state snapshot truncated at %d sealed objects", cap
                     )
                     break
+            # lineage producers (the compacted form of journal kind
+            # "lineage"): one spec per producer task, FIRST-insert order —
+            # boot replays these through _record_lineage, whose FIFO byte
+            # cap then evicts exactly what the pre-crash table had evicted
+            # (a spec with N returns re-creates all N entries from one
+            # record)
+            lineage_specs = []
+            lineage_seen: set = set()
+            for spec, _cost in self.lineage.values():
+                tidb = spec.task_id.binary()
+                if tidb not in lineage_seen:
+                    lineage_seen.add(tidb)
+                    lineage_specs.append(spec)
             return {
                 "version": 3,
                 "kv": kv_copy,
@@ -1239,6 +1265,7 @@ class Controller:
                 "actor_leases": actor_leases,
                 "actor_placements": placements,
                 "seals": seals,
+                "lineage": lineage_specs,
             }
 
     def _write_snapshot(self, suffix: str):
@@ -1450,6 +1477,13 @@ class Controller:
                 for oid, kind, payload in snap.get("seals", ())
             ),
             "nodes": set(snap.get("nodes", ())),
+            # producer specs in append order (snapshot base + journal
+            # tail); replay feeds them to _record_lineage SEQUENTIALLY so
+            # byte-cap eviction reproduces the pre-crash table exactly —
+            # dedup would break that (an evicted-then-resubmitted producer
+            # legitimately appears twice, and only the replayed SECOND
+            # record survives the cap)
+            "lineage": list(snap.get("lineage", ())),
         }
         for entry in snap.get("actors", ()):
             spec = entry["spec"]
@@ -1549,6 +1583,8 @@ class Controller:
             ns, key = payload
             with self._kv_lock:
                 self.kv.pop((ns, key), None)
+        elif kind == "lineage":
+            model["lineage"].append(payload)
         else:
             logger.warning("unknown WAL record kind %r (skipped)", kind)
 
@@ -1595,6 +1631,19 @@ class Controller:
             )
             with self.lock:
                 self.placement_groups[entry["pg_id"]] = pg
+        # lineage table BEFORE any seal/pending processing: replaying the
+        # journaled producer specs in append order through _record_lineage
+        # reproduces the pre-crash table (entries AND eviction state — the
+        # same FIFO byte cap applies), so _seal_lost_objects below and any
+        # post-recovery loss can reconstruct instead of failing getters
+        for spec in model.get("lineage", ()):
+            try:
+                self._record_lineage(spec)
+            except Exception:  # noqa: BLE001 — one bad spec, not the boot
+                logger.warning(
+                    "could not restore lineage record", exc_info=True
+                )
+        self.recovery_counters["lineage_restored"] += len(self.lineage)
         # sealed objects: inline/error payloads re-seal from the journal;
         # plasma locations lived in arenas — agent-arena copies park until
         # the owning agent's inventory confirms them, head-arena copies
@@ -1761,7 +1810,22 @@ class Controller:
         crash (head arena, or an agent that never reconciled) and whose
         producer is not pending: seal ObjectLostError so a reconnecting
         driver's get() FAILS instead of hanging forever on an entry that
-        can never re-seal."""
+        can never re-seal. The journaled lineage table gets the FIRST say:
+        reconstruction is attempted for every candidate, and only objects
+        whose producer is neither pending nor recovering after that seal
+        the loss — a restarted head re-executes instead of failing."""
+        if not oid_bins:
+            return
+        with self.lock:
+            for oid_bin in oid_bins:
+                # recovery pin (same contract as restored inline/error
+                # seals): the clients' add_ref traffic died with the
+                # crashed head, so without a pin the reconstructed result
+                # — or the ObjectLostError below — frees eagerly at seal
+                # and a reconnecting getter hangs forever. The driver's
+                # re-sent FreeObjects releases the pin.
+                self.ref_counts[ObjectID(oid_bin)] += 1
+        self._maybe_recover([ObjectID(b) for b in oid_bins])
         for oid_bin in oid_bins:
             oid = ObjectID(oid_bin)
             if self.memory_store.contains(oid):
@@ -2195,6 +2259,7 @@ class Controller:
                 "errors": w.errors,
                 "bytes_written": w.bytes_written,
                 "size_bytes": w.size_bytes(),
+                "kind_counts": dict(w.kind_counts),
             }
             if w is not None
             else {"enabled": False}
@@ -2427,14 +2492,24 @@ class Controller:
     # -------------------------------------------------------------- node drain
 
     def drain_node(
-        self, node_id: NodeID, deadline_s: float = 60.0, reason: str = ""
+        self,
+        node_id: NodeID,
+        deadline_s: float = 60.0,
+        reason: str = "",
+        preempt: bool = False,
     ) -> dict:
         """Begin a graceful drain (reference: the DrainRaylet protocol,
         ``node_manager.cc:1989`` / ``ray drain-node``). Marks the node
         DRAINING (no new leases/placements), quiesces its agent, waits for
         in-flight work within ``deadline_s``, migrates restartable actors
         and resident objects off, then releases the node. Idempotent:
-        re-draining a draining node returns the existing status."""
+        re-draining a draining node returns the existing status.
+
+        ``preempt=True`` is the termination-notice variant (the node WILL
+        die when the deadline lapses, announced or not): sole-copy arena
+        objects re-replicate to surviving nodes before release, and the
+        autoscaler reads ``preempting`` as a dead-launch signal and
+        launches the replacement immediately."""
         with self.lock:
             node = self.nodes.get(node_id)
             if node is None or not node.alive:
@@ -2442,8 +2517,15 @@ class Controller:
             if node_id == self.head_node_id:
                 raise ValueError("cannot drain the head node")
             if node.draining:
-                return self._drain_record_public(self.drains[node_id])
+                rec = self.drains[node_id]
+                if preempt and not node.preempting:
+                    # upgrade in place: a SIGTERM notice landing on an
+                    # operator-started drain adds the evacuation semantics
+                    node.preempting = True
+                    rec["preempt"] = True
+                return self._drain_record_public(rec)
             node.draining = True
+            node.preempting = preempt
             node.drain_reason = reason
             node.drain_deadline = time.time() + deadline_s
             rec = {
@@ -2451,10 +2533,12 @@ class Controller:
                 "state": "draining",
                 "phase": "quiesce",
                 "reason": reason,
+                "preempt": preempt,
                 "started_t": time.time(),
                 "deadline_s": deadline_s,
                 "migrated_actors": 0,
                 "migrated_objects": 0,
+                "replicated_objects": 0,
                 "agent_quiesced": node.agent is None,
                 "agent_remaining": 0,
             }
@@ -2506,6 +2590,15 @@ class Controller:
             # 2) wait for in-flight normal tasks (head-dispatched + leased)
             rec["phase"] = "wait-tasks"
             clean = self._drain_wait_tasks(node, deadline)
+            # 2b) preempt drains: sole-copy residents re-home onto
+            # SURVIVING nodes (replica-directory promotion at removal is
+            # then free); the head pull below stays the fallback for
+            # whatever the window didn't cover
+            if rec.get("preempt"):
+                rec["phase"] = "replicate-objects"
+                rec["replicated_objects"] = self._preempt_replicate_objects(
+                    node, deadline
+                )
             # 3) pull resident objects to the head before the arena dies
             rec["phase"] = "migrate-objects"
             rec["migrated_objects"] = self._migrate_node_objects(node, deadline)
@@ -2654,6 +2747,16 @@ class Controller:
                     for oid, (name, _) in self.plasma_resident.items()
                     if name.startswith(prefix)
                 ]
+            # a copy already replicated to a SURVIVING arena re-homes for
+            # free at removal (replica promotion) — don't also pay a full
+            # pull to the head (the preempt evacuation above feeds this)
+            oids = [
+                oid
+                for oid in oids
+                if not any(
+                    a != arena for a in self._object_replicas.get(oid, ())
+                )
+            ]
         moved = 0
         for oid in oids:
             if time.time() > deadline:
@@ -2685,6 +2788,127 @@ class Controller:
                     self._agent_spills.pop(oid, None)
             moved += 1
         return moved
+
+    def _preempt_replicate_objects(self, node: NodeState, deadline: float) -> int:
+        """Termination-notice evacuation: re-home the dying node's
+        SOLE-COPY resident objects onto surviving schedulable nodes before
+        the arena dies (the replica directory then promotes them at
+        removal — no reader pays lineage re-execution for an ANNOUNCED
+        death). Head-managed target arenas pull synchronously via
+        ``pull_into_arena``; real-agent targets get a ``ReplicateObjects``
+        push and pull through their own single-flight machinery (which
+        registers the replica back via ``register_replica``), with a
+        bounded wait on those registrations. Returns how many of the
+        sole-copy objects gained a surviving replica."""
+        store = self.node_stores.get(node.node_id)
+        if store is None or store is self.plasma:
+            return 0  # shared-store fallback: nothing dies with the node
+        dying = getattr(store, "arena_name", None)
+        is_remote = getattr(store, "is_remote", False)
+        with self.lock:
+            if is_remote:
+                oids = list(self._remote_resident.get(dying, ()))
+            else:
+                prefix = f"@{dying}#"
+                oids = [
+                    oid
+                    for oid, (name, _) in self.plasma_resident.items()
+                    if name.startswith(prefix)
+                ]
+            sole = []
+            for oid in oids:
+                if any(
+                    a != dying for a in self._object_replicas.get(oid, ())
+                ):
+                    continue  # already survives elsewhere: promotion is free
+                entry = self.memory_store.peek(oid)
+                if entry is None or entry[0] != "plasma":
+                    continue  # freed / inlined meanwhile
+                sole.append((oid, int(entry[1][1])))
+            targets = [
+                n
+                for n in self.nodes.values()
+                if n.node_id != node.node_id
+                and n.schedulable
+                and n.node_id != self.head_node_id
+            ]
+        if not sole or not targets:
+            return 0
+        # round-robin the sole copies across the survivors, then batch per
+        # target: agent-backed nodes take ONE ReplicateObjects push each,
+        # head-managed arena nodes pull synchronously from this thread
+        assignments: "dict[NodeID, list]" = {}
+        for i, pair in enumerate(sole):
+            assignments.setdefault(
+                targets[i % len(targets)].node_id, []
+            ).append(pair)
+        pushed: list = []
+        for nid, batch in assignments.items():
+            with self.lock:
+                n = self.nodes.get(nid)
+                agent = n.agent if n is not None and n.alive else None
+                hosted = n is not None and n.alive
+            if not hosted:
+                continue  # the target died mid-evacuation: fallback covers
+            if agent is not None:
+                try:
+                    self._maybe_inject_rpc_failure("replicate_objects")
+                    agent.send(P.ReplicateObjects(list(batch)))
+                    pushed.extend(oid for oid, _ in batch)
+                except (OSError, EOFError, WorkerCrashedError):
+                    continue  # dropped push: _migrate_node_objects covers
+            else:
+                for oid, size in batch:
+                    try:
+                        self.pull_into_arena(nid, oid, size_hint=size)
+                    except Exception:  # noqa: BLE001 — fallback covers
+                        logger.warning(
+                            "preempt replication of %s failed", oid.hex(),
+                            exc_info=True,
+                        )
+        # bounded wait for the pushed agents' register_replica round-trips
+        # (never past the notice deadline — the head pull fallback needs
+        # what's left of the window)
+        while pushed and time.time() < deadline and not self.shutting_down:
+            with self.lock:
+                pushed = [
+                    oid
+                    for oid in pushed
+                    if not any(
+                        a != dying
+                        for a in self._object_replicas.get(oid, ())
+                    )
+                ]
+            if pushed:
+                time.sleep(0.05)
+        with self.lock:
+            replicated = sum(
+                1
+                for oid, _ in sole
+                if any(
+                    a != dying for a in self._object_replicas.get(oid, ())
+                )
+            )
+            self.transfer_stats["preempt_replications"] += replicated
+        return replicated
+
+    def node_preempt_notice(
+        self, node_hex: str, notice_s: float, reason: str = ""
+    ) -> dict:
+        """The ``node_preempt_notice`` op (agent SIGTERM handler, `ray-tpu
+        drain --notice-s`): this node will be reclaimed in ``notice_s``
+        seconds. Starts a preempt drain — stop leasing, migrate actors,
+        re-replicate sole-copy objects — and flags the node ``preempting``
+        so the autoscaler launches a replacement NOW (the notice IS the
+        death signal; waiting out heartbeat loss wastes the window).
+        Idempotent: re-announcing returns the active drain record."""
+        nid = NodeID(bytes.fromhex(node_hex))
+        return self.drain_node(
+            nid,
+            deadline_s=max(float(notice_s), 0.0),
+            reason=reason or "preempt-notice",
+            preempt=True,
+        )
 
     # ------------------------------------------------------------ object plane
 
@@ -3279,7 +3503,9 @@ class Controller:
 
     def _on_object_sealed(self, object_id: ObjectID):
         with self.lock:
-            self._recovering.discard(TaskID(object_id.binary()[: TaskID.SIZE]))
+            producer = TaskID(object_id.binary()[: TaskID.SIZE])
+            self._recovering.discard(producer)
+            self._recon_depth.pop(producer, None)
             waiters = self.waiting_on_deps.pop(object_id, [])
             for pt in waiters:
                 pt.unresolved.discard(object_id)
@@ -3519,8 +3745,12 @@ class Controller:
         if unresolved:
             for d in unresolved:
                 self.waiting_on_deps[d].append(pt)
-            # a dep may be LOST (not merely pending) — kick recovery
-            self._maybe_recover(unresolved)
+            # a dep may be LOST (not merely pending) — kick recovery. A
+            # resubmitted producer's own chain depth carries through, so
+            # transitive reconstruction counts against the depth cap.
+            self._maybe_recover(
+                unresolved, depth=self._recon_depth.get(spec.task_id, 0)
+            )
         else:
             self._enqueue_ready(pt)
 
@@ -3639,13 +3869,24 @@ class Controller:
             while self.lineage_bytes > self.config.max_lineage_bytes and self.lineage:
                 _, (_, old_cost) = self.lineage.popitem(last=False)
                 self.lineage_bytes -= old_cost
+        # journal the producer spec (kind "lineage") so the table survives
+        # a head restart: boot replays these through this same method, so
+        # the byte-cap eviction above reproduces itself deterministically.
+        # Suppressed during replay (the record is already on disk) and
+        # compacted into the snapshot's "lineage" list.
+        self._journal("lineage", spec)
 
-    def _maybe_recover(self, object_ids):
+    def _maybe_recover(self, object_ids, depth: int = 0):
         """Resubmit producers of LOST objects (reference:
         ``object_recovery_manager.h:43``). An object is lost when no entry
         exists AND no pending task will produce it. Recovery is recursive
         through ``submit_task``: a resubmitted producer whose own args were
-        lost kicks their producers in turn (lineage chains)."""
+        lost kicks their producers in turn (lineage chains) — at
+        ``depth+1``, so a chain deeper than
+        ``lineage_reconstruction_max_depth`` stops with ObjectLostError
+        (counted as ``reconstruction_depth_capped``) instead of recursing
+        unboundedly."""
+        max_depth = self.config.lineage_reconstruction_max_depth
         to_resubmit = []
         with self.lock:
             for oid in object_ids:
@@ -3657,19 +3898,46 @@ class Controller:
                 entry = self.lineage.get(oid)
                 if entry is None:
                     continue  # not reconstructable (non-retriable or evicted)
+                if max_depth <= 0 or depth >= max_depth:
+                    self.recovery_counters["reconstruction_failures"] += 1
+                    self.recovery_counters["reconstruction_depth_capped"] += 1
+                    logger.warning(
+                        "lineage reconstruction of %s stopped: chain depth "
+                        "%d reached lineage_reconstruction_max_depth=%d",
+                        oid.hex(), depth, max_depth,
+                    )
+                    continue
                 spec = entry[0]
                 if spec.is_actor_task():
                     actor = self.actors.get(spec.actor_id)
                     if actor is None or actor.state == "DEAD":
+                        self.recovery_counters["reconstruction_failures"] += 1
                         continue  # producer actor gone — unrecoverable
                 self._recovering.add(producer)
+                self._recon_depth[producer] = depth + 1
                 to_resubmit.append(spec)
         for spec in to_resubmit:
             logger.warning(
                 "lineage reconstruction: resubmitting task %s for lost object(s)",
                 spec.name,
             )
-            self.submit_task(spec)
+            try:
+                self.submit_task(spec)
+            except Exception:  # noqa: BLE001
+                # the producer must NOT stay marked as in-flight recovery:
+                # a leaked _recovering entry permanently blocks every
+                # future reconstruction of this object (the waiter skips
+                # "already recovering" forever)
+                with self.lock:
+                    self._recovering.discard(spec.task_id)
+                    self._recon_depth.pop(spec.task_id, None)
+                    self.recovery_counters["reconstruction_failures"] += 1
+                logger.warning(
+                    "lineage resubmit of %s failed", spec.name, exc_info=True
+                )
+            else:
+                with self.lock:
+                    self.recovery_counters["reconstructions"] += 1
 
     def _shape_key(self, spec: TaskSpec) -> tuple:
         """Queue/lease key. The TENANT leads the tuple so lease pipelining
@@ -6126,6 +6394,11 @@ class Controller:
             )
         if op == "drain_status":
             return self.drain_status(payload)
+        if op == "node_preempt_notice":
+            node_hex, notice_s, reason = payload
+            return self.node_preempt_notice(
+                node_hex, float(notice_s), reason or ""
+            )
         if op == "nodes":
             return self.node_infos()
         if op == "cluster_resources":
@@ -6157,6 +6430,7 @@ class Controller:
                         ),
                         "alive": n.alive,
                         "draining": n.draining,
+                        "preempting": n.preempting,
                     }
                     for n in self.nodes.values()
                 ]
@@ -6502,6 +6776,16 @@ class Controller:
                     "write-ahead-journal write failures (each one degrades "
                     "durability to snapshot-only — never a silent hole)",
                 ),
+                "reconstructions": M.Counter(
+                    "rtpu_reconstructions_total",
+                    "lineage reconstructions: producer tasks resubmitted "
+                    "for lost objects",
+                ),
+                "reconstruction_failures": M.Counter(
+                    "rtpu_reconstruction_failures",
+                    "lineage reconstructions that could not run (depth cap "
+                    "hit, dead producer actor, resubmit raised)",
+                ),
                 "recovering": M.Gauge(
                     "rtpu_recovering",
                     "1 while the head is in its bounded RECOVERING phase",
@@ -6561,6 +6845,16 @@ class Controller:
                 float(recovery["wal_errors"]),
             )
         m["recovering"].set(1.0 if recovering else 0.0)
+        # dedicated reconstruction metrics (the per-event recovery counter
+        # carries them too; these are the stable names dashboards key on)
+        self._mirror_counter(
+            m["reconstructions"], ("reconstructions",), {},
+            float(recovery.get("reconstructions", 0)),
+        )
+        self._mirror_counter(
+            m["reconstruction_failures"], ("reconstruction_failures",), {},
+            float(recovery.get("reconstruction_failures", 0)),
+        )
         for table, mkey in (
             (lease, "lease"),
             (transfer, "transfer"),
@@ -7090,6 +7384,12 @@ class Controller:
             self._on_object_sealed(oid)
         with self.lock:
             self.pending_by_id.pop(pt.spec.task_id, None)
+            # a resubmitted producer failing TERMINALLY must leave the
+            # recovery set even when no return-id seal reached
+            # _on_object_sealed (zero-return specs, seal races) — a leaked
+            # entry blocks every future reconstruction of its objects
+            self._recovering.discard(pt.spec.task_id)
+            self._recon_depth.pop(pt.spec.task_id, None)
             self._unpin_task_deps(pt)
             self._journal("done", pt.spec.task_id.binary())
 

@@ -391,6 +391,7 @@ CONTROLLER_OPS = frozenset(
         "log_get",
         "log_list",
         "log_tail_buffer",
+        "node_preempt_notice",
         "nodes",
         "object_locations",
         "pg_create",
@@ -480,7 +481,13 @@ def parse_worker_chaos_table(spec: str) -> dict:
 # "agent_reconcile" covers the recovery ask (``AgentReconcile``): an injected
 # failure drops the push before the wire, exercising the head's single
 # bounded re-ask (see Controller._recovery_monitor).
-AGENT_PUSH_OPS = frozenset({"agent_reconcile", "lease_actor", "lease_batch"})
+# "replicate_objects" covers the preempt-evacuation push
+# (``ReplicateObjects``): an injected failure drops the replicate ask before
+# the wire — the drain loop's pull-to-head fallback (``_migrate_node_objects``)
+# still re-homes the sole-copy objects, exercising the degraded path.
+AGENT_PUSH_OPS = frozenset(
+    {"agent_reconcile", "lease_actor", "lease_batch", "replicate_objects"}
+)
 
 
 # Controller-internal chaos channels that are neither request ops nor agent
@@ -547,6 +554,7 @@ IDEMPOTENT_OPS = frozenset(
         "kill_actor",
         "kv_del",
         "kv_put",
+        "node_preempt_notice",
         "pull_into_arena",
         "push_object_chunk",
         "reconcile_report",
@@ -961,6 +969,19 @@ class DrainAgent:
 
     deadline_s: float
     reason: str = ""
+
+
+@dataclasses.dataclass
+class ReplicateObjects:
+    """Controller → agent: proactively pull these objects into YOUR arena
+    and register as a replica (the preempt-notice evacuation path — a
+    terminating node's sole-copy objects re-home onto surviving nodes
+    BEFORE the arena dies, so readers promote a replica instead of paying
+    lineage re-execution). Each entry is ``(object_id, size)``; the agent
+    pulls via its normal single-flight pull-into-arena machinery, so a
+    concurrent reader's pull coalesces with the evacuation."""
+
+    objects: list  # [(ObjectID, size_bytes)]
 
 
 @dataclasses.dataclass

@@ -77,6 +77,12 @@ class WriteAheadLog:
         self.flushes = 0
         self.errors = 0
         self.bytes_written = 0
+        # per-kind append counts (observability: `ray-tpu recovery` shows
+        # how much of the journal is e.g. lineage vs submit traffic, and
+        # tests pin "lineage records actually reached the journal" on it
+        # without re-reading the file). Plain dict mutated only by append
+        # callers (controller holds its own ordering); readers snapshot.
+        self.kind_counts: dict[str, int] = {}
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # append mode: an existing tail (pre-restart records) is preserved
         # until the owner compacts it away after replay
@@ -97,6 +103,7 @@ class WriteAheadLog:
             return
         self._pending.append((kind, payload))
         self.appends += 1
+        self.kind_counts[kind] = self.kind_counts.get(kind, 0) + 1
         self._dirty.set()
 
     # ------------------------------------------------------------- flushing

@@ -121,6 +121,9 @@ class Autoscaler:
         self._dead_since: dict[str, float] = {}  # launch key -> first dead t
         self._draining: dict[str, float] = {}  # launch key -> drain start t
         self._registered: set = set()  # launch keys that ever had a node
+        # launch keys whose preempt-notice replacement already launched: a
+        # termination notice fires ONE substitute launch, not one per tick
+        self._preempt_replaced: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -226,7 +229,34 @@ class Autoscaler:
                 self._dead_since.pop(key, None)
                 self._draining.pop(key, None)
                 self._registered.discard(key)
+                self._preempt_replaced.discard(key)
                 actions["scaled_down"].append(g.name)
+
+    def _replace_preempted(self, state: dict, actions: dict) -> None:
+        """A launch with a PREEMPTING node (termination notice received) is
+        already dead for capacity purposes: launch its replacement NOW —
+        the notice window is exactly the boot time the substitute needs —
+        instead of waiting out heartbeat loss plus the dead-reap dwell.
+        One replacement per launch; the dying launch leaves ``launched[]``
+        through the normal reap path once its nodes drop. The overlap may
+        briefly hold ``max_groups + 1`` launches of a group: the notice
+        guarantees one of them is on its way out."""
+        for g in self.config.node_groups:
+            for launch in list(self.launched[g.name]):
+                key = ",".join(launch)
+                if key in self._preempt_replaced:
+                    continue
+                infos = self._nodes_for_launch(launch, state)
+                if not any(i.get("preempting") for i in infos):
+                    continue
+                self._preempt_replaced.add(key)
+                if len(self.launched[g.name]) <= g.max_groups:
+                    self._record_launch(g, self.provider.create_node_group(g))
+                    actions["scaled_up"].append(g.name)
+                    logger.warning(
+                        "group %s: preempt notice on launch %s — replacement "
+                        "launched", g.name, key[:12],
+                    )
 
     def update(self) -> dict:
         state = self._call("autoscaler_state")
@@ -234,6 +264,7 @@ class Autoscaler:
         nodes_by_id = {n["node_id"]: n for n in state["nodes"]}
 
         self._reap_failed_launches(state, actions)
+        self._replace_preempted(state, actions)
 
         # ensure minimums
         for g in self.config.node_groups:
@@ -344,6 +375,7 @@ class Autoscaler:
         self._dead_since.pop(key, None)
         self._draining.pop(key, None)
         self._registered.discard(key)
+        self._preempt_replaced.discard(key)
         actions["scaled_down"].append(g.name)
 
     def _satisfiable(self, shape: dict, nodes_by_id: dict) -> bool:

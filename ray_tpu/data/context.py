@@ -24,6 +24,11 @@ class DataContext:
     preserve_order: bool = True
     # resources attached to each block task
     task_resources: Optional[dict] = None
+    # crash-retry budget for block tasks (read/transform). Block tasks on a
+    # preempted/killed node re-run from lineage instead of failing the
+    # pipeline — on a preemptible fleet every stage must survive its host
+    # (reference: ray.data's DEFAULT_TASK_MAX_RETRIES on block tasks)
+    block_max_retries: int = 4
     # logical optimizer rules applied before physical planning, in order
     # (reference: _internal/logical/rules; append custom Rule instances)
     optimizer_rules: tuple = dataclasses.field(

@@ -367,9 +367,21 @@ class ActorPoolStrategy:
 # -- streaming driver --------------------------------------------------------
 
 
+def _block_task_opts() -> dict:
+    """Per-block-task submit options from the current DataContext: the
+    crash-retry budget (lineage re-execution on a preempted host) and the
+    optional ``task_resources`` placement constraint."""
+    ctx = DataContext.get_current()
+    opts: dict = {"max_retries": ctx.block_max_retries}
+    if ctx.task_resources:
+        opts["resources"] = dict(ctx.task_resources)
+    return opts
+
+
 def _read_submits(tasks, transforms, backpressure=8):
     """Submit thunks with `transforms` bound NOW — the executor's loop
     variable gets rebound per stage, and these generators run lazily."""
+    opts = _block_task_opts()
     for t in tasks:
         if getattr(t, "streaming", False):
             # bound the producer's lead so a big file doesn't seal every
@@ -377,14 +389,16 @@ def _read_submits(tasks, transforms, backpressure=8):
             yield lambda t=t: _read_blocks_streaming.options(
                 num_returns="streaming",
                 _generator_backpressure_num_objects=backpressure,
+                **opts,
             ).remote(t, transforms)
         else:
-            yield lambda t=t: _read_block.remote(t, transforms)
+            yield lambda t=t: _read_block.options(**opts).remote(t, transforms)
 
 
 def _transform_submits(refs, transforms):
+    opts = _block_task_opts()
     for r in refs:
-        yield lambda r=r: _transform_block.remote(r, transforms)
+        yield lambda r=r: _transform_block.options(**opts).remote(r, transforms)
 
 
 def _same_compute(a, b) -> bool:

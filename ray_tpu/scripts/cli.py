@@ -90,13 +90,21 @@ def cmd_logs(args):
 
 
 def cmd_drain_node(args):
-    """``ray-tpu drain-node <node-id-prefix>``: gracefully quiesce and
-    release a node (reference: ``ray drain-node`` over
-    ``NodeManager::HandleDrainRaylet``) — the safe way to return a TPU
-    slice without killing its in-flight gang steps."""
+    """``ray-tpu drain-node <node-id-prefix>`` / ``ray-tpu drain <prefix>
+    --notice-s N``: gracefully quiesce and release a node (reference:
+    ``ray drain-node`` over ``NodeManager::HandleDrainRaylet``) — the safe
+    way to return a TPU slice without killing its in-flight gang steps.
+    With ``--notice-s`` the drain is a TERMINATION NOTICE (the node will be
+    reclaimed): sole-copy arena objects re-replicate to surviving nodes
+    and the autoscaler launches a replacement immediately."""
     import time
 
-    from ray_tpu.util.state.api import drain_node, drain_status, list_nodes
+    from ray_tpu.util.state.api import (
+        drain_node,
+        drain_status,
+        list_nodes,
+        preempt_node,
+    )
 
     _ensure_init(args)
     matches = [
@@ -116,14 +124,22 @@ def cmd_drain_node(args):
         )
         sys.exit(1)
     node_id = matches[0]["NodeID"]
+    notice_s = getattr(args, "notice_s", None)
+    deadline_s = notice_s if notice_s is not None else args.deadline
     try:
-        rec = drain_node(node_id, deadline_s=args.deadline, reason=args.reason)
+        if notice_s is not None:
+            rec = preempt_node(node_id, notice_s=notice_s, reason=args.reason)
+        else:
+            rec = drain_node(
+                node_id, deadline_s=args.deadline, reason=args.reason
+            )
     except Exception as e:  # noqa: BLE001 — e.g. "cannot drain the head node"
         print(f"error: {e}", file=sys.stderr)
         sys.exit(1)
-    print(f"draining node {node_id[:12]} (deadline {args.deadline:g}s)")
+    kind = "preempt-draining" if notice_s is not None else "draining"
+    print(f"{kind} node {node_id[:12]} (deadline {deadline_s:g}s)")
     if not args.no_wait:
-        deadline = time.time() + args.deadline + 15
+        deadline = time.time() + deadline_s + 15
         while time.time() < deadline:
             rec = drain_status(node_id) or rec
             if rec.get("state") != "draining":
@@ -157,6 +173,16 @@ def cmd_recovery(args):
             f"size={wal.get('size_bytes', 0)}B  {wal.get('path', '')}"
         )
     print(f"Phase: {rec.get('phase', 'normal')}")
+    all_counters = rec.get("counters") or {}
+    kind_counts = wal.get("kind_counts") or {}
+    print(
+        "Reconstruction: "
+        f"resubmitted={all_counters.get('reconstructions', 0)} "
+        f"failed={all_counters.get('reconstruction_failures', 0)} "
+        f"depth_capped={all_counters.get('reconstruction_depth_capped', 0)} "
+        f"lineage_journaled={kind_counts.get('lineage', 0)} "
+        f"lineage_restored={all_counters.get('lineage_restored', 0)}"
+    )
     nodes = rec.get("nodes") or {}
     if nodes:
         for h, status in sorted(nodes.items()):
@@ -463,6 +489,25 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("node_id", help="node id hex prefix (see `ray-tpu status`)")
     s.add_argument("--deadline", type=float, default=60.0,
                    help="seconds for in-flight work to finish")
+    s.add_argument("--reason", default="manual drain")
+    s.add_argument("--no-wait", action="store_true",
+                   help="initiate and return without polling completion")
+    s.add_argument("--num-cpus", type=int, default=4)
+    s.set_defaults(fn=cmd_drain_node)
+
+    s = sub.add_parser(
+        "drain",
+        help="drain a node; --notice-s delivers a termination notice "
+        "(preempt drain: objects evacuate, autoscaler replaces the node)",
+    )
+    s.add_argument("node_id", help="node id hex prefix (see `ray-tpu status`)")
+    s.add_argument("--notice-s", type=float, default=None, dest="notice_s",
+                   help="termination-notice window in seconds: the node "
+                   "WILL be reclaimed — evacuate and replace instead of "
+                   "just quiescing")
+    s.add_argument("--deadline", type=float, default=60.0,
+                   help="seconds for in-flight work to finish "
+                   "(plain drain; --notice-s supersedes)")
     s.add_argument("--reason", default="manual drain")
     s.add_argument("--no-wait", action="store_true",
                    help="initiate and return without polling completion")

@@ -59,6 +59,19 @@ def drain_status(node_id: Optional[str] = None):
     return _call("drain_status", node_id)
 
 
+def preempt_node(
+    node_id: str, notice_s: float = 30.0, reason: str = ""
+) -> dict:
+    """Deliver a termination notice for a node (the operator-side analog
+    of the agent's SIGTERM announcement, ``ray-tpu drain --notice-s``):
+    the node will be reclaimed in ``notice_s`` seconds. The head starts a
+    preempt drain — no new leases, actors migrate, sole-copy arena objects
+    re-replicate to surviving nodes — and the autoscaler launches a
+    replacement immediately. Returns the drain record; poll
+    :func:`drain_status` for completion."""
+    return _call("node_preempt_notice", (node_id, notice_s, reason))
+
+
 def tenant_stats() -> list[dict]:
     """Per-tenant arbitration state from the controller's scheduling core:
     fair-share weight, priority tier, quota + current usage, queue depth,

@@ -1,0 +1,107 @@
+"""Self-detection fixture: the preempt-notice ops done WRONG.
+
+The ISSUE 20 growth shape — a SIGTERM'd agent announces its own
+reclamation (``node_preempt_notice``) from a signal-handler thread far
+from the controller's dispatch ladder, so a typo'd notice op or a
+payload-arity drift ships clean and the fleet silently loses its
+termination notices (every announcement dies as an unknown-op error while
+the provider's reclaim clock runs out — the node is then reaped as a
+surprise death and sole-copy objects are lost instead of evacuated); and
+the notice-audit path stages a log handle that a raising downstream
+notifier strands. tpulint must flag:
+
+- wire-conformance: the misspelled ``node_preempt_notise`` send
+  (did-you-mean) and the 4-tuple ``node_preempt_notice`` payload against
+  the handler's 3-field unpack (the drain deadline IS the notice window,
+  it does not ride separately);
+- ref-lifecycle: the audit log handle leaked when the downstream notify
+  raises (leak-on-raise in the announce-and-audit path).
+
+Checked in as a FIXTURE on purpose — linted only by tests/test_tpulint.py,
+never imported.
+"""
+
+import threading
+
+
+class Reply:
+    def __init__(self, req_id, payload, error=None):
+        self.req_id = req_id
+        self.payload = payload
+        self.error = error
+
+
+class Head:
+    """Dispatch surface for the preempt-notice ops."""
+
+    def __init__(self):
+        self._drains = {}
+
+    def _dispatch_request(self, op, payload):
+        if op == "node_preempt_notice":
+            node_hex, notice_s, reason = payload
+            rec = {"state": "draining", "preempt": True, "reason": reason,
+                   "deadline_s": float(notice_s)}
+            self._drains[node_hex] = rec
+            return rec
+        if op == "drain_status":
+            return self._drains.get(payload)
+        raise ValueError(f"unknown op: {op}")
+
+    def _handle_request(self, handle, msg):
+        try:
+            reply = Reply(msg.req_id, self._dispatch_request(msg.op, msg.payload))
+        except Exception as e:  # noqa: BLE001
+            reply = Reply(msg.req_id, None, error=f"{type(e).__name__}: {e}")
+        handle.send(reply)
+
+
+class PreemptingAgent:
+    """Agent-side notice sender with the protocol bugs under test."""
+
+    def __init__(self, conn, node_hex):
+        self._conn = conn
+        self._node_hex = node_hex
+        self._reply_ready = threading.Event()
+        self._replies = {}
+        self._req_id = 0
+
+    def call_controller(self, op, payload=None):
+        self._req_id += 1
+        self._conn.send((self._req_id, op, payload))
+        self._reply_ready.wait(timeout=30.0)
+        return self._replies.pop(self._req_id)
+
+    def announce(self, notice_s, reason):
+        # BUG: "node_preempt_notise" — no handler branch matches; every
+        # SIGTERM announcement dies as one unknown-op error reply and the
+        # node is reaped as a surprise death when the provider pulls it
+        return self.call_controller(
+            "node_preempt_notise", (self._node_hex, notice_s, reason)
+        )
+
+    def announce_with_deadline(self, notice_s, reason, deadline):
+        # BUG: 4-tuple payload vs the handler's 3-field unpack (the drain
+        # deadline IS the notice window, it does not ride separately) —
+        # ValueError at dispatch, the notice never lands
+        return self.call_controller(
+            "node_preempt_notice",
+            (self._node_hex, notice_s, reason, deadline),
+        )
+
+
+class NoticeAudit:
+    """Preemption audit trail with the lifecycle bug under test."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def announce_and_audit(self, notice_line, notify_fn):
+        """Leak-on-raise in the announce-and-audit path: the audit log
+        handle is open while notify_fn() can raise — no handler, no
+        finally, the handle (and its fd) strands with the failed
+        announcement."""
+        audit = open(self.path, "ab")  # noqa: SIM115 — fixture shape
+        audit.write(notice_line)
+        notify_fn()
+        audit.close()

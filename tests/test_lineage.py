@@ -117,3 +117,119 @@ def test_non_retriable_objects_are_not_reconstructed(ray_proc):
     assert _lose(ref) is True
     with pytest.raises(Exception):
         ray_tpu.get(ref, timeout=5)
+
+
+def _controller():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller
+
+
+def test_lineage_journal_replay_reproduces_eviction(tmp_path):
+    """WAL-journaled lineage survives a head restart DETERMINISTICALLY:
+    the restored table holds the same entries in the same order with the
+    same byte charge — including the FIFO byte-cap eviction state. The
+    journal records every ``_record_lineage`` call (one per retriable
+    submit, evicted entries included) and replay runs them sequentially
+    through the same eviction loop, so an over-cap history converges to
+    the identical tail."""
+    snap = str(tmp_path / "snap.pkl")
+    cfg = {"gcs_snapshot_path": snap, "max_lineage_bytes": 10 * 1024}
+    ray_tpu.init(num_cpus=2, mode="thread", config=cfg)
+    try:
+        @ray_tpu.remote(max_retries=2)
+        def echo(blob, i):
+            return i  # inline result: nothing is "lost" at restart
+
+        # each spec charges > 4 KiB (the by-value blob arg), so a 10 KiB
+        # cap holds at most 2 of the 6 — eviction must have happened
+        refs = [echo.remote(b"x" * 4096, i) for i in range(6)]
+        assert ray_tpu.get(refs, timeout=60) == list(range(6))
+        ctrl = _controller()
+        with ctrl.lock:
+            before = [oid.binary() for oid in ctrl.lineage]
+            bytes_before = ctrl.lineage_bytes
+        assert 0 < len(before) < 6, "cap never evicted"
+        assert ctrl.recovery_report()["wal"]["kind_counts"]["lineage"] == 6
+    finally:
+        ray_tpu.shutdown()
+
+    ray_tpu.init(num_cpus=2, mode="thread", config=cfg)
+    try:
+        ctrl = _controller()
+        with ctrl.lock:
+            after = [oid.binary() for oid in ctrl.lineage]
+            bytes_after = ctrl.lineage_bytes
+        assert after == before
+        assert bytes_after == bytes_before
+        assert ctrl.recovery_counters["lineage_restored"] == len(before)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_recovering_cleared_when_resubmit_raises():
+    """A lineage resubmit that RAISES must not leak its ``_recovering``
+    entry — a leaked entry makes every later ``_maybe_recover`` of the
+    same object skip as "already in flight", blocking reconstruction
+    forever. The raise path discards the entry and counts a failure; the
+    next attempt goes through."""
+    ray_tpu.init(num_cpus=2, mode="thread")
+    try:
+        @ray_tpu.remote(max_retries=2)
+        def produce():
+            return np.ones((200_000,))
+
+        ref = produce.remote()
+        assert float(ray_tpu.get(ref, timeout=60).sum()) == 200_000.0
+        assert _lose(ref) is True
+        ctrl = _controller()
+        producer = ctrl.lineage[ref.id()][0].task_id
+
+        orig = ctrl.submit_task
+        def _boom(spec):
+            raise RuntimeError("injected resubmit failure")
+        ctrl.submit_task = _boom
+        try:
+            ctrl._maybe_recover([ref.id()])
+        finally:
+            ctrl.submit_task = orig
+        assert producer not in ctrl._recovering
+        assert producer not in ctrl._recon_depth
+        assert ctrl.recovery_counters["reconstruction_failures"] >= 1
+
+        # not poisoned: with submit_task restored the object reconstructs
+        assert float(ray_tpu.get(ref, timeout=60).sum()) == 200_000.0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_reconstruction_depth_cap():
+    """Transitive reconstruction stops at ``lineage_reconstruction_max_depth``:
+    an attempt AT the cap is counted (``reconstruction_depth_capped``) and
+    leaves no ``_recovering`` entry; the same object still reconstructs
+    below the cap."""
+    ray_tpu.init(
+        num_cpus=2, mode="thread",
+        config={"lineage_reconstruction_max_depth": 2},
+    )
+    try:
+        @ray_tpu.remote(max_retries=2)
+        def produce():
+            return np.ones((200_000,))
+
+        ref = produce.remote()
+        assert float(ray_tpu.get(ref, timeout=60).sum()) == 200_000.0
+        assert _lose(ref) is True
+        ctrl = _controller()
+        producer = ctrl.lineage[ref.id()][0].task_id
+
+        ctrl._maybe_recover([ref.id()], depth=2)  # at the cap: refused
+        assert producer not in ctrl._recovering
+        assert ctrl.recovery_counters["reconstruction_depth_capped"] == 1
+        assert ctrl.recovery_counters["reconstructions"] == 0
+
+        ctrl._maybe_recover([ref.id()], depth=1)  # below the cap: runs
+        assert float(ray_tpu.get(ref, timeout=60).sum()) == 200_000.0
+        assert ctrl.recovery_counters["reconstructions"] == 1
+    finally:
+        ray_tpu.shutdown()

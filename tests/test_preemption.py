@@ -1,0 +1,630 @@
+"""Preemptible-fleet survival: termination notices, journaled lineage, and
+node-churn chaos.
+
+Reference: spot/preemptible TPU fleets deliver a termination notice
+(SIGTERM + a metadata deadline) seconds before reclaiming a host. The
+runtime turns that notice into a preempt drain (``node_preempt_notice`` →
+DRAINING: actors migrate, sole-copy arena objects re-replicate to
+survivors, the autoscaler launches the replacement immediately), and
+WAL-journaled lineage lets a restarted head re-execute lost producers
+instead of failing gets with ObjectLostError.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.state.api import drain_status, preempt_node
+
+
+def _controller():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().controller
+
+
+def _native_available():
+    from ray_tpu._native import plasma
+
+    return plasma.available()
+
+
+def _wait_drained(node_hex: str, timeout: float = 60.0) -> dict:
+    deadline = time.time() + timeout
+    rec = None
+    while time.time() < deadline:
+        rec = drain_status(node_hex)
+        if rec is not None and rec["state"] != "draining":
+            return rec
+        time.sleep(0.05)
+    raise AssertionError(f"drain of {node_hex[:12]} never completed: {rec}")
+
+
+# ------------------------------------------------- journaled lineage restart
+
+
+def test_restart_reconstruction_via_journaled_lineage(tmp_path):
+    """The tentpole contract: a retriable producer's lineage record is
+    journaled into the WAL, so after a full head restart a get() on its
+    (now lost) plasma return RECONSTRUCTS the value instead of raising
+    ObjectLostError — and the counters prove the path (lineage restored
+    at boot, one resubmission)."""
+    snap = str(tmp_path / "snap.pkl")
+    cfg = {"gcs_snapshot_path": snap}
+    ray_tpu.init(num_cpus=2, mode="thread", config=cfg)
+
+    @ray_tpu.remote(max_retries=3)
+    def produce(n):
+        return np.ones(n, dtype=np.uint8)
+
+    ref = produce.remote(300_000)
+    assert ray_tpu.get(ref, timeout=60).nbytes == 300_000
+    ray_tpu.shutdown()
+
+    ray_tpu.init(num_cpus=2, mode="thread", config=cfg)
+    try:
+        out = ray_tpu.get(ref, timeout=60)
+        assert out.nbytes == 300_000 and int(out.sum()) == 300_000
+        ctrl = _controller()
+        assert ctrl.recovery_counters["lineage_restored"] >= 1
+        assert ctrl.recovery_counters["reconstructions"] >= 1
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_non_retriable_lost_object_seals_lost_error_at_restart(tmp_path):
+    """The other half of the recovery-close contract: a lost plasma object
+    with NO lineage (max_retries=0) seals ObjectLostError at boot — the
+    reconnecting getter fails fast instead of hanging."""
+    snap = str(tmp_path / "snap.pkl")
+    cfg = {"gcs_snapshot_path": snap}
+    ray_tpu.init(num_cpus=2, mode="thread", config=cfg)
+
+    @ray_tpu.remote(max_retries=0)
+    def once():
+        return np.zeros(300_000, dtype=np.uint8)
+
+    ref = once.remote()
+    assert ray_tpu.get(ref, timeout=60).nbytes == 300_000
+    ray_tpu.shutdown()
+
+    ray_tpu.init(num_cpus=2, mode="thread", config=cfg)
+    try:
+        with pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=10)
+        assert _controller().recovery_counters["objects_lost"] >= 1
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------ preempt-notice drain
+
+
+@pytest.fixture
+def preempt_cluster():
+    cluster = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 2, "mode": "thread"},
+    )
+    yield cluster
+    ray_tpu.shutdown()
+
+
+def test_preempt_notice_drains_migrates_and_keeps_objects(preempt_cluster):
+    """A termination notice on a node running an actor, in-flight tasks,
+    and the sole copy of a non-retriable object: every task finishes, the
+    actor migrates without charging its restart budget, and the object
+    survives the node (replicated/migrated BYTES, not re-executed —
+    max_retries=0 means reconstruction was never an option)."""
+    node_a = preempt_cluster.add_node(num_cpus=2, resources={"pool": 2})
+
+    @ray_tpu.remote(resources={"pool": 1}, max_retries=0)
+    def big():
+        return np.arange(300_000, dtype=np.int64)
+
+    @ray_tpu.remote(resources={"pool": 0.2})
+    def slow(i):
+        time.sleep(0.3)
+        return i
+
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return 1
+
+    ref = big.remote()
+    np.testing.assert_array_equal(
+        ray_tpu.get(ref, timeout=30), np.arange(300_000, dtype=np.int64)
+    )
+    actor = Holder.options(resources={"pool": 0.5}, max_restarts=2).remote()
+    assert ray_tpu.get(actor.ping.remote(), timeout=30) == 1
+    refs = [slow.remote(i) for i in range(4)]
+    time.sleep(0.1)
+
+    # the evacuation target must exist before the notice lands
+    preempt_cluster.add_node(num_cpus=2, resources={"pool": 2})
+
+    rec = preempt_node(node_a.hex(), notice_s=30.0, reason="spot reclaim")
+    assert rec["preempt"] is True
+    assert rec["state"] in ("draining", "drained")
+
+    assert ray_tpu.get(refs, timeout=60) == list(range(4))  # zero failures
+    rec = _wait_drained(node_a.hex())
+    assert rec["state"] == "drained", rec
+    assert rec["preempt"] is True
+    assert rec["migrated_actors"] >= 1
+
+    # the sole copy survived the node: bytes moved, nothing re-executed
+    out = ray_tpu.get(ref, timeout=30)  # must not raise ObjectLostError
+    np.testing.assert_array_equal(out, np.arange(300_000, dtype=np.int64))
+    ctrl = _controller()
+    assert ctrl.recovery_counters.get("reconstructions", 0) == 0
+    # the actor still serves from its new home
+    assert ray_tpu.get(actor.ping.remote(), timeout=60) == 1
+    infos = {n["NodeID"]: n for n in ray_tpu.nodes()}
+    assert not infos[node_a.hex()]["Alive"]
+
+
+def test_preempt_notice_upgrades_running_drain(preempt_cluster):
+    """A notice landing on an operator-started drain upgrades it IN PLACE
+    (idempotent): same record, ``preempt`` flips on, no second drain."""
+    node_a = preempt_cluster.add_node(num_cpus=2, resources={"pool": 2})
+
+    @ray_tpu.remote(resources={"pool": 0.5})
+    def hold(s):
+        time.sleep(s)
+        return 1
+
+    refs = [hold.remote(1.0) for _ in range(2)]
+    time.sleep(0.1)
+    from ray_tpu.util.state.api import drain_node
+
+    rec1 = drain_node(node_a.hex(), deadline_s=30.0, reason="operator")
+    assert rec1["preempt"] is False
+    rec2 = preempt_node(node_a.hex(), notice_s=30.0, reason="notice")
+    assert rec2["preempt"] is True
+    assert rec2["reason"] == "operator"  # same record, upgraded
+    assert ray_tpu.get(refs, timeout=60) == [1, 1]
+    rec = _wait_drained(node_a.hex())
+    assert rec["state"] == "drained" and rec["preempt"] is True
+
+
+def test_autoscaler_launches_replacement_on_preempt_notice():
+    """The autoscaler treats a PREEMPTING node as a dead launch: the
+    replacement launches on the next reconcile tick — inside the notice
+    window — rather than after heartbeat loss + the dead-reap dwell. One
+    replacement per notice (no stacking across ticks)."""
+    from ray_tpu.autoscaler.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        FakeNodeProvider,
+        NodeGroup,
+    )
+
+    ray_tpu.init(num_cpus=2, mode="thread")
+    try:
+        group = NodeGroup(
+            name="g",
+            resources_per_node={"CPU": 1, "elastic": 1},
+            min_groups=0,
+            max_groups=1,
+        )
+        scaler = Autoscaler(
+            AutoscalerConfig(node_groups=[group], idle_timeout_s=3600.0),
+            provider=FakeNodeProvider(),
+        )
+
+        @ray_tpu.remote(resources={"elastic": 0.5})
+        def work(s):
+            time.sleep(s)
+            return 1
+
+        first = [work.remote(0.0) for _ in range(2)]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not scaler.launched["g"]:
+            scaler.update()
+            time.sleep(0.1)
+        assert scaler.launched["g"], "autoscaler never scaled up"
+        assert ray_tpu.get(first, timeout=30) == [1, 1]
+        launch = scaler.launched["g"][0]
+
+        # keep the doomed node busy so the drain outlives the next ticks
+        holders = [work.remote(3.0) for _ in range(2)]
+        time.sleep(0.2)
+        preempt_node(launch[0], notice_s=30.0, reason="spot reclaim")
+        actions = scaler.update()
+        assert "g" in actions["scaled_up"], "no replacement inside the notice"
+        assert len(scaler.launched["g"]) == 2  # brief max_groups+1 overlap
+        # idempotent across ticks: one notice, one replacement
+        actions = scaler.update()
+        assert "g" not in actions["scaled_up"]
+        assert len(scaler.launched["g"]) == 2
+        assert ray_tpu.get(holders, timeout=60) == [1, 1]
+    finally:
+        ray_tpu.shutdown()
+
+
+# -------------------------------------------------- real-agent preempt paths
+
+
+def _start_agent(ctrl, base_dir, resources, env_extra=None):
+    env = dict(os.environ)
+    env["RAY_TPU_AUTHKEY"] = ctrl._authkey.hex()
+    env.pop("RAY_TPU_ARENA", None)
+    env.pop("RAY_TPU_WORKER", None)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu._private.agent",
+            "--address", ctrl.tcp_address,
+            "--resources", json.dumps(resources),
+            "--base-dir", str(base_dir),
+            "--object-store-memory", str(128 * 1024**2),
+        ],
+        env=env,
+    )
+
+
+def _wait_agents(ctrl, n, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(ctrl.agents) >= n:
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"only {len(ctrl.agents)}/{n} agents registered")
+
+
+def _stop(proc):
+    if proc is not None and proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not _native_available(), reason="node agents require the native store"
+)
+def test_agent_sigterm_announces_preemption(tmp_path):
+    """SIGTERM to a real agent process (the provider's reclaim signal)
+    turns into a preempt drain on the head: in-flight leased tasks finish
+    (zero failures) and the drain record carries the SIGTERM provenance."""
+    ray_tpu.init(num_cpus=2, mode="process", config={"tcp_port": 0})
+    proc = None
+    try:
+        ctrl = _controller()
+        proc = _start_agent(
+            ctrl, tmp_path / "agent", {"CPU": 2, "spot_pool": 2},
+            env_extra={"RAY_TPU_PREEMPT_NOTICE_S": "30.0"},
+        )
+        _wait_agents(ctrl, 1)
+        node_id = next(iter(ctrl.agents))
+
+        @ray_tpu.remote(
+            resources={"spot_pool": 0.5}, num_cpus=0.5, max_retries=0
+        )
+        def produce(i):
+            import time as _time
+
+            _time.sleep(1.5)
+            return i * 10
+
+        refs = [produce.remote(i) for i in range(4)]
+        node = ctrl.nodes[node_id]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(node.leased) < len(refs):
+            time.sleep(0.05)
+        assert len(node.leased) == len(refs), "tasks never leased to agent"
+
+        proc.send_signal(signal.SIGTERM)
+
+        deadline = time.monotonic() + 30
+        rec = None
+        while time.monotonic() < deadline:
+            rec = drain_status(node_id.hex())
+            if rec is not None:
+                break
+            time.sleep(0.1)
+        assert rec is not None, "SIGTERM never became a preempt drain"
+        assert rec["preempt"] is True
+        assert "SIGTERM" in rec["reason"]
+
+        # zero failures: the leased work finishes inside the notice window
+        assert ray_tpu.get(refs, timeout=120) == [0, 10, 20, 30]
+        rec = _wait_drained(node_id.hex(), timeout=90)
+        assert rec["state"] == "drained", rec
+        infos = {n["NodeID"]: n for n in ray_tpu.nodes()}
+        assert not infos[node_id.hex()]["Alive"]
+    finally:
+        _stop(proc)
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not _native_available(), reason="node agents require the native store"
+)
+def test_sigkill_sole_holder_reconstructs_not_promotes(tmp_path):
+    """SIGKILL (no notice at all) on the agent holding the SOLE copy of a
+    retriable result: a later get() returns via lineage re-execution on a
+    replacement agent. The counters prove the path — ``reconstructions``
+    moved, replica promotion did not (there was no replica to promote)."""
+    ray_tpu.init(num_cpus=1, mode="process", config={"tcp_port": 0})
+    procs = []
+    try:
+        ctrl = _controller()
+        base_promoted = ctrl.transfer_stats.get("replicas_promoted", 0)
+        procs.append(
+            _start_agent(ctrl, tmp_path / "agent-a", {"CPU": 2, "spot": 2})
+        )
+        _wait_agents(ctrl, 1)
+
+        @ray_tpu.remote(resources={"spot": 1}, num_cpus=0, max_retries=3)
+        def produce():
+            return np.full(300_000, 7, dtype=np.int64)
+
+        ref = produce.remote()
+        np.testing.assert_array_equal(
+            ray_tpu.get(ref, timeout=60), np.full(300_000, 7, dtype=np.int64)
+        )
+
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and ctrl.agents:
+            time.sleep(0.1)
+        assert not ctrl.agents, "dead agent never deregistered"
+
+        # replacement capacity arrives (the autoscaler path in miniature)
+        procs.append(
+            _start_agent(ctrl, tmp_path / "agent-b", {"CPU": 2, "spot": 2})
+        )
+        _wait_agents(ctrl, 1)
+
+        out = ray_tpu.get(ref, timeout=120)  # re-executed, not copied
+        np.testing.assert_array_equal(out, np.full(300_000, 7, dtype=np.int64))
+        assert ctrl.recovery_counters["reconstructions"] >= 1
+        assert ctrl.transfer_stats.get("replicas_promoted", 0) == base_promoted
+    finally:
+        for p in procs:
+            _stop(p)
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not _native_available(), reason="node agents require the native store"
+)
+def test_chaos_node_churn_data_pipeline(tmp_path):
+    """The chaos harness: 3 real agents, one SIGKILLed (and replaced)
+    every few seconds while a multi-stage Data pipeline runs with its
+    block tasks PINNED to the churning nodes. The pipeline completes with
+    the right answer, ZERO terminally-failed tasks, and at least one
+    lineage reconstruction — leased tasks on dead nodes retry, completed
+    blocks lost with a node re-execute from journaled lineage."""
+    import ray_tpu.data  # noqa: F401 -- not pulled in by `import ray_tpu`
+    from ray_tpu.data.block import BlockAccessor
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    saved = (ctx.block_max_retries, ctx.task_resources)
+    ray_tpu.init(num_cpus=2, mode="process", config={"tcp_port": 0})
+    procs: dict[str, subprocess.Popen] = {}
+    spawned = [0]
+
+    def _spawn(ctrl):
+        # a unique marker resource per agent lets the deterministic tail
+        # map a controller node record back to the OS process to SIGKILL
+        tag = f"churntag{spawned[0]}"
+        spawned[0] += 1
+        procs[tag] = _start_agent(
+            ctrl, tmp_path / f"agent-{tag}", {"CPU": 4, "churn": 4, tag: 1}
+        )
+
+    try:
+        ctrl = _controller()
+        for _ in range(3):
+            _spawn(ctrl)
+        _wait_agents(ctrl, 3)
+
+        import threading
+
+        # pin every block task onto the churning agents (the head has no
+        # "churn" resource) and give the budget headroom for hot kills
+        ctx.task_resources = {"churn": 1}
+        ctx.block_max_retries = 16
+
+        stop = threading.Event()
+
+        def _churn():
+            rng = 0
+            delay = 1.2  # first kill lands while the pipeline is young
+            # BOUNDED kills: with unbounded churn the fleet never settles
+            # — completed blocks are lost as fast as lineage re-executes
+            # them and the final get starves
+            for _ in range(4):
+                if stop.wait(delay):
+                    return
+                delay = 3.2
+                live = [p for p in procs.values() if p.poll() is None]
+                if len(live) <= 1:
+                    continue  # never take the last agent
+                victim = live[rng % len(live)]
+                rng += 1
+                victim.send_signal(signal.SIGKILL)
+                _spawn(ctrl)
+
+        churner = threading.Thread(target=_churn, daemon=True)
+        churner.start()
+        try:
+            def slow_double(batch):
+                time.sleep(0.4)
+                return {"id": batch["id"] * 2}
+
+            def plus_pad(batch):
+                time.sleep(0.4)
+                # plasma-sized blocks live on the producing agent's arena
+                # — inline results would seal on the head and nothing
+                # would ever be lost to a kill
+                pad = np.ones((len(batch["id"]), 15_000))
+                return {"id": batch["id"] + 1, "pad": pad}
+
+            ds = (
+                ray_tpu.data.range(400, parallelism=25)
+                .map_batches(slow_double, batch_format="dict")
+                .map_batches(plus_pad, batch_format="dict")
+            )
+            refs = ds.materialize().get_internal_block_refs()
+            # materialize() hands out refs while tasks are still in
+            # flight; this get — still under churn — waits out every
+            # block (retries included) and proves first-pass liveness
+            ray_tpu.get(refs, timeout=180)
+        finally:
+            stop.set()
+            churner.join(timeout=10)
+
+        # deterministic tail: SIGKILL a live agent whose arena holds at
+        # least one completed result block, so the final gets MUST cross
+        # the reconstruction path (churn-phase losses are timing-lucky)
+        wanted = {r.id() for r in refs}
+        victim_tag = None
+        with ctrl.lock:
+            for nid, store in ctrl.node_stores.items():
+                arena = getattr(store, "arena_name", None)
+                if not arena or not (
+                    ctrl._remote_resident.get(arena, set()) & wanted
+                ):
+                    continue
+                node = ctrl.nodes.get(nid)
+                tag = next(
+                    (k for k in node.total if k.startswith("churntag")), None
+                )
+                if tag and procs[tag].poll() is None:
+                    victim_tag = tag
+                    break
+        assert victim_tag is not None, "no live agent holds a result block"
+        victim = procs[victim_tag]
+        victim.send_signal(signal.SIGKILL)
+        victim.wait()
+
+        got = []
+        for ref in refs:
+            block = ray_tpu.get(ref, timeout=120)
+            got.extend(r["id"] for r in BlockAccessor.for_block(block).iter_rows())
+        assert sorted(got) == [2 * i + 1 for i in range(400)]
+        assert ctrl.recovery_counters["reconstructions"] >= 1
+        # zero terminally-failed tasks: churn cost retries, never results
+        failed = [
+            e for e in ctrl.task_events if e["event"] == "FAILED"
+        ]
+        assert failed == [], failed[:5]
+    finally:
+        ctx.block_max_retries, ctx.task_resources = saved
+        for p in procs.values():
+            _stop(p)
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------- head SIGKILL, real client
+
+
+HEAD_TOKEN = "preempt-restart-token"
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_head(port, snapshot_path):
+    env = dict(os.environ)
+    env.pop("RAY_TPU_ARENA", None)
+    env.pop("RAY_TPU_WORKER", None)
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu.scripts.cli", "start", "--head",
+            "--port", str(port), "--token", HEAD_TOKEN, "--num-cpus", "4",
+            "--gcs-snapshot", str(snapshot_path),
+        ],
+        env=env,
+    )
+
+
+def _attach(port, timeout=30):
+    from ray_tpu._private.protocol import token_to_authkey
+
+    authkey = token_to_authkey(HEAD_TOKEN).hex()
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return ray_tpu.init(
+                address=f"tcp://127.0.0.1:{port}?authkey={authkey}"
+            )
+        except Exception as e:  # noqa: BLE001
+            last = e
+            time.sleep(0.5)
+    raise TimeoutError(f"could not attach to head: {last}")
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not _native_available(), reason="subprocess head requires the native store"
+)
+def test_journaled_lineage_survives_head_sigkill(tmp_path):
+    """Lineage across a REAL head SIGKILL: a subprocess head journals a
+    retriable producer's lineage, dies without warning, restarts from the
+    snapshot+WAL, and the reconnecting client's get() on the lost plasma
+    return is served by re-execution."""
+    port = _free_port()
+    snap = tmp_path / "head.pkl"
+    head = _start_head(port, snap)
+    try:
+        _attach(port)
+
+        @ray_tpu.remote(max_retries=3)
+        def produce():
+            return np.full(400_000, 5, dtype=np.int64)
+
+        ref = produce.remote()
+        np.testing.assert_array_equal(
+            ray_tpu.get(ref, timeout=60), np.full(400_000, 5, dtype=np.int64)
+        )
+        time.sleep(0.3)  # > wal flush interval: the lineage is durable
+        ray_tpu.shutdown()
+        head.send_signal(signal.SIGKILL)
+        head.wait()
+
+        head = _start_head(port, snap)
+        _attach(port)
+        out = ray_tpu.get(ref, timeout=120)
+        np.testing.assert_array_equal(out, np.full(400_000, 5, dtype=np.int64))
+        from ray_tpu.util.state.api import recovery_stats
+
+        counters = recovery_stats().get("counters", {})
+        assert counters.get("lineage_restored", 0) >= 1
+        assert counters.get("reconstructions", 0) >= 1
+    finally:
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        if head.poll() is None:
+            head.terminate()
+            try:
+                head.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                head.kill()

@@ -1319,6 +1319,41 @@ def test_fixture_recovery_ops_clean_has_zero_findings():
     assert findings == [], [f.render() for f in findings]
 
 
+def test_fixture_preempt_ops_leak_flagged():
+    """The ISSUE 20 preempt-notice shape done wrong: a typo'd
+    node_preempt_notise send (did-you-mean), a 4-tuple node_preempt_notice
+    payload against the handler's 3-field unpack, and the
+    announce-and-audit path stranding the audit log handle when the
+    downstream notifier raises."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_preempt_ops_leak.py")]
+    )
+    wire = _by_check(findings).get("wire-conformance", [])
+    assert len(wire) == 2, [f.render() for f in findings]
+    typo = next(h for h in wire if "node_preempt_notise" in h.message)
+    assert 'did you mean "node_preempt_notice"' in typo.message
+    arity = next(
+        h for h in wire
+        if "node_preempt_notice" in h.message and "notise" not in h.message
+    )
+    assert "4-tuple" in arity.message and "3 fields" in arity.message
+    assert arity.qualname.endswith("PreemptingAgent.announce_with_deadline")
+    life = _by_check(findings).get("ref-lifecycle", [])
+    assert len(life) == 1, [f.render() for f in findings]
+    assert life[0].qualname.endswith("NoticeAudit.announce_and_audit")
+    assert "leaks when" in life[0].message
+
+
+def test_fixture_preempt_ops_clean_has_zero_findings():
+    """Same preempt-notice shapes done right (matching op and arity,
+    guarded maybe-missing drain_status reply, finally-credited audit
+    handle, declared op set in sync): zero findings across every family."""
+    findings = lint_paths(
+        [os.path.join(FIXTURES, "fixture_preempt_ops_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_protocol_doc_is_current_and_covers_controller_ops():
     """docs/PROTOCOL.md matches a fresh render of the extracted catalog and
     names every controller op + the agent data-plane surface."""
@@ -1487,6 +1522,7 @@ def test_cli_exits_nonzero_on_fixtures():
         "fixture_proxy_ops_leak.py",
         "fixture_observe_ops_leak.py",
         "fixture_recovery_ops_leak.py",
+        "fixture_preempt_ops_leak.py",
     ):
         proc = subprocess.run(
             [
